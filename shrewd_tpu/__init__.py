@@ -1,0 +1,31 @@
+"""shrewd_tpu — a TPU-native statistical fault-injection (SFI) framework.
+
+A ground-up re-design of the capabilities of the reference simulator (a gem5
+v25.0 fork carrying the SHREWD shadow-FU redundant-execution work and a SPEC
+CPU2017 campaign driver) for TPU hardware.  Instead of an event-driven C++
+simulator (reference: ``src/sim/eventq.hh:254``, ``src/sim/simulate.cc:191``),
+the core computation is a *pure, batched* trial kernel::
+
+    trial(snapshot, fault) -> outcome in {MASKED, SDC, DUE, DETECTED}
+
+vmapped over tens of thousands of (structure, bit, cycle) fault samples,
+sharded across a ``jax.sharding.Mesh`` of chips with ``shard_map``, with
+AVF/SDC tallies reduced via ``psum``.
+
+Package layout
+--------------
+- ``shrewd_tpu.utils``    — typed params/config system, units, PRNG, debug
+- ``shrewd_tpu.stats``    — statistics framework (gem5 ``base/statistics.hh`` analog)
+- ``shrewd_tpu.isa``      — the µop dataflow ISA used for trace replay
+- ``shrewd_tpu.trace``    — trace schema, synthetic workloads, native engine
+- ``shrewd_tpu.models``   — fault-target machine models (O3, Minor, Ruby, FUs)
+- ``shrewd_tpu.ops``      — inject / replay / classify kernels (JAX + Pallas)
+- ``shrewd_tpu.parallel`` — mesh, sharded campaign step, CI stopping
+- ``shrewd_tpu.sim``      — Simulator / orchestrator / checkpointing
+- ``shrewd_tpu.ingest``   — gem5 artifact parsers (m5.cpt, config.ini, stats.txt)
+- ``shrewd_tpu.native``   — ctypes bindings to the C++ runtime (csrc/)
+"""
+
+from shrewd_tpu._version import __version__
+
+__all__ = ["__version__"]

@@ -1,0 +1,3 @@
+from shrewd_tpu.utils import config, debug, prng, probes, units
+
+__all__ = ["config", "debug", "prng", "probes", "units"]

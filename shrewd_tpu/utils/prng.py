@@ -1,0 +1,69 @@
+"""Counter-based PRNG discipline.
+
+Replaces the reference's single global mt19937_64 stream
+(``src/base/random.hh:60,125``) with JAX's counter-based threefry keys, derived
+deterministically from campaign coordinates::
+
+    key = trial_key(seed, simpoint, structure, batch, trial)
+
+Every trial's randomness is a pure function of *what* it is, not *when* it
+runs — so results are bit-reproducible under any batching, sharding, or
+re-execution order.  This is the property the serial reference gets for free
+from determinism and that a batched TPU campaign must engineer.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def campaign_key(seed: int) -> jax.Array:
+    """Root key for a campaign."""
+    return jax.random.key(seed)
+
+
+def simpoint_key(root: jax.Array, simpoint_id: int) -> jax.Array:
+    return jax.random.fold_in(root, simpoint_id)
+
+
+def structure_key(sp_key: jax.Array, structure_id: int) -> jax.Array:
+    return jax.random.fold_in(sp_key, structure_id)
+
+
+def batch_key(st_key: jax.Array, batch_id: int) -> jax.Array:
+    return jax.random.fold_in(st_key, batch_id)
+
+
+def trial_keys(bk: jax.Array, n_trials: int) -> jax.Array:
+    """Per-trial keys, shape ``(n_trials,)``.
+
+    Derived by ``fold_in(batch_key, trial_id)`` — NOT ``split`` — so that
+    ``trial_keys(bk, n)[t]`` is bitwise-identical to the fully-addressed
+    ``trial_key(..., trial_id=t)``: a single trial observed in a batch can be
+    replayed standalone and reproduce the same fault sample.
+    """
+    return jax.vmap(lambda i: jax.random.fold_in(bk, i))(jnp.arange(n_trials))
+
+
+def trial_key(seed: int, simpoint_id: int, structure_id: int,
+              batch_id: int, trial_id: int) -> jax.Array:
+    """Fully-addressed single-trial key (the reproducibility contract)."""
+    k = campaign_key(seed)
+    for coord in (simpoint_id, structure_id, batch_id, trial_id):
+        k = jax.random.fold_in(k, coord)
+    return k
+
+
+def sample_fault(key: jax.Array, n_entries: int, bits_per_entry: int,
+                 n_cycles: int) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Draw one uniform (entry, bit, cycle) fault sample.
+
+    The (fault-bit, fault-cycle) sample space of the north star: uniform over
+    the structure's bit population × the measured cycle window.
+    """
+    ke, kb, kc = jax.random.split(key, 3)
+    entry = jax.random.randint(ke, (), 0, n_entries, dtype=jnp.int32)
+    bit = jax.random.randint(kb, (), 0, bits_per_entry, dtype=jnp.int32)
+    cycle = jax.random.randint(kc, (), 0, n_cycles, dtype=jnp.int32)
+    return entry, bit, cycle

@@ -1,0 +1,16 @@
+"""Test harness: force an 8-device virtual CPU platform.
+
+The multi-chip analog of the reference's dist-gem5-on-localhost / NULL-build
+testing posture (SURVEY §4): all sharding tests run on
+``--xla_force_host_platform_device_count=8`` without TPU hardware.  Must run
+before the first jax import anywhere in the test process.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("JAX_ENABLE_X64", "0")

@@ -1,0 +1,181 @@
+import pytest
+
+from shrewd_tpu.utils import config, debug, prng, probes, units
+from shrewd_tpu.utils.config import (Child, ConfigObject, Frequency,
+                                     MemorySize, Param, Time, VectorParam)
+
+
+# --- units ---
+
+def test_to_bytes():
+    assert units.to_bytes("64KiB") == 64 * 1024
+    assert units.to_bytes("2GB") == 2 << 30
+    assert units.to_bytes("512") == 512
+    assert units.to_bytes(4096) == 4096
+    assert units.to_bytes("1.5KiB") == 1536
+    with pytest.raises(units.UnitError):
+        units.to_bytes("xyz")
+
+
+def test_to_frequency_and_time():
+    assert units.to_frequency("3GHz") == 3e9
+    assert units.to_frequency("200MHz") == 2e8
+    assert units.to_seconds("10ns") == pytest.approx(1e-8)
+    assert units.to_seconds("1.5us") == pytest.approx(1.5e-6)
+
+
+def test_format():
+    assert units.format_bytes(64 * 1024) == "64KiB"
+    assert units.format_bytes(1000) == "1000B"
+
+
+# --- config ---
+
+class CacheCfg(ConfigObject):
+    size = Param(MemorySize, "32KiB", "capacity")
+    assoc = Param(int, 8, "ways")
+
+
+class CoreCfg(ConfigObject):
+    clock = Param(Frequency, "1GHz")
+    rob_entries = Param(int, 192, check=lambda v: v > 0)
+    widths = VectorParam(int, [8, 8, 8])
+    l1 = Child(CacheCfg)
+
+
+def test_config_defaults_and_overrides(tmp_path):
+    cfg = CoreCfg(clock="2GHz", l1=CacheCfg(size="64KiB"))
+    assert cfg.clock == 2e9
+    assert cfg.rob_entries == 192
+    assert cfg.l1.size == 64 * 1024
+    assert cfg.widths == [8, 8, 8]
+
+    cfg.rob_entries = "256"          # string conversion via descriptor
+    assert cfg.rob_entries == 256
+    with pytest.raises(ValueError):
+        cfg.rob_entries = -1          # check() enforcement
+    with pytest.raises(TypeError):
+        CoreCfg(clock="2GHz", nonsense=1)
+
+
+def test_config_roundtrip(tmp_path):
+    cfg = CoreCfg(clock="2GHz")
+    d = cfg.to_dict()
+    cfg2 = CoreCfg.from_dict(d)
+    assert cfg2.clock == cfg.clock
+    assert cfg2.l1.size == cfg.l1.size
+
+    ini = tmp_path / "config.ini"
+    js = tmp_path / "config.json"
+    cfg.dump_ini(ini)
+    cfg.dump_json(js)
+    text = ini.read_text()
+    assert "[root]" in text and "[root.l1]" in text
+    assert "rob_entries=192" in text
+
+
+def test_config_polymorphic_child_roundtrip():
+    class FancyCache(CacheCfg):
+        banks = Param(int, 4)
+
+    cfg = CoreCfg(clock="1GHz", l1=FancyCache(banks=8))
+    d = cfg.to_dict()
+    cfg2 = CoreCfg.from_dict(d)
+    assert type(cfg2.l1) is FancyCache
+    assert cfg2.l1.banks == 8
+
+
+def test_format_count_boundaries():
+    assert units.format_count(999999) == "1M"
+    assert units.format_count(12500000) == "12.5M"
+    assert units.format_count(999) == "999"
+    assert units.format_count(0) == "0"
+    assert units.format_count(1234) == "1.23k"
+
+
+def test_to_bytes_float():
+    assert units.to_bytes(4096.0) == 4096
+    with pytest.raises(units.UnitError):
+        units.to_bytes(4096.5)
+
+
+def test_required_param():
+    class NeedsIt(ConfigObject):
+        x = Param(int)
+    with pytest.raises(ValueError):
+        NeedsIt()
+    assert NeedsIt(x=3).x == 3
+
+
+# --- prng ---
+
+def test_trial_key_deterministic():
+    import jax
+    k1 = prng.trial_key(0, 1, 2, 3, 4)
+    k2 = prng.trial_key(0, 1, 2, 3, 4)
+    k3 = prng.trial_key(0, 1, 2, 3, 5)
+    assert (jax.random.key_data(k1) == jax.random.key_data(k2)).all()
+    assert not (jax.random.key_data(k1) == jax.random.key_data(k3)).all()
+
+
+def test_sample_fault_bounds():
+    import jax
+    keys = prng.trial_keys(prng.campaign_key(0), 128)
+    entries, bits, cycles = jax.vmap(
+        lambda k: prng.sample_fault(k, 64, 32, 1000))(keys)
+    assert int(entries.min()) >= 0 and int(entries.max()) < 64
+    assert int(bits.min()) >= 0 and int(bits.max()) < 32
+    assert int(cycles.min()) >= 0 and int(cycles.max()) < 1000
+
+
+# --- debug ---
+
+def test_debug_flags(capsys):
+    debug.register_flag("TestFlag", "test")
+    assert not debug.enabled("TestFlag")
+    debug.enable("TestFlag")
+    debug.dprintf("TestFlag", "hello %d", 42)
+    debug.disable("TestFlag")
+    err = capsys.readouterr().err
+    assert "hello 42" in err and "TestFlag" in err
+    with pytest.raises(KeyError):
+        debug.enable("NoSuchFlag")
+
+
+def test_debug_compound():
+    debug.enable("All")
+    assert debug.enabled("Campaign") and debug.enabled("Replay")
+    assert debug.enabled("All")          # compound name itself is enabled
+    debug.disable("All")
+    assert not debug.enabled("Campaign") and not debug.enabled("All")
+
+
+def test_debug_enable_atomic():
+    # an unknown name anywhere in the list must enable nothing
+    with pytest.raises(KeyError):
+        debug.enable("Campaign", "Bogus")
+    assert not debug.enabled("Campaign")
+
+
+def test_trial_keys_match_trial_key():
+    # batch-derived and fully-addressed keys must be bitwise identical
+    import jax
+    bk = prng.batch_key(prng.structure_key(
+        prng.simpoint_key(prng.campaign_key(9), 1), 2), 3)
+    ks = prng.trial_keys(bk, 8)
+    k5 = prng.trial_key(9, 1, 2, 3, 5)
+    assert (jax.random.key_data(ks[5]) == jax.random.key_data(k5)).all()
+
+
+# --- probes ---
+
+def test_probes():
+    pm = probes.ProbeManager("o3")
+    pp = pm.add_point("retired_batch")
+    seen = []
+    pm.listen("retired_batch", seen.append)
+    pp.notify([1, 2, 3])
+    assert seen == [[1, 2, 3]]
+    assert pm.points() == ["retired_batch"]
+    with pytest.raises(KeyError):
+        pm.add_point("retired_batch")
