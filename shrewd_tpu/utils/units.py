@@ -89,7 +89,7 @@ def format_count(n: float) -> str:
     # values promote cleanly (999999 → '1M', never '1e+03k').
     exp = math.floor(math.log10(abs(n)))
     r = round(n, -(exp - 2))
-    for suffix, mult in (("G", 1e9), ("M", 1e6), ("k", 1e3)):
+    for suffix, mult in (("T", 1e12), ("G", 1e9), ("M", 1e6), ("k", 1e3)):
         if abs(r) >= mult:
-            return f"{r / mult:.3g}{suffix}"
+            return f"{r / mult:.4g}{suffix}"
     return f"{r:.4g}"

@@ -1,0 +1,128 @@
+import io
+import json
+
+import numpy as np
+import pytest
+
+from shrewd_tpu import stats
+from shrewd_tpu.stats import (Distribution, Formula, Group, Histogram, Scalar,
+                              Vector)
+
+
+def make_group():
+    g = Group("campaign")
+    g.trials = Scalar("trials", "trials run")
+    g.outcomes = Vector("outcomes", 4, "per-class outcome tallies",
+                        subnames=["masked", "sdc", "due", "detected"])
+    g.avf = Formula("avf", lambda: (g.outcomes[1] + g.outcomes[2]) /
+                    max(g.trials.value, 1), "architectural vulnerability factor")
+    o3 = Group("o3")
+    o3.lat = Distribution("inject_cycle", 0, 100, 10, "fault cycle distribution")
+    g.o3 = o3
+    return g
+
+
+def test_scalar_vector():
+    g = make_group()
+    g.trials += 1000
+    g.outcomes += np.array([900, 50, 40, 10])
+    assert g.trials.value == 1000
+    assert g.outcomes[0] == 900
+    assert g.outcomes[1] == 50
+    assert g.outcomes.total() == 1000
+    assert g.avf.to_value() == pytest.approx(0.09)
+    with pytest.raises(ValueError):
+        g.outcomes += np.zeros(3)
+
+
+def test_distribution_moments():
+    d = Distribution("d", 0, 10, 10)
+    vals = np.array([1.0, 2.0, 3.0, 15.0, -1.0])
+    d.sample(vals)
+    assert d.samples == 5
+    assert d.overflow == 1 and d.underflow == 1
+    assert d.mean() == pytest.approx(vals.mean())
+    assert d.stdev() == pytest.approx(vals.std(ddof=1))
+    assert d.counts[1] == 1 and d.counts[2] == 1 and d.counts[3] == 1
+
+
+def test_histogram_autorange():
+    h = Histogram("h", 8)
+    h.sample(np.arange(8))
+    assert h.hi == 8
+    h.sample([100.0])
+    assert h.hi >= 101 or h.overflow == 0
+    assert h.samples == 9
+    # all original samples still counted after merging
+    assert h.counts.sum() == 9
+
+
+def test_distribution_edge_bucket():
+    # value just below hi must not index out of bounds
+    d = Distribution("d", 0, 3.3, 3)
+    d.sample([np.nextafter(3.3, 0)])
+    assert d.counts[2] == 1 and d.overflow == 0
+
+
+def test_histogram_nonfinite_rejected():
+    h = Histogram("h", 8)
+    with pytest.raises(ValueError):
+        h.sample([float("inf")])
+
+
+def test_histogram_reset_restores_range():
+    h = Histogram("h", 8)
+    h.sample([1e6])
+    assert h.hi > 1e6
+    h.reset()
+    assert h.hi == 8 and h.bucket_size == 1.0
+
+
+def test_group_rebind_drops_old():
+    g = Group("g")
+    g.x = Scalar("old")
+    g.x = Scalar("new")
+    names = [n for n, _, _ in g.rows()]
+    assert names == ["g.new"]
+
+
+def test_format_count_tera():
+    from shrewd_tpu.utils import units
+    assert units.format_count(1e12) == "1T"
+    assert units.format_count(2.5e13) == "25T"
+
+
+def test_reset():
+    g = make_group()
+    g.trials += 5
+    g.o3.lat.sample([1.0])
+    g.reset()
+    assert g.trials.value == 0
+    assert g.o3.lat.samples == 0
+
+
+def test_text_dump_format():
+    g = make_group()
+    g.trials += 10
+    g.outcomes += np.array([9, 1, 0, 0])
+    buf = io.StringIO()
+    text = stats.dump_text(g, buf)
+    assert buf.getvalue() == text
+    assert "Begin Simulation Statistics" in text
+    assert "campaign.trials" in text
+    assert "campaign.outcomes::masked" in text
+    assert "campaign.outcomes::total" in text
+    assert "campaign.avf" in text
+    assert "campaign.o3.inject_cycle::samples" in text
+    # value column parses back
+    line = [l for l in text.splitlines() if l.startswith("campaign.trials")][0]
+    assert int(line.split()[1]) == 10
+
+
+def test_json_dump():
+    g = make_group()
+    g.trials += 4
+    d = json.loads(stats.dump_json(g))
+    assert d["trials"] == 4
+    assert d["outcomes"]["total"] == 0
+    assert "inject_cycle" in d["o3"]
