@@ -1,0 +1,3 @@
+from shrewd_tpu.isa import semantics, uops
+
+__all__ = ["semantics", "uops"]

@@ -1,0 +1,122 @@
+"""The µop dataflow ISA.
+
+The replay substrate of the framework: a compact RISC-style micro-op set rich
+enough to follow a flipped bit through register/memory dataflow to
+architectural outputs.  It plays the role gem5's per-ISA ``StaticInst``
+hierarchy plays for execution semantics (reference ``src/cpu/static_inst.hh:88``
+and the ISA-DSL-generated ``execute()`` bodies), deliberately reduced to the
+dataflow algebra SFI classification needs (SURVEY §7 "Hard parts" #4: trace
+replay reduces classification to dataflow over recorded operands).
+
+Design constraints (TPU-first):
+- fixed-width 32-bit data path, ``uint32`` values everywhere (packed SoA
+  arrays, VPU-friendly; 64-bit extension = paired words);
+- a closed opcode set evaluated by *branchless select* inside ``lax.scan`` —
+  no data-dependent Python control flow;
+- every µop's timing proxy is its trace index (1-IPC issue model).
+
+OpClasses mirror the reference's FU capability classes
+(``src/cpu/FuncUnitConfig.py``, ``src/cpu/o3/fu_pool.cc:177-294``) at the
+granularity the shadow-FU model needs.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+# --- opcodes ---------------------------------------------------------------
+
+NOP = 0
+ADD = 1      # rd = rs1 + rs2
+SUB = 2      # rd = rs1 - rs2
+AND = 3
+OR = 4
+XOR = 5
+SLL = 6      # rd = rs1 << (rs2 & 31)
+SRL = 7      # logical right shift
+SRA = 8      # arithmetic right shift
+ADDI = 9     # rd = rs1 + imm
+ANDI = 10
+ORI = 11
+XORI = 12
+LUI = 13     # rd = imm
+MUL = 14     # rd = low32(rs1 * rs2)
+SLT = 15     # rd = (signed) rs1 < rs2
+SLTU = 16    # rd = (unsigned) rs1 < rs2
+LOAD = 17    # rd = mem[rs1 + imm]
+STORE = 18   # mem[rs1 + imm] = rs2
+BEQ = 19     # branch if rs1 == rs2
+BNE = 20
+BLT = 21     # signed
+BGE = 22     # signed
+
+N_OPCODES = 23
+
+OPCODE_NAMES = [
+    "nop", "add", "sub", "and", "or", "xor", "sll", "srl", "sra",
+    "addi", "andi", "ori", "xori", "lui", "mul", "slt", "sltu",
+    "load", "store", "beq", "bne", "blt", "bge",
+]
+
+# --- op classes (shadow-FU capability granularity) -------------------------
+
+OC_INT_ALU = 0    # add/sub/logic/shift/compare/branch-compare
+OC_INT_MULT = 1   # MUL
+OC_MEM_READ = 2   # LOAD (address-generation + access)
+OC_MEM_WRITE = 3  # STORE
+OC_NONE = 4       # NOP
+
+N_OPCLASSES = 5
+OPCLASS_NAMES = ["IntAlu", "IntMult", "MemRead", "MemWrite", "No_OpClass"]
+
+_OPCLASS_TABLE = np.array([
+    OC_NONE,                                      # NOP
+    OC_INT_ALU, OC_INT_ALU, OC_INT_ALU, OC_INT_ALU, OC_INT_ALU,   # ADD..XOR
+    OC_INT_ALU, OC_INT_ALU, OC_INT_ALU,           # shifts
+    OC_INT_ALU, OC_INT_ALU, OC_INT_ALU, OC_INT_ALU, OC_INT_ALU,   # imm ops
+    OC_INT_MULT,                                  # MUL
+    OC_INT_ALU, OC_INT_ALU,                       # SLT/SLTU
+    OC_MEM_READ, OC_MEM_WRITE,                    # LOAD/STORE
+    OC_INT_ALU, OC_INT_ALU, OC_INT_ALU, OC_INT_ALU,  # branches
+], dtype=np.int32)
+
+
+def opclass_of(opcodes: np.ndarray) -> np.ndarray:
+    """Vectorized opcode → OpClass map."""
+    return _OPCLASS_TABLE[np.asarray(opcodes)]
+
+
+# --- structural predicates (host-side; device code precomputes these) ------
+
+def writes_dest(op: np.ndarray) -> np.ndarray:
+    op = np.asarray(op)
+    return ((op >= ADD) & (op <= SLTU)) | (op == LOAD)
+
+
+def is_load(op):
+    return np.asarray(op) == LOAD
+
+
+def is_store(op):
+    return np.asarray(op) == STORE
+
+
+def is_branch(op):
+    op = np.asarray(op)
+    return (op >= BEQ) & (op <= BGE)
+
+
+def is_mem(op):
+    op = np.asarray(op)
+    return (op == LOAD) | (op == STORE)
+
+
+def uses_src1(op):
+    op = np.asarray(op)
+    return (op != NOP) & (op != LUI)
+
+
+def uses_src2(op):
+    op = np.asarray(op)
+    return (((op >= ADD) & (op <= SRA)) | (op == MUL) | (op == SLT)
+            | (op == SLTU) | (op == STORE) | is_branch(op))

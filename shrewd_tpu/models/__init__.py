@@ -1,0 +1,4 @@
+from shrewd_tpu.models import o3
+from shrewd_tpu.models.o3 import Fault, FaultSampler, O3Config, null_fault
+
+__all__ = ["Fault", "FaultSampler", "O3Config", "null_fault", "o3"]

@@ -1,0 +1,173 @@
+"""O3 fault-target model: structures, fault descriptors, samplers.
+
+Maps the reference's O3 microarchitectural state (the north-star injection
+targets: ``PhysRegFile`` banks ``src/cpu/o3/regfile.hh:65-99``, ``ROB``
+``rob.hh:71``, ``IQ`` ``inst_queue.hh``, ``LSQ`` ``lsq.hh:76``) onto the
+trace-replay fault model:
+
+- ``REGFILE``  — storage fault: flip bit *b* of register *entry* at cycle *c*;
+  masking arises naturally from overwrite-before-read (the AVF derating the
+  serial campaign measures by running gem5 forward).
+- ``FU``       — computation fault: flip bit *b* of µop *entry*'s result at
+  execute (the fault class SHREWD's shadow FUs detect,
+  ``src/cpu/o3/inst_queue.cc:897-903``).
+- ``ROB_DST``  — metadata fault in the ROB entry's destination register index
+  (commit writes the wrong register; the right one goes stale).
+- ``IQ_SRC1/2``— metadata fault in a waiting µop's source register index
+  (issue reads the wrong register).
+- ``LSQ_ADDR`` — store/load-queue address field fault (wrong location,
+  or a trap when the flipped address leaves the valid region → DUE).
+- ``LSQ_DATA`` — store-queue data field fault.
+
+The µop's trace index doubles as its timestamp (1-IPC issue proxy); ROB/IQ/LSQ
+entry faults are addressed by the affected µop index, sampled among µops
+*in flight* at the drawn fault cycle (entry ∈ [cycle, cycle + rob_size)),
+which is the occupancy model of SURVEY §2.12 P3.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from shrewd_tpu.isa import uops as U
+from shrewd_tpu.trace.format import Trace
+from shrewd_tpu.utils.config import ConfigObject, Param, VectorParam
+
+# --- fault kinds -----------------------------------------------------------
+
+KIND_NONE = 0
+KIND_REGFILE = 1
+KIND_FU = 2
+KIND_ROB_DST = 3
+KIND_IQ_SRC1 = 4
+KIND_IQ_SRC2 = 5
+KIND_LSQ_ADDR = 6
+KIND_LSQ_DATA = 7
+
+KIND_NAMES = ["none", "regfile", "fu", "rob_dst", "iq_src1", "iq_src2",
+              "lsq_addr", "lsq_data"]
+
+# structure name → kinds drawn for it
+STRUCTURES = {
+    "regfile": (KIND_REGFILE,),
+    "fu": (KIND_FU,),
+    "rob": (KIND_ROB_DST,),
+    "iq": (KIND_IQ_SRC1, KIND_IQ_SRC2),
+    "lsq": (KIND_LSQ_ADDR, KIND_LSQ_DATA),
+}
+
+
+class Fault(NamedTuple):
+    """One trial's fault coordinates (all scalars; batches are vmapped)."""
+
+    kind: jax.Array      # int32, KIND_*
+    cycle: jax.Array     # int32, step at which a storage flip lands
+    entry: jax.Array     # int32, register index (REGFILE) or µop index (others)
+    bit: jax.Array       # int32
+    shadow_u: jax.Array  # float32, uniform draw for shadow-FU detection
+
+    def bit_as_index_mask(self) -> jax.Array:
+        """The flip mask for register-*index* fields (ROB/IQ metadata)."""
+        return jnp.int32(1) << self.bit
+
+
+def null_fault() -> Fault:
+    """The fault-free trial (golden replay)."""
+    z = jnp.int32(0)
+    return Fault(kind=z, cycle=z, entry=z, bit=z, shadow_u=jnp.float32(1.0))
+
+
+class O3Config(ConfigObject):
+    """Machine-model knobs (the SimObject-param analog for the O3 target)."""
+
+    rob_size = Param(int, 192, "in-flight window for entry-fault sampling "
+                     "(reference ROB default, BaseO3CPU.py numROBEntries)")
+    compare_regs = Param(bool, True,
+                         "classify end-of-window register diffs as SDC "
+                         "(conservative); False compares memory only")
+    # Shadow-FU coverage per OpClass: probability an FU-class fault is caught
+    # by redundant execution (availability-derated, the quantity the
+    # reference tracks per OpClass in inst_queue.hh:581-606).
+    shadow_coverage = VectorParam(float, [0.0] * U.N_OPCLASSES,
+                                  "per-OpClass shadow detection probability")
+    priority_to_shadow = Param(bool, False,
+                               "reference priorityToShadow param "
+                               "(BaseO3CPU.py:227); affects availability "
+                               "model, not kernel semantics")
+
+
+class FaultSampler:
+    """Draws fault batches for one (trace, structure) pair.
+
+    Device-side and vmappable: ``sample(keys)`` maps per-trial PRNG keys to a
+    ``Fault`` batch.  Pre-computes the µop index tables (mem-op positions for
+    LSQ faults) from the trace on the host.
+    """
+
+    def __init__(self, trace: Trace, structure: str, cfg: O3Config):
+        if structure not in STRUCTURES:
+            raise KeyError(f"unknown structure {structure!r} "
+                           f"(known: {sorted(STRUCTURES)})")
+        self.structure = structure
+        self.cfg = cfg
+        self.n = trace.n
+        self.nphys = trace.nphys
+        self.idx_bits = int(np.log2(trace.nphys))
+        self.rob_size = min(cfg.rob_size, self.n)
+
+        mem_idx = np.nonzero(U.is_mem(trace.opcode))[0].astype(np.int32)
+        store_idx = np.nonzero(U.is_store(trace.opcode))[0].astype(np.int32)
+        # degenerate traces: point at µop 0 (fault lands on a non-mem µop and
+        # is architecturally masked, which is the correct physical reading of
+        # "the LSQ is empty")
+        self.mem_idx = jnp.asarray(mem_idx if mem_idx.size else np.zeros(1, np.int32))
+        self.store_idx = jnp.asarray(store_idx if store_idx.size else np.zeros(1, np.int32))
+
+    def sample(self, key: jax.Array) -> Fault:
+        kc, ke, kb, kk, ks = jax.random.split(key, 5)
+        cycle = jax.random.randint(kc, (), 0, self.n, dtype=jnp.int32)
+        shadow_u = jax.random.uniform(ks, (), dtype=jnp.float32)
+
+        if self.structure == "regfile":
+            entry = jax.random.randint(ke, (), 0, self.nphys, dtype=jnp.int32)
+            bit = jax.random.randint(kb, (), 0, 32, dtype=jnp.int32)
+            kind = jnp.int32(KIND_REGFILE)
+        elif self.structure == "fu":
+            entry = cycle                       # fault at execute of µop `cycle`
+            bit = jax.random.randint(kb, (), 0, 32, dtype=jnp.int32)
+            kind = jnp.int32(KIND_FU)
+        elif self.structure == "rob":
+            entry = self._inflight(ke, cycle)
+            bit = jax.random.randint(kb, (), 0, self.idx_bits, dtype=jnp.int32)
+            kind = jnp.int32(KIND_ROB_DST)
+        elif self.structure == "iq":
+            entry = self._inflight(ke, cycle)
+            bit = jax.random.randint(kb, (), 0, self.idx_bits, dtype=jnp.int32)
+            kind = jnp.where(jax.random.bernoulli(kk),
+                             jnp.int32(KIND_IQ_SRC1), jnp.int32(KIND_IQ_SRC2))
+        else:  # lsq
+            # uniform over mem µops still in flight ≈ uniform over mem µops
+            which = jax.random.bernoulli(kk)    # addr vs data field
+            i_mem = jax.random.randint(ke, (), 0, self.mem_idx.shape[0],
+                                       dtype=jnp.int32)
+            i_st = jax.random.randint(ke, (), 0, self.store_idx.shape[0],
+                                      dtype=jnp.int32)
+            entry = jnp.where(which, self.mem_idx[i_mem], self.store_idx[i_st])
+            kind = jnp.where(which, jnp.int32(KIND_LSQ_ADDR),
+                             jnp.int32(KIND_LSQ_DATA))
+            bit = jax.random.randint(kb, (), 0, 32, dtype=jnp.int32)
+        return Fault(kind=kind, cycle=cycle, entry=entry, bit=bit,
+                     shadow_u=shadow_u)
+
+    def _inflight(self, key: jax.Array, cycle: jax.Array) -> jax.Array:
+        """A µop resident in the ROB/IQ at `cycle`: index in
+        [cycle, cycle+rob_size), clamped to the window."""
+        off = jax.random.randint(key, (), 0, self.rob_size, dtype=jnp.int32)
+        return jnp.minimum(cycle + off, jnp.int32(self.n - 1))
+
+    def sample_batch(self, keys: jax.Array) -> Fault:
+        return jax.vmap(self.sample)(keys)

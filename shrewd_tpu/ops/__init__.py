@@ -1,0 +1,6 @@
+from shrewd_tpu.ops import classify, replay, trial
+from shrewd_tpu.ops.replay import TraceArrays, replay as replay_fn
+from shrewd_tpu.ops.trial import TrialKernel
+
+__all__ = ["TraceArrays", "TrialKernel", "classify", "replay", "replay_fn",
+           "trial"]

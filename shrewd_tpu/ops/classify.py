@@ -1,0 +1,58 @@
+"""Outcome classification.
+
+Maps a trial's final state against the golden (fault-free) replay to the
+standard SFI outcome taxonomy.  Precedence mirrors microarchitectural
+reality: detection happens at execute (before any corrupt commit), a trap
+ends the program (DUE), control divergence or any architectural state
+difference without detection is silent data corruption.
+
+The reference computes the same classes from full-timing gem5 runs; here they
+fall out of the replayed dataflow (BASELINE north star: inject → propagate →
+classify per trial).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from shrewd_tpu.ops.replay import ReplayResult
+
+OUTCOME_MASKED = 0
+OUTCOME_SDC = 1
+OUTCOME_DUE = 2
+OUTCOME_DETECTED = 3
+N_OUTCOMES = 4
+OUTCOME_NAMES = ["masked", "sdc", "due", "detected"]
+
+
+def classify(result: ReplayResult, golden: ReplayResult,
+             compare_regs: bool = True) -> jax.Array:
+    """One trial's outcome class (int32 scalar; vmap for batches)."""
+    mem_diff = jnp.any(result.mem != golden.mem)
+    state_diff = mem_diff
+    if compare_regs:
+        state_diff = state_diff | jnp.any(result.reg != golden.reg)
+    corrupt = result.diverged | state_diff
+    return jnp.where(
+        result.detected, jnp.int32(OUTCOME_DETECTED),
+        jnp.where(result.trapped, jnp.int32(OUTCOME_DUE),
+                  jnp.where(corrupt, jnp.int32(OUTCOME_SDC),
+                            jnp.int32(OUTCOME_MASKED))))
+
+
+def tally(outcomes: jax.Array) -> jax.Array:
+    """Outcome-class counts, shape (N_OUTCOMES,) — the psum-reducible tally."""
+    return jnp.sum(
+        jax.nn.one_hot(outcomes, N_OUTCOMES, dtype=jnp.int32), axis=0)
+
+
+def avf(tallies: jax.Array) -> jax.Array:
+    """Architectural vulnerability factor: P(visible error | fault) =
+    (SDC + DUE) / trials.  Detected faults are *covered*, not vulnerable."""
+    total = tallies.sum()
+    return (tallies[OUTCOME_SDC] + tallies[OUTCOME_DUE]) / jnp.maximum(total, 1)
+
+
+def sdc_rate(tallies: jax.Array) -> jax.Array:
+    return tallies[OUTCOME_SDC] / jnp.maximum(tallies.sum(), 1)

@@ -1,0 +1,186 @@
+"""The batched trial kernel: inject → propagate (→ classify elsewhere).
+
+This is the framework's replacement for gem5's event loop (SURVEY §7 design
+stance): one pure function advances a trial's machine state over the µop
+window with ``lax.scan`` — the fixed intra-step phase order below is the
+analog of the reference's event-priority ladder (``sim/eventq.hh:138-222``):
+
+  1. storage-fault landing (REGFILE flip at its cycle)
+  2. operand read (with IQ source-index faults applied)
+  3. execute (branchless ALU; FU result faults; shadow-FU detection)
+  4. memory access (LSQ addr/data faults; trap check → DUE)
+  5. branch resolution (divergence check)
+  6. writeback/commit (with ROB dest-index faults applied)
+
+Divergence/trap/detection freeze the trial (predication, not control flow —
+no data-dependent Python branching inside jit).
+
+Written for a single trial; batching is ``jax.vmap`` with the trace arrays
+held broadcast (`in_axes=None`) so one copy serves the whole batch.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from shrewd_tpu.isa import uops as U
+from shrewd_tpu.models.o3 import (Fault, KIND_FU, KIND_IQ_SRC1, KIND_IQ_SRC2,
+                                  KIND_LSQ_ADDR, KIND_LSQ_DATA, KIND_REGFILE,
+                                  KIND_ROB_DST)
+
+u32 = jnp.uint32
+i32 = jnp.int32
+
+
+class TraceArrays(NamedTuple):
+    """Device-resident trace constants (see trace.format.Trace)."""
+
+    opcode: jax.Array   # int32[n]
+    dst: jax.Array      # int32[n]
+    src1: jax.Array     # int32[n]
+    src2: jax.Array     # int32[n]
+    imm: jax.Array      # uint32[n]
+    taken: jax.Array    # int32[n]
+    opclass: jax.Array  # int32[n]
+
+    @classmethod
+    def from_trace(cls, trace) -> "TraceArrays":
+        return cls(
+            opcode=jnp.asarray(trace.opcode, dtype=i32),
+            dst=jnp.asarray(trace.dst, dtype=i32),
+            src1=jnp.asarray(trace.src1, dtype=i32),
+            src2=jnp.asarray(trace.src2, dtype=i32),
+            imm=jnp.asarray(trace.imm, dtype=u32),
+            taken=jnp.asarray(trace.taken, dtype=i32),
+            opclass=jnp.asarray(U.opclass_of(trace.opcode), dtype=i32),
+        )
+
+
+class ReplayResult(NamedTuple):
+    reg: jax.Array        # uint32[nphys] final register file
+    mem: jax.Array        # uint32[mem_words] final memory
+    detected: jax.Array   # bool — shadow-FU caught the fault
+    trapped: jax.Array    # bool — invalid memory access (DUE)
+    diverged: jax.Array   # bool — branch outcome differed from golden
+
+
+def _sra(a: jax.Array, sh: jax.Array) -> jax.Array:
+    ai = jax.lax.bitcast_convert_type(a, i32)
+    return jax.lax.bitcast_convert_type(ai >> sh.astype(i32), u32)
+
+
+def _signed_lt(a: jax.Array, b: jax.Array) -> jax.Array:
+    ai = jax.lax.bitcast_convert_type(a, i32)
+    bi = jax.lax.bitcast_convert_type(b, i32)
+    return ai < bi
+
+
+def _alu(op: jax.Array, a: jax.Array, b: jax.Array, imm: jax.Array) -> jax.Array:
+    """Branchless µop evaluation: compute all candidates, select by opcode.
+
+    23 candidate lanes of VPU work per step — cheap relative to the gathers;
+    keeps the scan body completely control-flow-free.
+    """
+    sh = (b & u32(31)).astype(u32)
+    zero = jnp.zeros_like(a)
+    one = jnp.ones_like(a)
+    cand = jnp.stack([
+        zero,                       # NOP
+        a + b, a - b, a & b, a | b, a ^ b,
+        a << sh, a >> sh, _sra(a, sh),
+        a + imm, a & imm, a | imm, a ^ imm, imm,
+        a * b,
+        jnp.where(_signed_lt(a, b), one, zero),
+        jnp.where(a < b, one, zero),
+        a + imm, a + imm,           # LOAD / STORE effective address
+        jnp.where(a == b, one, zero),
+        jnp.where(a != b, one, zero),
+        jnp.where(_signed_lt(a, b), one, zero),
+        jnp.where(~_signed_lt(a, b), one, zero),
+    ])
+    return cand[op]
+
+
+def replay(tr: TraceArrays, init_reg: jax.Array, init_mem: jax.Array,
+           fault: Fault, shadow_coverage: jax.Array) -> ReplayResult:
+    """Propagate one trial. All inputs are device arrays; jit/vmap-safe."""
+    nphys = init_reg.shape[0]
+    mem_words = init_mem.shape[0]
+    idx_mask = i32(nphys - 1)
+    n = tr.opcode.shape[0]
+
+    bitmask = u32(1) << fault.bit.astype(u32)
+
+    def step(carry, xs):
+        reg, mem, live, detected, trapped, diverged = carry
+        i, op, dstr, s1, s2, imm, tk, oc = xs
+
+        # 1. storage-fault landing
+        flip_here = (fault.kind == KIND_REGFILE) & (i == fault.cycle)
+        lane = jnp.arange(nphys, dtype=i32) == fault.entry
+        reg = jnp.where(flip_here & lane, reg ^ bitmask, reg)
+
+        # 2. operand read with IQ index faults
+        at_uop = i == fault.entry
+        s1e = jnp.where((fault.kind == KIND_IQ_SRC1) & at_uop,
+                        s1 ^ fault.bit_as_index_mask(), s1) & idx_mask
+        s2e = jnp.where((fault.kind == KIND_IQ_SRC2) & at_uop,
+                        s2 ^ fault.bit_as_index_mask(), s2) & idx_mask
+        a = reg[s1e]
+        b = reg[s2e]
+
+        # 3. execute
+        raw = _alu(op, a, b, imm)
+        fu_mask = jnp.where((fault.kind == KIND_FU) & at_uop, bitmask, u32(0))
+        eff = raw ^ fu_mask
+        detected_now = ((fault.kind == KIND_FU) & at_uop & live
+                        & (fault.shadow_u < shadow_coverage[oc]))
+
+        is_ld = op == U.LOAD
+        is_st = op == U.STORE
+        is_mem_op = is_ld | is_st
+        is_br = (op >= U.BEQ) & (op <= U.BGE)
+
+        # 4. memory access with LSQ faults
+        addr = eff ^ jnp.where((fault.kind == KIND_LSQ_ADDR) & at_uop,
+                               bitmask, u32(0))
+        valid = ((addr & u32(3)) == 0) & ((addr >> u32(2)) < u32(mem_words))
+        trapped_now = is_mem_op & ~valid & live
+        slot = (addr >> u32(2)).astype(i32) & i32(mem_words - 1)
+        ldval = mem[slot]
+        st_data = b ^ jnp.where((fault.kind == KIND_LSQ_DATA) & at_uop,
+                                bitmask, u32(0))
+
+        # 5. branch resolution
+        cond = eff != 0
+        diverged_now = is_br & (cond != (tk != 0)) & live
+
+        # freeze on any terminal condition this step
+        live_next = live & ~(detected_now | trapped_now | diverged_now)
+
+        # 6. writeback/commit with ROB dest-index fault
+        de = jnp.where((fault.kind == KIND_ROB_DST) & at_uop,
+                       dstr ^ fault.bit_as_index_mask(), dstr) & idx_mask
+        result = jnp.where(is_ld, ldval, eff)
+        writes = (((op >= U.ADD) & (op <= U.SLTU)) | is_ld) & live_next
+        reg = reg.at[de].set(jnp.where(writes, result, reg[de]))
+        do_store = is_st & valid & live_next
+        mem = mem.at[slot].set(jnp.where(do_store, st_data, mem[slot]))
+
+        return ((reg, mem, live_next,
+                 detected | detected_now,
+                 trapped | trapped_now,
+                 diverged | diverged_now), None)
+
+    xs = (jnp.arange(n, dtype=i32), tr.opcode, tr.dst, tr.src1, tr.src2,
+          tr.imm, tr.taken, tr.opclass)
+    init = (init_reg.astype(u32), init_mem.astype(u32),
+            jnp.bool_(True), jnp.bool_(False), jnp.bool_(False),
+            jnp.bool_(False))
+    (reg, mem, _live, detected, trapped, diverged), _ = jax.lax.scan(
+        step, init, xs)
+    return ReplayResult(reg=reg, mem=mem, detected=detected,
+                        trapped=trapped, diverged=diverged)

@@ -1,0 +1,54 @@
+"""Trial-batch driver: the jitted inject→propagate→classify pipeline.
+
+One ``TrialKernel`` binds a SimPoint trace (device-resident constants), the
+machine config, and the golden replay; ``run_batch`` maps a ``Fault`` batch to
+outcome classes, and ``run_keys`` goes straight from PRNG keys to the
+psum-reducible tally vector.  This is the per-chip unit the campaign layer
+shards over the mesh (SURVEY §2.12 P3: vmap over trials within a chip,
+shard_map over chips).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from shrewd_tpu.models.o3 import Fault, FaultSampler, O3Config, null_fault
+from shrewd_tpu.ops import classify as C
+from shrewd_tpu.ops.replay import ReplayResult, TraceArrays, replay
+
+
+class TrialKernel:
+    def __init__(self, trace, cfg: O3Config | None = None):
+        self.cfg = cfg if cfg is not None else O3Config()
+        self.trace = trace
+        self.tr = TraceArrays.from_trace(trace)
+        self.init_reg = jnp.asarray(trace.init_reg, dtype=jnp.uint32)
+        self.init_mem = jnp.asarray(trace.init_mem, dtype=jnp.uint32)
+        self.coverage = jnp.asarray(self.cfg.shadow_coverage, dtype=jnp.float32)
+        # Golden replay once per kernel: device-vs-device comparison makes
+        # MASKED exact by construction (the CheckerCPU-style scalar oracle is
+        # a separate differential test, not the classification baseline).
+        self.golden: ReplayResult = jax.jit(self._replay_one)(null_fault())
+
+    def _replay_one(self, fault: Fault) -> ReplayResult:
+        return replay(self.tr, self.init_reg, self.init_mem, fault,
+                      self.coverage)
+
+    @partial(jax.jit, static_argnums=0)
+    def run_batch(self, faults: Fault) -> jax.Array:
+        """Fault batch (vmapped leaves) → outcome classes int32[B]."""
+        results = jax.vmap(self._replay_one)(faults)
+        return jax.vmap(
+            lambda r: C.classify(r, self.golden, self.cfg.compare_regs))(results)
+
+    def sampler(self, structure: str) -> FaultSampler:
+        return FaultSampler(self.trace, structure, self.cfg)
+
+    @partial(jax.jit, static_argnums=(0, 2))
+    def run_keys(self, keys: jax.Array, structure: str) -> jax.Array:
+        """Per-trial keys → outcome tally (N_OUTCOMES,). The campaign unit."""
+        faults = self.sampler(structure).sample_batch(keys)
+        return C.tally(self.run_batch(faults))

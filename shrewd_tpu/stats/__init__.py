@@ -135,8 +135,16 @@ class Distribution(StatBase):
     def sample(self, values, weights=None) -> None:
         """Absorb a batch of samples (array-friendly: one host call/batch)."""
         v = np.atleast_1d(np.asarray(values, dtype=np.float64))
-        w = (np.ones_like(v) if weights is None
-             else np.atleast_1d(np.asarray(weights, dtype=np.float64)))
+        if weights is None:
+            w = np.ones_like(v)
+        else:
+            try:
+                w = np.broadcast_to(
+                    np.asarray(weights, dtype=np.float64), v.shape).copy()
+            except ValueError:
+                raise ValueError(
+                    f"{self.name}: weights shape "
+                    f"{np.shape(weights)} does not broadcast to {v.shape}")
         if v.size == 0:
             return
         self.underflow += w[v < self.lo].sum()
@@ -212,6 +220,9 @@ class Histogram(Distribution):
             return
         if not np.isfinite(v).all():
             raise ValueError(f"{self.name}: non-finite sample")
+        if (v < 0).any():
+            raise ValueError(f"{self.name}: Histogram range starts at 0; "
+                             f"negative sample rejected (use Distribution)")
         while float(v.max()) >= self.hi:
             # merge pairs: counts[i] = counts[2i] + counts[2i+1]; double range
             merged = self.counts.reshape(-1, 2).sum(axis=1)
@@ -261,15 +272,22 @@ class Group:
         object.__setattr__(self, "_groups", {})
 
     def __setattr__(self, key, value):
-        # rebinding an attribute drops its previous registration
+        # rebinding an attribute drops its previous registration (only if the
+        # registration actually points at the object being replaced)
         old = getattr(self, key, None)
-        if isinstance(old, StatBase):
-            self._stats.pop(old.name, None)
-        elif isinstance(old, Group):
-            self._groups.pop(old.name, None)
+        if isinstance(old, StatBase) and self._stats.get(old.name) is old:
+            del self._stats[old.name]
+        elif isinstance(old, Group) and self._groups.get(old.name) is old:
+            del self._groups[old.name]
         if isinstance(value, StatBase):
+            if value.name in self._stats:
+                raise ValueError(
+                    f"duplicate stat name {value.name!r} in group {self.name!r}")
             self._stats[value.name] = value
         elif isinstance(value, Group):
+            if value.name in self._groups:
+                raise ValueError(
+                    f"duplicate subgroup name {value.name!r} in group {self.name!r}")
             self._groups[value.name] = value
         object.__setattr__(self, key, value)
 

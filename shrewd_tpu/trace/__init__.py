@@ -1,0 +1,4 @@
+from shrewd_tpu.trace import format, synth
+from shrewd_tpu.trace.format import Trace
+
+__all__ = ["Trace", "format", "synth"]

@@ -1,0 +1,94 @@
+"""SimPoint trace format.
+
+The framework's analog of the reference's ElasticTrace capture
+(``src/cpu/o3/probe/elastic_trace.hh:93``): a recorded dynamic-instruction
+window, stored struct-of-arrays with fixed shapes so it uploads directly as
+device-resident constants for the replay kernel (SURVEY §7 "Hard parts" #1:
+replay real dataflow instead of re-deriving timing).
+
+A ``Trace`` is immutable once built.  Serialization is ``.npz`` (one file per
+SimPoint window) with a JSON metadata blob — the framework-native counterpart
+of the reference's protobuf trace files (``src/cpu/inst_pb_trace.*``).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import NamedTuple
+
+import numpy as np
+
+from shrewd_tpu.isa import uops as U
+
+FORMAT_VERSION = 1
+
+
+class Trace(NamedTuple):
+    """A dynamic µop window plus the machine state it starts from.
+
+    Array fields are the SoA layout of §A.1 of the survey (the reference's
+    ``DynInst`` already stores flattened per-inst register indices, confirming
+    fixed-shape SoA is faithful).
+    """
+
+    opcode: np.ndarray    # int32[n]
+    dst: np.ndarray       # int32[n]   destination register index
+    src1: np.ndarray      # int32[n]
+    src2: np.ndarray      # int32[n]
+    imm: np.ndarray       # uint32[n]
+    taken: np.ndarray     # int32[n]   golden branch outcome (0 for non-branches)
+    init_reg: np.ndarray  # uint32[nphys]  register file at window start
+    init_mem: np.ndarray  # uint32[mem_words]  memory image at window start
+
+    @property
+    def n(self) -> int:
+        return int(self.opcode.shape[0])
+
+    @property
+    def nphys(self) -> int:
+        return int(self.init_reg.shape[0])
+
+    @property
+    def mem_words(self) -> int:
+        return int(self.init_mem.shape[0])
+
+    def validate(self) -> None:
+        n = self.n
+        for name in ("dst", "src1", "src2", "imm", "taken"):
+            arr = getattr(self, name)
+            if arr.shape != (n,):
+                raise ValueError(f"{name}: shape {arr.shape} != ({n},)")
+        if not ((self.opcode >= 0) & (self.opcode < U.N_OPCODES)).all():
+            raise ValueError("opcode out of range")
+        for name in ("dst", "src1", "src2"):
+            arr = getattr(self, name)
+            if not ((arr >= 0) & (arr < self.nphys)).all():
+                raise ValueError(f"{name} register index out of range")
+        if self.nphys & (self.nphys - 1):
+            raise ValueError("nphys must be a power of two")
+        if self.mem_words & (self.mem_words - 1):
+            raise ValueError("mem_words must be a power of two")
+
+
+def save(path, trace: Trace, meta: dict | None = None) -> None:
+    trace.validate()
+    meta = dict(meta or {})
+    meta["format_version"] = FORMAT_VERSION
+    np.savez_compressed(
+        path,
+        meta=np.frombuffer(json.dumps(meta).encode(), dtype=np.uint8),
+        **{f: getattr(trace, f) for f in Trace._fields},
+    )
+
+
+def load(path) -> tuple[Trace, dict]:
+    with np.load(path) as z:
+        meta = json.loads(bytes(z["meta"]).decode())
+        if meta.get("format_version") != FORMAT_VERSION:
+            raise ValueError(
+                f"trace format version {meta.get('format_version')} != "
+                f"{FORMAT_VERSION} (regenerate or write an upgrader, the "
+                f"cpt_upgraders analog)")
+        trace = Trace(**{f: z[f] for f in Trace._fields})
+    trace.validate()
+    return trace, meta

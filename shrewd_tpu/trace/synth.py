@@ -1,0 +1,125 @@
+"""Synthetic workload generator.
+
+The framework's traffic-generator tier (SURVEY §4 tier 4): the analog of the
+reference's synthetic load generators (``cpu/testers/traffic_gen/base.hh:67``
+linear/random/strided generators and ``MemTest``) — drives the SFI kernels
+with self-checking workloads of controllable character without needing SPEC
+artifacts, which are licensed and external to the reference too (SURVEY §7
+"Hard parts" #7).
+
+Generates a µop window with a configurable instruction mix, dependency
+locality (geometric reuse distance over recently-written registers), and a
+bounded memory working set, executing as it generates (via the scalar golden
+semantics) so branch outcomes and the memory image are consistent.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from shrewd_tpu.isa import semantics, uops as U
+from shrewd_tpu.trace.format import Trace
+from shrewd_tpu.utils.config import ConfigObject, Param
+
+M32 = 0xFFFFFFFF
+
+_ALU_OPS = np.array([U.ADD, U.SUB, U.AND, U.OR, U.XOR, U.SLL, U.SRL, U.SRA,
+                     U.ADDI, U.ANDI, U.ORI, U.XORI, U.LUI, U.SLT, U.SLTU],
+                    dtype=np.int32)
+_BRANCH_OPS = np.array([U.BEQ, U.BNE, U.BLT, U.BGE], dtype=np.int32)
+
+
+class WorkloadConfig(ConfigObject):
+    """Mix/shape knobs for a synthetic SimPoint window."""
+
+    n = Param(int, 4096, "µops in the window")
+    nphys = Param(int, 256, "register-file entries (power of two)")
+    mem_words = Param(int, 4096, "memory words (power of two)")
+    working_set_words = Param(int, 1024, "words touched by loads/stores")
+    frac_alu = Param(float, 0.50, "ALU fraction")
+    frac_mul = Param(float, 0.05, "integer-multiply fraction")
+    frac_load = Param(float, 0.20, "load fraction")
+    frac_store = Param(float, 0.12, "store fraction")
+    frac_branch = Param(float, 0.08, "branch fraction")
+    # remaining fraction is NOPs
+    locality = Param(float, 0.8, "P(src comes from recently-written regs)")
+    reuse_geo_p = Param(float, 0.3, "geometric reuse-distance parameter")
+    seed = Param(int, 0, "generator seed")
+
+
+def generate(cfg: WorkloadConfig) -> Trace:
+    rng = np.random.default_rng(cfg.seed)
+    nphys, n = cfg.nphys, cfg.n
+    ws = min(cfg.working_set_words, cfg.mem_words)
+
+    reg = rng.integers(0, 1 << 32, size=nphys, dtype=np.uint32)
+    mem = rng.integers(0, 1 << 32, size=cfg.mem_words, dtype=np.uint32)
+    init_reg, init_mem = reg.copy(), mem.copy()
+
+    opcode = np.zeros(n, dtype=np.int32)
+    dst = np.zeros(n, dtype=np.int32)
+    src1 = np.zeros(n, dtype=np.int32)
+    src2 = np.zeros(n, dtype=np.int32)
+    imm = np.zeros(n, dtype=np.uint32)
+    taken = np.zeros(n, dtype=np.int32)
+
+    recent: list[int] = []           # recently-written register indices
+
+    def pick_src() -> int:
+        if recent and rng.random() < cfg.locality:
+            d = min(rng.geometric(cfg.reuse_geo_p), len(recent))
+            return recent[-d]
+        return int(rng.integers(nphys))
+
+    probs = np.array([cfg.frac_alu, cfg.frac_mul, cfg.frac_load,
+                      cfg.frac_store, cfg.frac_branch])
+    if probs.sum() > 1.0 + 1e-9:
+        raise ValueError("instruction-mix fractions exceed 1")
+    kinds = rng.choice(6, size=n, p=np.append(probs, 1.0 - probs.sum()))
+
+    for i in range(n):
+        kind = kinds[i]
+        if kind == 0:                 # ALU
+            op = int(_ALU_OPS[rng.integers(len(_ALU_OPS))])
+            s1, s2, d = pick_src(), pick_src(), int(rng.integers(nphys))
+            im = int(rng.integers(0, 1 << 16))
+        elif kind == 1:               # MUL
+            op, s1, s2, d = U.MUL, pick_src(), pick_src(), int(rng.integers(nphys))
+            im = 0
+        elif kind in (2, 3):          # LOAD / STORE
+            op = U.LOAD if kind == 2 else U.STORE
+            s1 = pick_src()
+            s2 = pick_src()           # store data (unused by load)
+            d = int(rng.integers(nphys))
+            word = int(rng.integers(ws))
+            # imm chosen so effective address rs1+imm lands on `word`
+            im = (word * 4 - int(reg[s1])) & M32
+        elif kind == 4:               # branch
+            op = int(_BRANCH_OPS[rng.integers(len(_BRANCH_OPS))])
+            s1, s2, d = pick_src(), pick_src(), 0
+            im = 0
+        else:                         # NOP
+            op, s1, s2, d, im = U.NOP, 0, 0, 0, 0
+
+        opcode[i], dst[i], src1[i], src2[i], imm[i] = op, d, s1, s2, im
+
+        # execute (keeps generator state consistent; records branch outcomes)
+        a, b = int(reg[s1]), int(reg[s2])
+        res = semantics.alu(op, a, b, im)
+        if op == U.LOAD:
+            reg[d] = mem[res >> 2]
+            recent.append(d)
+        elif op == U.STORE:
+            mem[res >> 2] = b
+        elif U.is_branch(np.int64(op)):
+            taken[i] = res
+        elif U.writes_dest(np.int64(op)):
+            reg[d] = res
+            recent.append(d)
+        if len(recent) > 64:
+            del recent[:-64]
+
+    trace = Trace(opcode=opcode, dst=dst, src1=src1, src2=src2, imm=imm,
+                  taken=taken, init_reg=init_reg, init_mem=init_mem)
+    trace.validate()
+    return trace

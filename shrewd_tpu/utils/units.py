@@ -89,6 +89,8 @@ def format_count(n: float) -> str:
     # values promote cleanly (999999 → '1M', never '1e+03k').
     exp = math.floor(math.log10(abs(n)))
     r = round(n, -(exp - 2))
+    if abs(r) >= 1e15:
+        return f"{r:.4g}"     # beyond the suffix table: plain e-notation
     for suffix, mult in (("T", 1e12), ("G", 1e9), ("M", 1e6), ("k", 1e3)):
         if abs(r) >= mult:
             return f"{r / mult:.4g}{suffix}"

@@ -1,0 +1,205 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from shrewd_tpu.isa import semantics, uops as U
+from shrewd_tpu.models.o3 import (Fault, KIND_FU, KIND_LSQ_ADDR,
+                                  KIND_REGFILE, KIND_ROB_DST, O3Config,
+                                  null_fault)
+from shrewd_tpu.ops import classify as C
+from shrewd_tpu.ops.replay import TraceArrays, replay
+from shrewd_tpu.ops.trial import TrialKernel
+from shrewd_tpu.trace.format import Trace
+from shrewd_tpu.trace.synth import WorkloadConfig, generate
+
+
+def mini_trace(rows, nphys=16, mem_words=64, init_reg=None, init_mem=None):
+    """rows: list of (opcode, dst, src1, src2, imm, taken)."""
+    arr = np.array(rows, dtype=np.int64)
+    t = Trace(
+        opcode=arr[:, 0].astype(np.int32),
+        dst=arr[:, 1].astype(np.int32),
+        src1=arr[:, 2].astype(np.int32),
+        src2=arr[:, 3].astype(np.int32),
+        imm=arr[:, 4].astype(np.uint32),
+        taken=arr[:, 5].astype(np.int32),
+        init_reg=(np.arange(nphys, dtype=np.uint32) * 3 + 1
+                  if init_reg is None else init_reg),
+        init_mem=(np.arange(mem_words, dtype=np.uint32) * 7 + 5
+                  if init_mem is None else init_mem),
+    )
+    t.validate()
+    return t
+
+
+def fault(kind=0, cycle=0, entry=0, bit=0, shadow_u=1.0):
+    return Fault(kind=jnp.int32(kind), cycle=jnp.int32(cycle),
+                 entry=jnp.int32(entry), bit=jnp.int32(bit),
+                 shadow_u=jnp.float32(shadow_u))
+
+
+ZERO_COV = jnp.zeros(U.N_OPCLASSES, dtype=jnp.float32)
+
+
+def run(trace, f, coverage=ZERO_COV):
+    tr = TraceArrays.from_trace(trace)
+    return replay(tr, jnp.asarray(trace.init_reg), jnp.asarray(trace.init_mem),
+                  f, coverage)
+
+
+# --- golden equivalence against the scalar oracle (CheckerCPU pattern) ---
+
+def test_golden_replay_matches_scalar_oracle():
+    cfg = WorkloadConfig(n=512, nphys=64, mem_words=256,
+                         working_set_words=128, seed=42)
+    t = generate(cfg)
+    reg, mem = t.init_reg.copy(), t.init_mem.copy()
+    semantics.scalar_replay(t, reg, mem)
+    res = run(t, null_fault())
+    np.testing.assert_array_equal(np.asarray(res.reg), reg)
+    np.testing.assert_array_equal(np.asarray(res.mem), mem)
+    assert not bool(res.diverged) and not bool(res.trapped) and not bool(res.detected)
+
+
+# --- handcrafted fault scenarios ---
+
+def test_null_fault_is_masked():
+    t = mini_trace([
+        (U.LUI, 2, 0, 0, 8, 0),     # r2 = 8
+        (U.ADDI, 1, 0, 0, 5, 0),    # r1 = r0 + 5
+        (U.STORE, 0, 2, 1, 0, 0),   # mem[8>>2] = r1
+    ])
+    k = TrialKernel(t)
+    out = k.run_batch(jax.tree.map(lambda x: x[None], null_fault()))
+    assert int(out[0]) == C.OUTCOME_MASKED
+
+
+def test_regfile_fault_consumed_is_sdc():
+    # r1 = r0 + 5 ; store r1 → flipping r0 before the add corrupts memory
+    t = mini_trace([
+        (U.LUI, 2, 0, 0, 8, 0),
+        (U.ADDI, 1, 0, 0, 5, 0),
+        (U.STORE, 0, 2, 1, 0, 0),
+    ])
+    res = run(t, fault(KIND_REGFILE, cycle=1, entry=0, bit=3))
+    golden = run(t, null_fault())
+    out = C.classify(res, golden)
+    assert int(out) == C.OUTCOME_SDC
+    # the store wrote a value differing in bit 3
+    diff = int(np.asarray(res.mem[2])) ^ int(np.asarray(golden.mem[2]))
+    assert diff == 8
+
+
+def test_regfile_fault_overwritten_is_masked():
+    # flip r1 BEFORE it is rewritten by the ADDI → dead value, masked
+    t = mini_trace([
+        (U.LUI, 2, 0, 0, 8, 0),
+        (U.ADDI, 1, 0, 0, 5, 0),
+        (U.STORE, 0, 2, 1, 0, 0),
+    ])
+    res = run(t, fault(KIND_REGFILE, cycle=0, entry=1, bit=7))
+    golden = run(t, null_fault())
+    assert int(C.classify(res, golden)) == C.OUTCOME_MASKED
+
+
+def test_regfile_fault_after_last_read_unconsumed():
+    # flip a register no µop ever reads → register-state diff only
+    t = mini_trace([
+        (U.LUI, 2, 0, 0, 8, 0),
+        (U.ADDI, 1, 0, 0, 5, 0),
+        (U.STORE, 0, 2, 1, 0, 0),
+    ])
+    res = run(t, fault(KIND_REGFILE, cycle=2, entry=9, bit=0))
+    golden = run(t, null_fault())
+    assert int(C.classify(res, golden)) == C.OUTCOME_SDC       # conservative
+    assert int(C.classify(res, golden, compare_regs=False)) == C.OUTCOME_MASKED
+
+
+def test_fu_fault_detected_with_full_coverage():
+    t = mini_trace([
+        (U.ADD, 1, 2, 3, 0, 0),
+        (U.ADD, 4, 1, 1, 0, 0),
+    ])
+    cov = jnp.ones(U.N_OPCLASSES, dtype=jnp.float32)
+    res = run(t, fault(KIND_FU, cycle=0, entry=0, bit=5, shadow_u=0.5), cov)
+    golden = run(t, null_fault(), cov)
+    assert bool(res.detected)
+    assert int(C.classify(res, golden)) == C.OUTCOME_DETECTED
+    # detection freezes the trial: faulty value never committed
+    np.testing.assert_array_equal(np.asarray(res.reg), np.asarray(t.init_reg))
+
+
+def test_fu_fault_undetected_is_sdc():
+    t = mini_trace([
+        (U.ADD, 1, 2, 3, 0, 0),
+    ])
+    res = run(t, fault(KIND_FU, cycle=0, entry=0, bit=5, shadow_u=0.5))
+    golden = run(t, null_fault())
+    assert not bool(res.detected)
+    assert int(C.classify(res, golden)) == C.OUTCOME_SDC
+
+
+def test_lsq_addr_highbit_fault_traps_due():
+    t = mini_trace([
+        (U.LUI, 2, 0, 0, 8, 0),
+        (U.STORE, 0, 2, 3, 0, 0),
+    ])
+    res = run(t, fault(KIND_LSQ_ADDR, cycle=1, entry=1, bit=31))
+    golden = run(t, null_fault())
+    assert bool(res.trapped)
+    assert int(C.classify(res, golden)) == C.OUTCOME_DUE
+
+
+def test_branch_divergence_is_sdc():
+    # r1=5, r2=5 → BEQ taken; flip r1 → not taken → divergence
+    t = mini_trace([
+        (U.ADDI, 1, 15, 0, 5, 0),
+        (U.ADDI, 2, 15, 0, 5, 0),
+        (U.BEQ, 0, 1, 2, 0, 1),
+    ], init_reg=np.zeros(16, dtype=np.uint32))
+    res = run(t, fault(KIND_REGFILE, cycle=2, entry=1, bit=0))
+    golden = run(t, null_fault())
+    assert bool(res.diverged)
+    assert int(C.classify(res, golden)) == C.OUTCOME_SDC
+
+
+def test_rob_dst_fault_misdirects_writeback():
+    # ADDI writes r1; ROB dst fault flips index bit 2 → writes r5 instead
+    t = mini_trace([
+        (U.ADDI, 1, 0, 0, 5, 0),
+    ])
+    res = run(t, fault(KIND_ROB_DST, cycle=0, entry=0, bit=2))
+    golden = run(t, null_fault())
+    g = np.asarray(golden.reg)
+    r = np.asarray(res.reg)
+    assert r[5] == g[1]            # value landed in the wrong register
+    assert r[1] == t.init_reg[1]   # intended register went stale
+
+
+# --- batched path ---
+
+def test_trial_kernel_batch_deterministic():
+    cfg = WorkloadConfig(n=256, nphys=64, mem_words=256,
+                         working_set_words=128, seed=1)
+    t = generate(cfg)
+    k = TrialKernel(t)
+    keys = jax.random.split(jax.random.key(0), 64)
+    t1 = k.run_keys(keys, "regfile")
+    t2 = k.run_keys(keys, "regfile")
+    np.testing.assert_array_equal(np.asarray(t1), np.asarray(t2))
+    assert int(t1.sum()) == 64
+    # regfile faults on a random trace: some masked, typically some not
+    assert int(t1[C.OUTCOME_MASKED]) > 0
+
+
+@pytest.mark.parametrize("structure", ["regfile", "fu", "rob", "iq", "lsq"])
+def test_all_structures_produce_valid_outcomes(structure):
+    cfg = WorkloadConfig(n=128, nphys=64, mem_words=128,
+                         working_set_words=64, seed=2)
+    t = generate(cfg)
+    k = TrialKernel(t, O3Config(shadow_coverage=[0.5] * U.N_OPCLASSES))
+    keys = jax.random.split(jax.random.key(1), 32)
+    tally = np.asarray(k.run_keys(keys, structure))
+    assert tally.sum() == 32
+    assert (tally >= 0).all()

@@ -86,6 +86,29 @@ def test_group_rebind_drops_old():
     assert names == ["g.new"]
 
 
+def test_group_duplicate_name_rejected():
+    g = Group("g")
+    g.a = Scalar("x")
+    with pytest.raises(ValueError):
+        g.b = Scalar("x")
+    # the surviving registration is untouched
+    assert [n for n, _, _ in g.rows()] == ["g.x"]
+
+
+def test_distribution_weights():
+    d = Distribution("d", 0, 10, 10)
+    d.sample([1.0, 2.0], weights=2.0)           # scalar broadcast
+    assert d.samples == 4
+    with pytest.raises(ValueError):
+        d.sample([1.0, 2.0], weights=[1.0, 2.0, 3.0])
+
+
+def test_histogram_negative_rejected():
+    h = Histogram("h", 8)
+    with pytest.raises(ValueError):
+        h.sample([-1.0])
+
+
 def test_format_count_tera():
     from shrewd_tpu.utils import units
     assert units.format_count(1e12) == "1T"
