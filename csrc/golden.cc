@@ -1,0 +1,184 @@
+// Serial golden trial kernel — the C++ oracle the batched TPU path is
+// differentially tested against, and the serial-baseline denominator for the
+// bench (the role gem5's serial C++ campaign plays in BASELINE configs[0]).
+//
+// Step phases and fault application mirror shrewd_tpu/ops/replay.py exactly:
+//   1. storage-fault landing  2. operand read (IQ index faults)
+//   3. execute (FU faults, shadow detection)  4. memory (LSQ faults, traps)
+//   5. branch resolution  6. writeback (ROB dest faults)
+#include "shrewd.h"
+
+#include <cstring>
+#include <vector>
+
+namespace {
+
+inline uint32_t alu(int32_t op, uint32_t a, uint32_t b, uint32_t imm) {
+  const uint32_t sh = b & 31u;
+  switch (op) {
+    case OP_NOP:  return 0;
+    case OP_ADD:  return a + b;
+    case OP_SUB:  return a - b;
+    case OP_AND:  return a & b;
+    case OP_OR:   return a | b;
+    case OP_XOR:  return a ^ b;
+    case OP_SLL:  return a << sh;
+    case OP_SRL:  return a >> sh;
+    case OP_SRA:  return static_cast<uint32_t>(static_cast<int32_t>(a) >> sh);
+    case OP_ADDI: return a + imm;
+    case OP_ANDI: return a & imm;
+    case OP_ORI:  return a | imm;
+    case OP_XORI: return a ^ imm;
+    case OP_LUI:  return imm;
+    case OP_MUL:  return a * b;
+    case OP_SLT:  return static_cast<int32_t>(a) < static_cast<int32_t>(b);
+    case OP_SLTU: return a < b;
+    case OP_LOAD: case OP_STORE: return a + imm;  // effective address
+    case OP_BEQ:  return a == b;
+    case OP_BNE:  return a != b;
+    case OP_BLT:  return static_cast<int32_t>(a) < static_cast<int32_t>(b);
+    case OP_BGE:  return static_cast<int32_t>(a) >= static_cast<int32_t>(b);
+    default:      return 0;
+  }
+}
+
+inline int32_t opclass_of(int32_t op) {
+  switch (op) {
+    case OP_NOP:   return OC_NONE;
+    case OP_MUL:   return OC_INT_MULT;
+    case OP_LOAD:  return OC_MEM_READ;
+    case OP_STORE: return OC_MEM_WRITE;
+    default:       return OC_INT_ALU;
+  }
+}
+
+struct TrialResult {
+  bool detected = false;
+  bool trapped = false;
+  bool diverged = false;
+};
+
+// One replay; reg/mem are the trial's state (modified in place).
+TrialResult replay(const TraceView& tr, uint32_t* reg, uint32_t* mem,
+                   int32_t kind, int32_t fcycle, int32_t fentry, int32_t fbit,
+                   float shadow_u, const float* coverage) {
+  TrialResult r;
+  const int32_t idx_mask = tr.nphys - 1;
+  const uint32_t bitmask = 1u << (fbit & 31);
+  const int32_t index_mask = (int32_t)(1u << (fbit & 31));
+
+  for (int32_t i = 0; i < tr.n; ++i) {
+    // 1. storage-fault landing
+    if (kind == KIND_REGFILE && i == fcycle) reg[fentry] ^= bitmask;
+
+    const int32_t op = tr.opcode[i];
+    const bool at_uop = (i == fentry);
+
+    // 2. operand read with IQ index faults
+    int32_t s1 = tr.src1[i];
+    int32_t s2 = tr.src2[i];
+    if (kind == KIND_IQ_SRC1 && at_uop) s1 = (s1 ^ index_mask) & idx_mask;
+    if (kind == KIND_IQ_SRC2 && at_uop) s2 = (s2 ^ index_mask) & idx_mask;
+    const uint32_t a = reg[s1];
+    const uint32_t b = reg[s2];
+
+    // 3. execute
+    uint32_t eff = alu(op, a, b, tr.imm[i]);
+    if (kind == KIND_FU && at_uop) {
+      eff ^= bitmask;
+      if (shadow_u < coverage[opclass_of(op)]) {  // shadow FU re-executes
+        r.detected = true;
+        return r;  // fault contained before any commit
+      }
+    }
+
+    const bool is_ld = (op == OP_LOAD);
+    const bool is_st = (op == OP_STORE);
+    const bool is_br = (op >= OP_BEQ && op <= OP_BGE);
+
+    // 4. memory access with LSQ faults
+    if (is_ld || is_st) {
+      uint32_t addr = eff;
+      if (kind == KIND_LSQ_ADDR && at_uop) addr ^= bitmask;
+      const bool valid = ((addr & 3u) == 0) && ((addr >> 2) < (uint32_t)tr.mem_words);
+      if (!valid) {
+        r.trapped = true;
+        return r;
+      }
+      const int32_t slot = (int32_t)(addr >> 2) & (tr.mem_words - 1);
+      if (is_ld) {
+        eff = mem[slot];
+      } else {
+        uint32_t data = b;
+        if (kind == KIND_LSQ_DATA && at_uop) data ^= bitmask;
+        mem[slot] = data;
+      }
+    }
+
+    // 5. branch resolution
+    if (is_br) {
+      const bool cond = eff != 0;
+      if (cond != (tr.taken[i] != 0)) {
+        r.diverged = true;
+        return r;
+      }
+      continue;
+    }
+
+    // 6. writeback with ROB dest-index fault
+    const bool writes = (op >= OP_ADD && op <= OP_SLTU) || is_ld;
+    if (writes) {
+      int32_t d = tr.dst[i];
+      if (kind == KIND_ROB_DST && at_uop) d = (d ^ index_mask) & idx_mask;
+      reg[d] = eff;
+    }
+  }
+  return r;
+}
+
+}  // namespace
+
+extern "C" {
+
+void shrewd_golden_replay(const TraceView* tr, const uint32_t* init_reg,
+                          const uint32_t* init_mem, uint32_t* final_reg,
+                          uint32_t* final_mem) {
+  std::memcpy(final_reg, init_reg, tr->nphys * sizeof(uint32_t));
+  std::memcpy(final_mem, init_mem, tr->mem_words * sizeof(uint32_t));
+  const float cov[N_OPCLASSES] = {0, 0, 0, 0, 0};
+  replay(*tr, final_reg, final_mem, KIND_NONE, 0, 0, 0, 1.0f, cov);
+}
+
+int32_t shrewd_golden_trials(const TraceView* tr, const uint32_t* init_reg,
+                             const uint32_t* init_mem, const FaultView* faults,
+                             const float* coverage, int32_t compare_regs,
+                             int32_t* outcomes) {
+  const size_t nr = tr->nphys, nm = tr->mem_words;
+  std::vector<uint32_t> gold_reg(nr), gold_mem(nm);
+  shrewd_golden_replay(tr, init_reg, init_mem, gold_reg.data(), gold_mem.data());
+
+  std::vector<uint32_t> reg(nr), mem(nm);
+  for (int32_t t = 0; t < faults->n_trials; ++t) {
+    std::memcpy(reg.data(), init_reg, nr * sizeof(uint32_t));
+    std::memcpy(mem.data(), init_mem, nm * sizeof(uint32_t));
+    const TrialResult r =
+        replay(*tr, reg.data(), mem.data(), faults->kind[t], faults->cycle[t],
+               faults->entry[t], faults->bit[t], faults->shadow_u[t], coverage);
+    int32_t out;
+    if (r.detected) {
+      out = OUTCOME_DETECTED;
+    } else if (r.trapped) {
+      out = OUTCOME_DUE;
+    } else {
+      bool diff = r.diverged ||
+                  std::memcmp(mem.data(), gold_mem.data(), nm * 4) != 0;
+      if (!diff && compare_regs)
+        diff = std::memcmp(reg.data(), gold_reg.data(), nr * 4) != 0;
+      out = diff ? OUTCOME_SDC : OUTCOME_MASKED;
+    }
+    outcomes[t] = out;
+  }
+  return faults->n_trials;
+}
+
+}  // extern "C"

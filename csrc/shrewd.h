@@ -1,0 +1,97 @@
+// Native runtime for shrewd_tpu: C ABI shared by the golden kernel and the
+// trace engine.  This is the framework's C++ tier — the counterpart of the
+// reference's C++ simulation core (gem5's src/sim + src/cpu), reduced to the
+// roles the TPU design keeps on the host: the serial golden oracle
+// (CheckerCPU pattern, reference src/cpu/checker/cpu.hh) and the workload
+// engine (traffic-generator pattern, reference cpu/testers/traffic_gen).
+//
+// Semantics here MUST stay bit-identical to shrewd_tpu/isa/semantics.py and
+// shrewd_tpu/ops/replay.py; the differential tests in
+// tests/test_native_diff.py enforce it.
+#ifndef SHREWD_NATIVE_H
+#define SHREWD_NATIVE_H
+
+#include <cstdint>
+
+extern "C" {
+
+// --- µop opcodes (mirror shrewd_tpu/isa/uops.py) ---
+enum Opcode : int32_t {
+  OP_NOP = 0, OP_ADD, OP_SUB, OP_AND, OP_OR, OP_XOR, OP_SLL, OP_SRL, OP_SRA,
+  OP_ADDI, OP_ANDI, OP_ORI, OP_XORI, OP_LUI, OP_MUL, OP_SLT, OP_SLTU,
+  OP_LOAD, OP_STORE, OP_BEQ, OP_BNE, OP_BLT, OP_BGE,
+  N_OPCODES
+};
+
+enum OpClass : int32_t {
+  OC_INT_ALU = 0, OC_INT_MULT, OC_MEM_READ, OC_MEM_WRITE, OC_NONE,
+  N_OPCLASSES
+};
+
+// --- fault kinds (mirror shrewd_tpu/models/o3.py) ---
+enum FaultKind : int32_t {
+  KIND_NONE = 0, KIND_REGFILE, KIND_FU, KIND_ROB_DST, KIND_IQ_SRC1,
+  KIND_IQ_SRC2, KIND_LSQ_ADDR, KIND_LSQ_DATA
+};
+
+// --- outcomes (mirror shrewd_tpu/ops/classify.py) ---
+enum Outcome : int32_t {
+  OUTCOME_MASKED = 0, OUTCOME_SDC, OUTCOME_DUE, OUTCOME_DETECTED
+};
+
+struct TraceView {       // SoA borrow of a trace window (not owned)
+  const int32_t* opcode;
+  const int32_t* dst;
+  const int32_t* src1;
+  const int32_t* src2;
+  const uint32_t* imm;
+  const int32_t* taken;
+  int32_t n;
+  int32_t nphys;      // power of two
+  int32_t mem_words;  // power of two
+};
+
+struct FaultView {       // SoA borrow of a fault batch
+  const int32_t* kind;
+  const int32_t* cycle;
+  const int32_t* entry;
+  const int32_t* bit;
+  const float* shadow_u;
+  int32_t n_trials;
+};
+
+// Run the fault-free replay; writes final_reg[nphys], final_mem[mem_words].
+void shrewd_golden_replay(const TraceView* tr, const uint32_t* init_reg,
+                          const uint32_t* init_mem, uint32_t* final_reg,
+                          uint32_t* final_mem);
+
+// Run a batch of serial trials; writes outcomes[n_trials].
+// coverage: float[N_OPCLASSES] shadow-FU detection probability per OpClass.
+// Returns the number of trials run.
+int32_t shrewd_golden_trials(const TraceView* tr, const uint32_t* init_reg,
+                             const uint32_t* init_mem, const FaultView* faults,
+                             const float* coverage, int32_t compare_regs,
+                             int32_t* outcomes);
+
+// Synthetic workload engine: fills caller-allocated SoA arrays (sizes per
+// TraceView) and the initial machine state, executing as it generates.
+// Returns 0 on success, nonzero on bad parameters.
+struct WorkloadParams {
+  uint64_t seed;
+  int32_t n;
+  int32_t nphys;
+  int32_t mem_words;
+  int32_t working_set_words;
+  float frac_alu, frac_mul, frac_load, frac_store, frac_branch;
+  float locality;
+  float reuse_geo_p;
+};
+
+int32_t shrewd_generate_trace(const WorkloadParams* p, int32_t* opcode,
+                              int32_t* dst, int32_t* src1, int32_t* src2,
+                              uint32_t* imm, int32_t* taken,
+                              uint32_t* init_reg, uint32_t* init_mem);
+
+}  // extern "C"
+
+#endif  // SHREWD_NATIVE_H

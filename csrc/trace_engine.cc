@@ -1,0 +1,164 @@
+// Native synthetic-workload engine — the data-loader tier of the runtime.
+// Plays the role of the reference's traffic/workload generators
+// (cpu/testers/traffic_gen/base.hh:67) at native speed for large windows;
+// the Python generator (shrewd_tpu/trace/synth.py) stays as the slow
+// reference.  The two produce *different* streams (different RNGs) — both are
+// valid workloads; replay semantics, not workload bits, are the contract.
+//
+// Executes as it generates (same scalar semantics as golden.cc) so branch
+// outcomes and memory addressing stay consistent.
+#include "shrewd.h"
+
+#include <cmath>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+// splitmix64: tiny, seedable, good-enough stream for workload shaping.
+struct Rng {
+  uint64_t s;
+  explicit Rng(uint64_t seed) : s(seed ^ 0x9E3779B97F4A7C15ull) {}
+  uint64_t next() {
+    uint64_t z = (s += 0x9E3779B97F4A7C15ull);
+    z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ull;
+    z = (z ^ (z >> 27)) * 0x94D049BB133111EBull;
+    return z ^ (z >> 31);
+  }
+  // uniform in [0, 1)
+  double uniform() { return (next() >> 11) * 0x1.0p-53; }
+  // uniform integer in [0, n)
+  int64_t below(int64_t n) { return (int64_t)(uniform() * n); }
+  uint32_t u32() { return (uint32_t)next(); }
+  int geometric(double p) {  // support {1, 2, ...}
+    double u = uniform();
+    if (u >= 1.0) u = 0.999999999;
+    int g = (int)std::ceil(std::log1p(-u) / std::log1p(-p));
+    return g < 1 ? 1 : g;
+  }
+};
+
+inline uint32_t alu32(int32_t op, uint32_t a, uint32_t b, uint32_t imm) {
+  const uint32_t sh = b & 31u;
+  switch (op) {
+    case OP_NOP:  return 0;
+    case OP_ADD:  return a + b;
+    case OP_SUB:  return a - b;
+    case OP_AND:  return a & b;
+    case OP_OR:   return a | b;
+    case OP_XOR:  return a ^ b;
+    case OP_SLL:  return a << sh;
+    case OP_SRL:  return a >> sh;
+    case OP_SRA:  return static_cast<uint32_t>(static_cast<int32_t>(a) >> sh);
+    case OP_ADDI: return a + imm;
+    case OP_ANDI: return a & imm;
+    case OP_ORI:  return a | imm;
+    case OP_XORI: return a ^ imm;
+    case OP_LUI:  return imm;
+    case OP_MUL:  return a * b;
+    case OP_SLT:  return static_cast<int32_t>(a) < static_cast<int32_t>(b);
+    case OP_SLTU: return a < b;
+    case OP_LOAD: case OP_STORE: return a + imm;
+    case OP_BEQ:  return a == b;
+    case OP_BNE:  return a != b;
+    case OP_BLT:  return static_cast<int32_t>(a) < static_cast<int32_t>(b);
+    case OP_BGE:  return static_cast<int32_t>(a) >= static_cast<int32_t>(b);
+    default:      return 0;
+  }
+}
+
+const int32_t kAluOps[] = {OP_ADD, OP_SUB, OP_AND, OP_OR, OP_XOR, OP_SLL,
+                           OP_SRL, OP_SRA, OP_ADDI, OP_ANDI, OP_ORI, OP_XORI,
+                           OP_LUI, OP_SLT, OP_SLTU};
+const int32_t kBranchOps[] = {OP_BEQ, OP_BNE, OP_BLT, OP_BGE};
+
+}  // namespace
+
+extern "C" {
+
+int32_t shrewd_generate_trace(const WorkloadParams* p, int32_t* opcode,
+                              int32_t* dst, int32_t* src1, int32_t* src2,
+                              uint32_t* imm, int32_t* taken,
+                              uint32_t* init_reg, uint32_t* init_mem) {
+  if (p->n <= 0 || p->nphys <= 0 || (p->nphys & (p->nphys - 1)) ||
+      p->mem_words <= 0 || (p->mem_words & (p->mem_words - 1)))
+    return 1;
+  const double fsum = p->frac_alu + p->frac_mul + p->frac_load +
+                      p->frac_store + p->frac_branch;
+  if (fsum > 1.0 + 1e-9) return 2;
+  const int32_t ws = p->working_set_words < p->mem_words ? p->working_set_words
+                                                         : p->mem_words;
+  if (ws <= 0) return 3;
+
+  Rng rng(p->seed);
+  std::vector<uint32_t> reg(p->nphys), mem(p->mem_words);
+  for (auto& r : reg) r = rng.u32();
+  for (auto& m : mem) m = rng.u32();
+  std::memcpy(init_reg, reg.data(), p->nphys * 4);
+  std::memcpy(init_mem, mem.data(), p->mem_words * 4);
+
+  std::vector<int32_t> recent;
+  recent.reserve(128);
+  auto pick_src = [&]() -> int32_t {
+    if (!recent.empty() && rng.uniform() < p->locality) {
+      int d = rng.geometric(p->reuse_geo_p);
+      if (d > (int)recent.size()) d = (int)recent.size();
+      return recent[recent.size() - d];
+    }
+    return (int32_t)rng.below(p->nphys);
+  };
+
+  for (int32_t i = 0; i < p->n; ++i) {
+    const double u = rng.uniform();
+    const double t_alu = p->frac_alu;
+    const double t_mul = t_alu + p->frac_mul;
+    const double t_load = t_mul + p->frac_load;
+    const double t_store = t_load + p->frac_store;
+    const double t_branch = t_store + p->frac_branch;
+    int32_t op, d = 0, s1 = 0, s2 = 0;
+    uint32_t im = 0;
+    if (u < t_alu) {
+      op = kAluOps[rng.below(15)];
+      s1 = pick_src(); s2 = pick_src();
+      d = (int32_t)rng.below(p->nphys);
+      im = (uint32_t)rng.below(1 << 16);
+    } else if (u < t_mul) {
+      op = OP_MUL;
+      s1 = pick_src(); s2 = pick_src();
+      d = (int32_t)rng.below(p->nphys);
+    } else if (u < t_store) {
+      op = (u < t_load) ? OP_LOAD : OP_STORE;
+      s1 = pick_src(); s2 = pick_src();
+      d = (int32_t)rng.below(p->nphys);
+      const uint32_t word = (uint32_t)rng.below(ws);
+      im = word * 4u - reg[s1];  // effective address lands on `word`
+    } else if (u < t_branch) {
+      op = kBranchOps[rng.below(4)];
+      s1 = pick_src(); s2 = pick_src();
+    } else {
+      op = OP_NOP;
+    }
+
+    opcode[i] = op; dst[i] = d; src1[i] = s1; src2[i] = s2; imm[i] = im;
+    taken[i] = 0;
+
+    // execute
+    const uint32_t a = reg[s1], b = reg[s2];
+    const uint32_t res = alu32(op, a, b, im);
+    if (op == OP_LOAD) {
+      reg[d] = mem[res >> 2];
+      recent.push_back(d);
+    } else if (op == OP_STORE) {
+      mem[res >> 2] = b;
+    } else if (op >= OP_BEQ && op <= OP_BGE) {
+      taken[i] = (int32_t)res;
+    } else if ((op >= OP_ADD && op <= OP_SLTU)) {
+      reg[d] = res;
+      recent.push_back(d);
+    }
+    if (recent.size() > 64) recent.erase(recent.begin(), recent.end() - 64);
+  }
+  return 0;
+}
+
+}  // extern "C"

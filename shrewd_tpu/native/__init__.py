@@ -1,0 +1,186 @@
+"""ctypes bindings to the native C++ runtime (csrc/).
+
+The Python↔C++ boundary of the framework — the counterpart of the reference's
+pybind11 layer (``src/python/pybind11/``, ``SimObject.getCCObject()``), done
+with ctypes per the environment (no pybind11).  Builds ``libshrewd.so`` on
+demand via the csrc Makefile.
+"""
+
+from __future__ import annotations
+
+import ctypes as ct
+import subprocess
+from pathlib import Path
+
+import numpy as np
+
+from shrewd_tpu.utils import debug
+
+_CSRC = Path(__file__).resolve().parent.parent.parent / "csrc"
+_LIB_PATH = _CSRC / "libshrewd.so"
+_lib = None
+
+
+class _TraceView(ct.Structure):
+    _fields_ = [
+        ("opcode", ct.POINTER(ct.c_int32)),
+        ("dst", ct.POINTER(ct.c_int32)),
+        ("src1", ct.POINTER(ct.c_int32)),
+        ("src2", ct.POINTER(ct.c_int32)),
+        ("imm", ct.POINTER(ct.c_uint32)),
+        ("taken", ct.POINTER(ct.c_int32)),
+        ("n", ct.c_int32),
+        ("nphys", ct.c_int32),
+        ("mem_words", ct.c_int32),
+    ]
+
+
+class _FaultView(ct.Structure):
+    _fields_ = [
+        ("kind", ct.POINTER(ct.c_int32)),
+        ("cycle", ct.POINTER(ct.c_int32)),
+        ("entry", ct.POINTER(ct.c_int32)),
+        ("bit", ct.POINTER(ct.c_int32)),
+        ("shadow_u", ct.POINTER(ct.c_float)),
+        ("n_trials", ct.c_int32),
+    ]
+
+
+class _WorkloadParams(ct.Structure):
+    _fields_ = [
+        ("seed", ct.c_uint64),
+        ("n", ct.c_int32),
+        ("nphys", ct.c_int32),
+        ("mem_words", ct.c_int32),
+        ("working_set_words", ct.c_int32),
+        ("frac_alu", ct.c_float),
+        ("frac_mul", ct.c_float),
+        ("frac_load", ct.c_float),
+        ("frac_store", ct.c_float),
+        ("frac_branch", ct.c_float),
+        ("locality", ct.c_float),
+        ("reuse_geo_p", ct.c_float),
+    ]
+
+
+def build(force: bool = False) -> Path:
+    """Compile libshrewd.so if missing (or force)."""
+    if force or not _LIB_PATH.exists():
+        debug.dprintf("Native", "building %s", _LIB_PATH)
+        subprocess.run(["make", "-C", str(_CSRC)] + (["-B"] if force else []),
+                       check=True, capture_output=True)
+    return _LIB_PATH
+
+
+def lib() -> ct.CDLL:
+    global _lib
+    if _lib is None:
+        build()
+        _lib = ct.CDLL(str(_LIB_PATH))
+        _lib.shrewd_golden_trials.restype = ct.c_int32
+        _lib.shrewd_generate_trace.restype = ct.c_int32
+    return _lib
+
+
+def _i32p(a: np.ndarray):
+    return a.ctypes.data_as(ct.POINTER(ct.c_int32))
+
+
+def _u32p(a: np.ndarray):
+    return a.ctypes.data_as(ct.POINTER(ct.c_uint32))
+
+
+def _ascontig(a, dtype) -> np.ndarray:
+    return np.ascontiguousarray(np.asarray(a), dtype=dtype)
+
+
+def _trace_view(trace, arrays_keepalive: list) -> _TraceView:
+    fields = {}
+    for name, dt in (("opcode", np.int32), ("dst", np.int32),
+                     ("src1", np.int32), ("src2", np.int32),
+                     ("imm", np.uint32), ("taken", np.int32)):
+        arr = _ascontig(getattr(trace, name), dt)
+        arrays_keepalive.append(arr)
+        fields[name] = arr
+    return _TraceView(
+        opcode=_i32p(fields["opcode"]), dst=_i32p(fields["dst"]),
+        src1=_i32p(fields["src1"]), src2=_i32p(fields["src2"]),
+        imm=_u32p(fields["imm"]), taken=_i32p(fields["taken"]),
+        n=trace.n, nphys=trace.nphys, mem_words=trace.mem_words)
+
+
+def golden_replay(trace) -> tuple[np.ndarray, np.ndarray]:
+    """Fault-free native replay → (final_reg, final_mem)."""
+    keep: list = []
+    tv = _trace_view(trace, keep)
+    init_reg = _ascontig(trace.init_reg, np.uint32)
+    init_mem = _ascontig(trace.init_mem, np.uint32)
+    out_reg = np.empty_like(init_reg)
+    out_mem = np.empty_like(init_mem)
+    lib().shrewd_golden_replay(ct.byref(tv), _u32p(init_reg), _u32p(init_mem),
+                               _u32p(out_reg), _u32p(out_mem))
+    return out_reg, out_mem
+
+
+def golden_trials(trace, kinds, cycles, entries, bits, shadow_us,
+                  coverage, compare_regs: bool = True) -> np.ndarray:
+    """Serial C++ trial batch → outcomes int32[n_trials].
+
+    The differential oracle for TrialKernel.run_batch and the serial-baseline
+    denominator for the bench.
+    """
+    keep: list = []
+    tv = _trace_view(trace, keep)
+    init_reg = _ascontig(trace.init_reg, np.uint32)
+    init_mem = _ascontig(trace.init_mem, np.uint32)
+    kinds = _ascontig(kinds, np.int32)
+    cycles = _ascontig(cycles, np.int32)
+    entries = _ascontig(entries, np.int32)
+    bits = _ascontig(bits, np.int32)
+    shadow_us = _ascontig(shadow_us, np.float32)
+    cov = _ascontig(coverage, np.float32)
+    n = len(kinds)
+    if not (len(cycles) == len(entries) == len(bits) == len(shadow_us) == n):
+        raise ValueError("fault field lengths differ")
+    fv = _FaultView(
+        kind=_i32p(kinds), cycle=_i32p(cycles), entry=_i32p(entries),
+        bit=_i32p(bits),
+        shadow_u=shadow_us.ctypes.data_as(ct.POINTER(ct.c_float)),
+        n_trials=n)
+    out = np.empty(n, dtype=np.int32)
+    ran = lib().shrewd_golden_trials(
+        ct.byref(tv), _u32p(init_reg), _u32p(init_mem), ct.byref(fv),
+        cov.ctypes.data_as(ct.POINTER(ct.c_float)),
+        ct.c_int32(1 if compare_regs else 0), _i32p(out))
+    assert ran == n
+    return out
+
+
+def generate_trace(seed: int, n: int, nphys: int, mem_words: int,
+                   working_set_words: int, frac_alu=0.50, frac_mul=0.05,
+                   frac_load=0.20, frac_store=0.12, frac_branch=0.08,
+                   locality=0.8, reuse_geo_p=0.3):
+    """Native workload engine → Trace (fast path for large windows)."""
+    from shrewd_tpu.trace.format import Trace
+    p = _WorkloadParams(
+        seed=seed, n=n, nphys=nphys, mem_words=mem_words,
+        working_set_words=working_set_words, frac_alu=frac_alu,
+        frac_mul=frac_mul, frac_load=frac_load, frac_store=frac_store,
+        frac_branch=frac_branch, locality=locality, reuse_geo_p=reuse_geo_p)
+    opcode = np.empty(n, dtype=np.int32)
+    dst = np.empty(n, dtype=np.int32)
+    src1 = np.empty(n, dtype=np.int32)
+    src2 = np.empty(n, dtype=np.int32)
+    imm = np.empty(n, dtype=np.uint32)
+    taken = np.empty(n, dtype=np.int32)
+    init_reg = np.empty(nphys, dtype=np.uint32)
+    init_mem = np.empty(mem_words, dtype=np.uint32)
+    rc = lib().shrewd_generate_trace(
+        ct.byref(p), _i32p(opcode), _i32p(dst), _i32p(src1), _i32p(src2),
+        _u32p(imm), _i32p(taken), _u32p(init_reg), _u32p(init_mem))
+    if rc != 0:
+        raise ValueError(f"shrewd_generate_trace failed with code {rc}")
+    t = Trace(opcode=opcode, dst=dst, src1=src1, src2=src2, imm=imm,
+              taken=taken, init_reg=init_reg, init_mem=init_mem)
+    t.validate()
+    return t

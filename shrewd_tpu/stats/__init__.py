@@ -272,22 +272,28 @@ class Group:
         object.__setattr__(self, "_groups", {})
 
     def __setattr__(self, key, value):
+        # Validate BEFORE mutating the registry so a rejected rebind leaves
+        # the existing registrations intact.
+        old = getattr(self, key, None)
+        if isinstance(value, StatBase):
+            clash = self._stats.get(value.name)
+            if clash is not None and clash is not old:
+                raise ValueError(
+                    f"duplicate stat name {value.name!r} in group {self.name!r}")
+        elif isinstance(value, Group):
+            clash = self._groups.get(value.name)
+            if clash is not None and clash is not old:
+                raise ValueError(
+                    f"duplicate subgroup name {value.name!r} in group {self.name!r}")
         # rebinding an attribute drops its previous registration (only if the
         # registration actually points at the object being replaced)
-        old = getattr(self, key, None)
         if isinstance(old, StatBase) and self._stats.get(old.name) is old:
             del self._stats[old.name]
         elif isinstance(old, Group) and self._groups.get(old.name) is old:
             del self._groups[old.name]
         if isinstance(value, StatBase):
-            if value.name in self._stats:
-                raise ValueError(
-                    f"duplicate stat name {value.name!r} in group {self.name!r}")
             self._stats[value.name] = value
         elif isinstance(value, Group):
-            if value.name in self._groups:
-                raise ValueError(
-                    f"duplicate subgroup name {value.name!r} in group {self.name!r}")
             self._groups[value.name] = value
         object.__setattr__(self, key, value)
 

@@ -2,8 +2,14 @@
 
 The multi-chip analog of the reference's dist-gem5-on-localhost / NULL-build
 testing posture (SURVEY §4): all sharding tests run on
-``--xla_force_host_platform_device_count=8`` without TPU hardware.  Must run
-before the first jax import anywhere in the test process.
+``--xla_force_host_platform_device_count=8`` without TPU hardware.
+
+IMPORTANT: this image's sitecustomize imports jax at interpreter startup with
+``JAX_PLATFORMS=axon`` (the TPU tunnel), so jax's config default is already
+baked by the time conftest runs — mutating ``os.environ`` here is NOT enough.
+``jax.config.update("jax_platforms", ...)`` is authoritative post-import, and
+XLA_FLAGS must be set before the first CPU backend *initialization* (lazy),
+which no code has triggered yet at conftest import time.
 """
 
 import os
@@ -13,4 +19,11 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
-os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_report_header(config):
+    return f"jax devices: {jax.device_count()} ({jax.default_backend()})"

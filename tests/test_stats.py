@@ -95,6 +95,19 @@ def test_group_duplicate_name_rejected():
     assert [n for n, _, _ in g.rows()] == ["g.x"]
 
 
+def test_group_rejected_rebind_keeps_registry_intact():
+    g = Group("g")
+    g.a = Scalar("x")
+    g.b = Scalar("y")
+    with pytest.raises(ValueError):
+        g.b = Scalar("x")        # clashes with g.a's name
+    # g.b's original stat must still be registered and dumpable
+    assert sorted(n for n, _, _ in g.rows()) == ["g.x", "g.y"]
+    # renaming an attribute to a stat with the SAME name is fine
+    g.a = Scalar("x", "replacement")
+    assert sorted(n for n, _, _ in g.rows()) == ["g.x", "g.y"]
+
+
 def test_distribution_weights():
     d = Distribution("d", 0, 10, 10)
     d.sample([1.0, 2.0], weights=2.0)           # scalar broadcast
