@@ -1,0 +1,95 @@
+"""Headline benchmark: fault-injection trials/sec/chip.
+
+Runs the flagship SFI campaign step (vmapped inject→propagate→classify over a
+4096-µop SimPoint window, regfile structure) on the default JAX device and
+compares against the serial native C++ golden kernel on this host — the
+stand-in for the reference's serial campaign path (BASELINE configs[0]; the
+reference repo publishes no numbers, BASELINE.md).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "trials/sec/chip", "vs_baseline": N}
+
+Progress goes to stderr.  --quick shrinks shapes for CI smoke runs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true", help="tiny shapes (CI smoke)")
+    ap.add_argument("--batch", type=int, default=None, help="trials per batch")
+    ap.add_argument("--uops", type=int, default=None, help="window length")
+    ap.add_argument("--reps", type=int, default=3, help="timed repetitions")
+    args = ap.parse_args()
+
+    n_uops = args.uops or (256 if args.quick else 4096)
+    batch = args.batch or (256 if args.quick else 8192)
+    nphys = 256
+    mem_words = 1024 if args.quick else 4096
+
+    import jax
+
+    from shrewd_tpu import native
+    from shrewd_tpu.models.o3 import O3Config
+    from shrewd_tpu.ops.trial import TrialKernel
+    from shrewd_tpu.utils import prng
+
+    dev = jax.devices()[0]
+    log(f"device: {dev} | window={n_uops} µops, batch={batch}")
+
+    trace = native.generate_trace(seed=1, n=n_uops, nphys=nphys,
+                                  mem_words=mem_words,
+                                  working_set_words=mem_words // 4)
+    kernel = TrialKernel(trace, O3Config())
+    keys = prng.trial_keys(prng.campaign_key(0), batch)
+
+    # device path: compile, then steady-state timing
+    t0 = time.monotonic()
+    tally = np.asarray(kernel.run_keys(keys, "regfile"))
+    log(f"compile+first batch: {time.monotonic() - t0:.1f}s tally={tally}")
+    rates = []
+    for _ in range(args.reps):
+        t0 = time.monotonic()
+        np.asarray(kernel.run_keys(keys, "regfile"))
+        rates.append(batch / (time.monotonic() - t0))
+    device_rate = max(rates)
+    log(f"device: {device_rate:,.0f} trials/s")
+
+    # serial C++ baseline on the same trace (sample of trials, extrapolated)
+    n_base = min(batch, 512 if args.quick else 2048)
+    faults = kernel.sampler("regfile").sample_batch(keys[:n_base])
+    fk, fc, fe, fb, fs = (np.asarray(x) for x in faults)
+    cov = np.asarray(kernel.cfg.shadow_coverage, dtype=np.float32)
+    t0 = time.monotonic()
+    base_out = native.golden_trials(trace, fk, fc, fe, fb, fs, cov)
+    base_rate = n_base / (time.monotonic() - t0)
+    log(f"serial C++ baseline: {base_rate:,.0f} trials/s")
+
+    # cross-check: device and serial outcomes agree on the sampled subset
+    dev_out = np.asarray(kernel.run_batch(faults))
+    mismatches = int((dev_out != base_out).sum())
+    if mismatches:
+        log(f"WARNING: {mismatches}/{n_base} outcome mismatches vs oracle")
+
+    print(json.dumps({
+        "metric": "sfi_trials_per_sec_per_chip",
+        "value": round(device_rate, 1),
+        "unit": "trials/sec/chip",
+        "vs_baseline": round(device_rate / base_rate, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

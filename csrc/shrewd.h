@@ -94,4 +94,47 @@ int32_t shrewd_generate_trace(const WorkloadParams* p, int32_t* opcode,
 
 }  // extern "C"
 
+// --- shared µop semantics (single definition for golden kernel + engine) ---
+// Must stay bit-identical to shrewd_tpu/isa/semantics.py and ops/replay.py;
+// tests/test_native_diff.py enforces the contract.
+
+inline uint32_t shrewd_alu(int32_t op, uint32_t a, uint32_t b, uint32_t imm) {
+  const uint32_t sh = b & 31u;
+  switch (op) {
+    case OP_NOP:  return 0;
+    case OP_ADD:  return a + b;
+    case OP_SUB:  return a - b;
+    case OP_AND:  return a & b;
+    case OP_OR:   return a | b;
+    case OP_XOR:  return a ^ b;
+    case OP_SLL:  return a << sh;
+    case OP_SRL:  return a >> sh;
+    case OP_SRA:  return static_cast<uint32_t>(static_cast<int32_t>(a) >> sh);
+    case OP_ADDI: return a + imm;
+    case OP_ANDI: return a & imm;
+    case OP_ORI:  return a | imm;
+    case OP_XORI: return a ^ imm;
+    case OP_LUI:  return imm;
+    case OP_MUL:  return a * b;
+    case OP_SLT:  return static_cast<int32_t>(a) < static_cast<int32_t>(b);
+    case OP_SLTU: return a < b;
+    case OP_LOAD: case OP_STORE: return a + imm;  // effective address
+    case OP_BEQ:  return a == b;
+    case OP_BNE:  return a != b;
+    case OP_BLT:  return static_cast<int32_t>(a) < static_cast<int32_t>(b);
+    case OP_BGE:  return static_cast<int32_t>(a) >= static_cast<int32_t>(b);
+    default:      return 0;
+  }
+}
+
+inline int32_t shrewd_opclass(int32_t op) {
+  switch (op) {
+    case OP_NOP:   return OC_NONE;
+    case OP_MUL:   return OC_INT_MULT;
+    case OP_LOAD:  return OC_MEM_READ;
+    case OP_STORE: return OC_MEM_WRITE;
+    default:       return OC_INT_ALU;
+  }
+}
+
 #endif  // SHREWD_NATIVE_H

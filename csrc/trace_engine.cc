@@ -38,34 +38,7 @@ struct Rng {
   }
 };
 
-inline uint32_t alu32(int32_t op, uint32_t a, uint32_t b, uint32_t imm) {
-  const uint32_t sh = b & 31u;
-  switch (op) {
-    case OP_NOP:  return 0;
-    case OP_ADD:  return a + b;
-    case OP_SUB:  return a - b;
-    case OP_AND:  return a & b;
-    case OP_OR:   return a | b;
-    case OP_XOR:  return a ^ b;
-    case OP_SLL:  return a << sh;
-    case OP_SRL:  return a >> sh;
-    case OP_SRA:  return static_cast<uint32_t>(static_cast<int32_t>(a) >> sh);
-    case OP_ADDI: return a + imm;
-    case OP_ANDI: return a & imm;
-    case OP_ORI:  return a | imm;
-    case OP_XORI: return a ^ imm;
-    case OP_LUI:  return imm;
-    case OP_MUL:  return a * b;
-    case OP_SLT:  return static_cast<int32_t>(a) < static_cast<int32_t>(b);
-    case OP_SLTU: return a < b;
-    case OP_LOAD: case OP_STORE: return a + imm;
-    case OP_BEQ:  return a == b;
-    case OP_BNE:  return a != b;
-    case OP_BLT:  return static_cast<int32_t>(a) < static_cast<int32_t>(b);
-    case OP_BGE:  return static_cast<int32_t>(a) >= static_cast<int32_t>(b);
-    default:      return 0;
-  }
-}
+constexpr auto alu32 = shrewd_alu;
 
 const int32_t kAluOps[] = {OP_ADD, OP_SUB, OP_AND, OP_OR, OP_XOR, OP_SLL,
                            OP_SRL, OP_SRA, OP_ADDI, OP_ANDI, OP_ORI, OP_XORI,

@@ -64,11 +64,15 @@ class _WorkloadParams(ct.Structure):
 
 
 def build(force: bool = False) -> Path:
-    """Compile libshrewd.so if missing (or force)."""
-    if force or not _LIB_PATH.exists():
-        debug.dprintf("Native", "building %s", _LIB_PATH)
+    """Compile libshrewd.so (make is timestamp-aware, so this is a cheap
+    no-op when the binary is fresh — and picks up csrc edits when not)."""
+    debug.dprintf("Native", "building %s", _LIB_PATH)
+    try:
         subprocess.run(["make", "-C", str(_CSRC)] + (["-B"] if force else []),
-                       check=True, capture_output=True)
+                       check=True, capture_output=True, text=True)
+    except subprocess.CalledProcessError as e:
+        raise RuntimeError(
+            f"native build failed:\n{e.stdout}\n{e.stderr}") from e
     return _LIB_PATH
 
 

@@ -177,9 +177,15 @@ def replay(tr: TraceArrays, init_reg: jax.Array, init_mem: jax.Array,
 
     xs = (jnp.arange(n, dtype=i32), tr.opcode, tr.dst, tr.src1, tr.src2,
           tr.imm, tr.taken, tr.opclass)
-    init = (init_reg.astype(u32), init_mem.astype(u32),
-            jnp.bool_(True), jnp.bool_(False), jnp.bool_(False),
-            jnp.bool_(False))
+    # Derive the initial carry from the fault so its "varying" type under
+    # shard_map matches the step outputs (the carry depends on the per-trial
+    # fault after one step; an unvarying init would fail scan's type check).
+    # Use `cycle`, which is always per-trial-sampled — `kind` can be a
+    # structure-wide constant and would stay unvarying.
+    vary0 = (fault.cycle * 0).astype(u32)         # varying zero
+    vary_false = fault.cycle != fault.cycle       # varying False
+    init = (init_reg.astype(u32) ^ vary0, init_mem.astype(u32) ^ vary0,
+            ~vary_false, vary_false, vary_false, vary_false)
     (reg, mem, _live, detected, trapped, diverged), _ = jax.lax.scan(
         step, init, xs)
     return ReplayResult(reg=reg, mem=mem, detected=detected,

@@ -1,0 +1,9 @@
+from shrewd_tpu.parallel import campaign, mesh, stopping
+from shrewd_tpu.parallel.campaign import (CampaignResult, ShardedCampaign,
+                                          run_until_ci)
+from shrewd_tpu.parallel.mesh import (TRIAL_AXIS, init_distributed, make_mesh,
+                                      shard_keys)
+
+__all__ = ["CampaignResult", "ShardedCampaign", "TRIAL_AXIS", "campaign",
+           "init_distributed", "make_mesh", "mesh", "run_until_ci",
+           "shard_keys", "stopping"]

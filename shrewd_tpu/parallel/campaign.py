@@ -1,0 +1,117 @@
+"""Sharded campaign step: vmap over trials within a chip, shard_map over the
+mesh, psum tally reduction.
+
+The TPU-native replacement of the reference's campaign fan-out (SURVEY §2.12
+P3: ``multisim`` host multiprocessing / one gem5 process per config): one
+jitted SPMD program runs ``batch_size`` trials spread across every device and
+returns the (replicated) outcome tally; the host loop accumulates tallies and
+applies the CI stopping rule (stopping.py).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from shrewd_tpu.ops import classify as C
+from shrewd_tpu.parallel import stopping
+from shrewd_tpu.parallel.mesh import TRIAL_AXIS, shard_keys
+from shrewd_tpu.utils import debug, prng
+
+debug.register_flag("CampaignStep", "per-batch sharded campaign steps")
+
+
+class ShardedCampaign:
+    """One (trace, structure) campaign compiled over a mesh."""
+
+    def __init__(self, kernel, mesh, structure: str):
+        self.kernel = kernel
+        self.mesh = mesh
+        self.structure = structure
+        sampler = kernel.sampler(structure)
+        golden = kernel.golden
+        compare_regs = kernel.cfg.compare_regs
+
+        def local_step(keys):
+            faults = sampler.sample_batch(keys)
+            results = jax.vmap(kernel._replay_one)(faults)
+            outs = jax.vmap(
+                lambda r: C.classify(r, golden, compare_regs))(results)
+            return jax.lax.psum(C.tally(outs), TRIAL_AXIS)
+
+        self._step = jax.jit(jax.shard_map(
+            local_step, mesh=mesh,
+            in_specs=P(TRIAL_AXIS), out_specs=P()))
+
+    def tally_batch(self, keys: jax.Array) -> jax.Array:
+        """Sharded keys (B,) → replicated tally (N_OUTCOMES,)."""
+        return self._step(shard_keys(self.mesh, keys))
+
+
+class CampaignResult(NamedTuple):
+    structure: str
+    tallies: np.ndarray          # (N_OUTCOMES,)
+    trials: int
+    batches: int
+    avf: float
+    avf_interval: stopping.Interval
+    sdc_interval: stopping.Interval
+    wall_seconds: float
+    trials_per_second: float
+    converged: bool
+
+
+def run_until_ci(campaign: ShardedCampaign, *, seed: int, simpoint_id: int,
+                 structure_id: int, batch_size: int = 4096,
+                 target_halfwidth: float = 0.01, confidence: float = 0.95,
+                 max_trials: int = 1_000_000, min_trials: int = 1000,
+                 start_batch: int = 0,
+                 initial_tallies: np.ndarray | None = None) -> CampaignResult:
+    """Accumulate batches until the AVF CI is tight enough (the north-star
+    wall-clock loop).  ``start_batch``/``initial_tallies`` resume a
+    checkpointed campaign without replaying old batches."""
+    sk = prng.structure_key(
+        prng.simpoint_key(prng.campaign_key(seed), simpoint_id), structure_id)
+    tallies = (np.zeros(C.N_OUTCOMES, dtype=np.int64)
+               if initial_tallies is None
+               else np.asarray(initial_tallies, dtype=np.int64).copy())
+    trials = int(tallies.sum())
+    batch_id = start_batch
+    t0 = time.monotonic()
+    converged = False
+    while trials < max_trials:
+        keys = prng.trial_keys(prng.batch_key(sk, batch_id), batch_size)
+        t = np.asarray(campaign.tally_batch(keys), dtype=np.int64)
+        tallies += t
+        trials += batch_size
+        batch_id += 1
+        vulnerable = int(tallies[C.OUTCOME_SDC] + tallies[C.OUTCOME_DUE])
+        debug.dprintf("CampaignStep", "%s batch %d: trials=%d avf=%.4f",
+                      campaign.structure, batch_id, trials,
+                      vulnerable / max(trials, 1))
+        if stopping.should_stop(vulnerable, trials, target_halfwidth,
+                                confidence, min_trials):
+            converged = True
+            break
+    wall = time.monotonic() - t0
+    vulnerable = int(tallies[C.OUTCOME_SDC] + tallies[C.OUTCOME_DUE])
+    return CampaignResult(
+        structure=campaign.structure,
+        tallies=tallies,
+        trials=trials,
+        batches=batch_id - start_batch,
+        avf=vulnerable / max(trials, 1),
+        avf_interval=stopping.wilson(vulnerable, trials, confidence),
+        sdc_interval=stopping.wilson(
+            int(tallies[C.OUTCOME_SDC]), trials, confidence),
+        wall_seconds=wall,
+        trials_per_second=(trials - int(0 if initial_tallies is None
+                                        else initial_tallies.sum())) / wall
+        if wall > 0 else float("inf"),
+        converged=converged,
+    )

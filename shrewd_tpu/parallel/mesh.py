@@ -1,0 +1,60 @@
+"""Device-mesh helpers.
+
+The framework's scaling fabric: where the reference scales with parallel
+event queues (SURVEY §2.12 P1), dist-gem5 TCP barriers (P2), and multisim
+process fan-out (P3), the TPU design uses one ``jax.sharding.Mesh`` with a
+``trials`` data-parallel axis; collectives (psum of tallies) ride ICI/DCN and
+the explicit barrier machinery disappears (SURVEY §5.8).
+
+Multi-host: call ``init_distributed()`` once per process before mesh
+creation — the ``jax.distributed`` analog of dist-gem5's launcher handshake
+(``util/dist/gem5-dist.sh``).
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+TRIAL_AXIS = "trials"
+
+
+def init_distributed(coordinator_address: str | None = None,
+                     num_processes: int | None = None,
+                     process_id: int | None = None) -> None:
+    """Initialize multi-host JAX (no-op when single-process).
+
+    Replaces the reference's hand-rolled TCP barrier layer
+    (``dev/net/dist_iface.hh:102``, ``tcp_iface.hh:62``): after this, XLA
+    collectives provide synchronization implicitly.
+    """
+    if num_processes is None or num_processes <= 1:
+        return
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+
+
+def make_mesh(devices=None) -> Mesh:
+    """A 1-D mesh over all (or the given) devices on the trial axis.
+
+    Trials are embarrassingly parallel, so one DP axis is the natural
+    topology; tallies reduce with a single psum. A 2-D (dp × structure)
+    mesh is a later refinement once per-structure campaigns co-schedule.
+    """
+    if devices is None:
+        devices = jax.devices()
+    return Mesh(np.asarray(devices), (TRIAL_AXIS,))
+
+
+def shard_keys(mesh: Mesh, keys: jax.Array) -> jax.Array:
+    """Place a per-trial key batch sharded across the trial axis."""
+    n = keys.shape[0]
+    if n % mesh.size:
+        raise ValueError(f"batch size {n} not divisible by mesh size {mesh.size}")
+    return jax.device_put(keys, NamedSharding(mesh, P(TRIAL_AXIS)))
+
+
+def replicated(mesh: Mesh, x) -> jax.Array:
+    return jax.device_put(x, NamedSharding(mesh, P()))

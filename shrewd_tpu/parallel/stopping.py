@@ -1,0 +1,73 @@
+"""Confidence-interval estimation and early stopping.
+
+The statistical machinery of the north star ("wall-clock to AVF ±1% CI"):
+AVF is a binomial proportion over trials; the campaign stops when the
+interval half-width reaches the target.  Wilson intervals avoid the Wald
+interval's collapse at p→0/1 (SDC rates near 1e-5 in the replication DSE),
+and stopping on a *fixed precision* rather than sequential significance keeps
+the early-stop bias negligible (SURVEY §7 hard part #5).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import NamedTuple
+
+# two-sided z for common confidence levels
+_Z = {0.90: 1.6448536269514722, 0.95: 1.959963984540054,
+      0.99: 2.5758293035489004}
+
+
+def z_value(confidence: float) -> float:
+    if confidence in _Z:
+        return _Z[confidence]
+    # Acklam-style rational approximation is overkill here; bisect the
+    # complementary error function instead (exact enough for stopping).
+    lo, hi = 0.0, 10.0
+    target = (1.0 + confidence) / 2.0
+    for _ in range(80):
+        mid = (lo + hi) / 2
+        if 0.5 * (1.0 + math.erf(mid / math.sqrt(2.0))) < target:
+            lo = mid
+        else:
+            hi = mid
+    return (lo + hi) / 2
+
+
+class Interval(NamedTuple):
+    estimate: float    # point estimate (Wilson center is used for bounds)
+    lo: float
+    hi: float
+
+    @property
+    def halfwidth(self) -> float:
+        return (self.hi - self.lo) / 2.0
+
+
+def wilson(successes: float, trials: float, confidence: float = 0.95) -> Interval:
+    """Wilson score interval for a binomial proportion."""
+    if trials <= 0:
+        return Interval(float("nan"), 0.0, 1.0)
+    z = z_value(confidence)
+    p = successes / trials
+    denom = 1.0 + z * z / trials
+    center = (p + z * z / (2 * trials)) / denom
+    margin = (z / denom) * math.sqrt(
+        p * (1 - p) / trials + z * z / (4 * trials * trials))
+    return Interval(p, max(0.0, center - margin), min(1.0, center + margin))
+
+
+def should_stop(successes: float, trials: float, target_halfwidth: float,
+                confidence: float = 0.95, min_trials: int = 1000) -> bool:
+    """The campaign stopping rule: enough trials AND CI tight enough."""
+    if trials < min_trials:
+        return False
+    return wilson(successes, trials, confidence).halfwidth <= target_halfwidth
+
+
+def trials_needed(p_guess: float, target_halfwidth: float,
+                  confidence: float = 0.95) -> int:
+    """Planning estimate: trials for a Wald-width target at proportion p."""
+    z = z_value(confidence)
+    p = min(max(p_guess, 1e-12), 1 - 1e-12)
+    return int(math.ceil(z * z * p * (1 - p) / (target_halfwidth ** 2)))

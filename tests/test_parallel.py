@@ -1,0 +1,109 @@
+"""Multi-device campaign tests on the 8-device virtual CPU mesh — the
+dist-on-localhost analog (SURVEY §4 tier 5)."""
+
+import jax
+import numpy as np
+import pytest
+
+from shrewd_tpu.models.o3 import O3Config
+from shrewd_tpu.ops import classify as C
+from shrewd_tpu.ops.trial import TrialKernel
+from shrewd_tpu.parallel import (ShardedCampaign, make_mesh, run_until_ci,
+                                 shard_keys, stopping)
+from shrewd_tpu.trace.synth import WorkloadConfig, generate
+from shrewd_tpu.utils import prng
+
+
+@pytest.fixture(scope="module")
+def kernel():
+    t = generate(WorkloadConfig(n=256, nphys=64, mem_words=256,
+                                working_set_words=128, seed=33))
+    return TrialKernel(t)
+
+
+def test_mesh_has_8_devices():
+    m = make_mesh()
+    assert m.size == 8
+
+
+def test_sharded_tally_matches_single_device(kernel):
+    """The SPMD path must produce exactly the single-device tallies —
+    determinism across sharding layouts (the PRNG discipline's promise)."""
+    m = make_mesh()
+    camp = ShardedCampaign(kernel, m, "regfile")
+    keys = prng.trial_keys(prng.campaign_key(5), 128)
+    sharded = np.asarray(camp.tally_batch(keys))
+    single = np.asarray(kernel.run_keys(keys, "regfile"))
+    np.testing.assert_array_equal(sharded, single)
+    assert sharded.sum() == 128
+
+
+def test_shard_keys_rejects_indivisible(kernel):
+    m = make_mesh()
+    keys = prng.trial_keys(prng.campaign_key(0), 12)
+    with pytest.raises(ValueError):
+        shard_keys(m, keys)
+
+
+def test_run_until_ci_converges(kernel):
+    m = make_mesh()
+    camp = ShardedCampaign(kernel, m, "regfile")
+    res = run_until_ci(camp, seed=0, simpoint_id=0, structure_id=0,
+                       batch_size=512, target_halfwidth=0.05,
+                       max_trials=100_000, min_trials=500)
+    assert res.converged
+    assert res.trials == res.tallies.sum()
+    assert res.avf_interval.halfwidth <= 0.05
+    assert 0.0 <= res.avf <= 1.0
+    assert res.trials_per_second > 0
+
+
+def test_run_until_ci_resume_is_exact(kernel):
+    """Resuming from a checkpointed (tallies, batch) must give the same
+    final tallies as an uninterrupted run."""
+    m = make_mesh()
+    camp = ShardedCampaign(kernel, m, "fu")
+    full = run_until_ci(camp, seed=1, simpoint_id=0, structure_id=1,
+                        batch_size=256, target_halfwidth=1e-9,
+                        max_trials=1024, min_trials=1)
+    # run 2 batches, "checkpoint", resume for the remaining 2
+    part1 = run_until_ci(camp, seed=1, simpoint_id=0, structure_id=1,
+                         batch_size=256, target_halfwidth=1e-9,
+                         max_trials=512, min_trials=1)
+    part2 = run_until_ci(camp, seed=1, simpoint_id=0, structure_id=1,
+                         batch_size=256, target_halfwidth=1e-9,
+                         max_trials=1024, min_trials=1,
+                         start_batch=part1.batches,
+                         initial_tallies=part1.tallies)
+    np.testing.assert_array_equal(full.tallies, part2.tallies)
+
+
+# --- stopping math ---
+
+def test_wilson_basics():
+    iv = stopping.wilson(50, 100)
+    assert iv.estimate == pytest.approx(0.5)
+    assert iv.lo < 0.5 < iv.hi
+    # tighter with more trials
+    iv2 = stopping.wilson(5000, 10000)
+    assert iv2.halfwidth < iv.halfwidth
+    # doesn't collapse at p=0
+    iv0 = stopping.wilson(0, 1000)
+    assert iv0.hi > 0
+
+
+def test_should_stop():
+    assert not stopping.should_stop(5, 10, 0.5)          # below min_trials
+    assert stopping.should_stop(500, 10000, 0.05, min_trials=100)
+    assert not stopping.should_stop(500, 1000, 0.001, min_trials=100)
+
+
+def test_z_value_bisection_matches_table():
+    assert stopping.z_value(0.95) == pytest.approx(1.959964, abs=1e-5)
+    assert stopping.z_value(0.98) == pytest.approx(2.326348, abs=1e-4)
+
+
+def test_trials_needed_monotone():
+    assert (stopping.trials_needed(0.5, 0.01)
+            > stopping.trials_needed(0.5, 0.02)
+            > stopping.trials_needed(0.05, 0.02))
