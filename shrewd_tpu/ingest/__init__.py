@@ -1,0 +1,22 @@
+"""Snapshot ingestion: golden-run artifacts → typed arrays.
+
+The bridge from the reference's serial C++ campaign to device state
+(SURVEY §7 build-order step 1): parse checkpoint (`m5.cpt` ini format,
+reference ``src/sim/serialize.hh:68-85``), ``config.ini``/``config.json``
+elaboration dumps (``src/python/m5/simulate.py:106-124``), and ``stats.txt``
+(``src/base/stats/text.cc``), then lift architectural state into the replay
+kernel's initial-state arrays.
+"""
+
+from shrewd_tpu.ingest.cpt import (ArchSnapshot, CheckpointIn, CheckpointOut,
+                                   load_arch_snapshot, write_arch_snapshot)
+from shrewd_tpu.ingest.configfile import load_config_ini, load_config_json
+from shrewd_tpu.ingest.statsfile import load_stats_txt
+from shrewd_tpu.ingest.warm import window_from_snapshot
+
+__all__ = [
+    "ArchSnapshot", "CheckpointIn", "CheckpointOut",
+    "load_arch_snapshot", "write_arch_snapshot",
+    "load_config_ini", "load_config_json", "load_stats_txt",
+    "window_from_snapshot",
+]

@@ -47,13 +47,33 @@ class WorkloadConfig(ConfigObject):
     seed = Param(int, 0, "generator seed")
 
 
-def generate(cfg: WorkloadConfig) -> Trace:
+def generate(cfg: WorkloadConfig, init_reg: np.ndarray | None = None,
+             init_mem: np.ndarray | None = None,
+             capture_at: int | None = None):
+    """Generate a window. ``init_reg``/``init_mem`` override the random
+    initial machine state — the restore path for ingested checkpoints
+    (ingest/warm.py) where the state comes from a golden gem5 run.
+
+    ``capture_at=k`` additionally returns the machine state after the first
+    k µops retire (``(trace, reg_k, mem_k)``) — the generator already
+    executes every µop, so warmup capture costs nothing extra."""
     rng = np.random.default_rng(cfg.seed)
     nphys, n = cfg.nphys, cfg.n
     ws = min(cfg.working_set_words, cfg.mem_words)
 
-    reg = rng.integers(0, 1 << 32, size=nphys, dtype=np.uint32)
-    mem = rng.integers(0, 1 << 32, size=cfg.mem_words, dtype=np.uint32)
+    if init_reg is None:
+        reg = rng.integers(0, 1 << 32, size=nphys, dtype=np.uint32)
+    else:
+        if init_reg.shape != (nphys,):
+            raise ValueError(f"init_reg shape {init_reg.shape} != ({nphys},)")
+        reg = np.asarray(init_reg, dtype=np.uint32).copy()
+    if init_mem is None:
+        mem = rng.integers(0, 1 << 32, size=cfg.mem_words, dtype=np.uint32)
+    else:
+        if init_mem.shape != (cfg.mem_words,):
+            raise ValueError(
+                f"init_mem shape {init_mem.shape} != ({cfg.mem_words},)")
+        mem = np.asarray(init_mem, dtype=np.uint32).copy()
     init_reg, init_mem = reg.copy(), mem.copy()
 
     opcode = np.zeros(n, dtype=np.int32)
@@ -77,7 +97,10 @@ def generate(cfg: WorkloadConfig) -> Trace:
         raise ValueError("instruction-mix fractions exceed 1")
     kinds = rng.choice(6, size=n, p=np.append(probs, 1.0 - probs.sum()))
 
+    captured: tuple[np.ndarray, np.ndarray] | None = None
     for i in range(n):
+        if capture_at is not None and i == capture_at:
+            captured = (reg.copy(), mem.copy())
         kind = kinds[i]
         if kind == 0:                 # ALU
             op = int(_ALU_OPS[rng.integers(len(_ALU_OPS))])
@@ -122,4 +145,8 @@ def generate(cfg: WorkloadConfig) -> Trace:
     trace = Trace(opcode=opcode, dst=dst, src1=src1, src2=src2, imm=imm,
                   taken=taken, init_reg=init_reg, init_mem=init_mem)
     trace.validate()
-    return trace
+    if capture_at is None:
+        return trace
+    if captured is None:                   # capture_at == n (or beyond)
+        captured = (reg.copy(), mem.copy())
+    return trace, captured[0], captured[1]
