@@ -1,0 +1,19 @@
+"""Campaign layer: plans, orchestration, checkpoint/resume.
+
+The framework's top-level automation tier — the analog of the reference's
+campaign driver + stdlib Simulator stack (``x86_spec/x86-spec-cpu2017.py``,
+``python/gem5/simulate/simulator.py``), re-shaped for batched TPU execution:
+a *plan* (simpoints × structures × precision targets) elaborates into sharded
+trial kernels; the orchestrator advances them batch-by-batch, owns the stats
+tree and the output directory, and can checkpoint/resume campaign progress
+(the framework's own serialization — JSON + tally arrays — replacing
+ini-format ``m5.cpt`` for campaign state).
+"""
+
+from shrewd_tpu.campaign.plan import (CampaignPlan, CheckpointSpec,
+                                      SimPointSpec, TraceFileSpec,
+                                      WorkloadSpec)
+from shrewd_tpu.campaign.orchestrator import Orchestrator
+
+__all__ = ["CampaignPlan", "SimPointSpec", "WorkloadSpec", "TraceFileSpec",
+           "CheckpointSpec", "Orchestrator"]

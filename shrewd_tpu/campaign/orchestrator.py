@@ -1,0 +1,277 @@
+"""Campaign orchestrator: advance, observe, checkpoint, resume.
+
+Drives a ``CampaignPlan`` over a device mesh batch-by-batch. Control shape
+follows the reference's Simulator/exit-event inversion (SURVEY §3.1: C++
+simulates, Python orchestrates): here the jitted sharded step is the hot
+path, and this host loop only consumes tallies, updates stats, applies the
+stopping rule, and emits typed events that ``sim.Simulator`` maps to user
+generators.
+
+Campaign checkpoint/resume replaces the reference's ``m5.cpt`` machinery
+(``sim/serialize.hh:169``) for *campaign* state: progress is a JSON document
+plus tally arrays; per-trial state never needs saving because the PRNG
+discipline (utils/prng.py) makes any batch re-derivable from its coordinates.
+Batch boundaries are the natural drain points (the Drainable analog,
+``sim/drain.hh:234``: the orchestrator only checkpoints between batches, when
+no device computation is in flight).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Iterator, NamedTuple
+
+import numpy as np
+
+from shrewd_tpu import stats as statsmod
+from shrewd_tpu.campaign.plan import CampaignPlan
+from shrewd_tpu.models.o3 import STRUCTURES
+from shrewd_tpu.ops import classify as C
+from shrewd_tpu.ops.trial import TrialKernel
+from shrewd_tpu.parallel import stopping
+from shrewd_tpu.parallel.campaign import ShardedCampaign
+from shrewd_tpu.parallel.mesh import make_mesh
+from shrewd_tpu.sim.exit_event import ExitEvent
+from shrewd_tpu.utils import debug, prng
+
+debug.register_flag("Campaign", "orchestrator progress")
+
+CKPT_VERSION = 1
+
+
+class BatchInfo(NamedTuple):
+    simpoint: str
+    structure: str
+    batch_id: int           # id of the batch just completed
+    trials: int             # cumulative trials for this (simpoint, structure)
+    tallies: np.ndarray     # cumulative outcome tallies
+    avf: float
+
+
+class StructureResult(NamedTuple):
+    simpoint: str
+    structure: str
+    tallies: np.ndarray
+    trials: int
+    avf: float
+    avf_interval: stopping.Interval
+    sdc_interval: stopping.Interval
+    converged: bool
+    wall_seconds: float
+
+
+class _State:
+    """Mutable per-(simpoint, structure) progress."""
+
+    def __init__(self):
+        self.tallies = np.zeros(C.N_OUTCOMES, dtype=np.int64)
+        self.next_batch = 0
+        self.converged = False
+        self.done = False
+
+    @property
+    def trials(self) -> int:
+        return int(self.tallies.sum())
+
+    def to_dict(self) -> dict:
+        return {"tallies": self.tallies.tolist(),
+                "next_batch": self.next_batch,
+                "converged": self.converged, "done": self.done}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "_State":
+        st = cls()
+        st.tallies = np.asarray(d["tallies"], dtype=np.int64)
+        st.next_batch = int(d["next_batch"])
+        st.converged = bool(d["converged"])
+        st.done = bool(d["done"])
+        return st
+
+
+def _structure_id(structure: str) -> int:
+    """Canonical id independent of plan ordering (PRNG stability across
+    resumes and plan edits)."""
+    return list(STRUCTURES).index(structure)
+
+
+class Orchestrator:
+    def __init__(self, plan: CampaignPlan, mesh=None, outdir: str | None = None):
+        self.plan = plan
+        self.mesh = mesh if mesh is not None else make_mesh()
+        self.outdir = outdir
+        self.state: dict[tuple[str, str], _State] = {
+            (sp.name, s): _State()
+            for sp in plan.simpoints for s in plan.structures}
+        self.results: dict[tuple[str, str], StructureResult] = {}
+        self._kernels: dict[int, TrialKernel] = {}
+        self._campaigns: dict[tuple[int, str], ShardedCampaign] = {}
+        self._build_stats()
+
+    # --- stats tree (statistics::Group bound to the object tree) ---
+
+    def _build_stats(self) -> None:
+        self.stats = statsmod.Group("campaign")
+        for sp in self.plan.simpoints:
+            g = statsmod.Group(sp.name)
+            setattr(self.stats, f"sp_{sp.name}", g)
+            for s in self.plan.structures:
+                sg = statsmod.Group(s)
+                setattr(g, f"st_{s}", sg)
+                sg.trials = statsmod.Scalar("trials", "trials run")
+                sg.outcomes = statsmod.Vector(
+                    "outcomes", C.N_OUTCOMES, "outcome tally",
+                    subnames=list(C.OUTCOME_NAMES))
+                st = self.state[(sp.name, s)]
+                sg.avf = statsmod.Formula(
+                    "avf", lambda st=st: float(C.avf(st.tallies)),
+                    "(SDC+DUE)/trials")
+        # refresh from restored state (resume path)
+        for (spn, s), st in self.state.items():
+            sg = getattr(getattr(self.stats, f"sp_{spn}"), f"st_{s}")
+            sg.trials.set(st.trials)
+            sg.outcomes.reset()
+            sg.outcomes += st.tallies
+
+    # --- lazy elaboration ---
+
+    def kernel(self, sp_idx: int) -> TrialKernel:
+        if sp_idx not in self._kernels:
+            trace = self.plan.simpoints[sp_idx].build_trace()
+            self._kernels[sp_idx] = TrialKernel(trace, self.plan.machine)
+        return self._kernels[sp_idx]
+
+    def campaign(self, sp_idx: int, structure: str) -> ShardedCampaign:
+        key = (sp_idx, structure)
+        if key not in self._campaigns:
+            self._campaigns[key] = ShardedCampaign(
+                self.kernel(sp_idx), self.mesh, structure)
+        return self._campaigns[key]
+
+    # --- the drive loop ---
+
+    def events(self) -> Iterator[tuple[ExitEvent, object]]:
+        """Advance the whole plan, yielding control at every typed event."""
+        plan = self.plan
+        for sp_idx, sp in enumerate(plan.simpoints):
+            for structure in plan.structures:
+                st = self.state[(sp.name, structure)]
+                if st.done:
+                    continue
+                yield from self._run_structure(sp_idx, sp.name, structure, st)
+            yield ExitEvent.SIMPOINT_COMPLETE, sp.name
+        yield ExitEvent.CAMPAIGN_COMPLETE, dict(self.results)
+
+    def _run_structure(self, sp_idx: int, sp_name: str, structure: str,
+                       st: _State) -> Iterator[tuple[ExitEvent, object]]:
+        plan = self.plan
+        camp = self.campaign(sp_idx, structure)
+        sk = prng.structure_key(
+            prng.simpoint_key(prng.campaign_key(plan.seed), sp_idx),
+            _structure_id(structure))
+        sg = getattr(getattr(self.stats, f"sp_{sp_name}"), f"st_{structure}")
+        t0 = time.monotonic()
+        while True:
+            # stopping rule first, so a resumed campaign re-evaluates the
+            # restored tallies instead of running one extra batch (the
+            # checkpoint may have been cut between a batch and its check)
+            vulnerable = int(st.tallies[C.OUTCOME_SDC] +
+                             st.tallies[C.OUTCOME_DUE])
+            avf_now = vulnerable / max(st.trials, 1)
+            converged = st.trials > 0 and stopping.should_stop(
+                vulnerable, st.trials, plan.target_halfwidth,
+                plan.confidence, plan.min_trials)
+            capped = st.trials >= plan.max_trials
+            if converged or capped:
+                st.converged = converged
+                st.done = True
+                result = StructureResult(
+                    simpoint=sp_name, structure=structure,
+                    tallies=st.tallies.copy(), trials=st.trials,
+                    avf=avf_now,
+                    avf_interval=stopping.wilson(vulnerable, st.trials,
+                                                 plan.confidence),
+                    sdc_interval=stopping.wilson(
+                        int(st.tallies[C.OUTCOME_SDC]), st.trials,
+                        plan.confidence),
+                    converged=converged,
+                    wall_seconds=time.monotonic() - t0)
+                self.results[(sp_name, structure)] = result
+                yield (ExitEvent.CI_CONVERGED if converged
+                       else ExitEvent.MAX_TRIALS), result
+                return
+
+            keys = prng.trial_keys(prng.batch_key(sk, st.next_batch),
+                                   plan.batch_size)
+            tally = np.asarray(camp.tally_batch(keys), dtype=np.int64)
+            st.tallies += tally
+            st.next_batch += 1
+            sg.trials += plan.batch_size
+            sg.outcomes += tally
+            avf_live = float(C.avf(st.tallies))
+            debug.dprintf("Campaign", "%s/%s batch %d: trials=%d avf=%.4f",
+                          sp_name, structure, st.next_batch, st.trials,
+                          avf_live)
+            yield ExitEvent.BATCH_COMPLETE, BatchInfo(
+                sp_name, structure, st.next_batch - 1, st.trials,
+                st.tallies.copy(), avf_live)
+
+            if (plan.checkpoint_every and self.outdir and
+                    st.next_batch % plan.checkpoint_every == 0):
+                yield ExitEvent.CHECKPOINT, self.checkpoint()
+
+    # --- outputs (the m5out contract) ---
+
+    def write_outputs(self) -> None:
+        """outdir/{config.json, stats.txt, stats.json} — the reference's run
+        artifacts (``python/m5/main.py:227-248``, ``base/stats/text.cc``)."""
+        if not self.outdir:
+            return
+        os.makedirs(self.outdir, exist_ok=True)
+        self.plan.dump_json(os.path.join(self.outdir, "config.json"))
+        with open(os.path.join(self.outdir, "stats.txt"), "w") as f:
+            statsmod.dump_text(self.stats, f)
+        with open(os.path.join(self.outdir, "stats.json"), "w") as f:
+            statsmod.dump_json(self.stats, f)
+
+    # --- campaign checkpoint/resume ---
+
+    def checkpoint(self, ckpt_dir: str | None = None) -> str:
+        """Write campaign progress; any batch is re-derivable from its
+        coordinates, so this plus the plan is the whole campaign state."""
+        if ckpt_dir is None:
+            if not self.outdir:
+                raise ValueError("no outdir and no explicit ckpt_dir")
+            ckpt_dir = os.path.join(self.outdir, "campaign_ckpt")
+        os.makedirs(ckpt_dir, exist_ok=True)
+        state_doc: dict[str, dict] = {}
+        for (spn, s), st in self.state.items():
+            state_doc.setdefault(spn, {})[s] = st.to_dict()
+        doc = {
+            "version": CKPT_VERSION,
+            "plan": self.plan.to_dict(),
+            "state": state_doc,
+        }
+        tmp = os.path.join(ckpt_dir, "campaign.json.tmp")
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=1, default=str)
+        os.replace(tmp, os.path.join(ckpt_dir, "campaign.json"))
+        return ckpt_dir
+
+    @classmethod
+    def resume(cls, ckpt_dir: str, mesh=None,
+               outdir: str | None = None) -> "Orchestrator":
+        with open(os.path.join(ckpt_dir, "campaign.json")) as f:
+            doc = json.load(f)
+        if doc.get("version") != CKPT_VERSION:
+            raise ValueError(
+                f"campaign checkpoint version {doc.get('version')} != "
+                f"{CKPT_VERSION} (write an upgrader — cpt_upgraders analog)")
+        plan = CampaignPlan.from_dict(doc["plan"])
+        orch = cls(plan, mesh=mesh, outdir=outdir)
+        for spn, per_structure in doc["state"].items():
+            for s, st_doc in per_structure.items():
+                orch.state[(spn, s)] = _State.from_dict(st_doc)
+        orch._build_stats()   # rebind formulas/counters to restored state
+        return orch

@@ -1,0 +1,106 @@
+"""Campaign plans: the declarative description of an SFI sweep.
+
+A ``CampaignPlan`` is the framework's entry-point config — the counterpart of
+the reference's driver script arguments (``x86_spec/x86-spec-cpu2017.py:229-319``)
+expressed in the typed config system, so a full campaign is reproducible from
+its ``config.json`` dump alone (the reproducibility contract of
+``m5.instantiate``'s config dumps, ``python/m5/simulate.py:106-124``).
+
+SimPoint sources polymorph over ``SimPointSpec``:
+
+- ``WorkloadSpec``   — synthesize a window (traffic-generator tier);
+- ``TraceFileSpec``  — load a captured ``.npz`` window (ElasticTrace analog);
+- ``CheckpointSpec`` — ingest a gem5 checkpoint, restore + re-warm
+  (SURVEY §5.4).
+"""
+
+from __future__ import annotations
+
+from shrewd_tpu.models.o3 import O3Config, STRUCTURES
+from shrewd_tpu.trace import synth
+from shrewd_tpu.trace.format import Trace
+from shrewd_tpu.utils.config import (Child, ConfigObject, Param, VectorParam)
+
+
+class SimPointSpec(ConfigObject):
+    """Abstract source of one SimPoint's replay window."""
+
+    name = Param(str, "simpoint", "label used in stats/output paths")
+
+    def build_trace(self) -> Trace:
+        raise NotImplementedError
+
+
+class WorkloadSpec(SimPointSpec):
+    """Synthetic window (tests / benchmarks / artifact-free runs)."""
+
+    workload = Child(synth.WorkloadConfig)
+
+    def build_trace(self) -> Trace:
+        return synth.generate(self.workload)
+
+
+class TraceFileSpec(SimPointSpec):
+    """A captured window on disk (.npz, trace/format.py)."""
+
+    path = Param(str, desc="path to the .npz trace")
+
+    def build_trace(self) -> Trace:
+        from shrewd_tpu.trace import format as tf
+        trace, _meta = tf.load(self.path)
+        return trace
+
+
+class CheckpointSpec(SimPointSpec):
+    """Restore a gem5 checkpoint and re-warm (ingest/warm.py)."""
+
+    cpt_dir = Param(str, desc="checkpoint directory containing m5.cpt")
+    thread = Param(int, 0, "thread context index")
+    warmup = Param(int, 1024, "µops retired functionally before capture")
+    workload = Child(synth.WorkloadConfig)
+
+    def build_trace(self) -> Trace:
+        from shrewd_tpu.ingest import load_arch_snapshot, window_from_snapshot
+        snap = load_arch_snapshot(self.cpt_dir, self.thread)
+        return window_from_snapshot(snap, self.workload, self.warmup)
+
+
+def _valid_structures(names: list[str]) -> bool:
+    return all(n in STRUCTURES for n in names)
+
+
+class CampaignPlan(ConfigObject):
+    """The full sweep: simpoints × structures × precision target."""
+
+    structures = VectorParam(str, ["regfile", "fu"],
+                             "structures to measure per simpoint",
+                             check=_valid_structures)
+    batch_size = Param(int, 4096, "trials per sharded batch")
+    target_halfwidth = Param(float, 0.01, "CI half-width stopping target "
+                             "(north star: AVF ±1%)")
+    confidence = Param(float, 0.95, "CI confidence level")
+    max_trials = Param(int, 1_000_000, "per-(simpoint,structure) trial cap")
+    min_trials = Param(int, 1000, "trials before the stop rule may fire")
+    seed = Param(int, 0, "campaign PRNG seed")
+    checkpoint_every = Param(int, 0,
+                             "batches between campaign checkpoints (0=off)")
+    machine = Child(O3Config)
+
+    def __init__(self, simpoints: list[SimPointSpec] | None = None, **kw):
+        super().__init__(**kw)
+        self.simpoints: list[SimPointSpec] = list(simpoints or [])
+
+    # simpoints are a variable-length polymorphic list, which the static
+    # Child-slot system doesn't model; extend the dump/load round-trip.
+    def to_dict(self) -> dict:
+        out = super().to_dict()
+        out["simpoints"] = [sp.to_dict() for sp in self.simpoints]
+        return out
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "CampaignPlan":
+        d = dict(d)
+        sps = [SimPointSpec.from_dict(s) for s in d.pop("simpoints", [])]
+        plan = super().from_dict(d)
+        plan.simpoints = sps
+        return plan

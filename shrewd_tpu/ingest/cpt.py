@@ -162,8 +162,14 @@ class ArchSnapshot(NamedTuple):
     pc: int
     int_regs: np.ndarray      # uint64[n_int]
     float_regs: np.ndarray    # uint64[n_float]
-    mem: np.ndarray           # uint8[range_size] flat physical image
+    mem: np.ndarray           # uint8 — stores concatenated in section order
     thread_section: str
+    # (section, size) per memory store. The cpt format records no base
+    # address per store (the reference restores by object identity,
+    # physical.cc:442-449; address ranges live in config.ini) — so for
+    # multi-store checkpoints, flat offsets into `mem` are per-store
+    # offsets plus the preceding stores' sizes, NOT physical addresses.
+    store_layout: tuple[tuple[str, int], ...] = ()
 
 
 def _thread_sections(cpt: CheckpointIn) -> list[str]:
@@ -172,18 +178,31 @@ def _thread_sections(cpt: CheckpointIn) -> list[str]:
 
 
 def load_arch_snapshot(cpt_dir: str, thread: int = 0) -> ArchSnapshot:
-    """Lift one thread's architectural state + the physical memory image."""
+    """Lift one thread's architectural state + the physical memory image.
+
+    Multi-store checkpoints concatenate store images in numeric section
+    order; see ``ArchSnapshot.store_layout`` for the boundaries (the cpt
+    format itself carries no per-store base address).
+    """
     cpt = CheckpointIn(cpt_dir)
     threads = _thread_sections(cpt)
     if not threads:
         raise ValueError(f"{cpt_dir}: no thread context (regs.integer) found")
+    if not 0 <= thread < len(threads):
+        raise ValueError(
+            f"{cpt_dir}: thread index {thread} out of range — checkpoint has "
+            f"{len(threads)} thread context(s): {threads}")
     tsec = threads[thread]
 
-    int_regs = cpt.get_bytes(tsec, "regs.integer")
-    if int_regs.size % 8:
-        raise ValueError(f"[{tsec}] regs.integer: {int_regs.size} bytes "
-                         f"is not a whole uint64 count")
-    float_regs = (cpt.get_bytes(tsec, "regs.floating_point")
+    def regs(entry: str) -> np.ndarray:
+        arr = cpt.get_bytes(tsec, entry)
+        if arr.size % 8:
+            raise ValueError(f"[{tsec}] {entry}: {arr.size} bytes "
+                             f"is not a whole uint64 count")
+        return arr
+
+    int_regs = regs("regs.integer")
+    float_regs = (regs("regs.floating_point")
                   if cpt.entry_exists(tsec, "regs.floating_point")
                   else np.zeros(0, np.uint8))
 
@@ -191,6 +210,7 @@ def load_arch_snapshot(cpt_dir: str, thread: int = 0) -> ArchSnapshot:
                      and "range_size" in e), key=_numeric_aware_key)
     images = [cpt.load_store(s)[1] for s in stores]
     mem = (np.concatenate(images) if images else np.zeros(0, np.uint8))
+    layout = tuple((s, int(img.size)) for s, img in zip(stores, images))
 
     return ArchSnapshot(
         cur_tick=cpt.get_int("Globals", "curTick"),
@@ -201,6 +221,7 @@ def load_arch_snapshot(cpt_dir: str, thread: int = 0) -> ArchSnapshot:
                     np.zeros(0, np.uint64)),
         mem=mem,
         thread_section=tsec,
+        store_layout=layout,
     )
 
 

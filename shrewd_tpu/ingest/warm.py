@@ -40,8 +40,14 @@ def lift_registers(snap: ArchSnapshot, nphys: int) -> np.ndarray:
     hi = (arch >> np.uint64(32)).astype(np.uint32)
     inter = np.empty(2 * arch.size, dtype=np.uint32)
     inter[0::2], inter[1::2] = lo, hi
-    n_arch = min(inter.size, nphys)
-    out[:n_arch] = inter[:n_arch]
+    if inter.size > nphys:
+        raise ValueError(
+            f"snapshot carries {arch.size} integer registers "
+            f"({inter.size} uint32 halves) but nphys={nphys}; dropping "
+            f"architectural state would silently corrupt the golden replay — "
+            f"use nphys >= {inter.size}")
+    n_arch = inter.size
+    out[:n_arch] = inter
     if nphys > n_arch:
         idx = np.arange(n_arch, nphys, dtype=np.uint64)
         mix = (idx * np.uint64(0x9E3779B97F4A7C15)

@@ -1,0 +1,6 @@
+"""Simulation-control tier: exit events + the Simulator automation API."""
+
+from shrewd_tpu.sim.exit_event import ExitEvent
+from shrewd_tpu.sim.simulator import Simulator
+
+__all__ = ["ExitEvent", "Simulator"]

@@ -1,0 +1,28 @@
+"""Typed exit events — the framework↔user automation contract.
+
+The reference's public automation API is the ``ExitEvent`` enum plus a
+user-generator mapping (``python/gem5/simulate/exit_event.py:39-58``,
+``simulator.py:208``; SURVEY §A.4 records it as the protocol to keep). The
+TPU campaign's control points differ — there is no guest OS raising
+hypercalls — so the event *vocabulary* is campaign-shaped, but the protocol
+(event → generator, ``yield True`` stops the run) is the same.
+"""
+
+from __future__ import annotations
+
+import enum
+
+
+class ExitEvent(enum.Enum):
+    # one sharded trial batch finished (payload: BatchInfo)
+    BATCH_COMPLETE = "batch_complete"
+    # a (simpoint, structure) campaign met its CI target (payload: result)
+    CI_CONVERGED = "ci_converged"
+    # a (simpoint, structure) campaign hit its trial cap unconverged
+    MAX_TRIALS = "max_trials"
+    # campaign checkpoint written (payload: checkpoint dir)
+    CHECKPOINT = "checkpoint"
+    # one simpoint finished all structures (payload: simpoint name)
+    SIMPOINT_COMPLETE = "simpoint_complete"
+    # the whole plan finished (payload: {(simpoint, structure): result})
+    CAMPAIGN_COMPLETE = "campaign_complete"

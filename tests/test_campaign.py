@@ -1,0 +1,148 @@
+"""Campaign orchestrator / Simulator / checkpoint-resume tests.
+
+Runs on the virtual 8-device CPU mesh (conftest sets
+xla_force_host_platform_device_count) — the multi-chip-on-localhost test
+pattern (SURVEY §4 tier 5: dist-gem5 on localhost / NULL-build analogs).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from shrewd_tpu.campaign import (CampaignPlan, Orchestrator, WorkloadSpec)
+from shrewd_tpu.campaign.orchestrator import BatchInfo, StructureResult
+from shrewd_tpu.ingest import load_stats_txt
+from shrewd_tpu.ops import classify as C
+from shrewd_tpu.sim import ExitEvent, Simulator
+from shrewd_tpu.trace.synth import WorkloadConfig
+
+
+def _tiny_plan(**kw) -> CampaignPlan:
+    sps = [WorkloadSpec(name="w0",
+                        workload=WorkloadConfig(n=96, nphys=32, mem_words=64,
+                                                working_set_words=32, seed=7)),
+           WorkloadSpec(name="w1",
+                        workload=WorkloadConfig(n=96, nphys=32, mem_words=64,
+                                                working_set_words=32, seed=8))]
+    defaults = dict(structures=["regfile", "fu"], batch_size=64,
+                    target_halfwidth=0.2, confidence=0.95,
+                    max_trials=256, min_trials=64)
+    defaults.update(kw)
+    return CampaignPlan(simpoints=sps, **defaults)
+
+
+def test_plan_round_trip():
+    plan = _tiny_plan()
+    doc = plan.to_dict()
+    back = CampaignPlan.from_dict(json.loads(json.dumps(doc)))
+    assert [sp.name for sp in back.simpoints] == ["w0", "w1"]
+    assert back.simpoints[0].workload.n == 96
+    assert back.structures == ["regfile", "fu"]
+    assert back.batch_size == 64
+
+
+def test_orchestrator_runs_to_completion():
+    orch = Orchestrator(_tiny_plan())
+    events = list(orch.events())
+    kinds = [e for e, _ in events]
+    assert kinds.count(ExitEvent.SIMPOINT_COMPLETE) == 2
+    assert kinds[-1] == ExitEvent.CAMPAIGN_COMPLETE
+    results = events[-1][1]
+    assert set(results) == {("w0", "regfile"), ("w0", "fu"),
+                            ("w1", "regfile"), ("w1", "fu")}
+    for r in results.values():
+        assert isinstance(r, StructureResult)
+        assert r.trials > 0 and r.tallies.sum() == r.trials
+        assert 0.0 <= r.avf <= 1.0
+        assert r.converged or r.trials >= 256
+
+
+def test_batch_events_carry_progress():
+    orch = Orchestrator(_tiny_plan())
+    batches = [p for e, p in orch.events() if e is ExitEvent.BATCH_COMPLETE]
+    assert all(isinstance(b, BatchInfo) for b in batches)
+    w0 = [b for b in batches if b.simpoint == "w0" and b.structure == "regfile"]
+    assert [b.batch_id for b in w0] == list(range(len(w0)))
+    assert w0[-1].trials == 64 * len(w0)
+
+
+def test_simulator_handler_stops_run(tmp_path):
+    plan = _tiny_plan(max_trials=100000, target_halfwidth=0.001)
+
+    def stop_after(n):
+        seen = 0
+        while True:
+            seen += 1
+            yield seen >= n
+
+    sim = Simulator(plan, outdir=str(tmp_path / "out"),
+                    on_exit_event={ExitEvent.BATCH_COMPLETE: stop_after(3)})
+    results = sim.run()
+    assert sim.last_event is ExitEvent.BATCH_COMPLETE
+    assert sim.last_payload.batch_id == 2          # stopped on third batch
+    assert results == {}                            # nothing converged yet
+    # outputs still written on early stop
+    assert (tmp_path / "out" / "stats.txt").exists()
+
+
+def test_simulator_runs_and_writes_outputs(tmp_path):
+    out = tmp_path / "m5out"
+    sim = Simulator(_tiny_plan(), outdir=str(out))
+    results = sim.run()
+    assert len(results) == 4
+    blocks = load_stats_txt(str(out / "stats.txt"))
+    assert len(blocks) == 1
+    stats = blocks[0]
+    r = results[("w0", "regfile")]
+    assert stats["campaign.w0.regfile.trials"] == r.trials
+    assert stats["campaign.w0.regfile.outcomes::sdc"] == \
+        r.tallies[C.OUTCOME_SDC]
+    assert stats["campaign.w0.regfile.avf"] == pytest.approx(r.avf)
+    cfg = json.loads((out / "config.json").read_text())
+    assert cfg["type"] == "CampaignPlan"
+    assert len(cfg["simpoints"]) == 2
+
+
+def test_checkpoint_resume_bitwise_equal(tmp_path):
+    """A resumed campaign must produce bitwise-identical final tallies —
+    the PRNG-discipline reproducibility contract."""
+    plan = _tiny_plan(checkpoint_every=1)
+    # straight-through run
+    orch_a = Orchestrator(plan)
+    events_a = list(orch_a.events())
+    final_a = events_a[-1][1]
+
+    # run that checkpoints and is killed after the first CHECKPOINT event
+    out = str(tmp_path / "out")
+    orch_b = Orchestrator(_tiny_plan(checkpoint_every=1), outdir=out)
+    ckpt_dir = None
+    for ev, payload in orch_b.events():
+        if ev is ExitEvent.CHECKPOINT:
+            ckpt_dir = payload
+            break
+    assert ckpt_dir is not None and os.path.exists(
+        os.path.join(ckpt_dir, "campaign.json"))
+
+    # resume and finish
+    orch_c = Orchestrator.resume(ckpt_dir, outdir=out)
+    mid_trials = {k: st.trials for k, st in orch_c.state.items()}
+    assert any(t > 0 for t in mid_trials.values())
+    events_c = list(orch_c.events())
+    final_c = events_c[-1][1]
+
+    assert set(final_a) == set(final_c)
+    for k in final_a:
+        np.testing.assert_array_equal(final_a[k].tallies, final_c[k].tallies)
+        assert final_a[k].trials == final_c[k].trials
+
+
+def test_resume_rejects_bad_version(tmp_path):
+    orch = Orchestrator(_tiny_plan(), outdir=str(tmp_path))
+    ckpt = orch.checkpoint()
+    doc = json.loads((tmp_path / "campaign_ckpt" / "campaign.json").read_text())
+    doc["version"] = 99
+    (tmp_path / "campaign_ckpt" / "campaign.json").write_text(json.dumps(doc))
+    with pytest.raises(ValueError, match="version"):
+        Orchestrator.resume(ckpt)

@@ -90,6 +90,24 @@ def test_missing_entry_raises(cpt_dir):
         cpt.find("Globals", "nonesuch")
 
 
+def test_bad_thread_index_raises(cpt_dir):
+    with pytest.raises(ValueError, match="thread index 1 out of range"):
+        load_arch_snapshot(cpt_dir, thread=1)
+    with pytest.raises(ValueError, match="out of range"):
+        load_arch_snapshot(cpt_dir, thread=-1)
+
+
+def test_store_layout_recorded(cpt_dir):
+    snap = load_arch_snapshot(cpt_dir)
+    assert snap.store_layout == (("system.physmem.store0", 32),)
+
+
+def test_lift_registers_rejects_truncation():
+    snap = _mk_snapshot(nregs=8)           # 16 uint32 halves
+    with pytest.raises(ValueError, match="nphys >= 16"):
+        lift_registers(snap, 8)
+
+
 # --- config.ini -------------------------------------------------------------
 
 class _Leaf(ConfigObject):
