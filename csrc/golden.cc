@@ -35,10 +35,21 @@ TrialResult replay(const TraceView& tr, uint32_t* reg, uint32_t* mem,
     // 1. storage-fault landing
     if (kind == KIND_REGFILE && i == fcycle) reg[fentry] ^= bitmask;
 
-    const int32_t op = tr.opcode[i];
+    int32_t op = tr.opcode[i];
     const bool at_uop = (i == fentry);
 
-    // 2. operand read with IQ index faults
+    // 2. operand read — latch-field faults first (MinorCPU model): a
+    // flipped opcode may leave the legal range (illegal µop → DUE), a
+    // flipped immediate just propagates through execute.
+    uint32_t imm = tr.imm[i];
+    if (kind == KIND_LATCH_OP && at_uop) {
+      op ^= index_mask;
+      if (op >= N_OPCODES || op < 0) {
+        r.trapped = true;
+        return r;
+      }
+    }
+    if (kind == KIND_LATCH_IMM && at_uop) imm ^= bitmask;
     int32_t s1 = tr.src1[i];
     int32_t s2 = tr.src2[i];
     if (kind == KIND_IQ_SRC1 && at_uop) s1 = (s1 ^ index_mask) & idx_mask;
@@ -47,7 +58,7 @@ TrialResult replay(const TraceView& tr, uint32_t* reg, uint32_t* mem,
     const uint32_t b = reg[s2];
 
     // 3. execute
-    uint32_t eff = alu(op, a, b, tr.imm[i]);
+    uint32_t eff = alu(op, a, b, imm);
     if (kind == KIND_FU && at_uop) {
       eff ^= bitmask;
       if (shadow_u < coverage[opclass_of(op)]) {  // shadow FU re-executes
@@ -79,15 +90,15 @@ TrialResult replay(const TraceView& tr, uint32_t* reg, uint32_t* mem,
       }
     }
 
-    // 5. branch resolution
-    if (is_br) {
-      const bool cond = eff != 0;
-      if (cond != (tr.taken[i] != 0)) {
-        r.diverged = true;
-        return r;
-      }
-      continue;
+    // 5. branch resolution — effective control flow vs the golden outcome;
+    // covers opcode latch flips that turn a branch into a non-branch and
+    // vice versa (taken is 0 for non-branches)
+    const bool taken_eff = is_br && (eff != 0);
+    if (taken_eff != (tr.taken[i] != 0)) {
+      r.diverged = true;
+      return r;
     }
+    if (is_br) continue;
 
     // 6. writeback with ROB dest-index fault
     const bool writes = (op >= OP_ADD && op <= OP_SLTU) || is_ld;

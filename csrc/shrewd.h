@@ -31,7 +31,7 @@ enum OpClass : int32_t {
 // --- fault kinds (mirror shrewd_tpu/models/o3.py) ---
 enum FaultKind : int32_t {
   KIND_NONE = 0, KIND_REGFILE, KIND_FU, KIND_ROB_DST, KIND_IQ_SRC1,
-  KIND_IQ_SRC2, KIND_LSQ_ADDR, KIND_LSQ_DATA
+  KIND_IQ_SRC2, KIND_LSQ_ADDR, KIND_LSQ_DATA, KIND_LATCH_OP, KIND_LATCH_IMM
 };
 
 // --- outcomes (mirror shrewd_tpu/ops/classify.py) ---

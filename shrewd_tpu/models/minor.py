@@ -1,0 +1,111 @@
+"""MinorCPU pipeline-latch transient-fault model.
+
+The reference's MinorCPU is a 4-stage in-order pipeline — fetch1 → fetch2 →
+decode → execute — whose stages communicate through explicit latch buffers
+(`src/cpu/minor/pipeline.hh:72`, `src/cpu/minor/buffers.hh`).  BASELINE
+configs[2] targets transient faults in those latches: a particle strike flips
+one bit of an in-flight µop's *metadata* while it sits in an inter-stage
+latch, before the consuming stage reads it.
+
+TPU-native mapping (no event queue, no per-latch simulation): under the
+1-IPC in-order timing proxy, µop *i* enters fetch1 at cycle *i* and occupies
+latch *s* (s ∈ {0..depth-2}, latch s sits after stage s) at cycle *i + s*.
+A fault drawn at (latch s, cycle c) therefore corrupts µop ``entry = c - s``;
+if that index falls outside the trace window the latch held a bubble and the
+fault is architecturally masked — which falls out naturally because the
+replay kernel's ``at_uop`` predicate never matches.
+
+Latch payload fields and their fault kinds (`ops/replay.py` step):
+
+  field   width           kind            consuming semantics
+  ------  --------------  --------------  ---------------------------------
+  opcode  OPCODE_BITS     KIND_LATCH_OP   flip may yield an illegal opcode
+                                          → DUE, or a different legal op
+  dst     log2(nphys)     KIND_ROB_DST    commit writes the wrong register
+  src1    log2(nphys)     KIND_IQ_SRC1    execute reads the wrong register
+  src2    log2(nphys)     KIND_IQ_SRC2
+  imm     32              KIND_LATCH_IMM  wrong immediate / address offset
+
+Bit positions are drawn uniformly over the *total* latch width (the sum of
+the field widths), so per-field fault probability is width-proportional —
+the same uniform-over-bits discipline the regfile model uses.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from shrewd_tpu.isa import uops as U
+from shrewd_tpu.models.o3 import (Fault, KIND_IQ_SRC1, KIND_IQ_SRC2,
+                                  KIND_LATCH_IMM, KIND_LATCH_OP,
+                                  KIND_ROB_DST, STRUCTURES)
+from shrewd_tpu.trace.format import Trace
+from shrewd_tpu.utils.config import ConfigObject, Param
+
+# Bits needed to hold any opcode (N_OPCODES=23 → 5 bits).
+OPCODE_BITS = int(np.ceil(np.log2(U.N_OPCODES)))
+
+FIELD_OP = 0
+FIELD_DST = 1
+FIELD_SRC1 = 2
+FIELD_SRC2 = 3
+FIELD_IMM = 4
+FIELD_NAMES = ["opcode", "dst", "src1", "src2", "imm"]
+
+_FIELD_KINDS = np.array(STRUCTURES["latch"], dtype=np.int32)
+assert list(_FIELD_KINDS) == [KIND_LATCH_OP, KIND_ROB_DST, KIND_IQ_SRC1,
+                              KIND_IQ_SRC2, KIND_LATCH_IMM], \
+    "o3.STRUCTURES['latch'] kind order must match the latch field order"
+
+
+class MinorConfig(ConfigObject):
+    """Machine knobs for the latch model (Minor pipeline analog).
+
+    Outcome classification knobs stay on ``O3Config`` (the TrialKernel's
+    config); this object only shapes fault sampling."""
+
+    depth = Param(int, 4, "pipeline depth; latches = depth - 1 "
+                  "(reference Minor: fetch1/fetch2/decode/execute)")
+
+
+class MinorFaultSampler:
+    """Draws latch faults for one trace. Device-side, vmappable.
+
+    ``sample(key)`` → a ``Fault`` whose (kind, entry, bit) address the latch
+    field flip; the shared replay kernel applies it.
+    """
+
+    def __init__(self, trace: Trace, cfg: MinorConfig | None = None):
+        self.cfg = cfg if cfg is not None else MinorConfig()
+        self.n = trace.n
+        self.n_latches = self.cfg.depth - 1
+        idx_bits = int(np.log2(trace.nphys))
+        widths = np.array([OPCODE_BITS, idx_bits, idx_bits, idx_bits, 32],
+                          dtype=np.int32)
+        # cumulative field boundaries over the flattened latch word
+        self.widths = jnp.asarray(widths)
+        self.bounds = jnp.asarray(np.cumsum(widths), dtype=jnp.int32)
+        self.total_bits = int(widths.sum())
+        self.field_kinds = jnp.asarray(_FIELD_KINDS)
+
+    def sample(self, key: jax.Array) -> Fault:
+        kc, ks, kb = jax.random.split(key, 3)
+        # fault lands at a uniform (cycle, latch) coordinate; cycles span the
+        # whole occupancy of the pipe: [0, n + n_latches)
+        cycle = jax.random.randint(kc, (), 0, self.n + self.n_latches,
+                                   dtype=jnp.int32)
+        stage = jax.random.randint(ks, (), 0, self.n_latches, dtype=jnp.int32)
+        entry = cycle - stage          # may be out of window → bubble → masked
+
+        flat = jax.random.randint(kb, (), 0, self.total_bits, dtype=jnp.int32)
+        field = jnp.sum((flat >= self.bounds).astype(jnp.int32))
+        lo = jnp.where(field == 0, 0, self.bounds[jnp.maximum(field - 1, 0)])
+        bit = flat - lo
+        kind = self.field_kinds[field]
+        return Fault(kind=kind, cycle=entry, entry=entry, bit=bit,
+                     shadow_u=jnp.float32(1.0))
+
+    def sample_batch(self, keys: jax.Array) -> Fault:
+        return jax.vmap(self.sample)(keys)

@@ -47,9 +47,15 @@ KIND_IQ_SRC1 = 4
 KIND_IQ_SRC2 = 5
 KIND_LSQ_ADDR = 6
 KIND_LSQ_DATA = 7
+# Pipeline-latch field faults (MinorCPU latch model, models/minor.py): the
+# flipped field is the µop's *opcode* or *immediate* as it sits in an
+# inter-stage latch (reference `src/cpu/minor/buffers.hh`).  Register-index
+# latch fields reuse KIND_ROB_DST / KIND_IQ_SRC1/2 semantics.
+KIND_LATCH_OP = 8
+KIND_LATCH_IMM = 9
 
 KIND_NAMES = ["none", "regfile", "fu", "rob_dst", "iq_src1", "iq_src2",
-              "lsq_addr", "lsq_data"]
+              "lsq_addr", "lsq_data", "latch_op", "latch_imm"]
 
 # structure name → kinds drawn for it
 STRUCTURES = {
@@ -58,6 +64,10 @@ STRUCTURES = {
     "rob": (KIND_ROB_DST,),
     "iq": (KIND_IQ_SRC1, KIND_IQ_SRC2),
     "lsq": (KIND_LSQ_ADDR, KIND_LSQ_DATA),
+    # MinorCPU inter-stage latch fields (sampled by models.minor's
+    # MinorFaultSampler; TrialKernel.sampler dispatches there)
+    "latch": (KIND_LATCH_OP, KIND_ROB_DST, KIND_IQ_SRC1, KIND_IQ_SRC2,
+              KIND_LATCH_IMM),
 }
 
 
@@ -112,6 +122,10 @@ class FaultSampler:
         if structure not in STRUCTURES:
             raise KeyError(f"unknown structure {structure!r} "
                            f"(known: {sorted(STRUCTURES)})")
+        if structure == "latch":
+            raise ValueError("latch faults are drawn by "
+                             "models.minor.MinorFaultSampler "
+                             "(TrialKernel.sampler dispatches there)")
         self.structure = structure
         self.cfg = cfg
         self.n = trace.n

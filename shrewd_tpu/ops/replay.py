@@ -28,6 +28,7 @@ import jax.numpy as jnp
 
 from shrewd_tpu.isa import uops as U
 from shrewd_tpu.models.o3 import (Fault, KIND_FU, KIND_IQ_SRC1, KIND_IQ_SRC2,
+                                  KIND_LATCH_IMM, KIND_LATCH_OP,
                                   KIND_LSQ_ADDR, KIND_LSQ_DATA, KIND_REGFILE,
                                   KIND_ROB_DST)
 
@@ -125,6 +126,14 @@ def replay(tr: TraceArrays, init_reg: jax.Array, init_mem: jax.Array,
 
         # 2. operand read with IQ index faults
         at_uop = i == fault.entry
+        # latch-field faults (MinorCPU model): the µop's opcode or immediate
+        # was corrupted in an inter-stage latch before execute consumed it.
+        op_flipped = op ^ jnp.where((fault.kind == KIND_LATCH_OP) & at_uop,
+                                    fault.bit_as_index_mask(), i32(0))
+        illegal_now = ((op_flipped >= i32(U.N_OPCODES)) | (op_flipped < 0)) & live
+        op = jnp.clip(op_flipped, 0, U.N_OPCODES - 1)
+        imm = imm ^ jnp.where((fault.kind == KIND_LATCH_IMM) & at_uop,
+                              bitmask, u32(0))
         s1e = jnp.where((fault.kind == KIND_IQ_SRC1) & at_uop,
                         s1 ^ fault.bit_as_index_mask(), s1) & idx_mask
         s2e = jnp.where((fault.kind == KIND_IQ_SRC2) & at_uop,
@@ -148,15 +157,18 @@ def replay(tr: TraceArrays, init_reg: jax.Array, init_mem: jax.Array,
         addr = eff ^ jnp.where((fault.kind == KIND_LSQ_ADDR) & at_uop,
                                bitmask, u32(0))
         valid = ((addr & u32(3)) == 0) & ((addr >> u32(2)) < u32(mem_words))
-        trapped_now = is_mem_op & ~valid & live
+        trapped_now = (is_mem_op & ~valid & live) | illegal_now
         slot = (addr >> u32(2)).astype(i32) & i32(mem_words - 1)
         ldval = mem[slot]
         st_data = b ^ jnp.where((fault.kind == KIND_LSQ_DATA) & at_uop,
                                 bitmask, u32(0))
 
-        # 5. branch resolution
-        cond = eff != 0
-        diverged_now = is_br & (cond != (tk != 0)) & live
+        # 5. branch resolution — compare effective control flow against the
+        # golden outcome; a latch-flipped opcode that turns a branch into a
+        # non-branch (or vice versa) diverges here too (tk is 0 for
+        # non-branches, so `taken_eff != tk` covers both directions).
+        taken_eff = is_br & (eff != 0)
+        diverged_now = (taken_eff != (tk != 0)) & live
 
         # freeze on any terminal condition this step
         live_next = live & ~(detected_now | trapped_now | diverged_now)

@@ -21,8 +21,9 @@ from shrewd_tpu.ops.replay import ReplayResult, TraceArrays, replay
 
 
 class TrialKernel:
-    def __init__(self, trace, cfg: O3Config | None = None):
+    def __init__(self, trace, cfg: O3Config | None = None, minor_cfg=None):
         self.cfg = cfg if cfg is not None else O3Config()
+        self.minor_cfg = minor_cfg    # models.minor.MinorConfig | None
         self.trace = trace
         self.tr = TraceArrays.from_trace(trace)
         self.init_reg = jnp.asarray(trace.init_reg, dtype=jnp.uint32)
@@ -44,7 +45,10 @@ class TrialKernel:
         return jax.vmap(
             lambda r: C.classify(r, self.golden, self.cfg.compare_regs))(results)
 
-    def sampler(self, structure: str) -> FaultSampler:
+    def sampler(self, structure: str):
+        if structure == "latch":
+            from shrewd_tpu.models.minor import MinorFaultSampler
+            return MinorFaultSampler(self.trace, self.minor_cfg)
         return FaultSampler(self.trace, structure, self.cfg)
 
     @partial(jax.jit, static_argnums=(0, 2))

@@ -64,6 +64,11 @@ class Trace(NamedTuple):
             arr = getattr(self, name)
             if not ((arr >= 0) & (arr < self.nphys)).all():
                 raise ValueError(f"{name} register index out of range")
+        # the replay kernels compare effective control flow against `taken`
+        # unconditionally (ops/replay.py branch resolution), which requires
+        # taken == 0 on every non-branch row
+        if self.taken[~U.is_branch(self.opcode)].any():
+            raise ValueError("taken must be 0 on non-branch µops")
         if self.nphys & (self.nphys - 1):
             raise ValueError("nphys must be a power of two")
         if self.mem_words & (self.mem_words - 1):

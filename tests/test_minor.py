@@ -1,0 +1,139 @@
+"""MinorCPU pipeline-latch fault model: directed + sampler tests."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from shrewd_tpu.isa import uops as U
+from shrewd_tpu.models.minor import (FIELD_NAMES, MinorConfig,
+                                     MinorFaultSampler, OPCODE_BITS)
+from shrewd_tpu.models.o3 import (KIND_IQ_SRC1, KIND_IQ_SRC2, KIND_LATCH_IMM,
+                                  KIND_LATCH_OP, KIND_ROB_DST)
+from shrewd_tpu.ops import classify as C
+from shrewd_tpu.ops.trial import TrialKernel
+from shrewd_tpu.trace.synth import WorkloadConfig, generate
+from shrewd_tpu.utils import prng
+
+from tests.test_replay import fault, mini_trace, run
+
+
+def test_opcode_flip_to_illegal_is_due():
+    # SRL (7) with bit 4 flipped → 23 ≥ N_OPCODES → illegal µop → DUE
+    t = mini_trace([
+        (U.SRL, 1, 2, 3, 0, 0),
+        (U.ADD, 4, 1, 2, 0, 0),
+    ])
+    assert U.SRL ^ (1 << 4) >= U.N_OPCODES
+    r = run(t, fault(kind=KIND_LATCH_OP, cycle=0, entry=0, bit=4))
+    assert bool(r.trapped)
+    golden = run(t, fault())
+    assert C.classify(r, golden) == C.OUTCOME_DUE
+
+
+def test_opcode_flip_to_other_legal_op_corrupts():
+    # ADD (1) bit 2 → 5 = XOR: r1 = r2 ^ r3 instead of r2 + r3 → SDC
+    t = mini_trace([(U.ADD, 1, 2, 3, 0, 0)])
+    r = run(t, fault(kind=KIND_LATCH_OP, cycle=0, entry=0, bit=2))
+    golden = run(t, fault())
+    reg = np.asarray(r.reg)
+    greg = np.asarray(golden.reg)
+    assert reg[1] == (7 ^ 10)          # init_reg[i] = 3i+1
+    assert greg[1] == 7 + 10
+    assert C.classify(r, golden) == C.OUTCOME_SDC
+
+
+def test_opcode_flip_branch_to_nonbranch_diverges():
+    # BNE (20) with unequal srcs (taken=1); flip bit 4 → 4 = OR → no branch
+    # executed where golden took one → control divergence
+    t = mini_trace([(U.BNE, 0, 2, 3, 0, 1)])
+    r = run(t, fault(kind=KIND_LATCH_OP, cycle=0, entry=0, bit=4))
+    assert bool(r.diverged)
+    golden = run(t, fault())
+    assert C.classify(r, golden) == C.OUTCOME_SDC
+
+
+def test_imm_flip_changes_result():
+    # ADDI r1 = r2 + 4; flip imm bit 5 → +36
+    t = mini_trace([(U.ADDI, 1, 2, 0, 4, 0)])
+    r = run(t, fault(kind=KIND_LATCH_IMM, cycle=0, entry=0, bit=5))
+    assert np.asarray(r.reg)[1] == 7 + (4 ^ 32)
+    golden = run(t, fault())
+    assert C.classify(r, golden) == C.OUTCOME_SDC
+
+
+def test_imm_flip_on_dead_value_masked():
+    # flip imm of an ADDI whose destination is overwritten before any read
+    t = mini_trace([
+        (U.ADDI, 1, 2, 0, 4, 0),
+        (U.LUI, 1, 0, 0, 99, 0),       # overwrites r1
+    ])
+    r = run(t, fault(kind=KIND_LATCH_IMM, cycle=0, entry=0, bit=5))
+    golden = run(t, fault())
+    assert C.classify(r, golden) == C.OUTCOME_MASKED
+
+
+def test_bubble_fault_is_masked():
+    # entry outside the window (latch held a bubble) → no effect
+    t = mini_trace([(U.ADD, 1, 2, 3, 0, 0)])
+    for entry in (-1, -3, 5):
+        r = run(t, fault(kind=KIND_LATCH_OP, cycle=entry, entry=entry, bit=1))
+        golden = run(t, fault())
+        assert C.classify(r, golden) == C.OUTCOME_MASKED
+
+
+def test_sampler_fields_and_bits_in_range():
+    t = generate(WorkloadConfig(n=256, nphys=64, mem_words=128,
+                                working_set_words=64, seed=3))
+    s = MinorFaultSampler(t, MinorConfig())
+    keys = prng.trial_keys(prng.campaign_key(11), 2048)
+    f = s.sample_batch(keys)
+    kind = np.asarray(f.kind)
+    bit = np.asarray(f.bit)
+    entry = np.asarray(f.entry)
+    idx_bits = int(np.log2(t.nphys))
+    widths = {KIND_LATCH_OP: OPCODE_BITS, KIND_ROB_DST: idx_bits,
+              KIND_IQ_SRC1: idx_bits, KIND_IQ_SRC2: idx_bits,
+              KIND_LATCH_IMM: 32}
+    # every latch field kind gets drawn, bits stay within field widths
+    assert set(widths) == set(np.unique(kind))
+    for k, w in widths.items():
+        sel = kind == k
+        assert sel.any()
+        assert (bit[sel] >= 0).all() and (bit[sel] < w).all()
+    # field probability ∝ width (imm is 32 of the 55-bit latch for nphys=64)
+    total = sum(widths.values())
+    frac_imm = (kind == KIND_LATCH_IMM).mean()
+    assert abs(frac_imm - 32 / total) < 0.05
+    # entries span the window incl. out-of-range bubbles at both edges
+    assert entry.min() < 0
+    assert entry.max() >= t.n - 1
+    assert (entry < t.n + s.n_latches).all()
+
+
+def test_latch_structure_via_trial_kernel():
+    t = generate(WorkloadConfig(n=256, nphys=64, mem_words=128,
+                                working_set_words=64, seed=4))
+    k = TrialKernel(t)
+    keys = prng.trial_keys(prng.campaign_key(12), 512)
+    tally = np.asarray(k.run_keys(keys, "latch"))
+    assert tally.sum() == 512
+    assert tally[C.OUTCOME_MASKED] > 0      # bubbles + dead values exist
+    assert tally[C.OUTCOME_SDC] > 0         # latch faults do corrupt
+
+
+def test_field_names_table():
+    assert FIELD_NAMES == ["opcode", "dst", "src1", "src2", "imm"]
+
+
+def test_minor_cfg_plumbed_through_trial_kernel():
+    t = generate(WorkloadConfig(n=64, nphys=16, mem_words=64,
+                                working_set_words=32, seed=6))
+    k = TrialKernel(t, minor_cfg=MinorConfig(depth=6))
+    assert k.sampler("latch").n_latches == 5
+
+
+def test_trace_validate_rejects_taken_on_nonbranch():
+    import pytest
+    t = mini_trace([(U.ADD, 1, 2, 3, 0, 0)])
+    bad = t._replace(taken=np.array([1], dtype=np.int32))
+    with pytest.raises(ValueError, match="non-branch"):
+        bad.validate()

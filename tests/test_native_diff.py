@@ -53,7 +53,8 @@ def test_native_engine_trace_is_valid_and_deterministic(built):
     assert (t1.opcode == U.STORE).mean() > 0.05
 
 
-@pytest.mark.parametrize("structure", ["regfile", "fu", "rob", "iq", "lsq"])
+@pytest.mark.parametrize("structure",
+                         ["regfile", "fu", "rob", "iq", "lsq", "latch"])
 @pytest.mark.parametrize("source", ["python", "native"])
 def test_jax_vs_native_trial_outcomes(built, structure, source, py_trace):
     """The core differential contract: identical fault coords → identical
