@@ -81,13 +81,18 @@ def alu(op: int, a: int, b: int, imm: int) -> int:
     raise ValueError(f"unknown opcode {op}")
 
 
-def scalar_replay(trace, reg: np.ndarray, mem: np.ndarray):
+def scalar_replay(trace, reg: np.ndarray, mem: np.ndarray,
+                  record_mem: list | None = None):
     """Run a whole trace over (regfile, memory) — fault-free golden path.
 
     ``reg``/``mem`` are uint32 arrays, modified in place.  Returns the list of
     computed branch outcomes (for generator bookkeeping).  Memory addressing:
     word index = addr >> 2, valid iff aligned and within ``len(mem)`` words —
     identical to the device kernel's model.
+
+    ``record_mem``, if given, collects the golden memory-access stream as
+    ``(µop_index, word_index, is_store)`` tuples — the input to the cache
+    timeline builder (models/ruby.py).
     """
     n_words = len(mem)
     taken = []
@@ -102,10 +107,14 @@ def scalar_replay(trace, reg: np.ndarray, mem: np.ndarray):
             assert addr % 4 == 0 and addr >> 2 < n_words, "golden trace must be in-range"
             res = int(mem[addr >> 2])
             reg[trace.dst[i]] = res
+            if record_mem is not None:
+                record_mem.append((i, addr >> 2, False))
         elif op == U.STORE:
             addr = res
             assert addr % 4 == 0 and addr >> 2 < n_words, "golden trace must be in-range"
             mem[addr >> 2] = b
+            if record_mem is not None:
+                record_mem.append((i, addr >> 2, True))
         elif U.is_branch(np.int64(op)):
             taken.append(res)
         elif U.writes_dest(np.int64(op)):

@@ -1,0 +1,425 @@
+"""Ruby cache SRAM SEU model: cache-line AVF by lifetime analysis.
+
+The reference's Ruby tier models caches as explicit SRAM-backed structures —
+``CacheMemory`` data/tag arrays (`mem/ruby/structures/CacheMemory.hh:70`)
+holding ``DataBlock`` line payloads (`mem/ruby/common/DataBlock.hh:61`) plus
+per-line coherence state — the SEU injection targets of BASELINE configs[3]
+(MESI_Two_Level SRAM SEU → cache-line AVF).
+
+TPU-native design — no per-trial cache re-simulation.  The golden cache
+behavior is deterministic and fault-independent (an SEU in a cache payload
+never changes *which* lines move; it only changes the bytes they carry), so
+the model splits into:
+
+1. **Host-side timeline build** (once per trace): a set-associative LRU
+   write-back cache simulation over the golden memory-access stream
+   (``isa.semantics.scalar_replay(record_mem=...)``) emits, per SRAM slot,
+   the event timeline that decides any fault's fate:
+
+   - word-granular data events: CONSUME (load hit of the word, or dirty
+     writeback of the line), OVERWRITE (store to the word, or line fill),
+     INVALIDATE (clean eviction);
+   - line-granular tag/state events carrying (valid, dirty)-after-event.
+
+2. **Device-side classification** (per trial): a fault at (slot, word, bit,
+   cycle) is classified by *binary search* over the sorted timelines —
+   first data event touching the faulted word after the fault cycle:
+   CONSUME → SDC, OVERWRITE/INVALIDATE → masked; tag/state faults read the
+   line's (valid, dirty) at the fault cycle: valid∧dirty → SDC (the dirty
+   payload eventually writes back under a corrupted tag / a flipped M-state
+   drops the only copy), else masked.  End-of-window residue follows the O3
+   kernel's convention: a fault still sitting in a valid dirty line counts
+   as SDC.  Everything is `searchsorted` + gathers under `vmap` — no scan,
+   no control flow.
+
+Protection (`parity` / `ecc` per array) transforms outcomes the way the
+hardware would: parity turns consumed corruption into detected-uncorrectable
+(DUE), SECDED ECC corrects single-bit faults (masked).  This is the knob the
+replication design-space search sweeps.
+
+A two-level hierarchy (MESI_Two_Level shape: private L1 + shared L2) chains
+two simulations: L1 misses and dirty writebacks form the L2 access stream.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from shrewd_tpu.isa import semantics
+from shrewd_tpu.ops import classify as C
+from shrewd_tpu.utils.config import ConfigObject, Param
+
+# word-event types
+EV_CONSUME = 0     # corrupted bits reach architecture → SDC
+EV_OVERWRITE = 1   # slot rewritten before any read → masked
+EV_INVALIDATE = 2  # line dropped clean → masked
+EV_NAMES = ["consume", "overwrite", "invalidate"]
+
+PROT_NONE = "none"
+PROT_PARITY = "parity"
+PROT_ECC = "ecc"
+_PROTECTIONS = (PROT_NONE, PROT_PARITY, PROT_ECC)
+
+
+class CacheConfig(ConfigObject):
+    """One cache level's geometry + protection (CacheMemory params analog)."""
+
+    n_sets = Param(int, 64, "sets (power of two)")
+    n_ways = Param(int, 4, "associativity")
+    words_per_line = Param(int, 8, "32-bit words per line (power of two)")
+    tag_bits = Param(int, 20, "tag field width per line")
+    state_bits = Param(int, 2, "coherence-state field width per line "
+                       "(MESI encoding)")
+    data_protection = Param(str, PROT_NONE, "none | parity | ecc")
+    tag_protection = Param(str, PROT_NONE, "none | parity | ecc "
+                           "(covers tag and state arrays)")
+
+    def validate(self) -> None:
+        for f in ("n_sets", "words_per_line"):
+            v = getattr(self, f)
+            if v & (v - 1) or v <= 0:
+                raise ValueError(f"{f} must be a power of two, got {v}")
+        for f in ("data_protection", "tag_protection"):
+            if getattr(self, f) not in _PROTECTIONS:
+                raise ValueError(f"{f} must be one of {_PROTECTIONS}")
+
+    @property
+    def n_slots(self) -> int:
+        return self.n_sets * self.n_ways
+
+
+class CacheTimeline(NamedTuple):
+    """Sorted per-slot event timelines for one cache level (host arrays)."""
+
+    # word-granular data events, sorted by key = (slot*wpl + word)*span + cycle
+    wkey: np.ndarray      # int64[Ew]
+    wtype: np.ndarray     # int32[Ew]   EV_*
+    # line-granular events, sorted by key = slot*span + cycle
+    lkey: np.ndarray      # int64[El]
+    lvalid: np.ndarray    # int32[El]   line valid after event
+    ldirty: np.ndarray    # int32[El]   line dirty after event
+    end_valid: np.ndarray  # int32[n_slots] resident line at window end
+    end_dirty: np.ndarray  # int32[n_slots]
+    span: int             # cycle span (n_cycles + 1)
+    n_cycles: int
+
+
+class AccessStream(NamedTuple):
+    """A (cycle, word, is_store, width) memory-access stream.  ``width`` is
+    the transfer size in words starting at ``word`` — 1 for CPU word
+    accesses, the *source level's* line width for inter-level fills and
+    writebacks, so a consuming level with a different line size expands the
+    transfer correctly (possibly across several of its own lines)."""
+
+    cycle: np.ndarray     # int32[A]
+    word: np.ndarray      # int32[A]
+    is_store: np.ndarray  # bool[A]
+    width: np.ndarray     # int32[A]
+
+
+def golden_access_stream(trace) -> AccessStream:
+    """Extract the golden memory-access stream by scalar replay."""
+    reg, mem = trace.init_reg.copy(), trace.init_mem.copy()
+    rec: list = []
+    semantics.scalar_replay(trace, reg, mem, record_mem=rec)
+    if rec:
+        cyc, word, st = (np.array(x) for x in zip(*rec))
+    else:
+        cyc = word = np.zeros(0, dtype=np.int64)
+        st = np.zeros(0, dtype=bool)
+    return AccessStream(cycle=cyc.astype(np.int32), word=word.astype(np.int32),
+                        is_store=st.astype(bool),
+                        width=np.ones(len(rec), dtype=np.int32))
+
+
+def simulate_cache(stream: AccessStream, cfg: CacheConfig, n_cycles: int
+                   ) -> tuple[CacheTimeline, AccessStream]:
+    """Run the set-assoc LRU write-back cache over an access stream.
+
+    Returns the slot-event timelines and the *miss stream* (fills as
+    line-wide reads, dirty writebacks as line-wide stores) that drives the
+    next level down — the framework's analog of Ruby's L1→L2 MessageBuffer
+    traffic (`mem/ruby/network/MessageBuffer.hh:74`).
+    """
+    cfg.validate()
+    wpl = cfg.words_per_line
+    span = n_cycles + 1
+
+    # per-slot resident line (-1 = invalid), dirty flag, LRU stamp
+    resident = np.full((cfg.n_sets, cfg.n_ways), -1, dtype=np.int64)
+    dirty = np.zeros((cfg.n_sets, cfg.n_ways), dtype=bool)
+    lru = np.zeros((cfg.n_sets, cfg.n_ways), dtype=np.int64)
+
+    wkey: list = []
+    wtype: list = []
+    lkey: list = []
+    lval: list = []
+    ldir: list = []
+    miss: list = []   # (cycle, word0, is_store, line_wide)
+
+    def slot_of(s: int, w: int) -> int:
+        return s * cfg.n_ways + w
+
+    def word_events(s: int, w: int, words, cyc: int, ev: int) -> None:
+        base = slot_of(s, w) * wpl
+        for wi in words:
+            wkey.append((base + wi) * span + cyc)
+            wtype.append(ev)
+
+    def line_event(s: int, w: int, cyc: int, valid: bool, dty: bool) -> None:
+        lkey.append(slot_of(s, w) * span + cyc)
+        lval.append(int(valid))
+        ldir.append(int(dty))
+
+    stamp = 0
+
+    def do_access(cyc: int, line: int, wis, is_store: bool) -> None:
+        """One access touching word-in-line indices `wis` of `line`."""
+        nonlocal stamp
+        s = line % cfg.n_sets
+        ways = resident[s]
+        hit = np.nonzero(ways == line)[0]
+        if hit.size:
+            w = int(hit[0])
+        else:
+            # victim = LRU way (invalid ways first)
+            invalid = np.nonzero(ways == -1)[0]
+            w = int(invalid[0]) if invalid.size else int(np.argmin(lru[s]))
+            if resident[s, w] != -1:
+                # eviction of the current resident line
+                if dirty[s, w]:
+                    # dirty writeback consumes every word of the line and
+                    # feeds a line-wide store to the next level
+                    word_events(s, w, range(wpl), cyc, EV_CONSUME)
+                    miss.append((cyc, int(resident[s, w]) * wpl, True, wpl))
+                else:
+                    word_events(s, w, range(wpl), cyc, EV_INVALIDATE)
+                line_event(s, w, cyc, False, False)
+            # fill from the next level (line-wide read there), overwriting
+            # the slot's SRAM
+            miss.append((cyc, line * wpl, False, wpl))
+            word_events(s, w, range(wpl), cyc, EV_OVERWRITE)
+            resident[s, w] = line
+            dirty[s, w] = False
+            line_event(s, w, cyc, True, False)
+        # the access itself
+        if is_store:
+            word_events(s, w, wis, cyc, EV_OVERWRITE)
+            if not dirty[s, w]:
+                dirty[s, w] = True
+                line_event(s, w, cyc, True, True)
+        else:
+            word_events(s, w, wis, cyc, EV_CONSUME)
+        stamp += 1
+        lru[s, w] = stamp
+
+    for a in range(len(stream.cycle)):
+        cyc = int(stream.cycle[a])
+        word = int(stream.word[a])
+        is_store = bool(stream.is_store[a])
+        width = int(stream.width[a])
+        # a transfer of `width` words may span several of THIS level's lines
+        # (source and consumer line sizes can differ)
+        for line in range(word // wpl, (word + width - 1) // wpl + 1):
+            lo = max(word, line * wpl)
+            hi = min(word + width, (line + 1) * wpl)
+            do_access(cyc, line, range(lo - line * wpl, hi - line * wpl),
+                      is_store)
+
+    def sorted_cols(keys, *cols):
+        k = np.asarray(keys, dtype=np.int64)
+        order = np.argsort(k, kind="stable")
+        return (k[order],) + tuple(
+            np.asarray(c, dtype=np.int32)[order] for c in cols)
+
+    wk, wt = sorted_cols(wkey, wtype) if wkey else (
+        np.zeros(0, np.int64), np.zeros(0, np.int32))
+    lk, lv, ld = sorted_cols(lkey, lval, ldir) if lkey else (
+        np.zeros(0, np.int64), np.zeros(0, np.int32), np.zeros(0, np.int32))
+
+    timeline = CacheTimeline(
+        wkey=wk, wtype=wt, lkey=lk, lvalid=lv, ldirty=ld,
+        end_valid=(resident != -1).astype(np.int32).reshape(-1),
+        end_dirty=dirty.astype(np.int32).reshape(-1),
+        span=span, n_cycles=n_cycles)
+    if miss:
+        mc, mw, ms, mwd = zip(*miss)
+        miss_stream = AccessStream(
+            cycle=np.asarray(mc, dtype=np.int32),
+            word=np.asarray(mw, dtype=np.int32),
+            is_store=np.asarray(ms, dtype=bool),
+            width=np.asarray(mwd, dtype=np.int32))
+    else:
+        miss_stream = AccessStream(*(np.zeros(0, d) for d in
+                                     (np.int32, np.int32, bool, np.int32)))
+    return timeline, miss_stream
+
+
+# --- device-side classification -------------------------------------------
+
+_PROT_TABLE = {
+    # what a consumed corrupted bit becomes under each protection scheme
+    PROT_NONE: C.OUTCOME_SDC,
+    PROT_PARITY: C.OUTCOME_DUE,    # detected, not correctable
+    PROT_ECC: C.OUTCOME_MASKED,    # SECDED corrects single-bit faults
+}
+
+
+class CacheFault(NamedTuple):
+    slot: jax.Array   # int32 — set*ways + way
+    word: jax.Array   # int32 — word within line (data faults; 0 otherwise)
+    bit: jax.Array    # int32
+    cycle: jax.Array  # int32
+
+
+class CacheKernel:
+    """Device-side fault classifier for one cache level.
+
+    Exposes the same campaign-facing protocol as ``ops.trial.TrialKernel``:
+    ``sampler(structure)``, ``outcomes_from_keys(keys, structure)``,
+    ``run_keys(keys, structure)`` — so the sharded campaign layer and the
+    orchestrator drive cache structures exactly like O3 structures.
+    Structures: ``"data"``, ``"tag"``, ``"state"``.
+    """
+
+    def __init__(self, timeline: CacheTimeline, cfg: CacheConfig):
+        cfg.validate()
+        self.cfg = cfg
+        self.tl = timeline
+        # keys must fit int32: jax runs with x64 disabled, and int32 keys
+        # keep the searchsorted cheap on device
+        max_key = cfg.n_slots * cfg.words_per_line * timeline.span
+        if max_key >= 2**31:
+            raise ValueError(
+                f"timeline key space {max_key} overflows int32 "
+                f"(shrink the window or the cache geometry)")
+        # pad empty timelines with a key=-1 sentinel (sorts first, never
+        # matches any fault's slot) so the device gathers always have a row
+        wk, wt = timeline.wkey, timeline.wtype
+        if wk.size == 0:
+            wk = np.array([-1], np.int64)
+            wt = np.array([EV_INVALIDATE], np.int32)
+        lk, lv, ld = timeline.lkey, timeline.lvalid, timeline.ldirty
+        if lk.size == 0:
+            lk = np.array([-1], np.int64)
+            lv = ld = np.zeros(1, np.int32)
+        self.wkey = jnp.asarray(wk, dtype=jnp.int32)
+        self.wtype = jnp.asarray(wt)
+        self.lkey = jnp.asarray(lk, dtype=jnp.int32)
+        self.lvalid = jnp.asarray(lv)
+        self.ldirty = jnp.asarray(ld)
+        self.end_valid = jnp.asarray(timeline.end_valid)
+        self.end_dirty = jnp.asarray(timeline.end_dirty)
+        self.span = timeline.span
+        self.n_cycles = timeline.n_cycles
+        self._data_consumed = jnp.int32(_PROT_TABLE[cfg.data_protection])
+        self._tag_consumed = jnp.int32(_PROT_TABLE[cfg.tag_protection])
+
+    # -- classification kernels (single trial; vmapped by callers) --
+
+    def _classify_data(self, f: CacheFault) -> jax.Array:
+        wpl = self.cfg.words_per_line
+        key = (f.slot * wpl + f.word) * self.span + f.cycle
+        pos = jnp.searchsorted(self.wkey, key, side="left")
+        n_ev = self.wkey.shape[0]
+        pc = jnp.minimum(pos, jnp.maximum(n_ev - 1, 0))
+        found = (n_ev > 0) & (pos < n_ev) & \
+            ((self.wkey[pc] // self.span) == f.slot * wpl + f.word)
+        ev = self.wtype[pc]
+        consumed = found & (ev == EV_CONSUME)
+        # no further event: residue in a valid dirty line eventually writes
+        # back (post-window) — count as consumed, matching the O3 kernel's
+        # end-of-window residual-corruption convention
+        residual = ~found & (self.end_valid[f.slot] == 1) & \
+            (self.end_dirty[f.slot] == 1)
+        return jnp.where(consumed | residual, self._data_consumed,
+                         jnp.int32(C.OUTCOME_MASKED))
+
+    def _classify_line_meta(self, f: CacheFault) -> jax.Array:
+        """Tag/state-field fault: SDC iff the line is valid∧dirty when hit —
+        the dirty payload is lost (flipped M-state) or lands at a corrupted
+        address (flipped tag); clean lines refetch (masked)."""
+        key = f.slot * self.span + f.cycle
+        pos = jnp.searchsorted(self.lkey, key, side="right") - 1
+        n_ev = self.lkey.shape[0]
+        pc = jnp.clip(pos, 0, jnp.maximum(n_ev - 1, 0))
+        found = (n_ev > 0) & (pos >= 0) & \
+            ((self.lkey[pc] // self.span) == f.slot)
+        valid = jnp.where(found, self.lvalid[pc], 0)
+        dirty = jnp.where(found, self.ldirty[pc], 0)
+        hit = (valid == 1) & (dirty == 1)
+        return jnp.where(hit, self._tag_consumed,
+                         jnp.int32(C.OUTCOME_MASKED))
+
+    # -- sampling --
+
+    def sampler(self, structure: str) -> "CacheFaultSampler":
+        return CacheFaultSampler(self.cfg, self.n_cycles, structure)
+
+    # -- campaign protocol --
+
+    def outcomes_from_keys(self, keys: jax.Array, structure: str) -> jax.Array:
+        faults = self.sampler(structure).sample_batch(keys)
+        fn = (self._classify_data if structure == "data"
+              else self._classify_line_meta)
+        return jax.vmap(fn)(faults)
+
+    @partial(jax.jit, static_argnums=(0, 2))
+    def run_keys(self, keys: jax.Array, structure: str) -> jax.Array:
+        return C.tally(self.outcomes_from_keys(keys, structure))
+
+
+CACHE_STRUCTURES = ("data", "tag", "state")
+
+
+class CacheFaultSampler:
+    """Uniform (slot, word, bit, cycle) draws for one cache structure."""
+
+    def __init__(self, cfg: CacheConfig, n_cycles: int, structure: str):
+        if structure not in CACHE_STRUCTURES:
+            raise KeyError(f"unknown cache structure {structure!r} "
+                           f"(known: {CACHE_STRUCTURES})")
+        self.cfg = cfg
+        self.n_cycles = n_cycles
+        self.structure = structure
+        self.n_bits = {"data": 32, "tag": cfg.tag_bits,
+                       "state": cfg.state_bits}[structure]
+
+    def sample(self, key: jax.Array) -> CacheFault:
+        ks, kw, kb, kc = jax.random.split(key, 4)
+        slot = jax.random.randint(ks, (), 0, self.cfg.n_slots, dtype=jnp.int32)
+        word = (jax.random.randint(kw, (), 0, self.cfg.words_per_line,
+                                   dtype=jnp.int32)
+                if self.structure == "data" else jnp.int32(0))
+        bit = jax.random.randint(kb, (), 0, self.n_bits, dtype=jnp.int32)
+        cycle = jax.random.randint(kc, (), 0, self.n_cycles, dtype=jnp.int32)
+        return CacheFault(slot=slot, word=word, bit=bit, cycle=cycle)
+
+    def sample_batch(self, keys: jax.Array) -> CacheFault:
+        return jax.vmap(self.sample)(keys)
+
+
+class CacheHierarchy(NamedTuple):
+    """MESI_Two_Level shape: private L1 + shared L2, chained timelines."""
+
+    l1: CacheKernel
+    l2: CacheKernel
+
+    @classmethod
+    def build(cls, trace, l1_cfg: CacheConfig | None = None,
+              l2_cfg: CacheConfig | None = None) -> "CacheHierarchy":
+        l1_cfg = l1_cfg or CacheConfig()
+        l2_cfg = l2_cfg or CacheConfig(n_sets=256, n_ways=8)
+        stream = golden_access_stream(trace)
+        l1_tl, l1_miss = simulate_cache(stream, l1_cfg, trace.n)
+        l2_tl, _ = simulate_cache(l1_miss, l2_cfg, trace.n)
+        return cls(l1=CacheKernel(l1_tl, l1_cfg),
+                   l2=CacheKernel(l2_tl, l2_cfg))
+
+    def kernels(self) -> dict[str, CacheKernel]:
+        return {"l1": self.l1, "l2": self.l2}

@@ -38,12 +38,15 @@ class TrialKernel:
         return replay(self.tr, self.init_reg, self.init_mem, fault,
                       self.coverage)
 
-    @partial(jax.jit, static_argnums=0)
-    def run_batch(self, faults: Fault) -> jax.Array:
-        """Fault batch (vmapped leaves) → outcome classes int32[B]."""
+    def _outcomes(self, faults: Fault) -> jax.Array:
         results = jax.vmap(self._replay_one)(faults)
         return jax.vmap(
             lambda r: C.classify(r, self.golden, self.cfg.compare_regs))(results)
+
+    @partial(jax.jit, static_argnums=0)
+    def run_batch(self, faults: Fault) -> jax.Array:
+        """Fault batch (vmapped leaves) → outcome classes int32[B]."""
+        return self._outcomes(faults)
 
     def sampler(self, structure: str):
         if structure == "latch":
@@ -51,8 +54,13 @@ class TrialKernel:
             return MinorFaultSampler(self.trace, self.minor_cfg)
         return FaultSampler(self.trace, structure, self.cfg)
 
+    def outcomes_from_keys(self, keys: jax.Array, structure: str) -> jax.Array:
+        """Per-trial keys → outcome classes int32[B].  The campaign-facing
+        protocol shared with models.ruby.CacheKernel (traceable; callers
+        jit/shard_map it)."""
+        return self._outcomes(self.sampler(structure).sample_batch(keys))
+
     @partial(jax.jit, static_argnums=(0, 2))
     def run_keys(self, keys: jax.Array, structure: str) -> jax.Array:
         """Per-trial keys → outcome tally (N_OUTCOMES,). The campaign unit."""
-        faults = self.sampler(structure).sample_batch(keys)
-        return C.tally(self.run_batch(faults))
+        return C.tally(self.outcomes_from_keys(keys, structure))

@@ -33,15 +33,11 @@ class ShardedCampaign:
         self.kernel = kernel
         self.mesh = mesh
         self.structure = structure
-        sampler = kernel.sampler(structure)
-        golden = kernel.golden
-        compare_regs = kernel.cfg.compare_regs
 
         def local_step(keys):
-            faults = sampler.sample_batch(keys)
-            results = jax.vmap(kernel._replay_one)(faults)
-            outs = jax.vmap(
-                lambda r: C.classify(r, golden, compare_regs))(results)
+            # any kernel speaking the campaign protocol (ops.trial.TrialKernel,
+            # models.ruby.CacheKernel): keys → per-trial outcome classes
+            outs = kernel.outcomes_from_keys(keys, structure)
             return jax.lax.psum(C.tally(outs), TRIAL_AXIS)
 
         self._step = jax.jit(jax.shard_map(
