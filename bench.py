@@ -71,7 +71,7 @@ def main() -> None:
     n_base = min(batch, 512 if args.quick else 2048)
     faults = kernel.sampler("regfile").sample_batch(keys[:n_base])
     fk, fc, fe, fb, fs = (np.asarray(x) for x in faults)
-    cov = np.asarray(kernel.cfg.shadow_coverage, dtype=np.float32)
+    cov = np.asarray(kernel.shadow_cov)    # per-µop, availability folded in
     t0 = time.monotonic()
     base_out = native.golden_trials(trace, fk, fc, fe, fb, fs, cov)
     base_rate = n_base / (time.monotonic() - t0)

@@ -14,7 +14,6 @@
 namespace {
 
 constexpr auto alu = shrewd_alu;
-constexpr auto opclass_of = shrewd_opclass;
 
 struct TrialResult {
   bool detected = false;
@@ -23,6 +22,8 @@ struct TrialResult {
 };
 
 // One replay; reg/mem are the trial's state (modified in place).
+// `coverage` is the per-µop shadow detection probability (length tr.n) —
+// FU-pool availability already folded in by the host (models/fupool.py).
 TrialResult replay(const TraceView& tr, uint32_t* reg, uint32_t* mem,
                    int32_t kind, int32_t fcycle, int32_t fentry, int32_t fbit,
                    float shadow_u, const float* coverage) {
@@ -61,7 +62,7 @@ TrialResult replay(const TraceView& tr, uint32_t* reg, uint32_t* mem,
     uint32_t eff = alu(op, a, b, imm);
     if (kind == KIND_FU && at_uop) {
       eff ^= bitmask;
-      if (shadow_u < coverage[opclass_of(op)]) {  // shadow FU re-executes
+      if (shadow_u < coverage[i]) {  // shadow FU re-executes
         r.detected = true;
         return r;  // fault contained before any commit
       }
@@ -120,8 +121,8 @@ void shrewd_golden_replay(const TraceView* tr, const uint32_t* init_reg,
                           uint32_t* final_mem) {
   std::memcpy(final_reg, init_reg, tr->nphys * sizeof(uint32_t));
   std::memcpy(final_mem, init_mem, tr->mem_words * sizeof(uint32_t));
-  const float cov[N_OPCLASSES] = {0, 0, 0, 0, 0};
-  replay(*tr, final_reg, final_mem, KIND_NONE, 0, 0, 0, 1.0f, cov);
+  const std::vector<float> cov(tr->n, 0.0f);
+  replay(*tr, final_reg, final_mem, KIND_NONE, 0, 0, 0, 1.0f, cov.data());
 }
 
 int32_t shrewd_golden_trials(const TraceView* tr, const uint32_t* init_reg,

@@ -66,7 +66,8 @@ void shrewd_golden_replay(const TraceView* tr, const uint32_t* init_reg,
                           uint32_t* final_mem);
 
 // Run a batch of serial trials; writes outcomes[n_trials].
-// coverage: float[N_OPCLASSES] shadow-FU detection probability per OpClass.
+// coverage: float[tr->n] per-µop shadow detection probability (FU-pool
+// availability folded in by the host, shrewd_tpu/models/fupool.py).
 // Returns the number of trials run.
 int32_t shrewd_golden_trials(const TraceView* tr, const uint32_t* init_reg,
                              const uint32_t* init_mem, const FaultView* faults,

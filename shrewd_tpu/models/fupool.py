@@ -1,0 +1,215 @@
+"""Shadow-FU pool model — the SHREWD microarchitecture proper.
+
+The reference's defining addition is redundant execution through *shadow*
+functional units: at issue, an ALU/FP µop may claim a second FU that
+re-executes and checks its result (``src/cpu/o3/inst_queue.cc:897-903``).
+Whether a shadow unit is available is a structural question answered by the
+FU pool — ``FUPool::getUnit(capability, is_shadow, approx_capability)``
+(``src/cpu/o3/fu_pool.hh:175-180``, ``fu_pool.cc:177-294``) hands out a free
+unit whose capability set matches the µop's OpClass exactly or, failing that,
+an *approximate* capability the caller is willing to accept; the sentinel
+``NoShadowFU`` (``fu_pool.hh:148``) denies the request.  With
+``priorityToShadow`` false, shadow requests are deferred to a second pass
+after all primary issues that cycle (``inst_queue.cc:1029-1066``,
+``requestShadow`` ``:1082-1096``).
+
+TPU-native mapping: there is no event-driven FU acquisition to replicate.
+Shadow availability is a *deterministic function of the trace* under the
+framework's 1-IPC issue proxy — µop *i* issues in cycle ``i // issue_width``
+alongside its cycle-mates, and a greedy in-order allocation over the pool's
+free units decides, per µop, whether a shadow was granted (exact), granted
+approximately, or denied.  The allocator runs once per (trace, config) on the
+host; the device kernel consumes a per-µop coverage array (``coverage()``),
+making detection in ``ops/replay.py`` a single gather + compare.  Per-OpClass
+availability statistics mirror the reference's IQ counters
+(``src/cpu/o3/inst_queue.hh:581-606``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from shrewd_tpu.isa import uops as U
+from shrewd_tpu.utils.config import Child, ConfigObject, Param, VectorParam
+
+# Shadow grant classes (per µop).
+GRANT_NONE = 0      # not shadow-eligible, or pool had no free unit
+GRANT_EXACT = 1     # shadow on an exactly-matching unit
+GRANT_APPROX = 2    # shadow on an approximate-capability unit
+
+
+class FUDesc(ConfigObject):
+    """One functional-unit type (``src/cpu/FuncUnitConfig.py`` analog).
+
+    ``capabilities`` lists the OpClass codes the unit executes;
+    ``approx_capabilities`` lists OpClasses it can *check* approximately when
+    claimed as a shadow (the ``approx_capability`` relaxation of
+    ``FUPool::getUnit``, ``fu_pool.hh:175-180``)."""
+
+    count = Param(int, 1, "number of units of this type")
+    op_lat = Param(int, 1, "operation latency in cycles")
+    capabilities = VectorParam(int, [], "OpClass codes executed")
+    approx_capabilities = VectorParam(
+        int, [], "OpClass codes checkable approximately as a shadow")
+
+
+class IntALU(FUDesc):
+    """Reference ``IntALU`` (count 6 in the default O3 pool,
+    ``src/cpu/o3/FUPool.py``); can approximately check multiplies (e.g. a
+    residue check) when claimed as a shadow."""
+    count = Param(int, 6, "number of units of this type")
+    capabilities = VectorParam(int, [U.OC_INT_ALU], "OpClass codes executed")
+    approx_capabilities = VectorParam(
+        int, [U.OC_INT_MULT], "OpClass codes checkable approximately")
+
+
+class IntMultDiv(FUDesc):
+    """Reference ``IntMultDiv`` (count 2 in the default pool)."""
+    count = Param(int, 2, "number of units of this type")
+    op_lat = Param(int, 3, "operation latency in cycles")
+    capabilities = VectorParam(int, [U.OC_INT_MULT], "OpClass codes executed")
+
+
+class RdWrPort(FUDesc):
+    """Reference ``RdWrPort`` (count 4): the load/store AGU+port units.
+    Memory µops are not shadow-eligible (SHREWD re-executes ALU/FP work;
+    re-issuing a memory access is not a containment-safe check)."""
+    count = Param(int, 4, "number of units of this type")
+    capabilities = VectorParam(int, [U.OC_MEM_READ, U.OC_MEM_WRITE],
+                               "OpClass codes executed")
+
+
+class FUPoolConfig(ConfigObject):
+    """The issue-stage functional-unit pool (``src/cpu/o3/FUPool.py`` analog,
+    reduced to this framework's OpClass granularity)."""
+
+    int_alu = Child(IntALU)
+    int_mult = Child(IntMultDiv)
+    mem_port = Child(RdWrPort)
+    shadow_eligible = VectorParam(
+        int, [U.OC_INT_ALU, U.OC_INT_MULT],
+        "OpClasses that request shadow re-execution when issued")
+    approx_coverage = Param(
+        float, 1.0, "detection probability when the shadow runs on an "
+        "approximate-capability unit (1.0 = approx check is exact)")
+
+    def descs(self) -> list[FUDesc]:
+        """Pool scan order — declaration order, like the reference's
+        ``fuPerCapList`` walk in ``FUPool::getUnit``."""
+        return [self.int_alu, self.int_mult, self.mem_port]
+
+
+class FUPoolModel:
+    """Greedy per-cycle FU allocation over a µop trace.
+
+    Produces, per µop: the shadow grant class (``grants``) and the derived
+    detection-coverage array (``coverage()``) the replay kernel gathers from.
+    Collects the per-OpClass availability counters the reference keeps in the
+    IQ (``inst_queue.hh:581-606``) plus the classic ``statFuBusy`` analog.
+    """
+
+    def __init__(self, opclass: np.ndarray, issue_width: int = 8,
+                 pool: FUPoolConfig | None = None,
+                 priority_to_shadow: bool = False):
+        self.pool = pool if pool is not None else FUPoolConfig()
+        self.issue_width = int(issue_width)
+        self.priority_to_shadow = bool(priority_to_shadow)
+        oc = np.asarray(opclass, dtype=np.int32)
+        self.n = int(oc.shape[0])
+
+        descs = self.pool.descs()
+        counts = np.array([d.count for d in descs], dtype=np.int64)
+        cap = np.zeros((len(descs), U.N_OPCLASSES), dtype=bool)
+        approx = np.zeros_like(cap)
+        for di, d in enumerate(descs):
+            cap[di, list(d.capabilities)] = True
+            approx[di, list(d.approx_capabilities)] = True
+        eligible = np.zeros(U.N_OPCLASSES, dtype=bool)
+        eligible[list(self.pool.shadow_eligible)] = True
+
+        # Stats (per OpClass).
+        self.shadow_requests = np.zeros(U.N_OPCLASSES, dtype=np.int64)
+        self.shadow_granted = np.zeros(U.N_OPCLASSES, dtype=np.int64)
+        self.shadow_granted_approx = np.zeros(U.N_OPCLASSES, dtype=np.int64)
+        self.shadow_denied = np.zeros(U.N_OPCLASSES, dtype=np.int64)
+        self.fu_busy = np.zeros(U.N_OPCLASSES, dtype=np.int64)
+
+        self.grants = np.zeros(self.n, dtype=np.int8)
+
+        # Loop-invariant unit-scan lists per OpClass (pool order).
+        cap_units = [list(np.nonzero(cap[:, c])[0]) for c in range(U.N_OPCLASSES)]
+        approx_units = [list(np.nonzero(approx[:, c])[0])
+                        for c in range(U.N_OPCLASSES)]
+        self._free = np.empty_like(counts)
+
+        W = self.issue_width
+        for c0 in range(0, self.n, W):
+            cycle_uops = range(c0, min(c0 + W, self.n))
+            self._free[:] = counts
+            deferred: list[tuple[int, int]] = []
+            for i in cycle_uops:
+                oc_i = int(oc[i])
+                if oc_i == U.OC_NONE:
+                    continue
+                self._primary(oc_i, cap_units)
+                if eligible[oc_i]:
+                    if self.priority_to_shadow:
+                        # shadow claimed immediately at issue
+                        # (inst_queue.cc:897-903)
+                        self._shadow(i, oc_i, cap_units, approx_units)
+                    else:
+                        deferred.append((i, oc_i))
+            # deferred shadow pass after all primaries issued
+            # (inst_queue.cc:1029-1066)
+            for i, oc_i in deferred:
+                self._shadow(i, oc_i, cap_units, approx_units)
+
+    def _primary(self, oc_i: int, cap_units) -> None:
+        for di in cap_units[oc_i]:
+            if self._free[di] > 0:
+                self._free[di] -= 1
+                return
+        # Pool over-subscribed: the 1-IPC proxy has no stall model, so the
+        # µop proceeds without consuming a unit; record it (the reference
+        # would hold it in the IQ — statFuBusy).
+        self.fu_busy[oc_i] += 1
+
+    def _shadow(self, i: int, oc_i: int, cap_units, approx_units) -> None:
+        self.shadow_requests[oc_i] += 1
+        for di in cap_units[oc_i]:
+            if self._free[di] > 0:
+                self._free[di] -= 1
+                self.shadow_granted[oc_i] += 1
+                self.grants[i] = GRANT_EXACT
+                return
+        for di in approx_units[oc_i]:
+            if self._free[di] > 0:
+                self._free[di] -= 1
+                self.shadow_granted_approx[oc_i] += 1
+                self.grants[i] = GRANT_APPROX
+                return
+        self.shadow_denied[oc_i] += 1    # NoShadowFU
+
+    def coverage(self) -> np.ndarray:
+        """Per-µop shadow detection probability, float32[n]."""
+        cov = np.zeros(self.n, dtype=np.float32)
+        cov[self.grants == GRANT_EXACT] = 1.0
+        cov[self.grants == GRANT_APPROX] = np.float32(self.pool.approx_coverage)
+        return cov
+
+    def stats_group(self, name: str = "fupool"):
+        """Availability counters as a stats Group (the per-OpClass counters
+        of ``inst_queue.hh:581-606`` plus ``statFuBusy``)."""
+        from shrewd_tpu import stats
+        g = stats.Group(name)
+        for attr, desc in (
+                ("shadow_requests", "shadow FU requests"),
+                ("shadow_granted", "shadow granted on exact-capability unit"),
+                ("shadow_granted_approx", "shadow granted on approx unit"),
+                ("shadow_denied", "shadow denied (NoShadowFU)"),
+                ("fu_busy", "primary issue found no free unit")):
+            v = stats.Vector(attr, U.N_OPCLASSES, desc,
+                             subnames=list(U.OPCLASS_NAMES))
+            v += getattr(self, attr)
+            setattr(g, attr, v)
+        return g

@@ -34,8 +34,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from shrewd_tpu.isa import uops as U
+from shrewd_tpu.models.fupool import FUPoolConfig, FUPoolModel
 from shrewd_tpu.trace.format import Trace
-from shrewd_tpu.utils.config import ConfigObject, Param, VectorParam
+from shrewd_tpu.utils.config import (Child, ConfigObject, Param, VectorParam)
 
 # --- fault kinds -----------------------------------------------------------
 
@@ -96,18 +97,48 @@ class O3Config(ConfigObject):
 
     rob_size = Param(int, 192, "in-flight window for entry-fault sampling "
                      "(reference ROB default, BaseO3CPU.py numROBEntries)")
+    issue_width = Param(int, 8, "µops issued per cycle (reference issueWidth "
+                        "default, BaseO3CPU.py)")
     compare_regs = Param(bool, True,
                          "classify end-of-window register diffs as SDC "
                          "(conservative); False compares memory only")
-    # Shadow-FU coverage per OpClass: probability an FU-class fault is caught
-    # by redundant execution (availability-derated, the quantity the
-    # reference tracks per OpClass in inst_queue.hh:581-606).
-    shadow_coverage = VectorParam(float, [0.0] * U.N_OPCLASSES,
-                                  "per-OpClass shadow detection probability")
+    # SHREWD controls (reference enableShrewd/priorityToShadow params,
+    # src/cpu/o3/BaseO3CPU.py:226-227; runtime pybind setters cpu.hh:298-302
+    # — here TrialKernel.with_shrewd rebuilds the kernel instead of mutating).
+    enable_shrewd = Param(bool, True,
+                          "master switch for shadow-FU detection")
     priority_to_shadow = Param(bool, False,
-                               "reference priorityToShadow param "
-                               "(BaseO3CPU.py:227); affects availability "
-                               "model, not kernel semantics")
+                               "shadow FU claimed at issue (True, "
+                               "inst_queue.cc:897-903) vs deferred pass "
+                               "(False, :1029-1066)")
+    # Two shadow-availability models:
+    #  "coverage" — abstract: per-OpClass detection probability (the
+    #               availability-derated quantity the reference tracks per
+    #               OpClass in inst_queue.hh:581-606), from shadow_coverage;
+    #  "fupool"   — structural: per-µop availability computed by greedy FU
+    #               allocation over fu_pool (models/fupool.py).
+    shadow_model = Param(str, "coverage",
+                         check=lambda s: s in ("coverage", "fupool"))
+    shadow_coverage = VectorParam(float, [0.0] * U.N_OPCLASSES,
+                                  "per-OpClass shadow detection probability "
+                                  "(shadow_model='coverage')")
+    fu_pool = Child(FUPoolConfig)
+
+
+def compute_shadow_cov(opclass, cfg: O3Config):
+    """Per-µop shadow detection coverage → (float32[n], FUPoolModel | None).
+
+    The single source the replay kernel gathers from; the FUPoolModel is
+    returned (structural model only) so callers can harvest its per-OpClass
+    availability stats."""
+    opclass = np.asarray(opclass, dtype=np.int32)
+    if not cfg.enable_shrewd:
+        return np.zeros(opclass.shape[0], dtype=np.float32), None
+    if cfg.shadow_model == "fupool":
+        m = FUPoolModel(opclass, cfg.issue_width, cfg.fu_pool,
+                        cfg.priority_to_shadow)
+        return m.coverage(), m
+    return np.asarray(cfg.shadow_coverage, dtype=np.float32)[opclass], None
 
 
 class FaultSampler:

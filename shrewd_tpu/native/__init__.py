@@ -131,7 +131,9 @@ def golden_trials(trace, kinds, cycles, entries, bits, shadow_us,
     """Serial C++ trial batch → outcomes int32[n_trials].
 
     The differential oracle for TrialKernel.run_batch and the serial-baseline
-    denominator for the bench.
+    denominator for the bench.  ``coverage`` is the per-µop shadow detection
+    probability, float[trace.n] (``models.o3.compute_shadow_cov`` /
+    ``TrialKernel.shadow_cov``).
     """
     keep: list = []
     tv = _trace_view(trace, keep)
@@ -143,6 +145,9 @@ def golden_trials(trace, kinds, cycles, entries, bits, shadow_us,
     bits = _ascontig(bits, np.int32)
     shadow_us = _ascontig(shadow_us, np.float32)
     cov = _ascontig(coverage, np.float32)
+    if len(cov) != trace.n:
+        raise ValueError(f"coverage must be per-µop (len {trace.n}), "
+                         f"got {len(cov)}")
     n = len(kinds)
     if not (len(cycles) == len(entries) == len(bits) == len(shadow_us) == n):
         raise ValueError("fault field lengths differ")

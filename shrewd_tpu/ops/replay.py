@@ -45,7 +45,6 @@ class TraceArrays(NamedTuple):
     src2: jax.Array     # int32[n]
     imm: jax.Array      # uint32[n]
     taken: jax.Array    # int32[n]
-    opclass: jax.Array  # int32[n]
 
     @classmethod
     def from_trace(cls, trace) -> "TraceArrays":
@@ -56,7 +55,6 @@ class TraceArrays(NamedTuple):
             src2=jnp.asarray(trace.src2, dtype=i32),
             imm=jnp.asarray(trace.imm, dtype=u32),
             taken=jnp.asarray(trace.taken, dtype=i32),
-            opclass=jnp.asarray(U.opclass_of(trace.opcode), dtype=i32),
         )
 
 
@@ -106,8 +104,11 @@ def _alu(op: jax.Array, a: jax.Array, b: jax.Array, imm: jax.Array) -> jax.Array
 
 
 def replay(tr: TraceArrays, init_reg: jax.Array, init_mem: jax.Array,
-           fault: Fault, shadow_coverage: jax.Array) -> ReplayResult:
-    """Propagate one trial. All inputs are device arrays; jit/vmap-safe."""
+           fault: Fault, shadow_cov: jax.Array) -> ReplayResult:
+    """Propagate one trial. All inputs are device arrays; jit/vmap-safe.
+
+    ``shadow_cov`` is the per-µop shadow detection probability, float32[n]
+    (``models.o3.compute_shadow_cov``) — availability already folded in."""
     nphys = init_reg.shape[0]
     mem_words = init_mem.shape[0]
     idx_mask = i32(nphys - 1)
@@ -117,7 +118,7 @@ def replay(tr: TraceArrays, init_reg: jax.Array, init_mem: jax.Array,
 
     def step(carry, xs):
         reg, mem, live, detected, trapped, diverged = carry
-        i, op, dstr, s1, s2, imm, tk, oc = xs
+        i, op, dstr, s1, s2, imm, tk, sc = xs
 
         # 1. storage-fault landing
         flip_here = (fault.kind == KIND_REGFILE) & (i == fault.cycle)
@@ -146,7 +147,7 @@ def replay(tr: TraceArrays, init_reg: jax.Array, init_mem: jax.Array,
         fu_mask = jnp.where((fault.kind == KIND_FU) & at_uop, bitmask, u32(0))
         eff = raw ^ fu_mask
         detected_now = ((fault.kind == KIND_FU) & at_uop & live
-                        & (fault.shadow_u < shadow_coverage[oc]))
+                        & (fault.shadow_u < sc))
 
         is_ld = op == U.LOAD
         is_st = op == U.STORE
@@ -188,7 +189,7 @@ def replay(tr: TraceArrays, init_reg: jax.Array, init_mem: jax.Array,
                  diverged | diverged_now), None)
 
     xs = (jnp.arange(n, dtype=i32), tr.opcode, tr.dst, tr.src1, tr.src2,
-          tr.imm, tr.taken, tr.opclass)
+          tr.imm, tr.taken, shadow_cov.astype(jnp.float32))
     # Derive the initial carry from the fault so its "varying" type under
     # shard_map matches the step outputs (the carry depends on the per-trial
     # fault after one step; an unvarying init would fail scan's type check).

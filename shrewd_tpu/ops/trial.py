@@ -15,7 +15,9 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
-from shrewd_tpu.models.o3 import Fault, FaultSampler, O3Config, null_fault
+from shrewd_tpu.isa import uops as U
+from shrewd_tpu.models.o3 import (Fault, FaultSampler, O3Config,
+                                  compute_shadow_cov, null_fault)
 from shrewd_tpu.ops import classify as C
 from shrewd_tpu.ops.replay import ReplayResult, TraceArrays, replay
 
@@ -28,15 +30,35 @@ class TrialKernel:
         self.tr = TraceArrays.from_trace(trace)
         self.init_reg = jnp.asarray(trace.init_reg, dtype=jnp.uint32)
         self.init_mem = jnp.asarray(trace.init_mem, dtype=jnp.uint32)
-        self.coverage = jnp.asarray(self.cfg.shadow_coverage, dtype=jnp.float32)
+        # Per-µop shadow detection coverage (availability folded in); the
+        # structural model also yields the FU pool's availability stats.
+        cov, self.fu_model = compute_shadow_cov(
+            U.opclass_of(trace.opcode), self.cfg)
+        self.shadow_cov = jnp.asarray(cov, dtype=jnp.float32)
         # Golden replay once per kernel: device-vs-device comparison makes
         # MASKED exact by construction (the CheckerCPU-style scalar oracle is
         # a separate differential test, not the classification baseline).
         self.golden: ReplayResult = jax.jit(self._replay_one)(null_fault())
 
+    def with_shrewd(self, enable: bool | None = None,
+                    priority_to_shadow: bool | None = None) -> "TrialKernel":
+        """Runtime SHREWD toggles, functional-style.
+
+        The reference flips these mid-run through pybind setters
+        (``setEnableShrewd``/``setPriorityToShadow``, ``cpu/o3/cpu.hh:298-302``,
+        exported at ``BaseO3CPU.py:70-71``); a jitted kernel's constants are
+        frozen at trace time, so the TPU framework returns a fresh kernel
+        instead of mutating."""
+        cfg = type(self.cfg).from_dict(self.cfg.to_dict())
+        if enable is not None:
+            cfg.enable_shrewd = enable
+        if priority_to_shadow is not None:
+            cfg.priority_to_shadow = priority_to_shadow
+        return TrialKernel(self.trace, cfg, self.minor_cfg)
+
     def _replay_one(self, fault: Fault) -> ReplayResult:
         return replay(self.tr, self.init_reg, self.init_mem, fault,
-                      self.coverage)
+                      self.shadow_cov)
 
     def _outcomes(self, faults: Fault) -> jax.Array:
         results = jax.vmap(self._replay_one)(faults)

@@ -1,0 +1,142 @@
+"""Shadow-FU pool model tests (models/fupool.py).
+
+Validates the structural availability model against hand-computable
+allocations, the reference's priorityToShadow semantics, and the end-to-end
+effect on trial classification (detected vs SDC)."""
+
+import numpy as np
+import pytest
+
+from shrewd_tpu.isa import uops as U
+from shrewd_tpu.models.fupool import (FUPoolConfig, FUPoolModel, GRANT_APPROX,
+                                      GRANT_EXACT, GRANT_NONE, IntALU,
+                                      IntMultDiv, RdWrPort)
+from shrewd_tpu.models.o3 import O3Config, compute_shadow_cov
+from shrewd_tpu.ops.trial import TrialKernel
+from shrewd_tpu.trace.synth import WorkloadConfig, generate
+
+
+def oc_seq(*classes):
+    return np.array(classes, dtype=np.int32)
+
+
+def test_underutilized_cycle_grants_all_shadows():
+    # 2 ALU µops in one 8-wide cycle against 6 IntALU units:
+    # 2 primaries + 2 shadows = 4 ≤ 6 → both granted exact.
+    m = FUPoolModel(oc_seq(U.OC_INT_ALU, U.OC_INT_ALU), issue_width=8)
+    assert list(m.grants) == [GRANT_EXACT, GRANT_EXACT]
+    assert m.shadow_denied.sum() == 0
+    np.testing.assert_array_equal(m.coverage(), [1.0, 1.0])
+
+
+def test_saturated_cycle_denies_late_shadows():
+    # 4 ALU µops, one cycle, 6 units: 4 primaries + shadows for the first 2
+    # exhaust the pool; shadows 3 and 4 are denied (NoShadowFU).
+    m = FUPoolModel(oc_seq(*[U.OC_INT_ALU] * 4), issue_width=8)
+    assert list(m.grants) == [GRANT_EXACT, GRANT_EXACT, GRANT_NONE, GRANT_NONE]
+    assert m.shadow_denied[U.OC_INT_ALU] == 2
+    assert m.fu_busy.sum() == 0
+
+
+def test_issue_width_splits_cycles():
+    # Same 4 µops but width 2 → two cycles of 2, each underutilized.
+    m = FUPoolModel(oc_seq(*[U.OC_INT_ALU] * 4), issue_width=2)
+    assert list(m.grants) == [GRANT_EXACT] * 4
+
+
+def test_mult_shadow_falls_back_to_approx_alu():
+    # 2 MUL µops, 2 IntMultDiv units: both primaries consume the mult units;
+    # shadows find no exact unit and fall back to approximate ALU checking.
+    m = FUPoolModel(oc_seq(U.OC_INT_MULT, U.OC_INT_MULT), issue_width=8)
+    assert list(m.grants) == [GRANT_APPROX, GRANT_APPROX]
+    assert m.shadow_granted_approx[U.OC_INT_MULT] == 2
+    cov = FUPoolConfig(approx_coverage=0.75)
+    m2 = FUPoolModel(oc_seq(U.OC_INT_MULT, U.OC_INT_MULT), issue_width=8,
+                     pool=cov)
+    np.testing.assert_allclose(m2.coverage(), [0.75, 0.75])
+
+
+def test_priority_to_shadow_starves_later_primaries_of_shadows():
+    # 3 ALU µops, pool shrunk to 4 ALU units.
+    # deferred (priorityToShadow=False): primaries take 3, one shadow unit
+    #   left → only µop 0's shadow granted.
+    # interleaved (True): µop0 primary+shadow (2), µop1 primary+shadow (2),
+    #   µop2 primary finds pool empty (fu_busy) and shadow denied.
+    pool = FUPoolConfig(int_alu=IntALU(count=4))
+    oc = oc_seq(*[U.OC_INT_ALU] * 3)
+    m_def = FUPoolModel(oc, issue_width=8, pool=pool, priority_to_shadow=False)
+    assert list(m_def.grants) == [GRANT_EXACT, GRANT_NONE, GRANT_NONE]
+    assert m_def.fu_busy.sum() == 0
+    m_pri = FUPoolModel(oc, issue_width=8, pool=pool, priority_to_shadow=True)
+    assert list(m_pri.grants) == [GRANT_EXACT, GRANT_EXACT, GRANT_NONE]
+    assert m_pri.fu_busy[U.OC_INT_ALU] == 1
+
+
+def test_mem_and_nop_not_shadow_eligible():
+    m = FUPoolModel(oc_seq(U.OC_MEM_READ, U.OC_MEM_WRITE, U.OC_NONE),
+                    issue_width=8)
+    assert list(m.grants) == [GRANT_NONE] * 3
+    assert m.shadow_requests.sum() == 0
+
+
+def test_stats_group_rows():
+    m = FUPoolModel(oc_seq(*[U.OC_INT_ALU] * 4), issue_width=8)
+    g = m.stats_group()
+    d = g.to_dict()
+    assert d["shadow_requests"]["IntAlu"] == 4
+    assert d["shadow_granted"]["IntAlu"] == 2
+    assert d["shadow_denied"]["IntAlu"] == 2
+
+
+def test_compute_shadow_cov_paths():
+    t = generate(WorkloadConfig(n=128, nphys=32, mem_words=64,
+                                working_set_words=32, seed=3))
+    oc = U.opclass_of(t.opcode)
+    # coverage model: straight per-OpClass gather
+    cfg = O3Config(shadow_coverage=[0.3, 0.5, 0.0, 0.0, 0.0])
+    cov, m = compute_shadow_cov(oc, cfg)
+    assert m is None
+    np.testing.assert_allclose(
+        cov, np.array([0.3, 0.5, 0.0, 0.0, 0.0], np.float32)[oc])
+    # disabled: all zero regardless of model
+    cov0, _ = compute_shadow_cov(oc, O3Config(
+        enable_shrewd=False, shadow_coverage=[1.0] * U.N_OPCLASSES))
+    assert not cov0.any()
+    # structural model: binary coverage (approx_coverage=1 default)
+    covf, mf = compute_shadow_cov(oc, O3Config(shadow_model="fupool"))
+    assert mf is not None
+    assert set(np.unique(covf)) <= {0.0, 1.0}
+    # shadows only ever granted to eligible classes
+    assert not covf[(oc != U.OC_INT_ALU) & (oc != U.OC_INT_MULT)].any()
+
+
+def test_trial_kernel_fupool_end_to_end():
+    t = generate(WorkloadConfig(n=128, nphys=32, mem_words=64,
+                                working_set_words=32, seed=4))
+    import jax
+    k = TrialKernel(t, O3Config(shadow_model="fupool"))
+    assert k.fu_model is not None
+    keys = jax.random.split(jax.random.key(7), 64)
+    tally = np.asarray(k.run_keys(keys, "fu"))
+    assert tally.sum() == 64
+    # an 8-wide window of mostly-ALU code leaves shadow units free most
+    # cycles → FU faults are frequently detected
+    from shrewd_tpu.ops import classify as C
+    assert tally[C.OUTCOME_DETECTED] > 0
+
+
+def test_with_shrewd_toggle():
+    t = generate(WorkloadConfig(n=96, nphys=32, mem_words=64,
+                                working_set_words=32, seed=5))
+    import jax
+    k_on = TrialKernel(t, O3Config(shadow_model="fupool"))
+    k_off = k_on.with_shrewd(enable=False)
+    assert not np.asarray(k_off.shadow_cov).any()
+    keys = jax.random.split(jax.random.key(8), 48)
+    from shrewd_tpu.ops import classify as C
+    t_on = np.asarray(k_on.run_keys(keys, "fu"))
+    t_off = np.asarray(k_off.run_keys(keys, "fu"))
+    assert t_off[C.OUTCOME_DETECTED] == 0
+    assert t_on[C.OUTCOME_DETECTED] >= t_off[C.OUTCOME_DETECTED]
+    # detection converts would-be SDC/masked outcomes, never creates trials
+    assert t_on.sum() == t_off.sum() == 48

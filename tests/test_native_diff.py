@@ -75,7 +75,7 @@ def test_jax_vs_native_trial_outcomes(built, structure, source, py_trace):
         np.asarray(faults.kind), np.asarray(faults.cycle),
         np.asarray(faults.entry), np.asarray(faults.bit),
         np.asarray(faults.shadow_u),
-        np.asarray(cfg.shadow_coverage, dtype=np.float32),
+        np.asarray(k.shadow_cov),          # per-µop coverage
         compare_regs=cfg.compare_regs)
     np.testing.assert_array_equal(jax_out, native_out)
 
@@ -83,7 +83,7 @@ def test_jax_vs_native_trial_outcomes(built, structure, source, py_trace):
 def test_native_null_fault_masked(built, py_trace):
     out = native.golden_trials(
         py_trace, [0], [0], [0], [0], [1.0],
-        np.zeros(U.N_OPCLASSES, dtype=np.float32))
+        np.zeros(py_trace.n, dtype=np.float32))
     assert out[0] == 0
 
 

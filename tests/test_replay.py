@@ -39,11 +39,11 @@ def fault(kind=0, cycle=0, entry=0, bit=0, shadow_u=1.0):
                  shadow_u=jnp.float32(shadow_u))
 
 
-ZERO_COV = jnp.zeros(U.N_OPCLASSES, dtype=jnp.float32)
-
-
-def run(trace, f, coverage=ZERO_COV):
+def run(trace, f, coverage=None):
+    """coverage: per-µop shadow detection probability (default all-zero)."""
     tr = TraceArrays.from_trace(trace)
+    if coverage is None:
+        coverage = jnp.zeros(trace.n, dtype=jnp.float32)
     return replay(tr, jnp.asarray(trace.init_reg), jnp.asarray(trace.init_mem),
                   f, coverage)
 
@@ -121,7 +121,7 @@ def test_fu_fault_detected_with_full_coverage():
         (U.ADD, 1, 2, 3, 0, 0),
         (U.ADD, 4, 1, 1, 0, 0),
     ])
-    cov = jnp.ones(U.N_OPCLASSES, dtype=jnp.float32)
+    cov = jnp.ones(t.n, dtype=jnp.float32)
     res = run(t, fault(KIND_FU, cycle=0, entry=0, bit=5, shadow_u=0.5), cov)
     golden = run(t, null_fault(), cov)
     assert bool(res.detected)
