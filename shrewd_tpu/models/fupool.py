@@ -119,6 +119,7 @@ class FUPoolModel:
 
         descs = self.pool.descs()
         counts = np.array([d.count for d in descs], dtype=np.int64)
+        op_lat = np.array([d.op_lat for d in descs], dtype=np.int64)
         cap = np.zeros((len(descs), U.N_OPCLASSES), dtype=bool)
         approx = np.zeros_like(cap)
         for di, d in enumerate(descs):
@@ -136,59 +137,65 @@ class FUPoolModel:
 
         self.grants = np.zeros(self.n, dtype=np.int8)
 
+        # Flattened unit instances: per unit, its desc id and the cycle it
+        # frees up (op_lat > 1 keeps a claimed unit busy across cycles —
+        # FUCompletion scheduling in the reference, inst_queue.cc:934-963).
+        unit_desc = np.repeat(np.arange(len(descs)), counts)
+        self._unit_lat = op_lat[unit_desc]
+        self._free_at = np.zeros(len(unit_desc), dtype=np.int64)
         # Loop-invariant unit-scan lists per OpClass (pool order).
-        cap_units = [list(np.nonzero(cap[:, c])[0]) for c in range(U.N_OPCLASSES)]
-        approx_units = [list(np.nonzero(approx[:, c])[0])
+        cap_units = [list(np.nonzero(cap[unit_desc, c])[0])
+                     for c in range(U.N_OPCLASSES)]
+        approx_units = [list(np.nonzero(approx[unit_desc, c])[0])
                         for c in range(U.N_OPCLASSES)]
-        self._free = np.empty_like(counts)
 
         W = self.issue_width
         for c0 in range(0, self.n, W):
+            cyc = c0 // W
             cycle_uops = range(c0, min(c0 + W, self.n))
-            self._free[:] = counts
             deferred: list[tuple[int, int]] = []
             for i in cycle_uops:
                 oc_i = int(oc[i])
                 if oc_i == U.OC_NONE:
                     continue
-                self._primary(oc_i, cap_units)
+                self._primary(cyc, oc_i, cap_units)
                 if eligible[oc_i]:
                     if self.priority_to_shadow:
                         # shadow claimed immediately at issue
                         # (inst_queue.cc:897-903)
-                        self._shadow(i, oc_i, cap_units, approx_units)
+                        self._shadow(cyc, i, oc_i, cap_units, approx_units)
                     else:
                         deferred.append((i, oc_i))
             # deferred shadow pass after all primaries issued
             # (inst_queue.cc:1029-1066)
             for i, oc_i in deferred:
-                self._shadow(i, oc_i, cap_units, approx_units)
+                self._shadow(cyc, i, oc_i, cap_units, approx_units)
 
-    def _primary(self, oc_i: int, cap_units) -> None:
-        for di in cap_units[oc_i]:
-            if self._free[di] > 0:
-                self._free[di] -= 1
-                return
-        # Pool over-subscribed: the 1-IPC proxy has no stall model, so the
-        # µop proceeds without consuming a unit; record it (the reference
-        # would hold it in the IQ — statFuBusy).
-        self.fu_busy[oc_i] += 1
+    def _claim(self, cyc: int, units) -> bool:
+        for u in units:
+            if self._free_at[u] <= cyc:
+                self._free_at[u] = cyc + self._unit_lat[u]
+                return True
+        return False
 
-    def _shadow(self, i: int, oc_i: int, cap_units, approx_units) -> None:
+    def _primary(self, cyc: int, oc_i: int, cap_units) -> None:
+        if not self._claim(cyc, cap_units[oc_i]):
+            # Pool over-subscribed: the 1-IPC proxy has no stall model, so
+            # the µop proceeds without consuming a unit; record it (the
+            # reference would hold it in the IQ — statFuBusy).
+            self.fu_busy[oc_i] += 1
+
+    def _shadow(self, cyc: int, i: int, oc_i: int, cap_units,
+                approx_units) -> None:
         self.shadow_requests[oc_i] += 1
-        for di in cap_units[oc_i]:
-            if self._free[di] > 0:
-                self._free[di] -= 1
-                self.shadow_granted[oc_i] += 1
-                self.grants[i] = GRANT_EXACT
-                return
-        for di in approx_units[oc_i]:
-            if self._free[di] > 0:
-                self._free[di] -= 1
-                self.shadow_granted_approx[oc_i] += 1
-                self.grants[i] = GRANT_APPROX
-                return
-        self.shadow_denied[oc_i] += 1    # NoShadowFU
+        if self._claim(cyc, cap_units[oc_i]):
+            self.shadow_granted[oc_i] += 1
+            self.grants[i] = GRANT_EXACT
+        elif self._claim(cyc, approx_units[oc_i]):
+            self.shadow_granted_approx[oc_i] += 1
+            self.grants[i] = GRANT_APPROX
+        else:
+            self.shadow_denied[oc_i] += 1    # NoShadowFU
 
     def coverage(self) -> np.ndarray:
         """Per-µop shadow detection probability, float32[n]."""

@@ -1,0 +1,8 @@
+"""Design-space search over protection configurations (BASELINE configs[4])."""
+
+from shrewd_tpu.search.protect import (DesignSpace, Scheme, SearchResult,
+                                       StructureProfile, DEFAULT_SCHEMES,
+                                       shadow_scheme)
+
+__all__ = ["DesignSpace", "Scheme", "SearchResult", "StructureProfile",
+           "DEFAULT_SCHEMES", "shadow_scheme"]

@@ -1,0 +1,232 @@
+"""SHREWD replication design-space search: pick protected structures for
+SDC < target at minimum area (BASELINE configs[4]; SURVEY §7 build-plan #7).
+
+The reference explores protection by *running* gem5 once per candidate
+microarchitecture (shadow FUs on/off, per-structure knobs) — each point a
+full serial campaign.  The TPU framework inverts this: the Monte-Carlo
+campaign measures each structure's **raw** conditional outcome distribution
+P(outcome | fault in s) once, and protection is then evaluated analytically
+over the whole design space at once — a vmapped sweep over protection
+assignments that reuses the trial outcomes instead of re-simulating them.
+
+Model
+-----
+A *scheme* protects one structure with detection probability ``d`` (fault
+intercepted and reported — the shadow-FU/ parity/ DMR class) and correction
+probability ``c`` (fault scrubbed — ECC/TMR class), at an area multiplier.
+A fault in structure *s* under scheme *k* lands:
+
+  masked':  c + (1-c-d)·P(masked|s)
+  sdc':         (1-c-d)·P(sdc|s)
+  due':         (1-c-d)·P(due|s)
+  detected': d + (1-c-d)·P(detected|s)
+
+Fault arrival per structure is ``fit_per_bit × bits × area_factor`` — extra
+protection bits are themselves targets (conservative).  System SDC rate is
+the rate-weighted sum of sdc' across structures; total area the bit-weighted
+sum of factors.  The search returns the minimum-area assignment meeting the
+SDC target, plus the area/SDC Pareto front for the full space.
+
+Raw distributions must come from an **unprotected** campaign
+(``O3Config(enable_shrewd=False)``) so protection is not double-counted;
+the shadow-FU scheme's detection probability is derated by structural
+availability via ``shadow_scheme(kernel)`` (models/fupool.py).
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from shrewd_tpu.ops import classify as C
+
+
+class Scheme(NamedTuple):
+    """One protection option (applies to a single structure)."""
+
+    name: str
+    detect: float    # P(fault intercepted and reported)
+    correct: float   # P(fault scrubbed before consumption)
+    area: float      # area multiplier on the protected structure
+
+    def validate(self) -> "Scheme":
+        if not (0.0 <= self.detect and 0.0 <= self.correct
+                and self.detect + self.correct <= 1.0):
+            raise ValueError(f"{self.name}: need detect+correct in [0,1]")
+        if self.area < 1.0:
+            raise ValueError(f"{self.name}: area multiplier < 1")
+        return self
+
+
+# The classic SEU-protection ladder.  Area factors are the conventional
+# storage overheads (parity: 1 bit/word proxy; SECDED on 32-bit words:
+# 7/32; DMR/TMR: full replication) — all overridable per design space.
+NONE = Scheme("none", 0.0, 0.0, 1.0)
+PARITY = Scheme("parity", 1.0, 0.0, 1.0 + 1 / 32)
+SECDED = Scheme("secded", 0.0, 1.0, 1.0 + 7 / 32)
+DMR = Scheme("dmr", 1.0, 0.0, 2.0)
+TMR = Scheme("tmr", 0.0, 1.0, 3.0)
+DEFAULT_SCHEMES = [NONE, PARITY, SECDED, DMR, TMR]
+
+
+def shadow_scheme(kernel, area: float = 1.5, name: str = "shadow") -> Scheme:
+    """The SHREWD scheme itself: redundant execution on shadow FUs.
+
+    Detection probability = the availability-derated per-µop coverage the FU
+    pool grants (mean over the uniform-over-µops FU fault model) — i.e. what
+    the reference's per-OpClass availability stats (inst_queue.hh:581-606)
+    aggregate to.  ``area`` is the FU-pool overhead of provisioning shadows
+    (no extra architectural state, so the default is a logic-area estimate).
+    """
+    cov = np.asarray(kernel.shadow_cov, dtype=np.float64)
+    return Scheme(name, float(cov.mean()), 0.0, float(area)).validate()
+
+
+class StructureProfile(NamedTuple):
+    """One structure's measured raw vulnerability profile."""
+
+    name: str
+    bits: int               # storage size (area & fault-rate proxy)
+    probs: np.ndarray       # P(outcome | fault in s), shape (N_OUTCOMES,)
+    fit_per_bit: float = 1.0e-3   # raw upset rate per bit (FIT-style unit)
+
+    @classmethod
+    def from_tally(cls, name: str, bits: int, tally,
+                   fit_per_bit: float = 1.0e-3) -> "StructureProfile":
+        t = np.asarray(tally, dtype=np.float64)
+        n = t.sum()
+        if n <= 0:
+            raise ValueError(f"{name}: empty tally")
+        return cls(name, int(bits), t / n, float(fit_per_bit))
+
+    @property
+    def fit(self) -> float:
+        return self.fit_per_bit * self.bits
+
+
+class SearchResult(NamedTuple):
+    feasible: bool
+    assignment: dict            # structure name → scheme name (best config)
+    area: float                 # total area (bit-weighted) of best config
+    sdc_rate: float             # system SDC rate of best config
+    due_rate: float
+    baseline_area: float        # unprotected-reference-config area
+    baseline_sdc: float         # unprotected-reference-config SDC rate
+    pareto: list                # [(area, sdc_rate, assignment dict), ...]
+    n_configs: int
+
+
+class DesignSpace:
+    """Structures × allowed schemes, evaluated in one vmapped pass.
+
+    ``allowed`` restricts per-structure scheme choices (e.g. the FU pool is
+    protected by shadows or nothing — parity on a logic path is meaningless):
+    a dict ``structure name → list of scheme indices``.
+    """
+
+    def __init__(self, profiles: list[StructureProfile],
+                 schemes: list[Scheme] | None = None,
+                 allowed: dict[str, list[int]] | None = None):
+        if not profiles:
+            raise ValueError("need at least one structure profile")
+        self.profiles = list(profiles)
+        self.schemes = [s.validate() for s in (schemes or DEFAULT_SCHEMES)]
+        names = [p.name for p in profiles]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate structure names: {names}")
+        all_k = list(range(len(self.schemes)))
+        allowed = allowed or {}
+        unknown = set(allowed) - set(names)
+        if unknown:
+            raise KeyError(f"allowed{sorted(unknown)} not in profiles {names}")
+        self.allowed = [list(allowed.get(n, all_k)) for n in names]
+        for n, ks in zip(names, self.allowed):
+            bad = [k for k in ks if not 0 <= k < len(self.schemes)]
+            if bad:
+                raise IndexError(f"{n}: scheme indices {bad} out of range")
+
+        # Device-resident evaluation tables.
+        self._p = jnp.asarray(np.stack([p.probs for p in self.profiles]))
+        self._fit = jnp.asarray([p.fit for p in self.profiles])
+        self._bits = jnp.asarray([float(p.bits) for p in self.profiles])
+        self._det = jnp.asarray([s.detect for s in self.schemes])
+        self._cor = jnp.asarray([s.correct for s in self.schemes])
+        self._area = jnp.asarray([s.area for s in self.schemes])
+
+        def one(cfg):
+            det = self._det[cfg]
+            cor = self._cor[cfg]
+            areaf = self._area[cfg]
+            resid = 1.0 - det - cor
+            rate = self._fit * areaf          # protection bits are targets too
+            sdc = jnp.sum(rate * resid * self._p[:, C.OUTCOME_SDC])
+            due = jnp.sum(rate * resid * self._p[:, C.OUTCOME_DUE])
+            area = jnp.sum(self._bits * areaf)
+            return sdc, due, area
+
+        self._evaluate = jax.jit(jax.vmap(one))
+
+        # The unprotected reference config: per structure, the identity
+        # scheme (detect=0, correct=0, area=1) if allowed, else the
+        # structure's minimum-area allowed scheme.
+        def baseline_choice(ks: list[int]) -> int:
+            ident = [k for k in ks if self.schemes[k].detect == 0.0
+                     and self.schemes[k].correct == 0.0
+                     and self.schemes[k].area == 1.0]
+            return ident[0] if ident else min(
+                ks, key=lambda k: self.schemes[k].area)
+        self._baseline_cfg = np.array(
+            [baseline_choice(ks) for ks in self.allowed], dtype=np.int32)
+
+    def enumerate(self) -> np.ndarray:
+        """All assignments, int32[n_configs, n_structures] of scheme ids."""
+        return np.array(list(itertools.product(*self.allowed)),
+                        dtype=np.int32)
+
+    def evaluate(self, configs) -> tuple[jax.Array, jax.Array, jax.Array]:
+        """(sdc_rate, due_rate, area) per config — one fused device pass."""
+        return self._evaluate(jnp.asarray(configs, dtype=jnp.int32))
+
+    def search(self, sdc_target: float) -> SearchResult:
+        """Minimum-area assignment with sdc_rate ≤ target, plus the Pareto
+        front over the full space."""
+        configs = self.enumerate()
+        sdc, due, area = (np.asarray(x) for x in self.evaluate(configs))
+        names = [p.name for p in self.profiles]
+
+        def assignment(i: int) -> dict:
+            return {n: self.schemes[k].name
+                    for n, k in zip(names, configs[i])}
+
+        # Pareto front: ascending area, strictly improving SDC.
+        order = np.lexsort((sdc, area))
+        pareto: list[tuple[float, float, dict]] = []
+        best_sdc = np.inf
+        for i in order:
+            if sdc[i] < best_sdc:
+                best_sdc = float(sdc[i])
+                pareto.append((float(area[i]), float(sdc[i]),
+                               assignment(int(i))))
+
+        feasible = sdc <= sdc_target
+        base_sdc, _, base_area = (
+            float(np.asarray(x)[0])
+            for x in self.evaluate(self._baseline_cfg[None, :]))
+        if feasible.any():
+            # min area among feasible; SDC breaks area ties
+            cand = np.nonzero(feasible)[0]
+            best = int(cand[np.lexsort((sdc[cand], area[cand]))[0]])
+            ok = True
+        else:
+            best = int(np.argmin(sdc))   # closest approach, reported infeasible
+            ok = False
+        return SearchResult(
+            feasible=ok, assignment=assignment(best),
+            area=float(area[best]), sdc_rate=float(sdc[best]),
+            due_rate=float(due[best]),
+            baseline_area=base_area, baseline_sdc=base_sdc,
+            pareto=pareto, n_configs=len(configs))

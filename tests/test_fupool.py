@@ -72,6 +72,21 @@ def test_priority_to_shadow_starves_later_primaries_of_shadows():
     assert m_pri.fu_busy[U.OC_INT_ALU] == 1
 
 
+def test_op_lat_keeps_units_busy_across_cycles():
+    # One MUL per cycle (issue_width=1) against 2 IntMultDiv units with
+    # op_lat=3: cycle 0 claims unit A (busy through cycle 2), its shadow
+    # claims unit B — so cycles 1 and 2 have no mult unit free (primary
+    # fu_busy, shadow → approx ALU); cycle 3 sees both free again.
+    m = FUPoolModel(oc_seq(*[U.OC_INT_MULT] * 4), issue_width=1)
+    assert list(m.grants) == [GRANT_EXACT, GRANT_APPROX, GRANT_APPROX,
+                              GRANT_EXACT]
+    assert m.fu_busy[U.OC_INT_MULT] == 2
+    # with op_lat=1 units, every cycle is fresh
+    pool = FUPoolConfig(int_mult=IntMultDiv(op_lat=1))
+    m1 = FUPoolModel(oc_seq(*[U.OC_INT_MULT] * 4), issue_width=1, pool=pool)
+    assert list(m1.grants) == [GRANT_EXACT] * 4
+
+
 def test_mem_and_nop_not_shadow_eligible():
     m = FUPoolModel(oc_seq(U.OC_MEM_READ, U.OC_MEM_WRITE, U.OC_NONE),
                     issue_width=8)

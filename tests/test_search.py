@@ -1,0 +1,138 @@
+"""Design-space search tests (search/protect.py)."""
+
+import numpy as np
+import pytest
+
+from shrewd_tpu.models.o3 import O3Config
+from shrewd_tpu.ops import classify as C
+from shrewd_tpu.search import (DEFAULT_SCHEMES, DesignSpace, Scheme,
+                               StructureProfile, shadow_scheme)
+from shrewd_tpu.trace.synth import WorkloadConfig, generate
+
+
+def profile(name, bits, masked, sdc, due, det=0, fit=1e-3):
+    return StructureProfile.from_tally(
+        name, bits, np.array([masked, sdc, due, det]), fit_per_bit=fit)
+
+
+def test_from_tally_normalizes():
+    p = profile("regfile", 8192, 60, 30, 10)
+    np.testing.assert_allclose(p.probs.sum(), 1.0)
+    assert p.probs[C.OUTCOME_SDC] == 0.3
+    assert p.fit == pytest.approx(8192 * 1e-3)
+
+
+def test_scheme_validation():
+    with pytest.raises(ValueError):
+        Scheme("bad", 0.7, 0.5, 1.2).validate()   # detect+correct > 1
+    with pytest.raises(ValueError):
+        Scheme("bad", 0.0, 0.0, 0.5).validate()   # area < 1
+
+
+def test_unprotected_baseline_math():
+    p = profile("regfile", 1000, 50, 40, 10)
+    ds = DesignSpace([p], schemes=[DEFAULT_SCHEMES[0]])
+    sdc, due, area = (np.asarray(x) for x in ds.evaluate(ds.enumerate()))
+    assert sdc[0] == pytest.approx(1000 * 1e-3 * 0.4)
+    assert due[0] == pytest.approx(1000 * 1e-3 * 0.1)
+    assert area[0] == pytest.approx(1000.0)
+
+
+def test_correction_converts_to_masked_detection_to_detected():
+    p = profile("rf", 1000, 0, 100, 0)
+    ds = DesignSpace([p])
+    cfgs = ds.enumerate()
+    sdc, due, area = (np.asarray(x) for x in ds.evaluate(cfgs))
+    by_name = {DEFAULT_SCHEMES[k].name: i
+               for i, (k,) in enumerate(cfgs)}
+    assert sdc[by_name["parity"]] == pytest.approx(0.0)    # full detection
+    assert sdc[by_name["tmr"]] == pytest.approx(0.0)       # full correction
+    # DMR doubles the fault targets but detects everything
+    assert sdc[by_name["dmr"]] == pytest.approx(0.0)
+    assert area[by_name["dmr"]] == pytest.approx(2000.0)
+
+
+def test_search_picks_min_area_feasible():
+    # big vulnerable structure + small benign one: protecting only the big
+    # one should win; schemes: none / cheap-detect / expensive-correct
+    schemes = [Scheme("none", 0, 0, 1.0),
+               Scheme("parity", 1.0, 0, 1.1),
+               Scheme("tmr", 0, 1.0, 3.0)]
+    big = profile("rob", 10_000, 20, 70, 10)
+    small = profile("iq", 100, 90, 5, 5)
+    ds = DesignSpace([big, small], schemes=schemes)
+    target = small.fit * 0.05 * 1.5     # small's raw SDC passes; big's cannot
+    res = ds.search(target)
+    assert res.feasible
+    assert res.assignment == {"rob": "parity", "iq": "none"}
+    assert res.area == pytest.approx(10_000 * 1.1 + 100)
+    assert res.sdc_rate <= target
+    assert res.baseline_sdc > target
+    assert res.n_configs == 9
+
+
+def test_search_infeasible_reports_closest():
+    p = profile("rf", 1000, 0, 100, 0)
+    ds = DesignSpace([p], schemes=[Scheme("none", 0, 0, 1.0),
+                                   Scheme("weak", 0.5, 0, 1.2)])
+    res = ds.search(0.0)    # unreachable: weak residual SDC > 0
+    assert not res.feasible
+    assert res.assignment == {"rf": "weak"}
+
+
+def test_pareto_front_monotone():
+    ds = DesignSpace([profile("a", 1000, 50, 40, 10),
+                      profile("b", 2000, 80, 15, 5)])
+    res = ds.search(1e-9)
+    areas = [a for a, _, _ in res.pareto]
+    sdcs = [s for _, s, _ in res.pareto]
+    assert areas == sorted(areas)
+    assert sdcs == sorted(sdcs, reverse=True)
+    assert len(res.pareto) >= 2
+
+
+def test_allowed_restricts_space():
+    ds = DesignSpace([profile("fu", 500, 40, 50, 10),
+                      profile("rf", 1000, 70, 20, 10)],
+                     schemes=[Scheme("none", 0, 0, 1.0),
+                              Scheme("shadow", 0.8, 0, 1.5),
+                              Scheme("secded", 0, 1.0, 1.2)],
+                     allowed={"fu": [0, 1], "rf": [0, 2]})
+    cfgs = ds.enumerate()
+    assert len(cfgs) == 4
+    assert set(map(tuple, cfgs)) == {(0, 0), (0, 2), (1, 0), (1, 2)}
+    with pytest.raises(KeyError):
+        DesignSpace([profile("fu", 1, 1, 0, 0)], allowed={"nope": [0]})
+
+
+def test_shadow_scheme_from_kernel():
+    from shrewd_tpu.ops.trial import TrialKernel
+    t = generate(WorkloadConfig(n=128, nphys=32, mem_words=64,
+                                working_set_words=32, seed=11))
+    k = TrialKernel(t, O3Config(shadow_model="fupool"))
+    s = shadow_scheme(k, area=1.4)
+    assert s.name == "shadow"
+    assert 0.0 < s.detect <= 1.0
+    assert s.correct == 0.0 and s.area == 1.4
+    # disabled SHREWD → zero detection
+    assert shadow_scheme(k.with_shrewd(enable=False)).detect == 0.0
+
+
+def test_end_to_end_campaign_to_search():
+    """Measured raw tallies (enable_shrewd=False) → profiles → search."""
+    import jax
+    from shrewd_tpu.ops.trial import TrialKernel
+    t = generate(WorkloadConfig(n=256, nphys=64, mem_words=128,
+                                working_set_words=64, seed=12))
+    k = TrialKernel(t, O3Config(enable_shrewd=False))
+    keys = jax.random.split(jax.random.key(3), 256)
+    profiles = []
+    bits = {"regfile": 64 * 32, "rob": 192 * 16, "lsq": 32 * 64}
+    for s, b in bits.items():
+        tally = np.asarray(k.run_keys(keys, s))
+        profiles.append(StructureProfile.from_tally(s, b, tally))
+    ds = DesignSpace(profiles)
+    res = ds.search(res_target := ds.search(0.0).baseline_sdc * 0.01)
+    assert res.n_configs == len(DEFAULT_SCHEMES) ** 3
+    assert res.feasible            # TMR everywhere always reaches 1% residual
+    assert res.sdc_rate <= res_target
