@@ -35,7 +35,7 @@ def main() -> None:
     args = ap.parse_args()
 
     n_uops = args.uops or (256 if args.quick else 4096)
-    batch = args.batch or (256 if args.quick else 8192)
+    batch = args.batch or (256 if args.quick else 131072)
     nphys = 256
     mem_words = 1024 if args.quick else 4096
 

@@ -102,6 +102,18 @@ class O3Config(ConfigObject):
     compare_regs = Param(bool, True,
                          "classify end-of-window register diffs as SDC "
                          "(conservative); False compares memory only")
+    # Replay kernel selection (ops/trial.py):
+    #  "dense"  — full-state scan (ops/replay.py), exact, HBM-bound;
+    #  "taint"  — deviation-set kernel (ops/taint.py), escapes unresolved;
+    #  "hybrid" — taint + dense re-run of escaped lanes: dense-exact, fast.
+    replay_kernel = Param(str, "hybrid",
+                          check=lambda s: s in ("dense", "taint", "hybrid"))
+    taint_k = Param(int, 16, "deviation-set capacity per trial (ops/taint.py);"
+                    " overflow escapes to the dense kernel")
+    taint_mem_timeline_mb = Param(int, 256,
+                                  "record the golden memory timeline when "
+                                  "n*mem_words*4 fits this budget (resolves "
+                                  "LSQ_ADDR-faulted loads without escaping)")
     # SHREWD controls (reference enableShrewd/priorityToShadow params,
     # src/cpu/o3/BaseO3CPU.py:226-227; runtime pybind setters cpu.hh:298-302
     # — here TrialKernel.with_shrewd rebuilds the kernel instead of mutating).

@@ -1,0 +1,325 @@
+"""Deviation-set ("taint") trial kernel — the TPU-native fast path.
+
+The dense kernel (ops/replay.py) carries each trial's full machine state
+(nphys + mem_words words) through ``lax.scan``; on TPU the scan rewrites that
+carry every step, so throughput is bound by HBM traffic on state that is
+~99% identical to the golden run.  This kernel exploits the structure of SFI:
+a trial differs from the golden replay only where the fault propagated.  Each
+trial carries a bounded *deviation set* — k (location, trial-value) entries —
+and every step consumes the golden run's per-step values (uniform across the
+batch, streamed by the scan) plus an O(k) associative lookup, so the carried
+state is ~16 entries instead of ~20k words.
+
+Exactness contract: outcomes equal the dense kernel's, except lanes flagged
+``escaped`` (deviation-set overflow, or a load from an address whose golden
+content at that cycle was not precomputed).  Escaped lanes are re-run on the
+dense kernel by the hybrid driver (ops/trial.py); the combined result is
+bit-identical to dense-everywhere.  tests/test_taint.py enforces this.
+
+The deviation set plays the role of gem5's store-queue/forwarding CAM
+(lsq_unit.cc) generalized to all machine state; golden per-step streams are
+the ElasticTrace analog (cpu/o3/probe/elastic_trace.hh:93) captured on
+device.  Locations are tagged: register r → r, memory word w → nphys + w.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from shrewd_tpu.isa import uops as U
+from shrewd_tpu.models.o3 import (Fault, KIND_FU, KIND_IQ_SRC1, KIND_IQ_SRC2,
+                                  KIND_LATCH_IMM, KIND_LATCH_OP,
+                                  KIND_LSQ_ADDR, KIND_LSQ_DATA, KIND_REGFILE,
+                                  KIND_ROB_DST)
+from shrewd_tpu.ops import classify as C
+from shrewd_tpu.ops.replay import TraceArrays, _alu
+
+u32 = jnp.uint32
+i32 = jnp.int32
+
+EMPTY = i32(-1)
+
+
+class GoldenRecord(NamedTuple):
+    """Golden-run streams consumed by the taint kernel.
+
+    Per-step arrays are uniform across the batch (streamed as scan inputs);
+    timelines serve the one-time per-lane fault-setup gathers."""
+
+    a: jax.Array          # uint32[n]  operand 1 value
+    b: jax.Array          # uint32[n]  operand 2 value
+    ea: jax.Array         # uint32[n]  ALU/effective-address output
+    res: jax.Array        # uint32[n]  writeback value (post-load for loads)
+    st_old: jax.Array     # uint32[n]  pre-store content of the store target
+    dst_old: jax.Array    # uint32[n]  pre-write content of the dest register
+    wr: jax.Array         # bool[n]    golden writes a register this step
+    is_ld: jax.Array      # bool[n]
+    is_st: jax.Array      # bool[n]
+    reg_t: jax.Array      # uint32[n, nphys]  reg state BEFORE step i
+    mem_t: jax.Array | None   # uint32[n, mem_words] mem BEFORE step i, or None
+    final_reg: jax.Array  # uint32[nphys]
+    final_mem: jax.Array  # uint32[mem_words]
+
+
+def record_golden(tr: TraceArrays, init_reg: jax.Array, init_mem: jax.Array,
+                  mem_timeline: bool) -> GoldenRecord:
+    """One fault-free recording replay → GoldenRecord (device arrays).
+
+    ``mem_timeline=False`` skips the [n, mem_words] memory timeline (whose
+    rows the taint scan streams to resolve loads at non-golden addresses
+    in-kernel); without it such loads escape to the dense kernel.
+    """
+    n = tr.opcode.shape[0]
+    mem_words = init_mem.shape[0]
+
+    def step(carry, xs):
+        reg, mem = carry
+        op, dstr, s1, s2, imm = xs
+        a = reg[s1]
+        b = reg[s2]
+        eff = _alu(op, a, b, imm)
+        is_ld = op == U.LOAD
+        is_st = op == U.STORE
+        slot = (eff >> u32(2)).astype(i32) & i32(mem_words - 1)
+        st_old = mem[slot]
+        ldval = st_old                     # pre-store content == load value
+        res = jnp.where(is_ld, ldval, eff)
+        dst_old = reg[dstr]
+        writes = ((op >= U.ADD) & (op <= U.SLTU)) | is_ld
+        ys = (a, b, eff, res, st_old, dst_old, reg) \
+            + ((mem,) if mem_timeline else ())
+        reg = reg.at[dstr].set(jnp.where(writes, res, dst_old))
+        mem = mem.at[slot].set(jnp.where(is_st, b, st_old))
+        return (reg, mem), ys
+
+    xs = (tr.opcode, tr.dst, tr.src1, tr.src2, tr.imm)
+    (final_reg, final_mem), ys = jax.lax.scan(
+        step, (init_reg.astype(u32), init_mem.astype(u32)), xs)
+    if mem_timeline:
+        a, b, ea, res, st_old, dst_old, reg_t, mem_t = ys
+    else:
+        a, b, ea, res, st_old, dst_old, reg_t = ys
+        mem_t = None
+    op_np = np.asarray(tr.opcode)
+    return GoldenRecord(
+        a=a, b=b, ea=ea, res=res, st_old=st_old, dst_old=dst_old,
+        wr=jnp.asarray(U.writes_dest(op_np)),
+        is_ld=jnp.asarray(U.is_load(op_np)),
+        is_st=jnp.asarray(U.is_store(op_np)),
+        reg_t=reg_t, mem_t=mem_t,
+        final_reg=final_reg, final_mem=final_mem)
+
+
+class TaintResult(NamedTuple):
+    outcome: jax.Array    # int32 — valid iff not escaped/overflowed
+    escaped: jax.Array    # bool — load at unresolved address (row pass fixes)
+    overflow: jax.Array   # bool — deviation set full (only dense fixes)
+
+
+# --- deviation-set primitives (k-vector ops; tags unique or EMPTY) ---------
+
+def _lookup(tags, vals, tag):
+    hit = tags == tag
+    return hit.any(), jnp.where(hit, vals, u32(0)).sum().astype(u32)
+
+
+def _set(tags, vals, tag, val, enable):
+    """Update-or-insert (tag, val) where enable; overflow when full."""
+    hit = tags == tag
+    found = hit.any()
+    empty = tags == EMPTY
+    slot = jnp.where(found, jnp.argmax(hit), jnp.argmax(empty))
+    can = found | empty.any()
+    do = enable & can
+    lane = jnp.arange(tags.shape[0]) == slot
+    tags = jnp.where(do & lane, tag, tags)
+    vals = jnp.where(do & lane, val, vals)
+    return tags, vals, enable & ~can
+
+
+def _remove(tags, tag, enable):
+    return jnp.where((tags == tag) & enable, EMPTY, tags)
+
+
+def taint_replay(gold: GoldenRecord, tr: TraceArrays, fault: Fault,
+                 shadow_cov: jax.Array, k: int = 16,
+                 compare_regs: bool = True) -> TaintResult:
+    """One trial via deviation tracking. jit/vmap-safe.
+
+    Phase order matches ops/replay.py exactly (the event-priority-ladder
+    analog); every dense-kernel fault kind is supported.
+    """
+    nphys = gold.final_reg.shape[0]
+    mem_words = gold.final_mem.shape[0]
+    idx_mask = i32(nphys - 1)
+    n = tr.opcode.shape[0]
+    bitmask = u32(1) << fault.bit.astype(u32)
+    index_mask = fault.bit_as_index_mask()
+
+    # --- one-time per-lane fault-setup gathers (outside the scan) ---
+    # REGFILE: trial content at the flipped register when the flip lands.
+    gold_at_fault = gold.reg_t[fault.cycle, fault.entry & idx_mask]
+    # IQ_SRC: golden value of the *alternate* register the faulted µop reads.
+    e = jnp.clip(fault.entry, 0, n - 1)
+    alt1 = gold.reg_t[e, (tr.src1[e] ^ index_mask) & idx_mask]
+    alt2 = gold.reg_t[e, (tr.src2[e] ^ index_mask) & idx_mask]
+    have_mem_t = gold.mem_t is not None   # static: selects the step variant
+
+    def step(carry, xs):
+        (tags, vals, live, detected, trapped, diverged, escaped,
+         overflowed) = carry
+        (i, op, dstr, s1, s2, imm, tk, sc,
+         g_a, g_b, g_ea, g_res, g_st_old, g_dst_old, g_wr, g_ld, g_st) = xs[:17]
+        # golden memory image BEFORE this step (streamed row, uniform
+        # across lanes) — resolves loads at non-golden addresses exactly:
+        # a location with no deviation entry holds the golden content.
+        g_mem_row = xs[17] if have_mem_t else None
+
+        at_uop = i == fault.entry
+
+        # 1. storage-fault landing (REGFILE)
+        flip_here = (fault.kind == KIND_REGFILE) & (i == fault.cycle) & live
+        found_f, val_f = _lookup(tags, vals, fault.entry)
+        content_f = jnp.where(found_f, val_f, gold_at_fault)
+        tags, vals, ovf0 = _set(tags, vals, fault.entry, content_f ^ bitmask,
+                                flip_here)
+
+        # 2. operand read (latch + IQ index faults)
+        op_flipped = op ^ jnp.where((fault.kind == KIND_LATCH_OP) & at_uop,
+                                    index_mask, i32(0))
+        illegal_now = ((op_flipped >= i32(U.N_OPCODES)) | (op_flipped < 0)) & live
+        op = jnp.clip(op_flipped, 0, U.N_OPCODES - 1)
+        imm = imm ^ jnp.where((fault.kind == KIND_LATCH_IMM) & at_uop,
+                              bitmask, u32(0))
+        iq1 = (fault.kind == KIND_IQ_SRC1) & at_uop
+        iq2 = (fault.kind == KIND_IQ_SRC2) & at_uop
+        tag1 = jnp.where(iq1, (s1 ^ index_mask) & idx_mask, s1)
+        tag2 = jnp.where(iq2, (s2 ^ index_mask) & idx_mask, s2)
+        f1, v1 = _lookup(tags, vals, tag1)
+        f2, v2 = _lookup(tags, vals, tag2)
+        a = jnp.where(f1, v1, jnp.where(iq1, alt1, g_a))
+        b = jnp.where(f2, v2, jnp.where(iq2, alt2, g_b))
+
+        # 3. execute
+        raw = _alu(op, a, b, imm)
+        eff = raw ^ jnp.where((fault.kind == KIND_FU) & at_uop, bitmask, u32(0))
+        detected_now = ((fault.kind == KIND_FU) & at_uop & live
+                        & (fault.shadow_u < sc))
+
+        is_ld = op == U.LOAD
+        is_st = op == U.STORE
+        is_mem_op = is_ld | is_st
+        is_br = (op >= U.BEQ) & (op <= U.BGE)
+
+        # 4. memory access
+        addr = eff ^ jnp.where((fault.kind == KIND_LSQ_ADDR) & at_uop,
+                               bitmask, u32(0))
+        valid = ((addr & u32(3)) == 0) & ((addr >> u32(2)) < u32(mem_words))
+        trapped_now = (is_mem_op & ~valid & live) | illegal_now
+        slot = (addr >> u32(2)).astype(i32) & i32(mem_words - 1)
+        slot_g = (g_ea >> u32(2)).astype(i32) & i32(mem_words - 1)
+        mtag = i32(nphys) + slot
+        gtag = i32(nphys) + slot_g
+        same_slot = slot == slot_g
+
+        # 4a. load value: deviation entry > golden same-slot stream > golden
+        # memory-timeline row (exact: no entry ⇒ trial content == golden
+        # content) > escape (timeline not recorded).
+        ld_here = is_ld & valid & live & ~trapped_now
+        fm, vm = _lookup(tags, vals, mtag)
+        golden_here = same_slot & (g_ld | g_st)
+        g_mem_val = jnp.where(g_ld, g_res, g_st_old)
+        if have_mem_t:
+            ldval = jnp.where(fm, vm,
+                              jnp.where(golden_here, g_mem_val,
+                                        g_mem_row[slot]))
+            escaped_now = jnp.bool_(False) & live
+        else:
+            ldval = jnp.where(fm, vm, jnp.where(golden_here, g_mem_val, u32(0)))
+            escaped_now = ld_here & ~fm & ~golden_here
+
+        # 5. branch resolution
+        taken_eff = is_br & (eff != 0)
+        diverged_now = (taken_eff != (tk != 0)) & live
+
+        live_next = live & ~(detected_now | trapped_now | diverged_now
+                             | escaped_now)
+
+        # 4b. store updates
+        st_data = b ^ jnp.where((fault.kind == KIND_LSQ_DATA) & at_uop,
+                                bitmask, u32(0))
+        st_t = is_st & valid & live_next
+        match_st = st_t & g_st & same_slot & (st_data == g_b)
+        tags = _remove(tags, mtag, match_st)
+        tags, vals, ovf1 = _set(tags, vals, mtag, st_data, st_t & ~match_st)
+        # missing golden store: trial did not write slot_g this step
+        miss_st = g_st & live_next & ~(st_t & same_slot)
+        fg, vg = _lookup(tags, vals, gtag)
+        content_g = jnp.where(fg, vg, g_st_old)
+        m_coinc = miss_st & (content_g == g_b)
+        tags = _remove(tags, gtag, m_coinc)
+        tags, vals, ovf2 = _set(tags, vals, gtag, content_g, miss_st & ~m_coinc)
+
+        # 6. writeback (ROB dest-index fault redirects the write)
+        rob_here = (fault.kind == KIND_ROB_DST) & at_uop
+        writes_t = (((op >= U.ADD) & (op <= U.SLTU)) | is_ld) & live_next
+        result = jnp.where(is_ld, ldval, eff)
+        wtag = jnp.where(rob_here, (dstr ^ index_mask) & idx_mask, dstr)
+        same_dst = wtag == dstr
+        g_post = jnp.where(g_wr, g_res, g_dst_old)   # golden dst content after
+        match_w = writes_t & same_dst & (result == g_post)
+        tags = _remove(tags, dstr, match_w)
+        tags, vals, ovf3 = _set(tags, vals, wtag, result, writes_t & ~match_w)
+        # missing register write: golden wrote dst, trial did not
+        miss_w = g_wr & live_next & ~(writes_t & same_dst)
+        fd, vd = _lookup(tags, vals, dstr)
+        content_d = jnp.where(fd, vd, g_dst_old)
+        w_coinc = miss_w & (content_d == g_res)
+        tags = _remove(tags, dstr, w_coinc)
+        tags, vals, ovf4 = _set(tags, vals, dstr, content_d, miss_w & ~w_coinc)
+
+        overflow_now = ovf0 | ovf1 | ovf2 | ovf3 | ovf4
+        live_next = live_next & ~overflow_now
+
+        return ((tags, vals, live_next,
+                 detected | detected_now,
+                 trapped | trapped_now,
+                 diverged | diverged_now,
+                 escaped | escaped_now,
+                 overflowed | overflow_now), None)
+
+    xs = (jnp.arange(n, dtype=i32), tr.opcode, tr.dst, tr.src1, tr.src2,
+          tr.imm, tr.taken, shadow_cov.astype(jnp.float32),
+          gold.a, gold.b, gold.ea, gold.res, gold.st_old, gold.dst_old,
+          gold.wr, gold.is_ld, gold.is_st) \
+        + ((gold.mem_t,) if have_mem_t else ())
+    # Derive the initial carry from the per-trial fault so its varying type
+    # under shard_map matches the step outputs (same trick as ops/replay.py).
+    vary0 = (fault.cycle * 0).astype(i32)
+    vary_false = fault.cycle != fault.cycle
+    init = (jnp.full((k,), EMPTY, dtype=i32) + vary0,
+            jnp.zeros((k,), dtype=u32) ^ vary0.astype(u32),
+            ~vary_false, vary_false, vary_false, vary_false, vary_false,
+            vary_false)
+    (tags, vals, _live, detected, trapped, diverged, escaped, overflowed), _ \
+        = jax.lax.scan(step, init, xs)
+
+    # End classification: any surviving deviation vs the golden FINAL state.
+    final_state = jnp.concatenate([gold.final_reg, gold.final_mem])
+    ent_live = tags != EMPTY
+    safe = jnp.where(ent_live, tags, 0)
+    differs = ent_live & (vals != final_state[safe])
+    if not compare_regs:
+        differs = differs & (tags >= nphys)
+    state_diff = differs.any()
+
+    outcome = jnp.where(
+        detected, i32(C.OUTCOME_DETECTED),
+        jnp.where(trapped, i32(C.OUTCOME_DUE),
+                  jnp.where(diverged | state_diff, i32(C.OUTCOME_SDC),
+                            i32(C.OUTCOME_MASKED))))
+    return TaintResult(outcome=outcome, escaped=escaped, overflow=overflowed)

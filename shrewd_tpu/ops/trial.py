@@ -6,6 +6,13 @@ outcome classes, and ``run_keys`` goes straight from PRNG keys to the
 psum-reducible tally vector.  This is the per-chip unit the campaign layer
 shards over the mesh (SURVEY §2.12 P3: vmap over trials within a chip,
 shard_map over chips).
+
+Kernel selection (``O3Config.replay_kernel``): the *hybrid* default runs the
+deviation-set kernel (ops/taint.py) for the whole batch and re-runs only the
+escaped lanes on the dense kernel — bit-identical outcomes to dense-
+everywhere at a fraction of the HBM traffic.  The dense path remains the
+in-framework oracle (the CheckerCPU pattern) and the shard_map-traceable
+``outcomes_from_keys`` protocol.
 """
 
 from __future__ import annotations
@@ -14,12 +21,14 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from shrewd_tpu.isa import uops as U
 from shrewd_tpu.models.o3 import (Fault, FaultSampler, O3Config,
                                   compute_shadow_cov, null_fault)
 from shrewd_tpu.ops import classify as C
 from shrewd_tpu.ops.replay import ReplayResult, TraceArrays, replay
+from shrewd_tpu.ops.taint import record_golden, taint_replay
 
 
 class TrialKernel:
@@ -39,6 +48,12 @@ class TrialKernel:
         # MASKED exact by construction (the CheckerCPU-style scalar oracle is
         # a separate differential test, not the classification baseline).
         self.golden: ReplayResult = jax.jit(self._replay_one)(null_fault())
+        self._golden_rec = None         # taint-kernel streams, lazy
+        self._samplers: dict = {}
+        self._sample_jits: dict = {}
+        # taint observability: escape counts feed campaign stats
+        self.escapes = 0
+        self.taint_trials = 0
 
     def with_shrewd(self, enable: bool | None = None,
                     priority_to_shadow: bool | None = None) -> "TrialKernel":
@@ -67,22 +82,144 @@ class TrialKernel:
 
     @partial(jax.jit, static_argnums=0)
     def run_batch(self, faults: Fault) -> jax.Array:
-        """Fault batch (vmapped leaves) → outcome classes int32[B]."""
+        """Fault batch (vmapped leaves) → outcome classes int32[B], dense
+        kernel (the in-framework oracle path)."""
         return self._outcomes(faults)
 
     def sampler(self, structure: str):
-        if structure == "latch":
-            from shrewd_tpu.models.minor import MinorFaultSampler
-            return MinorFaultSampler(self.trace, self.minor_cfg)
-        return FaultSampler(self.trace, structure, self.cfg)
+        if structure not in self._samplers:
+            if structure == "latch":
+                from shrewd_tpu.models.minor import MinorFaultSampler
+                self._samplers[structure] = MinorFaultSampler(
+                    self.trace, self.minor_cfg)
+            else:
+                self._samplers[structure] = FaultSampler(
+                    self.trace, structure, self.cfg)
+        return self._samplers[structure]
 
     def outcomes_from_keys(self, keys: jax.Array, structure: str) -> jax.Array:
-        """Per-trial keys → outcome classes int32[B].  The campaign-facing
-        protocol shared with models.ruby.CacheKernel (traceable; callers
-        jit/shard_map it)."""
+        """Per-trial keys → outcome classes int32[B], dense kernel.  The
+        campaign-facing traceable protocol shared with
+        models.ruby.CacheKernel (callers jit/shard_map it)."""
         return self._outcomes(self.sampler(structure).sample_batch(keys))
 
+    # --- taint/hybrid fast path -------------------------------------------
+
+    @property
+    def golden_rec(self):
+        """Golden streams for the taint kernel (recorded on first use).
+        Built eagerly even when first touched inside a jit trace, so the
+        concrete arrays live on self rather than leaking tracers."""
+        if self._golden_rec is None:
+            budget = self.cfg.taint_mem_timeline_mb * (1 << 20)
+            with_mem_t = self.trace.n * self.trace.mem_words * 4 <= budget
+            with jax.ensure_compile_time_eval():
+                self._golden_rec = record_golden(
+                    self.tr, self.init_reg, self.init_mem, with_mem_t)
+        return self._golden_rec
+
+    def _taint_one(self, fault: Fault, use_row: bool):
+        gold = self.golden_rec if use_row else self.golden_rec._replace(
+            mem_t=None)
+        return taint_replay(gold, self.tr, fault, self.shadow_cov,
+                            k=self.cfg.taint_k,
+                            compare_regs=self.cfg.compare_regs)
+
+    def taint_batch(self, faults: Fault, use_row: bool = False):
+        """Fault batch → TaintResult batch (outcome + escaped flags).
+
+        ``use_row=False`` is the fast pass: loads at non-golden addresses
+        escape instead of paying a per-step timeline-row gather.  The hybrid
+        driver re-runs escapes with ``use_row=True`` (exact in-kernel
+        resolution), then dense for deviation-set overflows."""
+        _ = self.golden_rec      # materialize outside the jit trace
+        return self._taint_batch_jit(faults, use_row)
+
     @partial(jax.jit, static_argnums=(0, 2))
-    def run_keys(self, keys: jax.Array, structure: str) -> jax.Array:
-        """Per-trial keys → outcome tally (N_OUTCOMES,). The campaign unit."""
+    def _taint_batch_jit(self, faults: Fault, use_row: bool):
+        return jax.vmap(partial(self._taint_one, use_row=use_row))(faults)
+
+    def sample_batch(self, keys: jax.Array, structure: str) -> Fault:
+        """Jitted fault sampling (cached per structure)."""
+        if structure not in self._sample_jits:
+            self._sample_jits[structure] = jax.jit(
+                self.sampler(structure).sample_batch)
+        return self._sample_jits[structure](keys)
+
+    @staticmethod
+    def _bucket(idx: np.ndarray) -> np.ndarray:
+        """Pad indices to a power-of-two bucket ≥ 64 to bound recompiles."""
+        m = max(64, 1 << int(np.ceil(np.log2(len(idx)))))
+        return np.concatenate([idx, np.zeros(m - len(idx), dtype=idx.dtype)])
+
+    def run_batch_hybrid(self, faults: Fault) -> np.ndarray:
+        """Three-pass exact driver: fast taint for all lanes → row-enabled
+        taint for lanes that escaped on loads → dense for deviation-set
+        overflows.  Outcomes are bit-identical to ``run_batch``
+        (tests/test_taint.py).  Host-side — not traceable; see
+        outcomes_from_keys for the shard_map path."""
+        res = self.taint_batch(faults, False)
+        outcomes = np.asarray(res.outcome).copy()
+        esc = np.asarray(res.escaped)
+        ovf = np.asarray(res.overflow)
+        self.escapes += int((esc | ovf).sum())
+        self.taint_trials += len(outcomes)
+        idx = np.nonzero(esc & ~ovf)[0]     # load escapes: row pass resolves
+        dense_idx = np.nonzero(ovf)[0]      # overflows: only dense resolves
+        if len(idx) and self.golden_rec.mem_t is not None:
+            pad = self._bucket(idx)
+            sub = jax.tree.map(lambda x: jnp.asarray(np.asarray(x)[pad]),
+                               faults)
+            res2 = self.taint_batch(sub, True)
+            outcomes[idx] = np.asarray(res2.outcome)[:len(idx)]
+            still = np.asarray(res2.escaped | res2.overflow)[:len(idx)]
+            dense_idx = np.concatenate([dense_idx, idx[still]])
+        elif len(idx):                      # no timeline recorded
+            dense_idx = np.concatenate([dense_idx, idx])
+        if len(dense_idx):
+            pad = self._bucket(dense_idx)
+            sub = jax.tree.map(lambda x: jnp.asarray(np.asarray(x)[pad]),
+                               faults)
+            sub_out = np.asarray(self.run_batch(sub))
+            outcomes[dense_idx] = sub_out[:len(dense_idx)]
+        return outcomes
+
+    # --- the campaign unit -------------------------------------------------
+
+    @partial(jax.jit, static_argnums=(0, 2))
+    def _run_keys_dense(self, keys: jax.Array, structure: str) -> jax.Array:
         return C.tally(self.outcomes_from_keys(keys, structure))
+
+    def run_keys_traceable(self, keys: jax.Array, structure: str) -> jax.Array:
+        """Keys → tally, fully traceable (jit/shard_map-safe) for any
+        ``cfg.replay_kernel``.  The taint path here classifies unresolved
+        lanes (escape/overflow) conservatively as SDC — exact resolution
+        needs the host-driven hybrid (``run_keys``)."""
+        if self.cfg.replay_kernel == "dense":
+            return C.tally(self.outcomes_from_keys(keys, structure))
+        _ = self.golden_rec
+        faults = self.sampler(structure).sample_batch(keys)
+        res = jax.vmap(partial(self._taint_one, use_row=True))(faults)
+        out = jnp.where(res.escaped | res.overflow,
+                        jnp.int32(C.OUTCOME_SDC), res.outcome)
+        return C.tally(out)
+
+    def run_keys(self, keys: jax.Array, structure: str) -> jax.Array:
+        """Per-trial keys → outcome tally (N_OUTCOMES,). The campaign unit.
+        Dispatches on ``cfg.replay_kernel``; "taint" classifies unresolved
+        lanes conservatively as SDC, "hybrid" resolves them exactly."""
+        mode = self.cfg.replay_kernel
+        if mode == "dense":
+            return self._run_keys_dense(keys, structure)
+        faults = self.sample_batch(keys, structure)
+        if mode == "taint":
+            res = self.taint_batch(faults)
+            unresolved = np.asarray(res.escaped | res.overflow)
+            out = np.asarray(res.outcome).copy()
+            out[unresolved] = C.OUTCOME_SDC
+            self.escapes += int(unresolved.sum())
+            self.taint_trials += len(out)
+        else:
+            out = self.run_batch_hybrid(faults)
+        return jnp.asarray(
+            np.bincount(out, minlength=C.N_OUTCOMES).astype(np.int32))

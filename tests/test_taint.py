@@ -1,0 +1,147 @@
+"""Taint (deviation-set) kernel tests (ops/taint.py).
+
+The core contract: hybrid (taint + dense-rerun-of-escapes) outcomes are
+bit-identical to the dense kernel for every structure and fault batch —
+the same differential discipline the dense kernel holds against the C++
+oracle (tests/test_native_diff.py), one level up."""
+
+import jax
+import numpy as np
+import pytest
+
+from shrewd_tpu.isa import semantics, uops as U
+from shrewd_tpu.models.o3 import O3Config
+from shrewd_tpu.ops import classify as C
+from shrewd_tpu.ops.taint import record_golden
+from shrewd_tpu.ops.trial import TrialKernel
+from shrewd_tpu.trace.synth import WorkloadConfig, generate
+from shrewd_tpu.utils import prng
+
+
+def make_trace(seed=1, n=256, nphys=64, mem_words=128):
+    return generate(WorkloadConfig(n=n, nphys=nphys, mem_words=mem_words,
+                                   working_set_words=mem_words // 2,
+                                   seed=seed))
+
+
+def test_record_golden_matches_scalar_oracle():
+    t = make_trace(seed=21)
+    gold = record_golden(
+        TrialKernel(t).tr,
+        jax.numpy.asarray(t.init_reg), jax.numpy.asarray(t.init_mem),
+        mem_timeline=True)
+    reg, mem = t.init_reg.copy(), t.init_mem.copy()
+    semantics.scalar_replay(t, reg, mem)
+    np.testing.assert_array_equal(np.asarray(gold.final_reg), reg)
+    np.testing.assert_array_equal(np.asarray(gold.final_mem), mem)
+    # reg_t[0] is the initial state; timelines are "state BEFORE step i"
+    np.testing.assert_array_equal(np.asarray(gold.reg_t[0]), t.init_reg)
+    np.testing.assert_array_equal(np.asarray(gold.mem_t[0]), t.init_mem)
+
+
+def test_null_fault_is_masked_no_escape():
+    t = make_trace(seed=22)
+    k = TrialKernel(t)
+    from shrewd_tpu.models.o3 import null_fault
+    res = k.taint_batch(jax.tree.map(lambda x: x[None], null_fault()))
+    assert int(res.outcome[0]) == C.OUTCOME_MASKED
+    assert not bool(res.escaped[0]) and not bool(res.overflow[0])
+
+
+@pytest.mark.parametrize("structure",
+                         ["regfile", "fu", "rob", "iq", "lsq", "latch"])
+def test_hybrid_equals_dense(structure):
+    t = make_trace(seed=23)
+    k = TrialKernel(t, O3Config(shadow_coverage=[0.4] * U.N_OPCLASSES))
+    keys = prng.trial_keys(prng.campaign_key(5), 128)
+    faults = k.sample_batch(keys, structure)
+    dense = np.asarray(k.run_batch(faults))
+    hybrid = k.run_batch_hybrid(faults)
+    np.testing.assert_array_equal(hybrid, dense)
+
+
+def test_overflow_escapes_and_hybrid_still_exact():
+    # k=1 deviation slot: almost any propagating fault overflows; the
+    # hybrid path must still match dense exactly.
+    t = make_trace(seed=24)
+    k = TrialKernel(t, O3Config(taint_k=1))
+    keys = prng.trial_keys(prng.campaign_key(6), 64)
+    faults = k.sample_batch(keys, "regfile")
+    res = k.taint_batch(faults)
+    assert int(np.asarray(res.overflow).sum()) > 0
+    np.testing.assert_array_equal(k.run_batch_hybrid(faults),
+                                  np.asarray(k.run_batch(faults)))
+
+
+def test_lsq_without_mem_timeline_still_exact():
+    # Disable the memory timeline: LSQ_ADDR-faulted loads escape, and the
+    # dense re-run keeps the hybrid result exact.
+    t = make_trace(seed=25)
+    k_no = TrialKernel(t, O3Config(taint_mem_timeline_mb=0))
+    k_yes = TrialKernel(t, O3Config())
+    assert k_no.golden_rec.mem_t is None
+    assert k_yes.golden_rec.mem_t is not None
+    keys = prng.trial_keys(prng.campaign_key(7), 96)
+    for k in (k_no, k_yes):
+        faults = k.sample_batch(keys, "lsq")
+        np.testing.assert_array_equal(k.run_batch_hybrid(faults),
+                                      np.asarray(k.run_batch(faults)))
+    # the timeline resolves load-address faults in-kernel → fewer escapes
+    assert k_yes.escapes <= k_no.escapes
+
+
+def test_run_keys_modes_agree():
+    t = make_trace(seed=26)
+    keys = prng.trial_keys(prng.campaign_key(8), 128)
+    tallies = {}
+    for mode in ("dense", "hybrid"):
+        k = TrialKernel(t, O3Config(replay_kernel=mode))
+        tallies[mode] = np.asarray(k.run_keys(keys, "regfile"))
+    np.testing.assert_array_equal(tallies["hybrid"], tallies["dense"])
+    # taint-only mode is conservative: SDC can only grow, masked only shrink
+    k = TrialKernel(t, O3Config(replay_kernel="taint"))
+    taint_tally = np.asarray(k.run_keys(keys, "regfile"))
+    assert taint_tally.sum() == tallies["dense"].sum()
+    assert taint_tally[C.OUTCOME_SDC] >= tallies["dense"][C.OUTCOME_SDC]
+
+
+def test_escape_rate_is_low_for_regfile():
+    t = make_trace(seed=27, n=512)
+    k = TrialKernel(t)
+    keys = prng.trial_keys(prng.campaign_key(9), 256)
+    k.run_batch_hybrid(k.sample_batch(keys, "regfile"))
+    assert k.taint_trials == 256
+    assert k.escapes / k.taint_trials < 0.25
+
+
+def test_graft_entry_fn_is_jittable():
+    """entry()'s documented contract: (jittable_fn, example_args)."""
+    import sys
+    sys.path.insert(0, "/root/repo")
+    import __graft_entry__ as g
+    fn, args = g.entry()
+    tally = np.asarray(jax.jit(fn)(*args))
+    assert tally.sum() == args[0].shape[0]
+
+
+def test_run_keys_traceable_matches_modes():
+    t = make_trace(seed=29)
+    k = TrialKernel(t)     # hybrid default
+    keys = prng.trial_keys(prng.campaign_key(11), 64)
+    traced = np.asarray(jax.jit(k.run_keys_traceable,
+                                static_argnums=1)(keys, "regfile"))
+    hybrid = np.asarray(k.run_keys(keys, "regfile"))
+    assert traced.sum() == hybrid.sum() == 64
+    # traceable path is conservative: SDC can only grow vs exact hybrid
+    assert traced[C.OUTCOME_SDC] >= hybrid[C.OUTCOME_SDC]
+
+
+def test_shadow_detection_in_taint():
+    t = make_trace(seed=28)
+    k = TrialKernel(t, O3Config(shadow_coverage=[1.0] * U.N_OPCLASSES))
+    keys = prng.trial_keys(prng.campaign_key(10), 64)
+    faults = k.sample_batch(keys, "fu")
+    res = k.taint_batch(faults)
+    out = np.asarray(res.outcome)
+    esc = np.asarray(res.escaped | res.overflow)
+    assert (out[~esc] == C.OUTCOME_DETECTED).all()
