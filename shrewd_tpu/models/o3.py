@@ -114,6 +114,10 @@ class O3Config(ConfigObject):
                                   "record the golden memory timeline when "
                                   "n*mem_words*4 fits this budget (resolves "
                                   "LSQ_ADDR-faulted loads without escaping)")
+    # Pallas fast pass (ops/pallas_taint.py): "auto" uses it on TPU backends
+    # only; "on" forces it (interpret mode off-TPU, for tests); "off" keeps
+    # the XLA taint kernel.
+    pallas = Param(str, "auto", check=lambda s: s in ("auto", "on", "off"))
     # SHREWD controls (reference enableShrewd/priorityToShadow params,
     # src/cpu/o3/BaseO3CPU.py:226-227; runtime pybind setters cpu.hh:298-302
     # — here TrialKernel.with_shrewd rebuilds the kernel instead of mutating).

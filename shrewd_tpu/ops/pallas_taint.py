@@ -1,0 +1,419 @@
+"""Pallas TPU kernel for the taint fast pass (SURVEY §7 build-plan #5).
+
+The XLA version of the deviation-set scan (ops/taint.py) leaves ~40× on the
+table: its (B, k) per-step temporaries spill to HBM because XLA won't keep
+the whole scan body fused.  This kernel pins everything on-chip:
+
+- grid over lane blocks of ``B_TILE`` trials; each block's deviation set
+  (k × B_TILE tags/values) lives in VMEM/registers for the whole window;
+- golden per-step streams (uniform across lanes) sit in VMEM once per core
+  and are read as scalars each step;
+- the µop is executed via ``lax.switch`` on the *scalar* opcode — one ALU
+  branch runs per step, instead of the 23-candidate select the batched XLA
+  kernel must evaluate (per-lane divergent opcodes only arise under
+  LATCH_OP faults, for which the where-chain vector ALU is used —
+  ``may_latch``);
+- end-of-window classification (gathers into the golden final state) stays
+  in XLA where gathers are cheap: the kernel returns the surviving
+  deviation sets and flags.
+
+Escape/overflow semantics are identical to ``taint_replay`` — the hybrid
+driver (ops/trial.py) resolves them with the row pass and the dense kernel.
+Differential tests pin this kernel to the XLA taint kernel bit-for-bit
+(tests/test_pallas_taint.py).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from shrewd_tpu.isa import uops as U
+from shrewd_tpu.models.o3 import (Fault, KIND_FU, KIND_IQ_SRC1, KIND_IQ_SRC2,
+                                  KIND_LATCH_IMM, KIND_LATCH_OP,
+                                  KIND_LSQ_ADDR, KIND_LSQ_DATA, KIND_REGFILE,
+                                  KIND_ROB_DST)
+from shrewd_tpu.ops import classify as C
+from shrewd_tpu.ops.taint import EMPTY, GoldenRecord, TaintResult
+
+i32 = jnp.int32
+u32 = jnp.uint32
+
+LANE = 128          # TPU lane width; B_TILE and n must be multiples
+
+
+def _u(x):
+    return jax.lax.bitcast_convert_type(x, u32)
+
+
+def _s(x):
+    return jax.lax.bitcast_convert_type(x, i32)
+
+
+def _alu_switch(op, a, b, imm):
+    """Scalar-opcode ALU: one branch executes (a/b/imm are lane vectors)."""
+    sh = b & i32(31)
+    one = jnp.ones_like(a)
+    zero = jnp.zeros_like(a)
+
+    def sra(_):
+        return _s(jax.lax.shift_right_arithmetic(a, sh))
+
+    def srl(_):
+        return _s(jax.lax.shift_right_logical(_u(a), _u(sh) & u32(31)))
+
+    branches = [
+        lambda _: zero,                                   # NOP
+        lambda _: a + b, lambda _: a - b,
+        lambda _: a & b, lambda _: a | b, lambda _: a ^ b,
+        lambda _: a << sh, srl, sra,
+        lambda _: a + imm, lambda _: a & imm, lambda _: a | imm,
+        lambda _: a ^ imm, lambda _: imm,
+        lambda _: a * b,
+        lambda _: jnp.where(a < b, one, zero),            # SLT (signed i32)
+        lambda _: jnp.where(_u(a) < _u(b), one, zero),    # SLTU
+        lambda _: a + imm, lambda _: a + imm,             # LOAD/STORE ea
+        lambda _: jnp.where(a == b, one, zero),
+        lambda _: jnp.where(a != b, one, zero),
+        lambda _: jnp.where(a < b, one, zero),
+        lambda _: jnp.where(a >= b, one, zero),
+    ]
+    return jax.lax.switch(op, branches, None)
+
+
+def _alu_vec(op, a, b, imm):
+    """Per-lane-opcode ALU (LATCH_OP support): where-chain over candidates."""
+    sh = b & i32(31)
+    one = jnp.ones_like(a)
+    zero = jnp.zeros_like(a)
+    cands = [
+        zero, a + b, a - b, a & b, a | b, a ^ b,
+        a << sh, _s(jax.lax.shift_right_logical(_u(a), _u(sh) & u32(31))),
+        _s(jax.lax.shift_right_arithmetic(a, sh)),
+        a + imm, a & imm, a | imm, a ^ imm, imm,
+        a * b,
+        jnp.where(a < b, one, zero),
+        jnp.where(_u(a) < _u(b), one, zero),
+        a + imm, a + imm,
+        jnp.where(a == b, one, zero),
+        jnp.where(a != b, one, zero),
+        jnp.where(a < b, one, zero),
+        jnp.where(a >= b, one, zero),
+    ]
+    out = zero
+    for c, cand in enumerate(cands):
+        out = jnp.where(op == i32(c), cand, out)
+    return out
+
+
+def _make_kernel(n: int, k: int, nphys: int, mem_words: int, may_latch: bool):
+    idx_mask = nphys - 1          # python ints: no captured traced constants
+    EMPTY_C = -1
+
+    def kernel(op_s, dst_s, s1_s, s2_s, imm_s, tk_s, sc_s,
+               ga_s, gb_s, gea_s, gres_s, gsto_s, gdsto_s, gwr_s, gld_s,
+               gst_s,
+               kind_r, cycle_r, entry_r, bit_r, su_r, gaf_r, alt1_r, alt2_r,
+               out_r, esc_r, ovf_r, tags_out, vals_out):
+        B = kind_r.shape[1]
+        kind = kind_r[0, :]
+        cycle = cycle_r[0, :]
+        entry = entry_r[0, :]
+        bit = bit_r[0, :]
+        shadow_u = su_r[0, :]
+        gold_at_fault = gaf_r[0, :]
+        alt1 = alt1_r[0, :]
+        alt2 = alt2_r[0, :]
+        bitmask = i32(1) << (bit & i32(31))      # i32 bit pattern
+        index_mask = i32(1) << bit
+        iota = jax.lax.broadcasted_iota(i32, (k, B), 0)
+
+        def lookup(tags, vals, tag):
+            hit = tags == tag[None, :]
+            found = hit.any(axis=0)
+            val = jnp.sum(jnp.where(hit, vals, 0), axis=0)
+            return found, val
+
+        def upsert(tags, vals, tag, val, write_en, hit=None):
+            if hit is None:
+                hit = tags == tag[None, :]
+            found = hit.any(axis=0)
+            empty = tags == EMPTY_C
+            hit_idx = jnp.min(jnp.where(hit, iota, k), axis=0)
+            empty_idx = jnp.min(jnp.where(empty, iota, k), axis=0)
+            slot = jnp.where(found, hit_idx, empty_idx)
+            can = slot < k
+            do = write_en & can
+            m = (iota == slot[None, :]) & do[None, :]
+            tags = jnp.where(m, tag[None, :], tags)
+            vals = jnp.where(m, val[None, :], vals)
+            return tags, vals, write_en & ~can
+
+        def remove(tags, tag, en):
+            return jnp.where((tags == tag[None, :]) & en[None, :],
+                             EMPTY_C, tags)
+
+        def step(i, carry):
+            tags, vals, live, det, trap, div, esc, ovf = carry
+            op0 = op_s[0, i]
+            dstr = dst_s[0, i]
+            s1 = s1_s[0, i]
+            s2 = s2_s[0, i]
+            imm0 = imm_s[0, i]
+            tk = tk_s[0, i]
+            sc = sc_s[0, i]
+            g_a = ga_s[0, i]
+            g_b = gb_s[0, i]
+            g_ea = gea_s[0, i]
+            g_res = gres_s[0, i]
+            g_st_old = gsto_s[0, i]
+            g_dst_old = gdsto_s[0, i]
+            g_wr = gwr_s[0, i] != 0
+            g_ld = gld_s[0, i] != 0
+            g_st = gst_s[0, i] != 0
+
+            at_uop = entry == i
+
+            # 1. REGFILE landing
+            flip = (kind == KIND_REGFILE) & (cycle == i) & live
+            ftag = entry & idx_mask
+            f0, v0 = lookup(tags, vals, ftag)
+            content0 = jnp.where(f0, v0, gold_at_fault)
+            tags, vals, o0 = upsert(tags, vals, ftag, content0 ^ bitmask, flip)
+
+            # 2. operand read
+            if may_latch:
+                opv = jnp.full((B,), op0, dtype=i32) ^ jnp.where(
+                    (kind == KIND_LATCH_OP) & at_uop, index_mask, i32(0))
+                illegal = ((opv >= i32(U.N_OPCODES)) | (opv < 0)) & live
+                opv = jnp.clip(opv, 0, U.N_OPCODES - 1)
+            else:
+                opv = None
+                illegal = jnp.zeros((B,), dtype=jnp.bool_)
+            immv = jnp.full((B,), imm0, dtype=i32) ^ jnp.where(
+                (kind == KIND_LATCH_IMM) & at_uop, bitmask, i32(0))
+            iq1 = (kind == KIND_IQ_SRC1) & at_uop
+            iq2 = (kind == KIND_IQ_SRC2) & at_uop
+            tag1 = jnp.where(iq1, (s1 ^ index_mask) & idx_mask,
+                             jnp.full((B,), s1, dtype=i32))
+            tag2 = jnp.where(iq2, (s2 ^ index_mask) & idx_mask,
+                             jnp.full((B,), s2, dtype=i32))
+            f1, v1 = lookup(tags, vals, tag1)
+            f2, v2 = lookup(tags, vals, tag2)
+            a = jnp.where(f1, v1, jnp.where(iq1, alt1, g_a))
+            b = jnp.where(f2, v2, jnp.where(iq2, alt2, g_b))
+
+            # 3. execute
+            if may_latch:
+                raw = _alu_vec(opv, a, b, immv)
+                is_ld = opv == U.LOAD
+                is_st = opv == U.STORE
+                is_br = (opv >= U.BEQ) & (opv <= U.BGE)
+                writes_op = ((opv >= U.ADD) & (opv <= U.SLTU))
+            else:
+                raw = _alu_switch(op0, a, b, immv)
+                is_ld = jnp.full((B,), op0 == U.LOAD)
+                is_st = jnp.full((B,), op0 == U.STORE)
+                is_br = jnp.full((B,), (op0 >= U.BEQ) & (op0 <= U.BGE))
+                writes_op = jnp.full((B,), (op0 >= U.ADD) & (op0 <= U.SLTU))
+            fu_here = (kind == KIND_FU) & at_uop
+            eff = raw ^ jnp.where(fu_here, bitmask, i32(0))
+            det_now = fu_here & live & (shadow_u < sc)
+
+            # 4. memory
+            addr = eff ^ jnp.where((kind == KIND_LSQ_ADDR) & at_uop,
+                                   bitmask, i32(0))
+            word = _s(jax.lax.shift_right_logical(_u(addr), u32(2)))
+            # word is a logical >>2 of a 32-bit value → always fits
+            # non-negative i32, so a signed compare is safe
+            valid = ((addr & i32(3)) == 0) & (word < i32(mem_words))
+            is_mem = is_ld | is_st
+            trap_now = (is_mem & ~valid & live) | illegal
+            slot = word & i32(mem_words - 1)
+            slot_g = _s(jax.lax.shift_right_logical(_u(
+                jnp.full((B,), g_ea, dtype=i32)), u32(2))) & i32(mem_words - 1)
+            mtag = i32(nphys) + slot
+            gtag = i32(nphys) + slot_g
+            same_slot = slot == slot_g
+
+            ld_here = is_ld & valid & live & ~trap_now
+            hit_m = tags == mtag[None, :]
+            fm = hit_m.any(axis=0)
+            vm = jnp.sum(jnp.where(hit_m, vals, 0), axis=0)
+            golden_here = same_slot & (g_ld | g_st)
+            g_mem_val = jnp.where(g_ld, g_res, g_st_old)
+            ldval = jnp.where(fm, vm, jnp.where(golden_here, g_mem_val,
+                                                i32(0)))
+            esc_now = ld_here & ~fm & ~golden_here
+
+            # 5. branch
+            taken_eff = is_br & (eff != 0)
+            div_now = (taken_eff != (tk != 0)) & live
+
+            live_next = live & ~(det_now | trap_now | div_now | esc_now)
+
+            # 4b. stores
+            st_data = b ^ jnp.where((kind == KIND_LSQ_DATA) & at_uop,
+                                    bitmask, i32(0))
+            st_t = is_st & valid & live_next
+            match_st = st_t & g_st & same_slot & (st_data == g_b)
+            tags = remove(tags, mtag, match_st)
+            tags, vals, o1 = upsert(tags, vals, mtag, st_data,
+                                    st_t & ~match_st)
+            miss_st = g_st & live_next & ~(st_t & same_slot)
+            fg, vg = lookup(tags, vals, gtag)
+            content_g = jnp.where(fg, vg, g_st_old)
+            m_coinc = miss_st & (content_g == g_b)
+            tags = remove(tags, gtag, m_coinc)
+            tags, vals, o2 = upsert(tags, vals, gtag, content_g,
+                                    miss_st & ~m_coinc)
+
+            # 6. writeback
+            rob_here = (kind == KIND_ROB_DST) & at_uop
+            writes_t = (writes_op | is_ld) & live_next
+            result = jnp.where(is_ld, ldval, eff)
+            dstv = jnp.full((B,), dstr, dtype=i32)
+            wtag = jnp.where(rob_here, (dstv ^ index_mask) & idx_mask, dstv)
+            same_dst = wtag == dstv
+            g_post = jnp.where(g_wr, g_res, g_dst_old)
+            match_w = writes_t & same_dst & (result == g_post)
+            tags = remove(tags, dstv, match_w)
+            tags, vals, o3 = upsert(tags, vals, wtag, result,
+                                    writes_t & ~match_w)
+            miss_w = g_wr & live_next & ~(writes_t & same_dst)
+            fd, vd = lookup(tags, vals, dstv)
+            content_d = jnp.where(fd, vd, g_dst_old)
+            w_coinc = miss_w & (content_d == g_res)
+            tags = remove(tags, dstv, w_coinc)
+            tags, vals, o4 = upsert(tags, vals, dstv, content_d,
+                                    miss_w & ~w_coinc)
+
+            ovf_now = o0 | o1 | o2 | o3 | o4
+            live_next = live_next & ~ovf_now
+            return (tags, vals, live_next, det | det_now, trap | trap_now,
+                    div | div_now, esc | esc_now, ovf | ovf_now)
+
+        B_ = kind_r.shape[1]
+        init = (jnp.full((k, B_), EMPTY_C, dtype=i32),
+                jnp.zeros((k, B_), dtype=i32),
+                jnp.ones((B_,), dtype=jnp.bool_),
+                jnp.zeros((B_,), dtype=jnp.bool_),
+                jnp.zeros((B_,), dtype=jnp.bool_),
+                jnp.zeros((B_,), dtype=jnp.bool_),
+                jnp.zeros((B_,), dtype=jnp.bool_),
+                jnp.zeros((B_,), dtype=jnp.bool_))
+        tags, vals, live, det, trap, div, esc, ovf = jax.lax.fori_loop(
+            0, n, step, init)
+        out_r[0, :] = (det.astype(i32) + trap.astype(i32) * 2
+                       + div.astype(i32) * 4)
+        esc_r[0, :] = esc.astype(i32)
+        ovf_r[0, :] = ovf.astype(i32)
+        tags_out[:, :] = tags
+        vals_out[:, :] = vals
+
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=("k", "compare_regs", "may_latch",
+                                             "b_tile", "interpret"))
+def taint_fast_pallas(gold: GoldenRecord, opcode, dst, src1, src2, imm,
+                      taken, shadow_cov, faults: Fault,
+                      gold_at_fault, alt1, alt2,
+                      k: int = 16, compare_regs: bool = True,
+                      may_latch: bool = True, b_tile: int = 512,
+                      interpret: bool = False) -> TaintResult:
+    """Pallas fast pass over a fault batch (padded to b_tile internally).
+
+    Takes the same GoldenRecord as the XLA kernel (mem_t unused) plus the
+    per-lane fault-setup gathers precomputed by the caller.  Returns the
+    same TaintResult contract as ``taint_replay`` (fast-pass variant:
+    loads at non-golden addresses escape).
+    """
+    n = int(opcode.shape[0])
+    nphys = int(gold.final_reg.shape[0])
+    mem_words = int(gold.final_mem.shape[0])
+    B = int(faults.kind.shape[0])
+    n_pad = -(-n // LANE) * LANE
+    B_pad = -(-B // b_tile) * b_tile
+
+    def pad_stream(x):
+        x = jnp.asarray(x, i32).reshape(1, -1)
+        return jnp.pad(x, ((0, 0), (0, n_pad - n)))
+
+    streams = [
+        pad_stream(opcode), pad_stream(dst), pad_stream(src1),
+        pad_stream(src2), pad_stream(_s(imm.astype(u32))),
+        pad_stream(taken),
+        jnp.pad(jnp.asarray(shadow_cov, jnp.float32).reshape(1, -1),
+                ((0, 0), (0, n_pad - n))),
+        pad_stream(_s(gold.a)), pad_stream(_s(gold.b)),
+        pad_stream(_s(gold.ea)), pad_stream(_s(gold.res)),
+        pad_stream(_s(gold.st_old)), pad_stream(_s(gold.dst_old)),
+        pad_stream(gold.wr.astype(i32)), pad_stream(gold.is_ld.astype(i32)),
+        pad_stream(gold.is_st.astype(i32)),
+    ]
+
+    def pad_lane(x, dtype=i32):
+        x = jnp.asarray(x).astype(dtype).reshape(1, -1)
+        return jnp.pad(x, ((0, 0), (0, B_pad - B)))
+
+    lanes = [
+        pad_lane(faults.kind), pad_lane(faults.cycle),
+        pad_lane(faults.entry), pad_lane(faults.bit),
+        jnp.pad(jnp.asarray(faults.shadow_u, jnp.float32).reshape(1, -1),
+                ((0, 0), (0, B_pad - B)), constant_values=2.0),
+        pad_lane(_s(gold_at_fault)), pad_lane(_s(alt1)), pad_lane(_s(alt2)),
+    ]
+
+    kernel = _make_kernel(n, k, nphys, mem_words, may_latch)
+    grid = (B_pad // b_tile,)
+    stream_spec = pl.BlockSpec((1, n_pad), lambda b: (0, 0),
+                               memory_space=pltpu.VMEM)
+    lane_spec = pl.BlockSpec((1, b_tile), lambda b: (0, b),
+                             memory_space=pltpu.VMEM)
+    kset_spec = pl.BlockSpec((k, b_tile), lambda b: (0, b),
+                             memory_space=pltpu.VMEM)
+    outcome_bits, esc, ovf, tags, vals = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[stream_spec] * len(streams) + [lane_spec] * len(lanes),
+        out_specs=[lane_spec, lane_spec, lane_spec, kset_spec, kset_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, B_pad), i32),   # det/trap/div bits
+            jax.ShapeDtypeStruct((1, B_pad), i32),
+            jax.ShapeDtypeStruct((1, B_pad), i32),
+            jax.ShapeDtypeStruct((k, B_pad), i32),
+            jax.ShapeDtypeStruct((k, B_pad), i32),
+        ],
+        interpret=interpret,
+    )(*streams, *lanes)
+
+    # --- XLA postprocessing: end-of-window classification ---
+    bits = outcome_bits[0, :B]
+    detected = (bits & 1) != 0
+    trapped = (bits & 2) != 0
+    diverged = (bits & 4) != 0
+    escaped = esc[0, :B] != 0
+    overflow = ovf[0, :B] != 0
+    tags = tags[:, :B]
+    vals = _u(vals[:, :B])
+
+    final_state = jnp.concatenate([gold.final_reg, gold.final_mem])
+    ent = tags != EMPTY
+    safe = jnp.where(ent, tags, 0)
+    differs = ent & (vals != final_state[safe])
+    if not compare_regs:
+        differs = differs & (tags >= nphys)
+    state_diff = differs.any(axis=0)
+
+    outcome = jnp.where(
+        detected, i32(C.OUTCOME_DETECTED),
+        jnp.where(trapped, i32(C.OUTCOME_DUE),
+                  jnp.where(diverged | state_diff, i32(C.OUTCOME_SDC),
+                            i32(C.OUTCOME_MASKED))))
+    return TaintResult(outcome=outcome, escaped=escaped, overflow=overflow)

@@ -145,6 +145,28 @@ def _remove(tags, tag, enable):
     return jnp.where((tags == tag) & enable, EMPTY, tags)
 
 
+def fault_setup(gold: GoldenRecord, tr: TraceArrays, fault: Fault):
+    """One-time fault-setup gathers → (gold_at_fault, alt1, alt2).
+
+    Works on scalar Faults (inside taint_replay, pre-scan) and on vmapped
+    batches alike — the single source both the XLA and Pallas fast passes
+    gather from, so the two kernels cannot drift:
+
+    - REGFILE: trial content at the flipped register when the flip lands;
+    - IQ_SRC:  golden value of the *alternate* register the faulted µop
+      reads (``reg_t[e, src^mask]``).
+    """
+    nphys = gold.final_reg.shape[0]
+    idx_mask = i32(nphys - 1)
+    n = tr.opcode.shape[0]
+    index_mask = fault.bit_as_index_mask()
+    gold_at_fault = gold.reg_t[fault.cycle, fault.entry & idx_mask]
+    e = jnp.clip(fault.entry, 0, n - 1)
+    alt1 = gold.reg_t[e, (tr.src1[e] ^ index_mask) & idx_mask]
+    alt2 = gold.reg_t[e, (tr.src2[e] ^ index_mask) & idx_mask]
+    return gold_at_fault, alt1, alt2
+
+
 def taint_replay(gold: GoldenRecord, tr: TraceArrays, fault: Fault,
                  shadow_cov: jax.Array, k: int = 16,
                  compare_regs: bool = True) -> TaintResult:
@@ -160,13 +182,7 @@ def taint_replay(gold: GoldenRecord, tr: TraceArrays, fault: Fault,
     bitmask = u32(1) << fault.bit.astype(u32)
     index_mask = fault.bit_as_index_mask()
 
-    # --- one-time per-lane fault-setup gathers (outside the scan) ---
-    # REGFILE: trial content at the flipped register when the flip lands.
-    gold_at_fault = gold.reg_t[fault.cycle, fault.entry & idx_mask]
-    # IQ_SRC: golden value of the *alternate* register the faulted µop reads.
-    e = jnp.clip(fault.entry, 0, n - 1)
-    alt1 = gold.reg_t[e, (tr.src1[e] ^ index_mask) & idx_mask]
-    alt2 = gold.reg_t[e, (tr.src2[e] ^ index_mask) & idx_mask]
+    gold_at_fault, alt1, alt2 = fault_setup(gold, tr, fault)
     have_mem_t = gold.mem_t is not None   # static: selects the step variant
 
     def step(carry, xs):

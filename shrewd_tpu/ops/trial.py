@@ -139,6 +139,31 @@ class TrialKernel:
     def _taint_batch_jit(self, faults: Fault, use_row: bool):
         return jax.vmap(partial(self._taint_one, use_row=use_row))(faults)
 
+    def _pallas_enabled(self) -> bool:
+        mode = self.cfg.pallas
+        if mode == "off":
+            return False
+        on_tpu = jax.devices()[0].platform in ("tpu", "axon")
+        return mode == "on" or on_tpu
+
+    def taint_fast(self, faults: Fault, may_latch: bool = True):
+        """Fast-pass dispatch: Pallas kernel (ops/pallas_taint.py) when
+        enabled for this backend, else the XLA taint kernel.  Identical
+        escape/overflow semantics either way.  Traceable (jit/shard_map)."""
+        _ = self.golden_rec
+        if not self._pallas_enabled():
+            return self._taint_batch_jit(faults, False)
+        from shrewd_tpu.ops.pallas_taint import taint_fast_pallas
+        from shrewd_tpu.ops.taint import fault_setup
+        gaf, alt1, alt2 = fault_setup(self.golden_rec, self.tr, faults)
+        interp = jax.devices()[0].platform not in ("tpu", "axon")
+        return taint_fast_pallas(
+            self.golden_rec, self.tr.opcode, self.tr.dst, self.tr.src1,
+            self.tr.src2, self.tr.imm, self.tr.taken, self.shadow_cov,
+            faults, gaf, alt1, alt2, k=self.cfg.taint_k,
+            compare_regs=self.cfg.compare_regs, may_latch=may_latch,
+            interpret=interp)
+
     def sample_batch(self, keys: jax.Array, structure: str) -> Fault:
         """Jitted fault sampling (cached per structure)."""
         if structure not in self._sample_jits:
@@ -152,16 +177,27 @@ class TrialKernel:
         m = max(64, 1 << int(np.ceil(np.log2(len(idx)))))
         return np.concatenate([idx, np.zeros(m - len(idx), dtype=idx.dtype)])
 
-    def run_batch_hybrid(self, faults: Fault) -> np.ndarray:
+    def run_batch_hybrid(self, faults: Fault,
+                         may_latch: bool = True) -> np.ndarray:
         """Three-pass exact driver: fast taint for all lanes → row-enabled
         taint for lanes that escaped on loads → dense for deviation-set
         overflows.  Outcomes are bit-identical to ``run_batch``
         (tests/test_taint.py).  Host-side — not traceable; see
-        outcomes_from_keys for the shard_map path."""
-        res = self.taint_batch(faults, False)
-        outcomes = np.asarray(res.outcome).copy()
-        esc = np.asarray(res.escaped)
-        ovf = np.asarray(res.overflow)
+        outcomes_from_keys for the shard_map path.
+
+        ``may_latch=False`` tells the Pallas fast pass no LATCH_OP faults
+        are present, enabling the scalar-opcode ALU (one lax.switch branch
+        per step instead of 23 candidates)."""
+        res = self.taint_fast(faults, may_latch=may_latch)
+        return self.resolve_escapes(faults, np.asarray(res.outcome).copy(),
+                                    np.asarray(res.escaped),
+                                    np.asarray(res.overflow))
+
+    def resolve_escapes(self, faults: Fault, outcomes: np.ndarray,
+                        esc: np.ndarray, ovf: np.ndarray) -> np.ndarray:
+        """Host-side passes 2+3 of the hybrid: row-enabled taint for load
+        escapes, dense for deviation-set overflows.  Shared by the
+        single-chip driver and the sharded campaign layer."""
         self.escapes += int((esc | ovf).sum())
         self.taint_trials += len(outcomes)
         idx = np.nonzero(esc & ~ovf)[0]     # load escapes: row pass resolves
@@ -212,14 +248,15 @@ class TrialKernel:
         if mode == "dense":
             return self._run_keys_dense(keys, structure)
         faults = self.sample_batch(keys, structure)
+        may_latch = structure == "latch"
         if mode == "taint":
-            res = self.taint_batch(faults)
+            res = self.taint_fast(faults, may_latch=may_latch)
             unresolved = np.asarray(res.escaped | res.overflow)
             out = np.asarray(res.outcome).copy()
             out[unresolved] = C.OUTCOME_SDC
             self.escapes += int(unresolved.sum())
             self.taint_trials += len(out)
         else:
-            out = self.run_batch_hybrid(faults)
+            out = self.run_batch_hybrid(faults, may_latch=may_latch)
         return jnp.asarray(
             np.bincount(out, minlength=C.N_OUTCOMES).astype(np.int32))

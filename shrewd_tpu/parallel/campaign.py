@@ -27,15 +27,27 @@ debug.register_flag("CampaignStep", "per-batch sharded campaign steps")
 
 
 class ShardedCampaign:
-    """One (trace, structure) campaign compiled over a mesh."""
+    """One (trace, structure) campaign compiled over a mesh.
+
+    Honors the kernel's ``replay_kernel`` config: "dense" runs the fully
+    SPMD dense path with an in-graph psum; "taint"/"hybrid" run the sharded
+    taint fast pass and resolve escapes on the host (the escaped subset is
+    tiny, so its re-run — row-enabled taint + dense — stays off the mesh,
+    exactly like the single-chip hybrid driver in ops/trial.py).  Kernels
+    without a replay_kernel knob (models.ruby.CacheKernel) use the dense
+    protocol: ``outcomes_from_keys(keys, structure)``.
+    """
 
     def __init__(self, kernel, mesh, structure: str):
         self.kernel = kernel
         self.mesh = mesh
         self.structure = structure
+        self.mode = getattr(getattr(kernel, "cfg", None),
+                            "replay_kernel", "dense")
+        may_latch = structure == "latch"
 
         def local_step(keys):
-            # any kernel speaking the campaign protocol (ops.trial.TrialKernel,
+            # the traceable campaign protocol (ops.trial.TrialKernel,
             # models.ruby.CacheKernel): keys → per-trial outcome classes
             outs = kernel.outcomes_from_keys(keys, structure)
             return jax.lax.psum(C.tally(outs), TRIAL_AXIS)
@@ -44,9 +56,36 @@ class ShardedCampaign:
             local_step, mesh=mesh,
             in_specs=P(TRIAL_AXIS), out_specs=P()))
 
+        self._taint_step = None
+        if self.mode != "dense":
+            _ = kernel.golden_rec     # materialize before tracing
+
+            def taint_step(keys):
+                faults = kernel.sampler(structure).sample_batch(keys)
+                res = kernel.taint_fast(faults, may_latch=may_latch)
+                return res.outcome, res.escaped, res.overflow
+
+            self._taint_step = jax.jit(jax.shard_map(
+                taint_step, mesh=mesh,
+                in_specs=P(TRIAL_AXIS),
+                out_specs=(P(TRIAL_AXIS),) * 3))
+
     def tally_batch(self, keys: jax.Array) -> jax.Array:
         """Sharded keys (B,) → replicated tally (N_OUTCOMES,)."""
-        return self._step(shard_keys(self.mesh, keys))
+        if self._taint_step is None:
+            return self._step(shard_keys(self.mesh, keys))
+        keys_sh = shard_keys(self.mesh, keys)
+        out, esc, ovf = self._taint_step(keys_sh)
+        out = np.asarray(out).copy()
+        esc = np.asarray(esc)
+        ovf = np.asarray(ovf)
+        if self.mode == "taint":    # conservative, no host re-runs
+            out[esc | ovf] = C.OUTCOME_SDC
+        elif (esc | ovf).any():
+            faults = self.kernel.sample_batch(keys_sh, self.structure)
+            out = self.kernel.resolve_escapes(faults, out, esc, ovf)
+        return jnp.asarray(
+            np.bincount(out, minlength=C.N_OUTCOMES).astype(np.int32))
 
 
 class CampaignResult(NamedTuple):

@@ -1,0 +1,81 @@
+"""Pallas fast-pass kernel tests (ops/pallas_taint.py).
+
+Differential contract: the Pallas kernel's TaintResult (outcome, escaped,
+overflow) is bit-identical to the XLA taint fast pass for every structure.
+Runs in interpreter mode on CPU (the NULL-build analog); the real lowering
+is exercised on the TPU by bench.py."""
+
+import jax
+import numpy as np
+import pytest
+
+from shrewd_tpu.isa import uops as U
+from shrewd_tpu.models.o3 import O3Config
+from shrewd_tpu.ops import classify as C
+from shrewd_tpu.ops.trial import TrialKernel
+from shrewd_tpu.trace.synth import WorkloadConfig, generate
+from shrewd_tpu.utils import prng
+
+
+def make_kernel(seed=31, n=160, pallas="on", **cfg_kw):
+    t = generate(WorkloadConfig(n=n, nphys=32, mem_words=64,
+                                working_set_words=32, seed=seed))
+    return TrialKernel(t, O3Config(pallas=pallas, **cfg_kw))
+
+
+@pytest.mark.parametrize("structure",
+                         ["regfile", "fu", "rob", "iq", "lsq", "latch"])
+def test_pallas_matches_xla_taint(structure):
+    k = make_kernel()
+    keys = prng.trial_keys(prng.campaign_key(12), 32)
+    faults = k.sample_batch(keys, structure)
+    ref = k.taint_batch(faults, False)
+    got = k.taint_fast(faults, may_latch=True)
+    np.testing.assert_array_equal(np.asarray(got.escaped),
+                                  np.asarray(ref.escaped))
+    np.testing.assert_array_equal(np.asarray(got.overflow),
+                                  np.asarray(ref.overflow))
+    resolved = ~np.asarray(ref.escaped | ref.overflow)
+    np.testing.assert_array_equal(np.asarray(got.outcome)[resolved],
+                                  np.asarray(ref.outcome)[resolved])
+
+
+@pytest.mark.parametrize("structure", ["regfile", "fu", "iq"])
+def test_scalar_alu_path_matches(structure):
+    """may_latch=False (lax.switch scalar ALU) on non-latch structures."""
+    k = make_kernel(seed=32)
+    keys = prng.trial_keys(prng.campaign_key(13), 32)
+    faults = k.sample_batch(keys, structure)
+    ref = k.taint_batch(faults, False)
+    got = k.taint_fast(faults, may_latch=False)
+    resolved = ~np.asarray(ref.escaped | ref.overflow)
+    np.testing.assert_array_equal(np.asarray(got.outcome)[resolved],
+                                  np.asarray(ref.outcome)[resolved])
+    np.testing.assert_array_equal(np.asarray(got.escaped),
+                                  np.asarray(ref.escaped))
+
+
+def test_hybrid_with_pallas_equals_dense():
+    k = make_kernel(seed=33)
+    keys = prng.trial_keys(prng.campaign_key(14), 48)
+    faults = k.sample_batch(keys, "regfile")
+    np.testing.assert_array_equal(k.run_batch_hybrid(faults),
+                                  np.asarray(k.run_batch(faults)))
+
+
+def test_batch_padding():
+    """Batch sizes that are not multiples of b_tile are padded internally."""
+    k = make_kernel(seed=34)
+    keys = prng.trial_keys(prng.campaign_key(15), 33)   # odd batch
+    faults = k.sample_batch(keys, "fu")
+    ref = k.taint_batch(faults, False)
+    got = k.taint_fast(faults)
+    assert got.outcome.shape == ref.outcome.shape == (33,)
+    resolved = ~np.asarray(ref.escaped | ref.overflow)
+    np.testing.assert_array_equal(np.asarray(got.outcome)[resolved],
+                                  np.asarray(ref.outcome)[resolved])
+
+
+def test_pallas_off_uses_xla():
+    k = make_kernel(seed=35, pallas="off")
+    assert not k._pallas_enabled()
