@@ -1,7 +1,7 @@
 """Headline benchmark: fault-injection trials/sec/chip.
 
 Runs the flagship SFI campaign step (vmapped inject→propagate→classify over a
-4096-µop SimPoint window, regfile structure) on the default JAX device and
+4096-µop SimPoint window, regfile structure) on the requested JAX device and
 compares against the serial native C++ golden kernel on this host — the
 stand-in for the reference's serial campaign path (BASELINE configs[0]; the
 reference repo publishes no numbers, BASELINE.md).
@@ -9,45 +9,137 @@ reference repo publishes no numbers, BASELINE.md).
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": "trials/sec/chip", "vs_baseline": N}
 
-Progress goes to stderr.  --quick shrinks shapes for CI smoke runs.
+Robustness (VERDICT r1 weak #1: the round-1 bench hung >9 min in TPU backend
+init and produced no number): the top-level process is a *supervisor* that
+never imports jax.  It re-execs itself as a worker pinned to one platform
+with a hard wall-clock timeout and bounded retries, falling back
+axon → cpu; a wedged backend init is SIGKILLed and the next platform tried,
+so exactly one JSON line is always emitted (a diagnostic one in the worst
+case).  Progress and diagnostics go to stderr.
+
+--quick shrinks shapes for CI smoke runs.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
+import statistics
+import subprocess
 import sys
 import time
 
-import numpy as np
+PLATFORM_TIMEOUTS = (("axon", 420.0), ("cpu", 600.0))
 
 
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-def main() -> None:
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--quick", action="store_true", help="tiny shapes (CI smoke)")
-    ap.add_argument("--batch", type=int, default=None, help="trials per batch")
-    ap.add_argument("--uops", type=int, default=None, help="window length")
-    ap.add_argument("--reps", type=int, default=3, help="timed repetitions")
-    args = ap.parse_args()
+# --------------------------------------------------------------------------
+# supervisor: no jax imports here
+# --------------------------------------------------------------------------
 
-    n_uops = args.uops or (256 if args.quick else 4096)
-    batch = args.batch or (256 if args.quick else 131072)
-    nphys = 256
-    mem_words = 1024 if args.quick else 4096
+def _strip_axon_site(env: dict) -> dict:
+    """CPU attempts must not load the axon sitecustomize: it dials the TPU
+    relay at *interpreter startup* and can hang every python for minutes
+    even under JAX_PLATFORMS=cpu (.claude/skills/verify/SKILL.md)."""
+    env = dict(env)
+    pp = [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+          if p and "axon_site" not in p]
+    env["PYTHONPATH"] = os.pathsep.join(pp)
+    return env
 
+
+def supervise(args) -> None:
+    platforms = list(PLATFORM_TIMEOUTS)
+    env_plat = args.platform or os.environ.get("JAX_PLATFORMS")
+    if env_plat:
+        # explicit request goes first, with a hard timeout — but keep the
+        # cpu fallback so a wedged TPU tunnel still yields a (clearly
+        # labeled) number instead of rc=1 (BENCH_r01 failure mode)
+        platforms = [(env_plat, 420.0)]
+        if env_plat != "cpu":
+            platforms.append(("cpu", 600.0))
+    worker_args = ["--reps", str(args.reps)]
+    if args.quick:
+        worker_args.append("--quick")
+    if args.batch:
+        worker_args += ["--batch", str(args.batch)]
+    if args.uops:
+        worker_args += ["--uops", str(args.uops)]
+    errors = []
+    for plat, tmo in platforms:
+        cmd = [sys.executable, os.path.abspath(__file__),
+               "--worker", "--platform", plat] + worker_args
+        env = dict(os.environ, JAX_PLATFORMS=plat)
+        if plat == "cpu":
+            env = _strip_axon_site(env)
+        log(f"bench supervisor: trying platform={plat} timeout={tmo:.0f}s")
+        t0 = time.monotonic()
+        try:
+            proc = subprocess.run(cmd, timeout=tmo, capture_output=True,
+                                  text=True, env=env)
+        except subprocess.TimeoutExpired as e:
+            for stream in (e.stderr, e.stdout):
+                if stream:
+                    sys.stderr.write(stream.decode(errors="replace")
+                                     if isinstance(stream, bytes)
+                                     else stream)
+            errors.append(f"{plat}: timeout after {tmo:.0f}s (backend hang)")
+            log(errors[-1])
+            continue
+        sys.stderr.write(proc.stderr)
+        line = next((ln for ln in proc.stdout.splitlines()
+                     if ln.startswith("{")), None)
+        if proc.returncode == 0 and line:
+            log(f"bench supervisor: platform={plat} ok "
+                f"in {time.monotonic() - t0:.0f}s")
+            print(line)
+            return
+        errors.append(f"{plat}: rc={proc.returncode} "
+                      f"stdout={proc.stdout[-200:]!r}")
+        log(errors[-1])
+    # every platform failed: emit a diagnostic JSON line, not a crash
+    print(json.dumps({
+        "metric": "sfi_trials_per_sec_per_chip",
+        "value": 0.0,
+        "unit": "trials/sec/chip",
+        "vs_baseline": 0.0,
+        "error": "; ".join(errors)[-500:],
+    }))
+
+
+# --------------------------------------------------------------------------
+# worker: one platform, real measurement
+# --------------------------------------------------------------------------
+
+def run_worker(args) -> None:
     import jax
+
+    if args.platform:
+        # authoritative post-import override: this image's sitecustomize
+        # pre-imports jax with JAX_PLATFORMS=axon, so mutating os.environ
+        # is not enough (see tests/conftest.py for the same dance)
+        jax.config.update("jax_platforms", args.platform)
+
+    import numpy as np
 
     from shrewd_tpu import native
     from shrewd_tpu.models.o3 import O3Config
     from shrewd_tpu.ops.trial import TrialKernel
     from shrewd_tpu.utils import prng
 
+    n_uops = args.uops or (256 if args.quick else 4096)
+    batch = args.batch or (256 if args.quick else 131072)
+    nphys = 256
+    mem_words = 1024 if args.quick else 4096
+
+    t0 = time.monotonic()
     dev = jax.devices()[0]
-    log(f"device: {dev} | window={n_uops} µops, batch={batch}")
+    log(f"device: {dev} ({dev.platform}) init {time.monotonic() - t0:.1f}s "
+        f"| window={n_uops} µops, batch={batch}")
 
     trace = native.generate_trace(seed=1, n=n_uops, nphys=nphys,
                                   mem_words=mem_words,
@@ -55,7 +147,12 @@ def main() -> None:
     kernel = TrialKernel(trace, O3Config())
     keys = prng.trial_keys(prng.campaign_key(0), batch)
 
-    # device path: compile, then steady-state timing
+    # pre-warm with a tiny compile first so a compiler problem surfaces fast
+    warm_keys = prng.trial_keys(prng.campaign_key(99), 8)
+    t0 = time.monotonic()
+    np.asarray(kernel.run_keys(warm_keys, "regfile"))
+    log(f"warm-up compile (8 trials): {time.monotonic() - t0:.1f}s")
+
     t0 = time.monotonic()
     tally = np.asarray(kernel.run_keys(keys, "regfile"))
     log(f"compile+first batch: {time.monotonic() - t0:.1f}s tally={tally}")
@@ -64,8 +161,26 @@ def main() -> None:
         t0 = time.monotonic()
         np.asarray(kernel.run_keys(keys, "regfile"))
         rates.append(batch / (time.monotonic() - t0))
-    device_rate = max(rates)
-    log(f"device: {device_rate:,.0f} trials/s")
+    device_rate = statistics.median(rates)
+    log(f"device: median {device_rate:,.0f} trials/s over {args.reps} reps "
+        f"(min {min(rates):,.0f}, max {max(rates):,.0f})")
+
+    # Pallas on/off delta (the fast pass is auto-enabled on TPU backends;
+    # force-off comparison quantifies its win on the same device)
+    pallas_delta = None
+    if kernel._pallas_enabled():
+        cfg_off = O3Config(pallas="off")
+        k_off = TrialKernel(trace, cfg_off)
+        np.asarray(k_off.run_keys(keys, "regfile"))      # compile
+        off_rates = []
+        for _ in range(args.reps):
+            t0 = time.monotonic()
+            np.asarray(k_off.run_keys(keys, "regfile"))
+            off_rates.append(batch / (time.monotonic() - t0))
+        off_rate = statistics.median(off_rates)
+        pallas_delta = device_rate / off_rate
+        log(f"pallas off: median {off_rate:,.0f} trials/s → pallas speedup "
+            f"×{pallas_delta:.2f}")
 
     # serial C++ baseline on the same trace (sample of trials, extrapolated)
     n_base = min(batch, 512 if args.quick else 2048)
@@ -83,12 +198,33 @@ def main() -> None:
     if mismatches:
         log(f"WARNING: {mismatches}/{n_base} outcome mismatches vs oracle")
 
-    print(json.dumps({
+    out = {
         "metric": "sfi_trials_per_sec_per_chip",
         "value": round(device_rate, 1),
         "unit": "trials/sec/chip",
         "vs_baseline": round(device_rate / base_rate, 3),
-    }))
+        "platform": dev.platform,
+    }
+    if pallas_delta is not None:
+        out["pallas_speedup"] = round(pallas_delta, 3)
+    print(json.dumps(out))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true", help="tiny shapes (CI smoke)")
+    ap.add_argument("--batch", type=int, default=None, help="trials per batch")
+    ap.add_argument("--uops", type=int, default=None, help="window length")
+    ap.add_argument("--reps", type=int, default=3, help="timed repetitions")
+    ap.add_argument("--worker", action="store_true", help=argparse.SUPPRESS)
+    ap.add_argument("--platform", type=str, default=None,
+                    help="jax platform to pin (worker mode)")
+    args = ap.parse_args()
+
+    if args.worker:
+        run_worker(args)
+        return
+    supervise(args)
 
 
 if __name__ == "__main__":

@@ -158,8 +158,11 @@ class FUPoolModel:
                 oc_i = int(oc[i])
                 if oc_i == U.OC_NONE:
                     continue
-                self._primary(cyc, oc_i, cap_units)
-                if eligible[oc_i]:
+                got_primary = self._primary(cyc, oc_i, cap_units)
+                # requestShadow only fires when the primary got a valid FU
+                # (reference inst_queue.cc:1082+: idx != NoFreeFU /
+                # NoCapableFU guard before the shadow request)
+                if eligible[oc_i] and got_primary:
                     if self.priority_to_shadow:
                         # shadow claimed immediately at issue
                         # (inst_queue.cc:897-903)
@@ -178,12 +181,14 @@ class FUPoolModel:
                 return True
         return False
 
-    def _primary(self, cyc: int, oc_i: int, cap_units) -> None:
+    def _primary(self, cyc: int, oc_i: int, cap_units) -> bool:
         if not self._claim(cyc, cap_units[oc_i]):
             # Pool over-subscribed: the 1-IPC proxy has no stall model, so
             # the µop proceeds without consuming a unit; record it (the
             # reference would hold it in the IQ — statFuBusy).
             self.fu_busy[oc_i] += 1
+            return False
+        return True
 
     def _shadow(self, cyc: int, i: int, oc_i: int, cap_units,
                 approx_units) -> None:

@@ -114,6 +114,12 @@ class O3Config(ConfigObject):
                                   "record the golden memory timeline when "
                                   "n*mem_words*4 fits this budget (resolves "
                                   "LSQ_ADDR-faulted loads without escaping)")
+    taint_reg_timeline_mb = Param(int, 256,
+                                  "keep the golden register timeline "
+                                  "device-resident when n*nphys*4 fits this "
+                                  "budget; over budget the fault-setup "
+                                  "gathers run as a per-batch setup scan "
+                                  "(ops/taint.py setup_scan)")
     # Pallas fast pass (ops/pallas_taint.py): "auto" uses it on TPU backends
     # only; "on" forces it (interpret mode off-TPU, for tests); "off" keeps
     # the XLA taint kernel.

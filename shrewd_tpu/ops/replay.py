@@ -120,9 +120,11 @@ def replay(tr: TraceArrays, init_reg: jax.Array, init_mem: jax.Array,
         reg, mem, live, detected, trapped, diverged = carry
         i, op, dstr, s1, s2, imm, tk, sc = xs
 
-        # 1. storage-fault landing
+        # 1. storage-fault landing (entry masked to the register space so a
+        # hand-constructed out-of-range entry behaves identically in the
+        # dense, taint, and Pallas kernels)
         flip_here = (fault.kind == KIND_REGFILE) & (i == fault.cycle)
-        lane = jnp.arange(nphys, dtype=i32) == fault.entry
+        lane = jnp.arange(nphys, dtype=i32) == (fault.entry & idx_mask)
         reg = jnp.where(flip_here & lane, reg ^ bitmask, reg)
 
         # 2. operand read with IQ index faults

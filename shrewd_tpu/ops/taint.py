@@ -59,19 +59,23 @@ class GoldenRecord(NamedTuple):
     wr: jax.Array         # bool[n]    golden writes a register this step
     is_ld: jax.Array      # bool[n]
     is_st: jax.Array      # bool[n]
-    reg_t: jax.Array      # uint32[n, nphys]  reg state BEFORE step i
+    reg_t: jax.Array | None   # uint32[n, nphys]  reg state BEFORE step i,
+    #                           or None (over budget → setup_scan per batch)
     mem_t: jax.Array | None   # uint32[n, mem_words] mem BEFORE step i, or None
     final_reg: jax.Array  # uint32[nphys]
     final_mem: jax.Array  # uint32[mem_words]
 
 
 def record_golden(tr: TraceArrays, init_reg: jax.Array, init_mem: jax.Array,
-                  mem_timeline: bool) -> GoldenRecord:
+                  mem_timeline: bool, reg_timeline: bool = True) -> GoldenRecord:
     """One fault-free recording replay → GoldenRecord (device arrays).
 
     ``mem_timeline=False`` skips the [n, mem_words] memory timeline (whose
     rows the taint scan streams to resolve loads at non-golden addresses
     in-kernel); without it such loads escape to the dense kernel.
+    ``reg_timeline=False`` skips the [n, nphys] register timeline (only the
+    one-time fault-setup gathers need it); callers then compute setup via
+    ``setup_scan`` per batch, keeping device memory bounded for long traces.
     """
     n = tr.opcode.shape[0]
     mem_words = init_mem.shape[0]
@@ -90,7 +94,8 @@ def record_golden(tr: TraceArrays, init_reg: jax.Array, init_mem: jax.Array,
         res = jnp.where(is_ld, ldval, eff)
         dst_old = reg[dstr]
         writes = ((op >= U.ADD) & (op <= U.SLTU)) | is_ld
-        ys = (a, b, eff, res, st_old, dst_old, reg) \
+        ys = (a, b, eff, res, st_old, dst_old) \
+            + ((reg,) if reg_timeline else ()) \
             + ((mem,) if mem_timeline else ())
         reg = reg.at[dstr].set(jnp.where(writes, res, dst_old))
         mem = mem.at[slot].set(jnp.where(is_st, b, st_old))
@@ -99,11 +104,10 @@ def record_golden(tr: TraceArrays, init_reg: jax.Array, init_mem: jax.Array,
     xs = (tr.opcode, tr.dst, tr.src1, tr.src2, tr.imm)
     (final_reg, final_mem), ys = jax.lax.scan(
         step, (init_reg.astype(u32), init_mem.astype(u32)), xs)
-    if mem_timeline:
-        a, b, ea, res, st_old, dst_old, reg_t, mem_t = ys
-    else:
-        a, b, ea, res, st_old, dst_old, reg_t = ys
-        mem_t = None
+    a, b, ea, res, st_old, dst_old = ys[:6]
+    rest = list(ys[6:])
+    reg_t = rest.pop(0) if reg_timeline else None
+    mem_t = rest.pop(0) if mem_timeline else None
     op_np = np.asarray(tr.opcode)
     return GoldenRecord(
         a=a, b=b, ea=ea, res=res, st_old=st_old, dst_old=dst_old,
@@ -167,13 +171,68 @@ def fault_setup(gold: GoldenRecord, tr: TraceArrays, fault: Fault):
     return gold_at_fault, alt1, alt2
 
 
+def setup_scan(tr: TraceArrays, init_reg: jax.Array, init_mem: jax.Array,
+               faults: Fault):
+    """Fault-setup gathers without the [n, nphys] register timeline.
+
+    Recomputes ``fault_setup``'s three per-lane values for a whole fault
+    *batch* in one golden replay: the carried state is the single
+    batch-uniform golden machine state (nphys + mem_words words), and each
+    step gathers the lanes whose capture point is this step.  O(n·B)
+    gathers total — the same order as the taint scan itself — with O(1)
+    carried state in the batch dimension, which is what bounds device
+    memory for long traces (the n×nphys reg_t timeline then has no reason
+    to stay resident; ADVICE r1).  jit/shard_map-traceable.
+    """
+    nphys = init_reg.shape[0]
+    idx_mask = i32(nphys - 1)
+    n = tr.opcode.shape[0]
+    index_mask = jax.vmap(Fault.bit_as_index_mask)(faults)
+    e = jnp.clip(faults.entry, 0, n - 1)
+    gaf_reg = faults.entry & idx_mask
+    alt1_reg = (tr.src1[e] ^ index_mask) & idx_mask
+    alt2_reg = (tr.src2[e] ^ index_mask) & idx_mask
+
+    def step(carry, xs):
+        reg, mem, gaf, alt1, alt2 = carry
+        i, op, dstr, s1, s2, imm = xs
+        # capture BEFORE this step executes (reg_t[i] semantics)
+        gaf = jnp.where(faults.cycle == i, reg[gaf_reg], gaf)
+        alt1 = jnp.where(e == i, reg[alt1_reg], alt1)
+        alt2 = jnp.where(e == i, reg[alt2_reg], alt2)
+        a = reg[s1]
+        b = reg[s2]
+        eff = _alu(op, a, b, imm)
+        is_ld = op == U.LOAD
+        is_st = op == U.STORE
+        mem_words = mem.shape[0]
+        slot = (eff >> u32(2)).astype(i32) & i32(mem_words - 1)
+        res = jnp.where(is_ld, mem[slot], eff)
+        writes = ((op >= U.ADD) & (op <= U.SLTU)) | is_ld
+        reg = reg.at[dstr].set(jnp.where(writes, res, reg[dstr]))
+        mem = mem.at[slot].set(jnp.where(is_st, b, mem[slot]))
+        return (reg, mem, gaf, alt1, alt2), None
+
+    xs = (jnp.arange(n, dtype=i32), tr.opcode, tr.dst, tr.src1, tr.src2,
+          tr.imm)
+    zero = jnp.zeros_like(gaf_reg, dtype=u32)
+    (_, _, gaf, alt1, alt2), _ = jax.lax.scan(
+        step, (init_reg.astype(u32), init_mem.astype(u32),
+               zero, zero, zero), xs)
+    return gaf, alt1, alt2
+
+
 def taint_replay(gold: GoldenRecord, tr: TraceArrays, fault: Fault,
                  shadow_cov: jax.Array, k: int = 16,
-                 compare_regs: bool = True) -> TaintResult:
+                 compare_regs: bool = True, setup=None) -> TaintResult:
     """One trial via deviation tracking. jit/vmap-safe.
 
     Phase order matches ops/replay.py exactly (the event-priority-ladder
     analog); every dense-kernel fault kind is supported.
+
+    ``setup`` optionally supplies this lane's precomputed
+    ``(gold_at_fault, alt1, alt2)`` triple (from ``setup_scan``) when the
+    GoldenRecord was built without the register timeline.
     """
     nphys = gold.final_reg.shape[0]
     mem_words = gold.final_mem.shape[0]
@@ -182,7 +241,9 @@ def taint_replay(gold: GoldenRecord, tr: TraceArrays, fault: Fault,
     bitmask = u32(1) << fault.bit.astype(u32)
     index_mask = fault.bit_as_index_mask()
 
-    gold_at_fault, alt1, alt2 = fault_setup(gold, tr, fault)
+    if setup is None:
+        setup = fault_setup(gold, tr, fault)
+    gold_at_fault, alt1, alt2 = setup
     have_mem_t = gold.mem_t is not None   # static: selects the step variant
 
     def step(carry, xs):
@@ -197,11 +258,15 @@ def taint_replay(gold: GoldenRecord, tr: TraceArrays, fault: Fault,
 
         at_uop = i == fault.entry
 
-        # 1. storage-fault landing (REGFILE)
+        # 1. storage-fault landing (REGFILE).  The tag is masked to the
+        # register space (matching the Pallas kernel and the dense kernel's
+        # masked lane select) so out-of-range entries cannot collide with
+        # the memory tag space (nphys + slot).
+        reg_tag = fault.entry & idx_mask
         flip_here = (fault.kind == KIND_REGFILE) & (i == fault.cycle) & live
-        found_f, val_f = _lookup(tags, vals, fault.entry)
+        found_f, val_f = _lookup(tags, vals, reg_tag)
         content_f = jnp.where(found_f, val_f, gold_at_fault)
-        tags, vals, ovf0 = _set(tags, vals, fault.entry, content_f ^ bitmask,
+        tags, vals, ovf0 = _set(tags, vals, reg_tag, content_f ^ bitmask,
                                 flip_here)
 
         # 2. operand read (latch + IQ index faults)

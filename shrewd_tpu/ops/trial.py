@@ -28,7 +28,8 @@ from shrewd_tpu.models.o3 import (Fault, FaultSampler, O3Config,
                                   compute_shadow_cov, null_fault)
 from shrewd_tpu.ops import classify as C
 from shrewd_tpu.ops.replay import ReplayResult, TraceArrays, replay
-from shrewd_tpu.ops.taint import record_golden, taint_replay
+from shrewd_tpu.ops.taint import (fault_setup, record_golden, setup_scan,
+                                  taint_replay)
 
 
 class TrialKernel:
@@ -111,19 +112,29 @@ class TrialKernel:
         Built eagerly even when first touched inside a jit trace, so the
         concrete arrays live on self rather than leaking tracers."""
         if self._golden_rec is None:
-            budget = self.cfg.taint_mem_timeline_mb * (1 << 20)
-            with_mem_t = self.trace.n * self.trace.mem_words * 4 <= budget
+            mem_budget = self.cfg.taint_mem_timeline_mb * (1 << 20)
+            with_mem_t = self.trace.n * self.trace.mem_words * 4 <= mem_budget
+            reg_budget = self.cfg.taint_reg_timeline_mb * (1 << 20)
+            with_reg_t = self.trace.n * self.trace.nphys * 4 <= reg_budget
             with jax.ensure_compile_time_eval():
                 self._golden_rec = record_golden(
-                    self.tr, self.init_reg, self.init_mem, with_mem_t)
+                    self.tr, self.init_reg, self.init_mem, with_mem_t,
+                    reg_timeline=with_reg_t)
         return self._golden_rec
 
-    def _taint_one(self, fault: Fault, use_row: bool):
+    def _setup_batch(self, faults: Fault):
+        """Batched (gold_at_fault, alt1, alt2): timeline gathers when reg_t
+        is resident, else the O(nphys)-carry setup scan.  Traceable."""
+        if self.golden_rec.reg_t is not None:
+            return fault_setup(self.golden_rec, self.tr, faults)
+        return setup_scan(self.tr, self.init_reg, self.init_mem, faults)
+
+    def _taint_one(self, fault: Fault, use_row: bool, setup=None):
         gold = self.golden_rec if use_row else self.golden_rec._replace(
             mem_t=None)
         return taint_replay(gold, self.tr, fault, self.shadow_cov,
                             k=self.cfg.taint_k,
-                            compare_regs=self.cfg.compare_regs)
+                            compare_regs=self.cfg.compare_regs, setup=setup)
 
     def taint_batch(self, faults: Fault, use_row: bool = False):
         """Fault batch → TaintResult batch (outcome + escaped flags).
@@ -137,7 +148,9 @@ class TrialKernel:
 
     @partial(jax.jit, static_argnums=(0, 2))
     def _taint_batch_jit(self, faults: Fault, use_row: bool):
-        return jax.vmap(partial(self._taint_one, use_row=use_row))(faults)
+        setup = self._setup_batch(faults)
+        return jax.vmap(
+            lambda f, s: self._taint_one(f, use_row, setup=s))(faults, setup)
 
     def _pallas_enabled(self) -> bool:
         mode = self.cfg.pallas
@@ -154,8 +167,7 @@ class TrialKernel:
         if not self._pallas_enabled():
             return self._taint_batch_jit(faults, False)
         from shrewd_tpu.ops.pallas_taint import taint_fast_pallas
-        from shrewd_tpu.ops.taint import fault_setup
-        gaf, alt1, alt2 = fault_setup(self.golden_rec, self.tr, faults)
+        gaf, alt1, alt2 = self._setup_batch(faults)
         interp = jax.devices()[0].platform not in ("tpu", "axon")
         return taint_fast_pallas(
             self.golden_rec, self.tr.opcode, self.tr.dst, self.tr.src1,
@@ -235,7 +247,9 @@ class TrialKernel:
             return C.tally(self.outcomes_from_keys(keys, structure))
         _ = self.golden_rec
         faults = self.sampler(structure).sample_batch(keys)
-        res = jax.vmap(partial(self._taint_one, use_row=True))(faults)
+        setup = self._setup_batch(faults)
+        res = jax.vmap(
+            lambda f, s: self._taint_one(f, True, setup=s))(faults, setup)
         out = jnp.where(res.escaped | res.overflow,
                         jnp.int32(C.OUTCOME_SDC), res.outcome)
         return C.tally(out)

@@ -81,9 +81,15 @@ class ShardedCampaign:
         ovf = np.asarray(ovf)
         if self.mode == "taint":    # conservative, no host re-runs
             out[esc | ovf] = C.OUTCOME_SDC
+            self.kernel.escapes += int((esc | ovf).sum())
+            self.kernel.taint_trials += out.size
         elif (esc | ovf).any():
             faults = self.kernel.sample_batch(keys_sh, self.structure)
             out = self.kernel.resolve_escapes(faults, out, esc, ovf)
+        else:
+            # zero-escape batches still count toward the escape-rate stats
+            # (resolve_escapes, which increments both, was not needed)
+            self.kernel.taint_trials += out.size
         return jnp.asarray(
             np.bincount(out, minlength=C.N_OUTCOMES).astype(np.int32))
 

@@ -182,14 +182,44 @@ class DesignSpace:
         self._baseline_cfg = np.array(
             [baseline_choice(ks) for ks in self.allowed], dtype=np.int32)
 
+    # Enumeration guard: the cross product grows as len(schemes)^n_structures;
+    # past this many configs the host materialization alone is multi-GB.
+    MAX_CONFIGS = 1 << 24
+
+    @property
+    def n_configs(self) -> int:
+        n = 1
+        for ks in self.allowed:
+            n *= len(ks)
+        return n
+
     def enumerate(self) -> np.ndarray:
         """All assignments, int32[n_configs, n_structures] of scheme ids."""
+        n = self.n_configs
+        if n > self.MAX_CONFIGS:
+            raise ValueError(
+                f"design space has {n:,} configs (> {self.MAX_CONFIGS:,}); "
+                f"restrict per-structure choices via `allowed` or search a "
+                f"subset explicitly — exhaustive enumeration would exhaust "
+                f"host/device memory")
         return np.array(list(itertools.product(*self.allowed)),
                         dtype=np.int32)
 
+    # Device pass chunking: bounds peak device memory for large spaces
+    # (ADVICE r1: ~10 structures × 5 schemes ≈ 10M configs).
+    EVAL_CHUNK = 1 << 20
+
     def evaluate(self, configs) -> tuple[jax.Array, jax.Array, jax.Array]:
-        """(sdc_rate, due_rate, area) per config — one fused device pass."""
-        return self._evaluate(jnp.asarray(configs, dtype=jnp.int32))
+        """(sdc_rate, due_rate, area) per config — fused device passes,
+        chunked to bound peak device memory."""
+        configs = np.asarray(configs, dtype=np.int32)
+        if len(configs) <= self.EVAL_CHUNK:
+            return self._evaluate(jnp.asarray(configs))
+        outs = [tuple(np.asarray(x) for x in
+                      self._evaluate(jnp.asarray(configs[i:i + self.EVAL_CHUNK])))
+                for i in range(0, len(configs), self.EVAL_CHUNK)]
+        return tuple(jnp.asarray(np.concatenate([o[j] for o in outs]))
+                     for j in range(3))
 
     def search(self, sdc_target: float) -> SearchResult:
         """Minimum-area assignment with sdc_rate ≤ target, plus the Pareto

@@ -75,12 +75,16 @@ def test_priority_to_shadow_starves_later_primaries_of_shadows():
 def test_op_lat_keeps_units_busy_across_cycles():
     # One MUL per cycle (issue_width=1) against 2 IntMultDiv units with
     # op_lat=3: cycle 0 claims unit A (busy through cycle 2), its shadow
-    # claims unit B — so cycles 1 and 2 have no mult unit free (primary
-    # fu_busy, shadow → approx ALU); cycle 3 sees both free again.
+    # claims unit B — so cycles 1 and 2 have no mult unit free: the primary
+    # fails (fu_busy) and, per the reference's issue-stage guard
+    # (requestShadow only fires for a successfully issued primary,
+    # inst_queue.cc:1082+), NO shadow is requested for those µops.
+    # Cycle 3 sees both units free again.
     m = FUPoolModel(oc_seq(*[U.OC_INT_MULT] * 4), issue_width=1)
-    assert list(m.grants) == [GRANT_EXACT, GRANT_APPROX, GRANT_APPROX,
+    assert list(m.grants) == [GRANT_EXACT, GRANT_NONE, GRANT_NONE,
                               GRANT_EXACT]
     assert m.fu_busy[U.OC_INT_MULT] == 2
+    assert m.shadow_requests[U.OC_INT_MULT] == 2   # µops 0 and 3 only
     # with op_lat=1 units, every cycle is fresh
     pool = FUPoolConfig(int_mult=IntMultDiv(op_lat=1))
     m1 = FUPoolModel(oc_seq(*[U.OC_INT_MULT] * 4), issue_width=1, pool=pool)

@@ -136,6 +136,59 @@ def test_run_keys_traceable_matches_modes():
     assert traced[C.OUTCOME_SDC] >= hybrid[C.OUTCOME_SDC]
 
 
+def test_setup_scan_matches_timeline_gathers():
+    # The O(nphys)-carry setup scan must reproduce the reg_t timeline
+    # gathers exactly for every structure's fault coordinates.
+    from shrewd_tpu.ops.taint import fault_setup, setup_scan
+    t = make_trace(seed=30)
+    k = TrialKernel(t)
+    keys = prng.trial_keys(prng.campaign_key(12), 64)
+    for structure in ("regfile", "fu", "rob", "iq", "lsq"):
+        faults = k.sample_batch(keys, structure)
+        want = fault_setup(k.golden_rec, k.tr, faults)
+        got = setup_scan(k.tr, k.init_reg, k.init_mem, faults)
+        for w, g in zip(want, got):
+            np.testing.assert_array_equal(np.asarray(w), np.asarray(g))
+
+
+def test_reg_timeline_budget_path_exact():
+    # Over-budget register timeline (reg_t=None): taint uses setup_scan and
+    # hybrid outcomes stay bit-identical to dense.
+    t = make_trace(seed=31)
+    k_no = TrialKernel(t, O3Config(taint_reg_timeline_mb=0))
+    assert k_no.golden_rec.reg_t is None
+    keys = prng.trial_keys(prng.campaign_key(13), 96)
+    for structure in ("regfile", "iq", "rob"):
+        faults = k_no.sample_batch(keys, structure)
+        np.testing.assert_array_equal(k_no.run_batch_hybrid(faults),
+                                      np.asarray(k_no.run_batch(faults)))
+
+
+def test_out_of_range_regfile_entry_agrees_across_kernels():
+    # Hand-constructed REGFILE fault with entry >= nphys: dense, taint, and
+    # Pallas all mask the entry to the register space (ADVICE r1).
+    import jax.numpy as jnp
+    from shrewd_tpu.models.o3 import Fault, KIND_REGFILE
+    t = make_trace(seed=32)
+    k = TrialKernel(t)
+    nphys = t.nphys
+    faults = Fault(
+        kind=jnp.full((8,), KIND_REGFILE, dtype=jnp.int32),
+        cycle=jnp.arange(8, dtype=jnp.int32) * 13,
+        entry=jnp.asarray([nphys, nphys + 3, 2 * nphys - 1, 5,
+                           nphys + 7, 3, nphys + 1, nphys + 63],
+                          dtype=jnp.int32),
+        bit=jnp.arange(8, dtype=jnp.int32),
+        shadow_u=jnp.ones((8,), dtype=jnp.float32))
+    dense = np.asarray(k.run_batch(faults))
+    hybrid = k.run_batch_hybrid(faults)
+    np.testing.assert_array_equal(hybrid, dense)
+    # masked entry ≡ same fault with in-range entry
+    faults_masked = faults._replace(entry=faults.entry & (nphys - 1))
+    np.testing.assert_array_equal(np.asarray(k.run_batch(faults_masked)),
+                                  dense)
+
+
 def test_shadow_detection_in_taint():
     t = make_trace(seed=28)
     k = TrialKernel(t, O3Config(shadow_coverage=[1.0] * U.N_OPCLASSES))

@@ -1,0 +1,128 @@
+// Shared ptrace plumbing for the native-trace tools.
+//
+// These tools are the framework's analog of the reference's NativeTrace /
+// statetrace machinery (reference src/cpu/nativetrace.{hh,cc} and
+// util/statetrace): instead of diffing a simulated CPU against a live host
+// process, we *capture* a live host process's dynamic instruction stream as
+// ground truth (tools/nativetrace.cc) and drive real-hardware fault-injection
+// campaigns against it (tools/hostsfi.cc).  The host CPU plays the role of
+// the golden oracle that gem5's serial C++ path plays in BASELINE configs[0].
+#ifndef SHREWD_PTRACE_COMMON_H
+#define SHREWD_PTRACE_COMMON_H
+
+#include <cerrno>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+
+#include <fcntl.h>
+#include <signal.h>
+#include <sys/personality.h>
+#include <sys/ptrace.h>
+#include <sys/types.h>
+#include <sys/user.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+// Canonical register order: x86-64 instruction-encoding order (the ModRM
+// register numbering objdump's operand names map onto), then rip, eflags.
+// The lifter (shrewd_tpu/ingest/lift.py) and the SFI harness index registers
+// by this table; keep all three in sync.
+static const int kNumGPR = 16;
+static const int kRegsPerStep = 18;  // 16 GPRs + rip + eflags
+
+static inline void regs_to_canonical(const struct user_regs_struct &r,
+                                     uint64_t out[kRegsPerStep]) {
+  out[0] = r.rax;  out[1] = r.rcx;  out[2] = r.rdx;  out[3] = r.rbx;
+  out[4] = r.rsp;  out[5] = r.rbp;  out[6] = r.rsi;  out[7] = r.rdi;
+  out[8] = r.r8;   out[9] = r.r9;   out[10] = r.r10; out[11] = r.r11;
+  out[12] = r.r12; out[13] = r.r13; out[14] = r.r14; out[15] = r.r15;
+  out[16] = r.rip;
+  out[17] = r.eflags;
+}
+
+static inline void canonical_set(struct user_regs_struct &r, int idx,
+                                 uint64_t val) {
+  switch (idx) {
+    case 0: r.rax = val; break;   case 1: r.rcx = val; break;
+    case 2: r.rdx = val; break;   case 3: r.rbx = val; break;
+    case 4: r.rsp = val; break;   case 5: r.rbp = val; break;
+    case 6: r.rsi = val; break;   case 7: r.rdi = val; break;
+    case 8: r.r8 = val; break;    case 9: r.r9 = val; break;
+    case 10: r.r10 = val; break;  case 11: r.r11 = val; break;
+    case 12: r.r12 = val; break;  case 13: r.r13 = val; break;
+    case 14: r.r14 = val; break;  case 15: r.r15 = val; break;
+    default:
+      fprintf(stderr, "canonical_set: bad reg %d\n", idx);
+      exit(2);
+  }
+}
+
+static inline uint64_t canonical_get(const struct user_regs_struct &r,
+                                     int idx) {
+  uint64_t c[kRegsPerStep];
+  regs_to_canonical(r, c);
+  return c[idx];
+}
+
+// Spawn the target stopped at exec, ASLR off (deterministic PCs — the same
+// reason the reference pins guest state via checkpoints).  argv must be
+// NULL-terminated.  Returns the child pid.
+static inline pid_t spawn_traced(char **argv, int stdout_fd) {
+  pid_t pid = fork();
+  if (pid < 0) { perror("fork"); exit(2); }
+  if (pid == 0) {
+    personality(ADDR_NO_RANDOMIZE);
+    if (stdout_fd >= 0) {
+      dup2(stdout_fd, 1);
+      close(stdout_fd);
+    }
+    ptrace(PTRACE_TRACEME, 0, nullptr, nullptr);
+    execv(argv[0], argv);
+    perror("execv");
+    _exit(127);
+  }
+  int status = 0;
+  if (waitpid(pid, &status, 0) < 0 || !WIFSTOPPED(status)) {
+    fprintf(stderr, "child did not stop at exec\n");
+    exit(2);
+  }
+  ptrace(PTRACE_SETOPTIONS, pid, nullptr, PTRACE_O_EXITKILL);
+  return pid;
+}
+
+// Run to `addr` via an int3 breakpoint.  Returns false if the child exited
+// before reaching it.
+static inline bool run_to(pid_t pid, uint64_t addr) {
+  errno = 0;
+  long orig = ptrace(PTRACE_PEEKTEXT, pid, (void *)addr, nullptr);
+  if (errno) { perror("peektext"); exit(2); }
+  long patched = (orig & ~0xffL) | 0xccL;
+  ptrace(PTRACE_POKETEXT, pid, (void *)addr, (void *)patched);
+  ptrace(PTRACE_CONT, pid, nullptr, nullptr);
+  int status = 0;
+  waitpid(pid, &status, 0);
+  if (!WIFSTOPPED(status)) return false;
+  struct user_regs_struct regs;
+  ptrace(PTRACE_GETREGS, pid, nullptr, &regs);
+  if (regs.rip != addr + 1) {
+    fprintf(stderr, "breakpoint: stopped at %llx, want %lx\n",
+            (unsigned long long)regs.rip, (unsigned long)(addr + 1));
+    return false;
+  }
+  regs.rip = addr;  // rewind over the int3
+  ptrace(PTRACE_SETREGS, pid, nullptr, &regs);
+  ptrace(PTRACE_POKETEXT, pid, (void *)addr, (void *)orig);
+  return true;
+}
+
+// One single-step; returns false when the child exited.
+static inline bool single_step(pid_t pid) {
+  ptrace(PTRACE_SINGLESTEP, pid, nullptr, nullptr);
+  int status = 0;
+  waitpid(pid, &status, 0);
+  return WIFSTOPPED(status);
+}
+
+#endif  // SHREWD_PTRACE_COMMON_H

@@ -1,0 +1,68 @@
+/* Deterministic integer matrix-multiply workload (the FloatMM analog of the
+ * reference's tests/gem5/cpu_tests, on the int32 datapath).  Same contract
+ * as sort.c: kernel_begin/kernel_end markers delimit the measured window,
+ * one checksum line on stdout classifies the run. */
+
+#include <unistd.h>
+
+#define M 12
+
+static int a[M][M], b[M][M], c[M][M];
+static volatile int sink;
+
+static unsigned int rng_state = 0x9E3779B9u;
+static unsigned int xorshift(void) {
+    unsigned int x = rng_state;
+    x ^= x << 13;
+    x ^= x >> 17;
+    x ^= x << 5;
+    rng_state = x;
+    return x;
+}
+
+__attribute__((noinline)) void kernel_begin(void) { __asm__ volatile(""); }
+__attribute__((noinline)) void kernel_end(void)   { __asm__ volatile(""); }
+
+__attribute__((noinline)) static void mm_kernel(void) {
+    for (int i = 0; i < M; i++) {
+        for (int j = 0; j < M; j++) {
+            int acc = 0;
+            for (int k = 0; k < M; k++) {
+                acc += a[i][k] * b[k][j];
+            }
+            c[i][j] = acc;
+        }
+    }
+}
+
+static void emit_checksum(void) {
+    unsigned int h = 2166136261u;
+    for (int i = 0; i < M; i++) {
+        for (int j = 0; j < M; j++) {
+            h = (h ^ (unsigned int)c[i][j]) * 16777619u;
+        }
+    }
+    char buf[16];
+    for (int i = 7; i >= 0; i--) {
+        unsigned int nib = h & 0xfu;
+        buf[i] = (char)(nib < 10 ? '0' + nib : 'a' + nib - 10);
+        h >>= 4;
+    }
+    buf[8] = '\n';
+    write(1, buf, 9);
+}
+
+int main(void) {
+    for (int i = 0; i < M; i++) {
+        for (int j = 0; j < M; j++) {
+            a[i][j] = (int)(xorshift() & 0xff) - 0x80;
+            b[i][j] = (int)(xorshift() & 0xff) - 0x80;
+        }
+    }
+    kernel_begin();
+    mm_kernel();
+    kernel_end();
+    emit_checksum();
+    sink = c[0][0];
+    return 0;
+}

@@ -1,0 +1,80 @@
+/* Deterministic integer bubblesort workload for the native-trace pipeline.
+ *
+ * The framework's analog of the reference's self-checking guest programs
+ * (tests/gem5/cpu_tests ref workloads: Bubblesort, FloatMM): a small,
+ * deterministic, stack-light computational kernel whose output is a single
+ * checksum line, so the host-SFI harness (tools/hostsfi.cc) can classify a
+ * perturbed run by exit status + output alone.
+ *
+ * Design constraints (see shrewd_tpu/ingest/lift.py):
+ *  - int32 data only (the lifter's datapath is the 32-bit projection);
+ *  - no libc calls inside the measured kernel (pure compute between the
+ *    markers), output via one write(2) at the end;
+ *  - `kernel_begin`/`kernel_end` are global symbols the tracer uses to
+ *    delimit the measured window (the SimPoint analog);
+ *  - static, -no-pie build so static decode (objdump) matches runtime PCs.
+ */
+
+#include <unistd.h>
+
+#define N 48
+
+static int data[N];
+static volatile int sink;
+
+/* xorshift32 — deterministic fill, no libc rand */
+static unsigned int rng_state = 0x2545F491u;
+static unsigned int xorshift(void) {
+    unsigned int x = rng_state;
+    x ^= x << 13;
+    x ^= x >> 17;
+    x ^= x << 5;
+    rng_state = x;
+    return x;
+}
+
+/* Markers: the tracer single-steps from the first PC == kernel_begin's
+ * address to PC == kernel_end's address.  noinline + asm barrier keep the
+ * symbols real at -O1. */
+__attribute__((noinline)) void kernel_begin(void) { __asm__ volatile(""); }
+__attribute__((noinline)) void kernel_end(void)   { __asm__ volatile(""); }
+
+__attribute__((noinline)) static void sort_kernel(void) {
+    for (int i = 0; i < N - 1; i++) {
+        for (int j = 0; j < N - 1 - i; j++) {
+            if (data[j] > data[j + 1]) {
+                int t = data[j];
+                data[j] = data[j + 1];
+                data[j + 1] = t;
+            }
+        }
+    }
+}
+
+static void emit_checksum(void) {
+    /* order-sensitive checksum of the sorted array */
+    unsigned int h = 2166136261u;
+    for (int i = 0; i < N; i++) {
+        h = (h ^ (unsigned int)data[i]) * 16777619u;
+    }
+    char buf[16];
+    for (int i = 7; i >= 0; i--) {
+        unsigned int nib = h & 0xfu;
+        buf[i] = (char)(nib < 10 ? '0' + nib : 'a' + nib - 10);
+        h >>= 4;
+    }
+    buf[8] = '\n';
+    write(1, buf, 9);
+}
+
+int main(void) {
+    for (int i = 0; i < N; i++) {
+        data[i] = (int)(xorshift() & 0xffff) - 0x8000;
+    }
+    kernel_begin();
+    sort_kernel();
+    kernel_end();
+    emit_checksum();
+    sink = data[0];
+    return 0;
+}
