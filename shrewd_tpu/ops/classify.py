@@ -27,12 +27,21 @@ OUTCOME_NAMES = ["masked", "sdc", "due", "detected"]
 
 
 def classify(result: ReplayResult, golden: ReplayResult,
-             compare_regs: bool = True) -> jax.Array:
-    """One trial's outcome class (int32 scalar; vmap for batches)."""
+             compare_regs: bool = True,
+             reg_mask: jax.Array | None = None) -> jax.Array:
+    """One trial's outcome class (int32 scalar; vmap for batches).
+
+    ``reg_mask`` (bool[nphys], optional) restricts the register comparison
+    to a live-out subset — used by windowed-vs-whole-program differential
+    comparisons (ingest/hostdiff.py) where dead-at-window-end registers
+    must not count as architectural corruption."""
     mem_diff = jnp.any(result.mem != golden.mem)
     state_diff = mem_diff
     if compare_regs:
-        state_diff = state_diff | jnp.any(result.reg != golden.reg)
+        reg_diff = result.reg != golden.reg
+        if reg_mask is not None:
+            reg_diff = reg_diff & reg_mask
+        state_diff = state_diff | jnp.any(reg_diff)
     corrupt = result.diverged | state_diff
     return jnp.where(
         result.detected, jnp.int32(OUTCOME_DETECTED),
