@@ -28,6 +28,7 @@ with Wilson CIs on both AVFs.
 from __future__ import annotations
 
 import json
+import os
 import subprocess
 from pathlib import Path
 from typing import NamedTuple
@@ -86,11 +87,104 @@ def capture_and_lift(paths: BuildPaths, build_dir: Path | None = None,
                      max_steps: int = 2_000_000):
     from shrewd_tpu.ingest.lift import lift
     bd = build_dir or (REPO / "tests" / "_build")
-    trace_bin = bd / f"{paths.workload.name}_trace.bin"
-    subprocess.run([str(paths.tracer), str(trace_bin), f"{paths.begin:x}",
-                    f"{paths.end:x}", str(max_steps), str(paths.workload)],
-                   check=True, capture_output=True, text=True)
-    return lift(str(trace_bin), str(paths.workload))
+    trace_bin = bd / f"{paths.workload.name}_trace.{os.getpid()}.bin"
+    try:
+        subprocess.run([str(paths.tracer), str(trace_bin),
+                        f"{paths.begin:x}", f"{paths.end:x}",
+                        str(max_steps), str(paths.workload)],
+                       check=True, capture_output=True, text=True)
+        return lift(str(trace_bin), str(paths.workload))
+    finally:
+        trace_bin.unlink(missing_ok=True)
+
+
+def capture_and_lift_to_output(paths: BuildPaths,
+                               build_dir: Path | None = None,
+                               max_steps: int = 2_000_000):
+    """Capture and lift the *extended* window: kernel_begin → process exit.
+
+    The replay then runs through the workload's own output stage (checksum
+    + write syscall + exit), so classification can compare exactly the
+    program-visible bytes — the same quantity the host oracle hashes from
+    stdout (tools/hostsfi.cc; reference: MatchStdout,
+    /root/reference/tests/gem5/verifier.py:158).  Adds to meta:
+
+    - ``window_macro_ops``: macro steps inside [kernel_begin, kernel_end)
+      — the fault-injection window (hostsfi injects only there);
+    - ``output_words``: replay-memory word indices covering every byte the
+      program passes to write(2) on stdout, at syscall time;
+    - ``output_syscalls``: count of stdout writes found.
+    """
+    from shrewd_tpu.ingest.lift import (M32, lift, read_nativetrace,
+                                        static_decode)
+    bd = build_dir or (REPO / "tests" / "_build")
+    trace_bin = bd / f"{paths.workload.name}_full.{os.getpid()}.bin"
+    try:
+        proc = subprocess.run(
+            [str(paths.tracer), str(trace_bin), f"{paths.begin:x}", "0",
+             str(max_steps), str(paths.workload)],
+            capture_output=True, text=True)
+        # rc 1 ("child exited mid-window") is the clean outcome with end=0
+        if proc.returncode not in (0, 1) or not trace_bin.exists():
+            raise RuntimeError(f"full capture failed: {proc.stderr}")
+        nt = read_nativetrace(trace_bin)
+        insts = static_decode(str(paths.workload))
+        trace, meta = lift(str(trace_bin), str(paths.workload), nt=nt,
+                           insts=insts)
+    finally:
+        trace_bin.unlink(missing_ok=True)
+    # executed steps only — the trailing record is state-at-end, not a step
+    steps = nt.steps[:-1] if len(nt.steps) else nt.steps
+    ends = np.nonzero(steps[:, 16] == np.uint64(paths.end))[0]
+    if len(ends) == 0:
+        raise RuntimeError("kernel_end marker never reached in full capture")
+    window_end = int(ends[0])
+    out_events = []                      # (macro_step, rsi, rdx)
+    cand = np.nonzero((steps[:, 0] == 1) & (steps[:, 7] == 1))[0]
+    for i in cand:
+        inst = insts.get(int(steps[i][16]))
+        if inst is not None and inst.mnemonic == "syscall":
+            out_events.append((int(i), int(steps[i][6]), int(steps[i][2])))
+
+    def words_of(a: int, ln: int) -> dict:
+        """Replay word index → byte mask for the written range [a, a+ln).
+        Byte-granular: an unaligned head/tail must not drag the dead bytes
+        sharing its word into the comparison.  Raises on bytes outside
+        every replay cluster — dropping them would silently under-report
+        SDC on exactly the bytes the host oracle hashes."""
+        masks: dict[int, int] = {}
+        for b in range(a, a + ln):
+            b32 = b & M32
+            waddr = b32 & ~0x3
+            for lo, hi, word_off in meta["clusters"]:
+                if lo <= waddr < hi:
+                    w = word_off + (waddr - lo) // 4
+                    masks[w] = masks.get(w, 0) | (0xFF << (8 * (b32 & 3)))
+                    break
+            else:
+                raise RuntimeError(
+                    f"output byte {b32:#x} not in any replay cluster — the "
+                    "write(2) buffer was never touched by a lifted store")
+        return masks
+
+    # Each output event is compared AT ITS SYSCALL µOP, not at window end:
+    # the exit path reuses the stack frames that held the output buffer, so
+    # the bytes at trace end are unrelated to what the kernel wrote out
+    # (pushes of fault-corrupted callee-saved registers were landing on the
+    # dead buffer and reading back as false SDC).
+    uop_start = meta["uop_start"]
+    meta["output_events"] = []
+    for m, a, ln in out_events:
+        masks = words_of(a, ln)
+        ws = sorted(masks)
+        meta["output_events"].append(
+            {"macro": m, "cut_uop": int(uop_start[m]), "words": ws,
+             "byte_masks": [masks[w] for w in ws]})
+    meta["window_macro_ops"] = window_end
+    meta["output_words"] = sorted(
+        {w for ev in meta["output_events"] for w in ev["words"]})
+    meta["output_syscalls"] = len(out_events)
+    return trace, meta
 
 
 def sample_coords(n_trials: int, window: int, seed: int = 0) -> np.ndarray:
@@ -106,30 +200,45 @@ def sample_coords(n_trials: int, window: int, seed: int = 0) -> np.ndarray:
 
 def run_host(paths: BuildPaths, coords: np.ndarray,
              build_dir: Path | None = None) -> np.ndarray:
-    """hostsfi over the coordinate list → outcome classes int32[n]."""
+    """hostsfi over the coordinate list → outcome classes int32[n].
+
+    Coordinate/result files are run-scoped (pid-suffixed): two concurrent
+    campaigns sharing a build dir must not truncate each other's open
+    results stream."""
     bd = build_dir or (REPO / "tests" / "_build")
-    cpath = bd / "coords.txt"
-    rpath = bd / "host_results.jsonl"
-    np.savetxt(cpath, coords, fmt="%d")
-    subprocess.run([str(paths.hostsfi), str(cpath), str(rpath),
-                    f"{paths.begin:x}", f"{paths.end:x}",
-                    str(paths.workload)],
-                   check=True, capture_output=True, text=True)
-    out = np.full(len(coords), -1, dtype=np.int32)
-    with open(rpath) as f:
-        for line in f:
-            r = json.loads(line)
-            out[r["trial"]] = HOST_OUTCOME[r["outcome"]]
-    if (out < 0).any():
-        raise RuntimeError("missing host trial results")
-    return out
+    cpath = bd / f"coords.{os.getpid()}.txt"
+    rpath = bd / f"host_results.{os.getpid()}.jsonl"
+    try:
+        np.savetxt(cpath, coords, fmt="%d")
+        subprocess.run([str(paths.hostsfi), str(cpath), str(rpath),
+                        f"{paths.begin:x}", f"{paths.end:x}",
+                        str(paths.workload)],
+                       check=True, capture_output=True, text=True)
+        out = np.full(len(coords), -1, dtype=np.int32)
+        with open(rpath) as f:
+            for line in f:
+                r = json.loads(line)
+                out[r["trial"]] = HOST_OUTCOME[r["outcome"]]
+        if (out < 0).any():
+            raise RuntimeError("missing host trial results")
+        return out
+    finally:
+        for p in (cpath, rpath):
+            p.unlink(missing_ok=True)
 
 
-def run_device(trace, meta: dict, coords: np.ndarray) -> np.ndarray:
+def run_device(trace, meta: dict, coords: np.ndarray,
+               liveness=None) -> np.ndarray:
     """The same trials on the replay kernel → outcome classes int32[n].
 
-    Dense kernel, no shadow detection (the host has no shadow FUs), memory
-    plus ABI-live-out registers compared (see module docstring)."""
+    Dense kernel, no shadow detection (the host has no shadow FUs).  With a
+    measured ``liveness`` (ingest.liveness.Liveness from the post-window
+    capture), comparison is restricted to the registers and memory words the
+    post-window code actually reads before writing — the program-visible
+    state.  Without one, falls back to the static ABI heuristic
+    (callee-saved registers + all memory), which over-reports SDC for state
+    that is dead at the output boundary (VERDICT r2 measured 25 points of
+    inflation on sort.c from exactly this)."""
     import jax
     import jax.numpy as jnp
 
@@ -146,15 +255,62 @@ def run_device(trace, meta: dict, coords: np.ndarray) -> np.ndarray:
         entry=jnp.asarray(reg, dtype=jnp.int32),
         bit=jnp.asarray(bit, dtype=jnp.int32),
         shadow_u=jnp.ones(len(coords), dtype=jnp.float32))
+
+    if "output_events" in meta:
+        # Extended-window ("output") mode — exact host-oracle semantics:
+        #   SDC  ⇔ the bytes passed to write(2) differ AT SYSCALL TIME
+        #          (truncated replay per output event; the exit path reuses
+        #          the buffer's stack frames, so window-end state is dead),
+        #          or the exit status (low 8 bits of rdi at exit_group)
+        #          differs, or control flow diverged (conservative),
+        #   DUE  ⇔ the replay trapped anywhere up to process exit.
+        # NOTE: one truncated TrialKernel (fresh XLA compile) per output
+        # event — fine under the workload contract of a single batched
+        # write(2); a printf-per-line workload would recompile per line.
+        rfull = jax.jit(jax.vmap(k._replay_one))(faults)
+        sdc = np.asarray(rfull.diverged).copy()
+        for ev in meta["output_events"]:
+            cut = ev["cut_uop"]
+            words = np.asarray(ev["words"], dtype=np.int64)
+            if len(words) == 0 or cut == 0:
+                continue
+            tr_cut = trace.__class__(
+                opcode=trace.opcode[:cut], dst=trace.dst[:cut],
+                src1=trace.src1[:cut], src2=trace.src2[:cut],
+                imm=trace.imm[:cut], taken=trace.taken[:cut],
+                init_reg=trace.init_reg, init_mem=trace.init_mem)
+            k_cut = TrialKernel(tr_cut, O3Config(enable_shrewd=False))
+            rcut = jax.jit(jax.vmap(k_cut._replay_one))(faults)
+            gold_w = np.asarray(k_cut.golden.mem)[words]
+            bmask = np.asarray(ev["byte_masks"], dtype=np.uint32)
+            delta = (np.asarray(rcut.mem)[:, words] ^ gold_w[None, :])
+            sdc |= ((delta & bmask[None, :]) != 0).any(1)
+        exit_diff = ((np.asarray(rfull.reg)[:, 7]
+                      ^ np.asarray(k.golden.reg)[7]) & 0xFF) != 0
+        sdc |= exit_diff
+        trapped = np.asarray(rfull.trapped)
+        detected = np.asarray(rfull.detected)
+        out = np.full(len(coords), C.OUTCOME_MASKED, dtype=np.int32)
+        out[sdc] = C.OUTCOME_SDC
+        out[trapped] = C.OUTCOME_DUE
+        out[detected] = C.OUTCOME_DETECTED
+        return out
+
     mask = np.zeros(trace.nphys, dtype=bool)
-    mask[list(LIVE_OUT_REGS)] = True
+    mem_mask = None
+    if liveness is not None:
+        mask[:len(liveness.reg_live)] = liveness.reg_live
+        mem_mask = jnp.asarray(liveness.mem_word_mask(
+            meta["clusters"], trace.mem_words))
+    else:
+        mask[list(LIVE_OUT_REGS)] = True
 
     @jax.jit
     def outcomes(faults):
         results = jax.vmap(k._replay_one)(faults)
         return jax.vmap(lambda r: C.classify(
             r, k.golden, compare_regs=True,
-            reg_mask=jnp.asarray(mask)))(results)
+            reg_mask=jnp.asarray(mask), mem_mask=mem_mask))(results)
 
     return np.asarray(outcomes(faults))
 
@@ -195,16 +351,54 @@ def compare(host: np.ndarray, dev: np.ndarray) -> dict:
 
 
 def run_diff(n_trials: int = 500, seed: int = 0,
-             workload_c: str = "workloads/sort.c") -> dict:
+             workload_c: str = "workloads/sort.c",
+             mode: str = "output") -> dict:
+    """Paired host-vs-device differential AVF.
+
+    ``mode``:
+      - "output" (default): extended-window replay to process exit,
+        classification on the written stdout bytes + exit code — the exact
+        host-oracle semantics;
+      - "liveness": [kernel_begin, kernel_end) window with measured
+        post-window first-access liveness masks (ingest/liveness.py);
+      - "abi": static callee-saved-register heuristic (the r2 baseline,
+        kept for comparison — known to over-report).
+    """
+    from shrewd_tpu.ingest.lift import GPR_NAMES_64
+
     paths = build_tools(workload_c)
-    trace, meta = capture_and_lift(paths)
-    coords = sample_coords(n_trials, meta["macro_ops"], seed)
+    lv = None
+    if mode == "output":
+        trace, meta = capture_and_lift_to_output(paths)
+        window = meta["window_macro_ops"]
+    else:
+        trace, meta = capture_and_lift(paths)
+        window = meta["macro_ops"]
+        if mode == "liveness":
+            from shrewd_tpu.ingest.liveness import post_window_liveness
+            lv = post_window_liveness(paths, meta["clusters"])
+    coords = sample_coords(n_trials, window, seed)
     host = run_host(paths, coords)
-    dev = run_device(trace, meta, coords)
+    dev = run_device(trace, meta, coords, liveness=lv)
     rep = compare(host, dev)
     rep["workload"] = workload_c
     rep["seed"] = seed
+    rep["mode"] = mode
     rep["lift_stats"] = meta["stats"]
+    if mode == "output":
+        rep["window_macro_ops"] = window
+        rep["output_words"] = len(meta["output_words"])
+        rep["output_syscalls"] = meta["output_syscalls"]
+    if lv is not None:
+        rep["liveness"] = {
+            "live_regs": [GPR_NAMES_64[i] for i in
+                          np.nonzero(lv.reg_live)[0]],
+            "live_mem_words": int(lv.mem_word_mask(
+                meta["clusters"], trace.mem_words).sum()),
+            "post_window_steps": lv.steps,
+            "truncated": lv.truncated,
+            "unknown_insts": lv.unknown_insts,
+        }
     return rep
 
 
@@ -216,9 +410,11 @@ if __name__ == "__main__":
     ap.add_argument("--trials", type=int, default=1000)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--workload", default="workloads/sort.c")
+    ap.add_argument("--mode", default="output",
+                    choices=("output", "liveness", "abi"))
     ap.add_argument("--out", default=str(REPO / "DIFF_AVF.json"))
     a = ap.parse_args()
-    rep = run_diff(a.trials, a.seed, a.workload)
+    rep = run_diff(a.trials, a.seed, a.workload, mode=a.mode)
     with open(a.out, "w") as f:
         json.dump(rep, f, indent=1)
     print(json.dumps({k: rep[k] for k in

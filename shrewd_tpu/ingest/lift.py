@@ -85,6 +85,12 @@ N_GPR = 16
 ZERO = 16          # always-0 register (never written)
 TCMP = 17          # cmp-immediate staging (live cmp → jcc only)
 T0, T1, T2, T3 = 18, 19, 20, 21
+T4, T5 = 22, 23    # sub-word expansion / cmov scratch
+T6, T7 = 24, 25    # flags-preserving-instruction scratch
+# Register discipline: flags_src may reference T1/T2/TCMP between the
+# flag-setting instruction and its consumer (jcc/cmov), and x86 mov/cmov/
+# string/push do NOT write EFLAGS — so every lift of a flags-PRESERVING
+# instruction must keep its scratch to T0/T3..T7 and never write T1/T2/TCMP.
 NPHYS = 32
 
 M32 = 0xFFFFFFFF
@@ -286,6 +292,12 @@ _JCC_SIGNED = {  # cond after cmp(src=b, dst=a): flags of a-b
 }
 _JCC_UNSIGNED = {"jb": False, "jnae": False, "jae": True, "jnb": True,
                  "ja": "swap_b", "jbe": "swap_ae"}
+
+_CMOV = {"cmove": "eq", "cmovz": "eq", "cmovne": "ne", "cmovnz": "ne",
+         "cmovl": "lt", "cmovge": "ge", "cmovg": "swap_lt",
+         "cmovle": "swap_ge", "cmovs": "sign", "cmovns": "nsign",
+         "cmovb": "ub", "cmovnae": "ub", "cmovae": "uae", "cmovnb": "uae",
+         "cmova": "ua", "cmovnbe": "ua", "cmovbe": "ube", "cmovna": "ube"}
 
 
 class Cluster(NamedTuple):
@@ -534,6 +546,127 @@ class Lifter:
             disp = op.disp
         return base_reg, (disp + delta) & M32
 
+    # -- sub-word (byte/halfword) memory access expansion ------------------
+    #
+    # The replay µop ISA is word-only (LOAD/STORE trap on addr&3 != 0, the
+    # reference analog being x86's own alignment machinery); byte accesses
+    # are expanded to word load + shift/mask/merge sequences whose shift
+    # amount is computed *dynamically* from the effective address, so a
+    # fault-corrupted base register still selects the right byte of the
+    # right word (mirrors how x86 µcode slices sub-word accesses,
+    # /root/reference/src/arch/x86/isa/microops/ldstop.isa).
+
+    def _subword_addr(self, op: Operand, pc: int, regs: np.ndarray,
+                      width: int):
+        """µops leaving word address in T0 and bit-shift (=8×byte-offset)
+        in T3 → (T0, T3); None demotes (unmappable or straddling word)."""
+        ea = self._ea_of(op, regs)
+        if ea is None or (ea & 3) + width > 4:
+            return None
+        a = self._addr_uops(op, pc, T0)
+        if a is None:
+            return None
+        self._emit(U.ADDI, T0, a[0], ZERO, a[1])        # byte EA (remapped)
+        c3 = self._const(3, T4)
+        self._emit(U.ANDI, T3, T0, ZERO, 3)
+        self._emit(U.SLL, T3, T3, c3)                   # (ea & 3) * 8
+        self._emit(U.ANDI, T0, T0, ZERO, 0xFFFFFFFC)
+        return T0, T3
+
+    def _subword_load_value(self, src: Operand, pc: int, regs: np.ndarray,
+                            width: int, signed: bool, out_reg: int) -> bool:
+        """Load byte/halfword → zero/sign-extended value in ``out_reg``."""
+        wa = self._subword_addr(src, pc, regs, width)
+        if wa is None:
+            return False
+        word_r, sh_r = wa
+        self._emit(U.LOAD, T6, word_r, ZERO, 0)
+        self._emit(U.SRL, T6, T6, sh_r)
+        msk = 0xFF if width == 1 else 0xFFFF
+        self._emit(U.ANDI, out_reg, T6, ZERO, msk)
+        if signed:
+            sbit = msk ^ (msk >> 1)
+            self._emit(U.XORI, out_reg, out_reg, ZERO, sbit)
+            self._emit(U.ADDI, out_reg, out_reg, ZERO, (-sbit) & M32)
+        return True
+
+    def _subword_store(self, dst: Operand, pc: int, regs: np.ndarray,
+                       width: int, src_reg: int | None = None,
+                       src_imm: int | None = None) -> bool:
+        """Store the low byte/halfword of a register (or an immediate)."""
+        wa = self._subword_addr(dst, pc, regs, width)
+        if wa is None:
+            return False
+        word_r, sh_r = wa
+        msk = 0xFF if width == 1 else 0xFFFF
+        self._emit(U.LOAD, T6, word_r, ZERO, 0)
+        self._emit(U.LUI, T7, ZERO, ZERO, msk)
+        self._emit(U.SLL, T7, T7, sh_r)
+        self._emit(U.XORI, T7, T7, ZERO, M32)           # ~(msk << sh)
+        self._emit(U.AND, T6, T6, T7)
+        if src_imm is not None:
+            self._emit(U.LUI, T5, ZERO, ZERO, src_imm & msk)
+        else:
+            self._emit(U.ANDI, T5, src_reg, ZERO, msk)
+        self._emit(U.SLL, T5, T5, sh_r)
+        self._emit(U.OR, T6, T6, T5)
+        self._emit(U.STORE, 0, word_r, T6, 0)
+        return True
+
+    def _extend_reg(self, src_reg: int, width: int, signed: bool,
+                    out_reg: int) -> None:
+        """out = zero/sign-extended low byte/halfword of src."""
+        msk = 0xFF if width == 1 else 0xFFFF
+        self._emit(U.ANDI, out_reg, src_reg, ZERO, msk)
+        if signed:
+            sbit = msk ^ (msk >> 1)
+            self._emit(U.XORI, out_reg, out_reg, ZERO, sbit)
+            self._emit(U.ADDI, out_reg, out_reg, ZERO, (-sbit) & M32)
+
+    def _cond_bool(self, cond: str, out_reg: int) -> int | None:
+        """Materialize a flag condition as 0/1 in ``out_reg`` (for cmov),
+        from the recorded flags_src — same condition algebra as _lift_jcc
+        but branch-free (the select must stay value-faithful under faults,
+        so no control flow)."""
+        if self.flags_src is None:
+            return None
+        k = self.flags_src[0]
+        if k in ("cmp", "cmpb"):
+            a, b = self.flags_src[1], self.flags_src[2]
+        else:
+            a, b = self.flags_src[1], ZERO
+        neg = False
+        if cond in ("eq", "ne"):
+            self._emit(U.XOR, out_reg, a, b)
+            self._emit(U.SLTU, out_reg, ZERO, out_reg)      # != 0
+            neg = cond == "eq"
+        elif cond in ("lt", "ge"):
+            self._emit(U.SLT, out_reg, a, b)
+            neg = cond == "ge"
+        elif cond in ("swap_lt", "swap_ge"):                # gt / le
+            self._emit(U.SLT, out_reg, b, a)
+            neg = cond == "swap_ge"
+        elif cond in ("sign", "nsign"):
+            if k == "cmpb":
+                return None      # sub-word SF not reproducible (overflow)
+            if k == "cmp":
+                self._emit(U.SUB, out_reg, a, b)
+                self._emit(U.SLT, out_reg, out_reg, ZERO)
+            else:
+                self._emit(U.SLT, out_reg, a, ZERO)
+            neg = cond == "nsign"
+        elif cond in ("ub", "uae"):                         # b / ae
+            self._emit(U.SLTU, out_reg, a, b)
+            neg = cond == "uae"
+        elif cond in ("ua", "ube"):                         # a / be
+            self._emit(U.SLTU, out_reg, b, a)
+            neg = cond == "ube"
+        else:
+            return None
+        if neg:
+            self._emit(U.XORI, out_reg, out_reg, ZERO, 1)
+        return out_reg
+
     def _lift_one(self, i: int, inst: Inst, regs: np.ndarray,
                   next_regs: np.ndarray, next_pc: int) -> bool:
         """Emit µops for macro-op i; returns False to request opaque demotion
@@ -544,13 +677,51 @@ class Lifter:
         pc = inst.pc
 
         # --- moves ---
-        if m in ("mov", "movq", "movl", "movabs", "movslq", "movsxd",
-                 "cltq", "cdqe"):
+        if m in ("mov", "movq", "movl", "movb", "movw", "movabs", "movslq",
+                 "movsxd", "cltq", "cdqe"):
             if m in ("cltq", "cdqe"):            # sign-extend eax→rax: low32 id
                 return True                       # no-op in projection
             if len(ops) != 2:
                 return False
             src, dst = ops
+            width = {"movb": 1, "movw": 2}.get(m)
+            if width is None:
+                rws = [abs(o.width) // 8 for o in ops
+                       if o.kind == "reg" and o.reg >= 0 and o.width]
+                width = min(rws) if rws else 4
+            if any(o.kind == "reg" and o.reg >= 0 and o.width < 0
+                   for o in ops):
+                return False      # %ah-family: not the low byte — demote
+                                  # (a store writes no GPR, so the register
+                                  # self-check could NOT catch this)
+            if width < 4:
+                # sub-word: byte/halfword stores, loads with partial-reg
+                # merge, and partial-reg register moves
+                msk = 0xFF if width == 1 else 0xFFFF
+                if dst.kind == "mem":
+                    if src.kind == "imm":
+                        return self._subword_store(dst, pc, regs, width,
+                                                   src_imm=src.imm)
+                    if src.kind == "reg" and src.reg >= 0:
+                        return self._subword_store(dst, pc, regs, width,
+                                                   src_reg=src.reg)
+                    return False
+                if dst.kind == "reg" and dst.reg >= 0:
+                    if src.kind == "imm":
+                        self._emit(U.LUI, T6, ZERO, ZERO, src.imm & msk)
+                    elif src.kind == "reg" and src.reg >= 0:
+                        self._emit(U.ANDI, T6, src.reg, ZERO, msk)
+                    elif src.kind == "mem":
+                        if not self._subword_load_value(src, pc, regs,
+                                                        width, False, T6):
+                            return False
+                    else:
+                        return False
+                    self._emit(U.ANDI, dst.reg, dst.reg, ZERO,
+                               (~msk) & M32)
+                    self._emit(U.OR, dst.reg, dst.reg, T6)
+                    return True
+                return False
             if dst.kind == "reg" and dst.reg >= 0:
                 if src.kind == "imm":
                     self._emit(U.LUI, dst.reg, ZERO, ZERO, src.imm)
@@ -559,8 +730,6 @@ class Lifter:
                     self._emit(U.ADD, dst.reg, src.reg, ZERO)
                     return True
                 if src.kind == "mem":
-                    if self._mem_width(inst, src) < 4:
-                        return False
                     a = self._addr_uops(src, pc, T0)
                     if a is None:
                         return False
@@ -574,8 +743,9 @@ class Lifter:
                 if a is None:
                     return False
                 if src.kind == "imm":
-                    v = self._const(src.imm, T1)
-                    self._emit(U.STORE, 0, a[0], v, a[1])
+                    # mov writes no flags: T6, not T1 (flags_src may be T1)
+                    self._emit(U.ADDI, T6, ZERO, ZERO, src.imm & M32)
+                    self._emit(U.STORE, 0, a[0], T6, a[1])
                     return True
                 if src.kind == "reg" and src.reg >= 0:
                     self._emit(U.STORE, 0, a[0], src.reg, a[1])
@@ -585,7 +755,105 @@ class Lifter:
 
         if m in ("movzbl", "movzwl", "movzbq", "movzwq",
                  "movsbl", "movswl", "movsbq", "movswq"):
-            return False                          # sub-word: demote
+            if len(ops) != 2:
+                return False
+            src, dst = ops
+            width = 1 if m[4] == "b" else 2
+            signed = m.startswith("movs")
+            # 16-bit destinations (movzbw) merge into dst[15:0] on real
+            # x86 — not handled; the *l/*q forms write the full register
+            if dst.kind != "reg" or dst.reg < 0 or abs(dst.width) < 32:
+                return False
+            if src.kind == "reg" and src.reg >= 0 and src.width < 0:
+                return False                      # %ah-family source
+            if src.kind == "reg" and src.reg >= 0:
+                self._extend_reg(src.reg, width, signed, dst.reg)
+                return True
+            if src.kind == "mem":
+                return self._subword_load_value(src, pc, regs, width,
+                                                signed, dst.reg)
+            return False
+
+        # --- cmov: branch-free select (value-faithful under faults) ---
+        if m.startswith("cmov"):
+            base = m if m in _CMOV else m.rstrip("lqw")
+            if base not in _CMOV or len(ops) != 2:
+                return False
+            src, dst = ops
+            if dst.kind != "reg" or dst.reg < 0 or abs(dst.width) < 32:
+                return False        # 16-bit cmov merges into dst[15:0]
+            if src.kind == "reg" and src.reg >= 0:
+                sreg = src.reg
+            elif src.kind == "mem" and self._mem_width(inst, src) >= 4:
+                a = self._addr_uops(src, pc, T0)
+                if a is None:
+                    return False
+                self._emit(U.LOAD, T5, a[0], ZERO, a[1])
+                sreg = T5
+            else:
+                return False
+            if self._cond_bool(_CMOV[base], T4) is None:
+                return False
+            # cmov preserves EFLAGS — T6/T7 scratch keeps a live flags_src
+            # in T1/T2/TCMP intact for a later consumer
+            self._emit(U.XOR, T6, dst.reg, sreg)
+            self._emit(U.SUB, T7, ZERO, T4)        # 0 or all-ones
+            self._emit(U.AND, T6, T6, T7)
+            self._emit(U.XOR, dst.reg, dst.reg, T6)
+            return True
+
+        # --- byte/halfword compare & test: sign-extended operands preserve
+        # both the signed and the unsigned ordering of the sub-word domain
+        if m in ("cmpb", "cmpw"):
+            if len(ops) != 2:
+                return False
+            width = 1 if m == "cmpb" else 2
+            msk = 0xFF if width == 1 else 0xFFFF
+            sbit = msk ^ (msk >> 1)
+            src, dst = ops                        # flags of dst - src
+            def _sext_operand(o, treg):
+                if o.kind == "imm":
+                    v = o.imm & msk
+                    v = v - (msk + 1) if v & sbit else v
+                    return self._const(v & M32, treg)
+                if o.kind == "reg" and o.reg >= 0 and o.width > 0:
+                    self._extend_reg(o.reg, width, True, treg)
+                    return treg
+                if o.kind == "mem" and self._subword_load_value(
+                        o, pc, regs, width, True, treg):
+                    return treg
+                return None
+            breg = _sext_operand(src, TCMP)
+            areg = _sext_operand(dst, T2) if breg is not None else None
+            if areg is None:
+                return False
+            # kind "cmpb" ≠ "cmp": SF of a sub-word cmp is bit 7/15 of the
+            # *wrapped* sub-word difference, which the sext-operand SUB does
+            # not reproduce on overflow — sign-consumers must demote
+            self.flags_src = ("cmpb", areg, breg)
+            return True
+        if m in ("testb", "testw"):
+            if len(ops) != 2:
+                return False
+            width = 1 if m == "testb" else 2
+            a, b = ops
+            if any(o.kind == "reg" and o.reg >= 0 and o.width < 0
+                   for o in ops):
+                return False                      # %ah-family
+            if a.kind == "imm" and b.kind == "reg" and b.reg >= 0:
+                self._emit(U.ANDI, T2, b.reg, ZERO,
+                           a.imm & (0xFF if width == 1 else 0xFFFF))
+            elif a.kind == "reg" and a.reg >= 0 and b.kind == "reg" \
+                    and b.reg >= 0:
+                self._emit(U.AND, T2, a.reg, b.reg)
+                self._emit(U.ANDI, T2, T2, ZERO,
+                           0xFF if width == 1 else 0xFFFF)
+            else:
+                return False
+            # sign-extend the sub-word result so SF (js/jns) is faithful
+            self._extend_reg(T2, width, True, T2)
+            self.flags_src = ("res", T2)
+            return True
 
         # --- lea: pure address arithmetic, NO remap (real addresses) ---
         if m == "lea" or m == "leaq":
@@ -782,8 +1050,11 @@ class Lifter:
             self._emit(U.ADDI, 4, 4, ZERO, 8)
             return True
         if m in ("call", "callq"):
-            if ops and ops[0].kind == "mem":
-                return False                      # indirect call
+            # direct or indirect: the only architectural effects are the
+            # return-address push and rip (which follows the captured
+            # stream); an indirect target read has no register effect, so
+            # both forms lift identically — demoting indirect calls would
+            # drop the push and desynchronize the later ret's stack slot
             cl = self.pc_cluster.get(pc)
             if cl is None:
                 return False
@@ -797,6 +1068,15 @@ class Lifter:
             if cl is None:
                 return False
             delta = self._remap_const(cl)
+            # golden-sim guard: the stack slot must hold the captured
+            # return target (it won't when the RA was pushed by an op that
+            # demoted to opaque, whose memory effects are unrecoverable) —
+            # else the integrity branch below would diverge on the golden
+            # replay itself
+            addr = (int(self.reg[4]) + delta) & M32
+            if (addr & 3) or (addr >> 2) >= self.mem_words or \
+                    int(self.mem[addr >> 2]) != (next_pc & M32):
+                return False
             self._emit(U.LOAD, T1, 4, ZERO, delta)
             self._emit(U.ADDI, 4, 4, ZERO, 8)
             # return-address integrity check: corrupting the stack slot is a
@@ -807,9 +1087,11 @@ class Lifter:
             self.stats.branches_lifted += 1
             return True
 
-        # --- unconditional jump: control flow follows the stream ---
+        # --- unconditional jump: control flow follows the stream (indirect
+        # targets included — the captured next_pc is the truth either way,
+        # and a jmp has no register or memory effect to model) ---
         if m in ("jmp", "jmpq"):
-            return not (ops and ops[0].kind == "mem")
+            return True
 
         # --- conditional branches ---
         if m in _JCC_SIGNED or m in _JCC_UNSIGNED:
@@ -844,16 +1126,16 @@ class Lifter:
         if self.flags_src is None:
             return False
         kind = self.flags_src[0]
-        if kind == "cmp":
+        if kind in ("cmp", "cmpb"):
             _, a, b = self.flags_src
         else:                                     # result vs zero
             a, b = self.flags_src[1], ZERO
         if m in _JCC_SIGNED:
             cond = _JCC_SIGNED[m][0]
             if cond == "sign":
-                br = (U.BLT, a, ZERO) if kind != "cmp" else None
+                br = (U.BLT, a, ZERO) if kind == "res" else None
             elif cond == "nsign":
-                br = (U.BGE, a, ZERO) if kind != "cmp" else None
+                br = (U.BGE, a, ZERO) if kind == "res" else None
             else:
                 br = self._branch_cond(cond, a, b)
             if br is None:
@@ -989,9 +1271,15 @@ class Lifter:
         return trace, meta
 
 
-def lift(trace_path: str, binary: str,
-         max_uops: int | None = None) -> tuple[Trace, dict]:
-    """nativetrace capture + binary → (Trace, metadata)."""
-    nt = read_nativetrace(trace_path)
-    insts = static_decode(binary)
+def lift(trace_path: str, binary: str, max_uops: int | None = None,
+         nt: NativeTrace | None = None,
+         insts: "dict[int, Inst] | None" = None) -> tuple[Trace, dict]:
+    """nativetrace capture + binary → (Trace, metadata).
+
+    ``nt``/``insts`` accept pre-parsed inputs so callers that also scan the
+    raw capture (e.g. hostdiff's output-event pass) parse once."""
+    if nt is None:
+        nt = read_nativetrace(trace_path)
+    if insts is None:
+        insts = static_decode(binary)
     return Lifter(nt, insts, max_uops=max_uops).run()

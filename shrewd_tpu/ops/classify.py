@@ -28,15 +28,20 @@ OUTCOME_NAMES = ["masked", "sdc", "due", "detected"]
 
 def classify(result: ReplayResult, golden: ReplayResult,
              compare_regs: bool = True,
-             reg_mask: jax.Array | None = None) -> jax.Array:
+             reg_mask: jax.Array | None = None,
+             mem_mask: jax.Array | None = None) -> jax.Array:
     """One trial's outcome class (int32 scalar; vmap for batches).
 
-    ``reg_mask`` (bool[nphys], optional) restricts the register comparison
-    to a live-out subset — used by windowed-vs-whole-program differential
-    comparisons (ingest/hostdiff.py) where dead-at-window-end registers
-    must not count as architectural corruption."""
-    mem_diff = jnp.any(result.mem != golden.mem)
-    state_diff = mem_diff
+    ``reg_mask`` (bool[nphys]) / ``mem_mask`` (bool[mem_words], optional)
+    restrict the comparison to the post-window *live* subset — used by
+    windowed-vs-whole-program differential comparisons (ingest/hostdiff.py)
+    where state the post-window code never reads (ingest/liveness.py) must
+    not count as architectural corruption, matching the reference's
+    program-output classification (tests/gem5/verifier.py:158)."""
+    mem_diff = result.mem != golden.mem
+    if mem_mask is not None:
+        mem_diff = mem_diff & mem_mask
+    state_diff = jnp.any(mem_diff)
     if compare_regs:
         reg_diff = result.reg != golden.reg
         if reg_mask is not None:

@@ -1,0 +1,145 @@
+"""Unit tests for the post-window liveness analysis (ingest/liveness.py).
+
+classify_access is pure (Inst + captured regs → read/write sets), so the
+rule table is testable without ptrace; the end-to-end test needs the build
+toolchain and exercises a real post-window capture of sort.c.
+"""
+
+import shutil
+
+import numpy as np
+import pytest
+
+from shrewd_tpu.ingest.lift import Inst, Operand
+from shrewd_tpu.ingest import liveness as lv
+from shrewd_tpu.ingest.liveness import (RAX, RCX, RDX, RSP, RBP, RSI, RDI,
+                                        R12, classify_access)
+
+
+def _regs(over=None):
+    r = np.zeros(18, dtype=np.uint64)
+    r[RSP] = 0x7FFF0000
+    r[RBP] = 0x7FFF0100
+    r[RSI] = 0x500000
+    r[RDI] = 0x600000
+    r[RCX] = 4
+    for k, v in (over or {}).items():
+        r[k] = v
+    return r
+
+
+def _inst(mnem, *ops):
+    return Inst(0x1000, 4, mnem, list(ops), None)
+
+
+def reg_op(idx, width=64):
+    return Operand("reg", reg=idx, width=width)
+
+
+def mem_op(base=-1, disp=0, index=-1, scale=1):
+    return Operand("mem", base=base, index=index, scale=scale, disp=disp)
+
+
+def test_mov_load_reads_mem_writes_reg():
+    acc = classify_access(_inst("mov", mem_op(base=RSI, disp=8),
+                                reg_op(RAX)), _regs())
+    assert RSI in acc.reg_reads and RAX in acc.reg_writes
+    assert acc.mem_reads == ((0x500008, 8),) and not acc.mem_writes
+
+
+def test_mov_store_writes_mem():
+    acc = classify_access(_inst("mov", reg_op(RAX), mem_op(base=RDI)),
+                          _regs())
+    assert RAX in acc.reg_reads and acc.mem_writes == ((0x600000, 8),)
+
+
+def test_partial_reg_write_counts_as_read():
+    # writes to %al merge with the old rax value
+    acc = classify_access(_inst("mov", mem_op(base=RSI), reg_op(RAX, 8)),
+                          _regs())
+    assert RAX in acc.reg_reads and RAX in acc.reg_writes
+
+
+def test_lea_does_not_touch_memory():
+    acc = classify_access(_inst("lea", mem_op(base=RSI, disp=0x30),
+                                reg_op(RDI)), _regs())
+    assert not acc.mem_reads and not acc.mem_writes
+    assert RSI in acc.reg_reads and RDI in acc.reg_writes
+
+
+def test_push_pop_ret():
+    acc = classify_access(_inst("push", reg_op(R12)), _regs())
+    assert R12 in acc.reg_reads and acc.mem_writes == ((0x7FFF0000 - 8, 8),)
+    acc = classify_access(_inst("pop", reg_op(R12)), _regs())
+    assert acc.mem_reads == ((0x7FFF0000, 8),) and R12 in acc.reg_writes
+    acc = classify_access(_inst("ret"), _regs())
+    assert acc.mem_reads == ((0x7FFF0000, 8),)
+
+
+def test_rmw_reads_and_writes_dst():
+    acc = classify_access(_inst("add", reg_op(RCX), reg_op(RAX)), _regs())
+    assert RCX in acc.reg_reads and RAX in acc.reg_reads
+    assert RAX in acc.reg_writes
+
+
+def test_cmp_reads_only():
+    acc = classify_access(_inst("cmp", reg_op(RCX), reg_op(RAX)), _regs())
+    assert not acc.reg_writes and not acc.mem_writes
+
+
+def test_write_syscall_reads_buffer_and_stops_on_exit():
+    acc = classify_access(_inst("syscall"),
+                          _regs({RAX: 1, RDX: 9}))
+    assert (0x500000, 9) in acc.mem_reads
+    assert not acc.stop
+    acc = classify_access(_inst("syscall"), _regs({RAX: 231}))
+    assert acc.stop
+
+
+def test_rep_movs_conservative_ranges():
+    # both ranges are marked LIVE (reads) — with unknown element size a
+    # mis-sized DEAD marking could hide a host-visible SDC
+    acc = classify_access(_inst("rep", reg_op(-2)), _regs())
+    assert any(a == 0x500000 for a, _ in acc.mem_reads)
+    assert any(a == 0x600000 for a, _ in acc.mem_reads)
+    assert not acc.mem_writes
+    # rcx = 0: no access at all
+    acc = classify_access(_inst("rep", reg_op(-2)), _regs({RCX: 0}))
+    assert not acc.mem_reads and not acc.mem_writes
+
+
+def test_subword_store_marks_word_live_not_dead():
+    # movb writes one byte: the containing word keeps 3 live bytes, so a
+    # DEAD marking would hide SDC there — analyze must mark it LIVE
+    nt = lv.NativeTrace(0, 0, np.stack([
+        _regs({16: 0x1000}), _regs({16: 0x1004})]), [])
+    insts = {0x1000: _inst("movb", Operand("imm", imm=7),
+                           mem_op(base=RDI)),
+             0x1004: _inst("syscall")}
+    res = lv.analyze(nt._replace(
+        steps=np.stack([_regs({16: 0x1000}),
+                        _regs({RAX: 231, 16: 0x1004})])), insts)
+    assert 0x600000 in res.mem_live32
+
+
+def test_unknown_mnemonic_is_conservative():
+    acc = classify_access(_inst("fxsave64", mem_op(base=RDI)), _regs())
+    assert acc.unknown
+    assert acc.mem_reads and acc.mem_writes      # both directions assumed
+
+
+@pytest.mark.skipif(shutil.which("gcc") is None or
+                    shutil.which("objdump") is None,
+                    reason="host toolchain required")
+def test_sort_post_window_liveness_end_to_end():
+    from shrewd_tpu.ingest import hostdiff as hd
+    from shrewd_tpu.ingest.liveness import post_window_liveness
+
+    paths = hd.build_tools()
+    trace, meta = hd.capture_and_lift(paths)
+    res = post_window_liveness(paths, meta["clusters"])
+    assert not res.truncated                 # exit reached
+    assert res.reg_live[RSP]                 # stack pointer always read
+    # data[] is read by the post-window checksum loop → live words exist
+    mask = res.mem_word_mask(meta["clusters"], trace.mem_words)
+    assert mask.sum() >= 48                  # the 48-int array at minimum
