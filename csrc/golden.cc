@@ -72,6 +72,17 @@ TrialResult replay(const TraceView& tr, uint32_t* reg, uint32_t* mem,
     const bool is_st = (op == OP_STORE);
     const bool is_br = (op >= OP_BEQ && op <= OP_BGE);
 
+    // x86 #DE: div-by-zero / INT_MIN÷-1 traps (DUE) — ops/replay.py div_trap
+    if (op >= OP_DIV && op <= OP_REMU) {
+      const bool bad_s = (b == 0) || (a == 0x80000000u && b == 0xFFFFFFFFu);
+      const bool bad_u = (b == 0);
+      if (((op == OP_DIV || op == OP_REM) && bad_s) ||
+          ((op == OP_DIVU || op == OP_REMU) && bad_u)) {
+        r.trapped = true;
+        return r;
+      }
+    }
+
     // 4. memory access with LSQ faults
     if (is_ld || is_st) {
       uint32_t addr = eff;
@@ -102,7 +113,7 @@ TrialResult replay(const TraceView& tr, uint32_t* reg, uint32_t* mem,
     if (is_br) continue;
 
     // 6. writeback with ROB dest-index fault
-    const bool writes = (op >= OP_ADD && op <= OP_SLTU) || is_ld;
+    const bool writes = (op >= OP_ADD && op <= OP_REMU) || is_ld;
     if (writes) {
       int32_t d = tr.dst[i];
       if (kind == KIND_ROB_DST && at_uop) d = (d ^ index_mask) & idx_mask;

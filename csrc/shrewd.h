@@ -19,6 +19,7 @@ extern "C" {
 enum Opcode : int32_t {
   OP_NOP = 0, OP_ADD, OP_SUB, OP_AND, OP_OR, OP_XOR, OP_SLL, OP_SRL, OP_SRA,
   OP_ADDI, OP_ANDI, OP_ORI, OP_XORI, OP_LUI, OP_MUL, OP_SLT, OP_SLTU,
+  OP_DIV, OP_REM, OP_DIVU, OP_REMU,
   OP_LOAD, OP_STORE, OP_BEQ, OP_BNE, OP_BLT, OP_BGE,
   N_OPCODES
 };
@@ -119,6 +120,20 @@ inline uint32_t shrewd_alu(int32_t op, uint32_t a, uint32_t b, uint32_t imm) {
     case OP_MUL:  return a * b;
     case OP_SLT:  return static_cast<int32_t>(a) < static_cast<int32_t>(b);
     case OP_SLTU: return a < b;
+    // x86 #DE cases (b==0, INT_MIN/-1) return 0 here; the replay's trap
+    // path classifies them DUE — matches ops/replay.py _div4 exactly
+    case OP_DIV: {
+      if (b == 0 || (a == 0x80000000u && b == 0xFFFFFFFFu)) return 0;
+      return static_cast<uint32_t>(static_cast<int32_t>(a)
+                                   / static_cast<int32_t>(b));
+    }
+    case OP_REM: {
+      if (b == 0 || (a == 0x80000000u && b == 0xFFFFFFFFu)) return 0;
+      return static_cast<uint32_t>(static_cast<int32_t>(a)
+                                   % static_cast<int32_t>(b));
+    }
+    case OP_DIVU: return b ? a / b : 0;
+    case OP_REMU: return b ? a % b : 0;
     case OP_LOAD: case OP_STORE: return a + imm;  // effective address
     case OP_BEQ:  return a == b;
     case OP_BNE:  return a != b;
@@ -131,7 +146,8 @@ inline uint32_t shrewd_alu(int32_t op, uint32_t a, uint32_t b, uint32_t imm) {
 inline int32_t shrewd_opclass(int32_t op) {
   switch (op) {
     case OP_NOP:   return OC_NONE;
-    case OP_MUL:   return OC_INT_MULT;
+    case OP_MUL: case OP_DIV: case OP_REM: case OP_DIVU: case OP_REMU:
+      return OC_INT_MULT;  // the reference's IntMultDiv unit
     case OP_LOAD:  return OC_MEM_READ;
     case OP_STORE: return OC_MEM_WRITE;
     default:       return OC_INT_ALU;

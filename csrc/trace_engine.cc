@@ -125,7 +125,7 @@ int32_t shrewd_generate_trace(const WorkloadParams* p, int32_t* opcode,
       mem[res >> 2] = b;
     } else if (op >= OP_BEQ && op <= OP_BGE) {
       taken[i] = (int32_t)res;
-    } else if ((op >= OP_ADD && op <= OP_SLTU)) {
+    } else if ((op >= OP_ADD && op <= OP_REMU)) {
       reg[d] = res;
       recent.push_back(d);
     }

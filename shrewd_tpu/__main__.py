@@ -1,0 +1,7 @@
+"""``python -m shrewd_tpu`` — see shrewd_tpu/main.py."""
+
+import sys
+
+from shrewd_tpu.main import main
+
+sys.exit(main())

@@ -52,16 +52,30 @@ class TraceFileSpec(SimPointSpec):
 
 
 class CheckpointSpec(SimPointSpec):
-    """Restore a gem5 checkpoint and re-warm (ingest/warm.py)."""
+    """Restore a gem5 checkpoint and re-warm (ingest/warm.py).
+
+    With ``binary`` set, the window is the REAL instruction stream: the
+    snapshot-seeded emulator runs forward from the checkpoint PC and the
+    macro→µop lifter lifts it (restore-then-rewarm,
+    ``src/cpu/o3/cpu.cc:706-799``).  Without it, a synthetic stream runs
+    over the snapshot state (artifact-free fallback)."""
 
     cpt_dir = Param(str, desc="checkpoint directory containing m5.cpt")
     thread = Param(int, 0, "thread context index")
     warmup = Param(int, 1024, "µops retired functionally before capture")
+    binary = Param(str, "", "workload ELF for the lifted (real-stream) path")
+    max_steps = Param(int, 200_000, "emulated macro-op budget (lifted path)")
     workload = Child(synth.WorkloadConfig)
 
     def build_trace(self) -> Trace:
-        from shrewd_tpu.ingest import load_arch_snapshot, window_from_snapshot
+        from shrewd_tpu.ingest import (load_arch_snapshot,
+                                       window_from_snapshot,
+                                       window_from_snapshot_lifted)
         snap = load_arch_snapshot(self.cpt_dir, self.thread)
+        if self.binary:
+            trace, _meta = window_from_snapshot_lifted(
+                snap, self.binary, max_steps=self.max_steps)
+            return trace
         return window_from_snapshot(snap, self.workload, self.warmup)
 
 

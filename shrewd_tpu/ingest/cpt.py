@@ -29,6 +29,7 @@ this framework produces (differential-testing in both directions).
 from __future__ import annotations
 
 import gzip
+import json
 import os
 import re
 from typing import Iterator, NamedTuple
@@ -170,6 +171,12 @@ class ArchSnapshot(NamedTuple):
     # multi-store checkpoints, flat offsets into `mem` are per-store
     # offsets plus the preceding stores' sizes, NOT physical addresses.
     store_layout: tuple[tuple[str, int], ...] = ()
+    # (vaddr, size) per store, from the checkpoint dir's config.json
+    # sidecar when present.  The m5.cpt format itself records no base
+    # addresses (the reference keeps address ranges in config.ini,
+    # physical.cc:442-449); the sidecar plays config.ini's role so
+    # snapshot-seeded emulation (ingest/emu.py) can address the image.
+    regions: tuple = ()
 
 
 def _thread_sections(cpt: CheckpointIn) -> list[str]:
@@ -212,6 +219,17 @@ def load_arch_snapshot(cpt_dir: str, thread: int = 0) -> ArchSnapshot:
     mem = (np.concatenate(images) if images else np.zeros(0, np.uint8))
     layout = tuple((s, int(img.size)) for s, img in zip(stores, images))
 
+    regions: tuple = ()
+    side = os.path.join(cpt_dir, "config.json")
+    if os.path.exists(side):
+        with open(side) as f:
+            cfg = json.load(f)
+        by_sec = {e["section"]: int(e["vaddr"])
+                  for e in cfg.get("stores", [])}
+        if all(s in by_sec for s in stores):
+            regions = tuple((by_sec[s], int(img.size))
+                            for s, img in zip(stores, images))
+
     return ArchSnapshot(
         cur_tick=cpt.get_int("Globals", "curTick"),
         version_tags=tuple(cpt.find("Globals", "version_tags").split()),
@@ -222,6 +240,7 @@ def load_arch_snapshot(cpt_dir: str, thread: int = 0) -> ArchSnapshot:
         mem=mem,
         thread_section=tsec,
         store_layout=layout,
+        regions=regions,
     )
 
 
@@ -245,7 +264,49 @@ def write_arch_snapshot(cpt_dir: str, snap: ArchSnapshot,
     out.param("_pc", snap.pc)
     out.param("_upc", 0)
 
-    if snap.mem.size:
+    sidecar_stores = []
+    if snap.regions:
+        # one store per region + a config.json sidecar carrying the vaddrs
+        # (the role config.ini plays in the reference)
+        off = 0
+        for sid, (vaddr, size) in enumerate(snap.regions):
+            sec = f"{system}.physmem.store{sid}"
+            out.begin_section(sec)
+            out.store(f"{system}.physmem", sid,
+                      snap.mem[off:off + size])
+            sidecar_stores.append({"section": sec, "vaddr": int(vaddr),
+                                   "size": int(size)})
+            off += size
+    elif snap.mem.size:
         out.begin_section(f"{system}.physmem.store0")
         out.store(f"{system}.physmem", 0, snap.mem)
     out.close()
+    if sidecar_stores:
+        with open(os.path.join(cpt_dir, "config.json"), "w") as f:
+            json.dump({"stores": sidecar_stores}, f, indent=1)
+
+
+def snapshot_from_capture(nt, cur_tick: int = 0) -> ArchSnapshot:
+    """A nativetrace capture's initial state → ArchSnapshot.
+
+    The capture already holds exactly what a drained checkpoint holds —
+    architectural registers + memory image at the window boundary (SURVEY
+    §5.4) — so the tracer doubles as the framework's SE-mode checkpointing
+    tool; ``write_arch_snapshot`` of this result produces an m5.cpt that
+    ``CheckpointSpec`` restores without the original process."""
+    step0 = nt.steps[0]
+    return ArchSnapshot(
+        cur_tick=cur_tick,
+        version_tags=VERSION_TAGS,
+        pc=int(step0[16]),
+        int_regs=np.asarray(step0[:16], dtype=np.uint64),
+        float_regs=np.zeros(0, np.uint64),
+        mem=np.concatenate([np.frombuffer(d, dtype=np.uint8)
+                            for _, d in nt.regions])
+        if nt.regions else np.zeros(0, np.uint8),
+        thread_section="system.cpu.xc.0",
+        store_layout=tuple(
+            (f"system.physmem.store{i}", len(d))
+            for i, (_, d) in enumerate(nt.regions)),
+        regions=tuple((int(v), len(d)) for v, d in nt.regions),
+    )

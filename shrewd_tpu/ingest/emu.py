@@ -1,0 +1,473 @@
+"""Snapshot-seeded x86-64 subset emulator: checkpoint → synthetic capture.
+
+Checkpoint restore needs an instruction stream to rebuild a replay window
+(SURVEY §5.4: checkpoints are architectural-only — the reference restores
+arch state and *runs forward*, ``src/cpu/o3/cpu.cc:706-799``).  A live
+ptrace capture (tools/nativetrace.cc) needs the program running on this
+host at the right marker; a checkpoint mid-run has no such luxury.  This
+module plays the host CPU's role instead: a 64-bit x86 subset interpreter
+seeded from the ``ArchSnapshot`` (regs + memory image + pc) that emits the
+same per-step record stream the ptrace tracer produces, so the *unchanged*
+capture-based lifter (ingest/lift.py) consumes it.
+
+The duplication is deliberate and load-bearing: the lifter re-simulates
+every macro-op in its own 32-bit µop semantics and demotes on mismatch, so
+running it over this emulator's stream is a differential test between two
+independent implementations — a bug in either shows up as opaque demotions
+(visible in LiftStats), not silent corruption.  On workloads with a live
+capture available, ``tests/test_emu.py`` additionally pins this emulator's
+step stream bit-for-bit against the real ptrace capture.
+
+Width semantics follow the ISA: 8/16-bit destination writes merge, 32-bit
+zero-extend to 64, 64-bit overwrite.  Flags are kept lazily (source op +
+operands) and materialized per condition code.  Anything outside the
+supported subset (syscalls included) ends the window — the window-boundary
+analog of the tracer's end marker.
+
+Reference anchors: restore-then-rewarm (``src/cpu/o3/cpu.cc:706-799``),
+the CheckerCPU lockstep-interpreter pattern (``src/cpu/checker/cpu.hh``).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import numpy as np
+
+from shrewd_tpu.ingest.lift import (Inst, NativeTrace, Operand, _CMOV,
+                                    static_decode)
+
+M8, M16, M32, M64 = 0xFF, 0xFFFF, 0xFFFFFFFF, 0xFFFFFFFFFFFFFFFF
+RAX, RCX, RDX, RBX, RSP, RBP, RSI, RDI = range(8)
+
+_ALU = {"add", "sub", "and", "or", "xor", "imul"}
+_SHIFT = {"shl": "shl", "sal": "shl", "shr": "shr", "sar": "sar"}
+
+_JCC = {"je": "e", "jz": "e", "jne": "ne", "jnz": "ne",
+        "jb": "b", "jnae": "b", "jae": "ae", "jnb": "ae",
+        "ja": "a", "jnbe": "a", "jbe": "be", "jna": "be",
+        "jl": "l", "jnge": "l", "jge": "ge", "jnl": "ge",
+        "jg": "g", "jnle": "g", "jle": "le", "jng": "le",
+        "js": "s", "jns": "ns"}
+
+# _CMOV maps cmov* → the lifter's condition vocabulary; translate to ours
+_LIFT_COND = {"eq": "e", "ne": "ne", "lt": "l", "ge": "ge",
+              "swap_lt": "g", "swap_ge": "le", "sign": "s", "nsign": "ns",
+              "ub": "b", "uae": "ae", "ua": "a", "ube": "be"}
+
+
+class StopEmu(Exception):
+    """Window boundary: unsupported instruction / memory miss / syscall."""
+
+
+class Region:
+    def __init__(self, vaddr: int, data: bytes):
+        self.vaddr = vaddr
+        self.buf = bytearray(data)
+
+    def contains(self, addr: int, size: int) -> bool:
+        return self.vaddr <= addr and addr + size <= self.vaddr + len(self.buf)
+
+
+class EmuResult(NamedTuple):
+    nt: NativeTrace            # lifter-compatible synthetic capture
+    steps: int
+    stop_reason: str
+    stop_pc: int
+
+
+class Emulator:
+    def __init__(self, insts: dict[int, Inst], regs: np.ndarray,
+                 regions: list[tuple[int, bytes]], pc: int):
+        self.insts = insts
+        self.reg = [int(x) & M64 for x in regs[:16]]
+        self.regions = [Region(v, d) for v, d in regions]
+        self.pc = int(pc)
+        self.flags = ("res", 0, 64, 0)   # kind, operands..., width
+        self.stop_reason = "max_steps"
+
+    # -- memory ------------------------------------------------------------
+
+    def _region(self, addr: int, size: int) -> Region:
+        for r in self.regions:
+            if r.contains(addr, size):
+                return r
+        raise StopEmu(f"mem miss {addr:#x}+{size}")
+
+    def load(self, addr: int, size: int) -> int:
+        r = self._region(addr, size)
+        off = addr - r.vaddr
+        return int.from_bytes(r.buf[off:off + size], "little")
+
+    def store(self, addr: int, size: int, value: int) -> None:
+        r = self._region(addr, size)
+        off = addr - r.vaddr
+        r.buf[off:off + size] = (value & ((1 << (8 * size)) - 1)).to_bytes(
+            size, "little")
+
+    # -- registers ---------------------------------------------------------
+
+    def rget(self, op: Operand) -> int:
+        v = self.reg[op.reg]
+        w = op.width
+        if w == 64:
+            return v
+        if w == 32:
+            return v & M32
+        if w == 16:
+            return v & M16
+        if w == 8:
+            return v & M8
+        if w == -8:                       # high byte (%ah family)
+            return (v >> 8) & M8
+        raise StopEmu(f"reg width {w}")
+
+    def rset(self, op: Operand, value: int) -> None:
+        old = self.reg[op.reg]
+        w = op.width
+        if w == 64:
+            nv = value & M64
+        elif w == 32:
+            nv = value & M32              # zero-extends
+        elif w == 16:
+            nv = (old & ~M16) | (value & M16)
+        elif w == 8:
+            nv = (old & ~M8) | (value & M8)
+        elif w == -8:
+            nv = (old & ~(M8 << 8)) | ((value & M8) << 8)
+        else:
+            raise StopEmu(f"reg width {w}")
+        self.reg[op.reg] = nv
+
+    # -- operands ----------------------------------------------------------
+
+    def ea(self, op: Operand) -> int:
+        if op.base == -3:
+            raise StopEmu("unparsed mem operand")
+        if op.rip_rel:
+            return op.disp & M64
+        a = op.disp
+        if op.base >= 0:
+            a += self.reg[op.base]
+        if op.index >= 0:
+            a += self.reg[op.index] * op.scale
+        return a & M64
+
+    def _op_width(self, inst: Inst, default: int = 64) -> int:
+        for o in inst.operands:
+            if o.kind == "reg" and o.reg >= 0 and o.width:
+                return abs(o.width)
+        return {"b": 8, "w": 16, "l": 32, "q": 64}.get(
+            inst.mnemonic[-1], default)
+
+    def read(self, inst: Inst, op: Operand, width: int) -> int:
+        if op.kind == "imm":
+            return op.imm & ((1 << width) - 1)
+        if op.kind == "reg":
+            if op.reg < 0:
+                raise StopEmu("non-GPR operand")
+            return self.rget(op)
+        if op.kind == "mem":
+            return self.load(self.ea(op), width // 8)
+        raise StopEmu("operand kind")
+
+    def write(self, inst: Inst, op: Operand, width: int, value: int) -> None:
+        if op.kind == "reg":
+            if op.reg < 0:
+                raise StopEmu("non-GPR operand")
+            self.rset(op, value)
+        elif op.kind == "mem":
+            self.store(self.ea(op), width // 8, value)
+        else:
+            raise StopEmu("write to imm")
+
+    # -- flags -------------------------------------------------------------
+
+    def set_flags_sub(self, a: int, b: int, width: int) -> None:
+        self.flags = ("sub", a, b, width)
+
+    def set_flags_add(self, a: int, b: int, width: int) -> None:
+        self.flags = ("add", a, b, width)
+
+    def set_flags_res(self, v: int, width: int) -> None:
+        self.flags = ("res", v, width, 0)
+
+    def _fl(self) -> tuple[bool, bool, bool, bool]:
+        """(ZF, SF, CF, OF) from the lazy flags record."""
+        kind = self.flags[0]
+        if kind == "res":
+            _, v, w, _ = self.flags
+            mask = (1 << w) - 1
+            r = v & mask
+            return r == 0, bool(r >> (w - 1)), False, False
+        _, a, b, w = self.flags
+        mask = (1 << w) - 1
+        a &= mask
+        b &= mask
+        if kind == "sub":
+            r = (a - b) & mask
+            cf = b > a
+            of = bool(((a ^ b) & (a ^ r)) >> (w - 1) & 1)
+        else:                              # add
+            r = (a + b) & mask
+            cf = a + b > mask
+            of = bool((~(a ^ b) & (a ^ r)) >> (w - 1) & 1)
+        return r == 0, bool(r >> (w - 1)), cf, of
+
+    def cond(self, cc: str) -> bool:
+        zf, sf, cf, of = self._fl()
+        return {
+            "e": zf, "ne": not zf,
+            "b": cf, "ae": not cf,
+            "a": not cf and not zf, "be": cf or zf,
+            "l": sf != of, "ge": sf == of,
+            "g": not zf and sf == of, "le": zf or sf != of,
+            "s": sf, "ns": not sf,
+        }[cc]
+
+    # -- one step ----------------------------------------------------------
+
+    def step(self) -> None:
+        inst = self.insts.get(self.pc)
+        if inst is None:
+            raise StopEmu("undecoded pc")
+        m = inst.mnemonic
+        ops = inst.operands
+        next_pc = self.pc + inst.length
+        w = self._op_width(inst)
+        mask = (1 << w) - 1
+        sign = 1 << (w - 1)
+
+        def sx(v: int, from_w: int) -> int:
+            v &= (1 << from_w) - 1
+            return v - (1 << from_w) if v >> (from_w - 1) else v
+
+        if m in ("nop", "nopw", "nopl", "endbr64") or m.startswith("nop"):
+            pass
+        elif m in ("mov", "movb", "movw", "movl", "movq", "movabs"):
+            src, dst = ops
+            self.write(inst, dst, w, self.read(inst, src, w))
+        elif m in ("movslq", "movsxd"):
+            src, dst = ops
+            self.write(inst, dst, 64, sx(self.read(inst, src, 32), 32) & M64)
+        elif m.startswith(("movz", "movs")) and len(m) >= 6:
+            src, dst = ops
+            fw = 8 if m[4] == "b" else 16
+            v = self.read(inst, src, fw)
+            if m.startswith("movs"):
+                v = sx(v, fw) & mask
+            dw = abs(dst.width) if dst.kind == "reg" and dst.width else w
+            self.write(inst, dst, dw, v & ((1 << dw) - 1))
+        elif m in ("lea", "leaq", "leal"):
+            src, dst = ops
+            self.write(inst, dst, w, self.ea(src) & mask)
+        elif m.rstrip("bwlq") in _ALU or m in _ALU:
+            stem = m if m in _ALU else m.rstrip("bwlq")
+            if stem == "imul" and len(ops) == 3:
+                immv, src, dst = ops
+                r = sx(self.read(inst, src, w), w) * immv.imm
+                self.write(inst, dst, w, r & mask)
+                self.set_flags_res(r & mask, w)
+            else:
+                src, dst = ops
+                a = self.read(inst, dst, w)
+                b = self.read(inst, src, w)
+                if stem == "add":
+                    r = a + b
+                    self.set_flags_add(a, b, w)
+                elif stem == "sub":
+                    r = a - b
+                    self.set_flags_sub(a, b, w)
+                elif stem == "imul":
+                    r = sx(a, w) * sx(b, w)
+                    self.set_flags_res(r & mask, w)
+                else:
+                    r = {"and": a & b, "or": a | b, "xor": a ^ b}[stem]
+                    self.set_flags_res(r & mask, w)
+                self.write(inst, dst, w, r & mask)
+        elif m.rstrip("bwlq") in _SHIFT or m in _SHIFT:
+            stem = _SHIFT[m if m in _SHIFT else m.rstrip("bwlq")]
+            if len(ops) == 1:
+                ops = [Operand("imm", imm=1)] + ops
+            src, dst = ops
+            sh = self.read(inst, src, 8) & (63 if w == 64 else 31)
+            a = self.read(inst, dst, w)
+            if stem == "shl":
+                r = a << sh
+            elif stem == "shr":
+                r = a >> sh
+            else:
+                r = (sx(a, w) >> sh) & mask
+            self.write(inst, dst, w, r & mask)
+            if sh:
+                self.set_flags_res(r & mask, w)
+        elif m.rstrip("lqwb") in ("inc", "dec", "neg", "not"):
+            stem = m.rstrip("lqwb")
+            d = ops[0]
+            a = self.read(inst, d, w)
+            if stem == "inc":
+                r = a + 1
+                self.set_flags_res(r & mask, w)      # CF preserved ≈ res
+            elif stem == "dec":
+                r = a - 1
+                self.set_flags_res(r & mask, w)
+            elif stem == "neg":
+                r = -a
+                self.set_flags_sub(0, a, w)
+            else:
+                r = ~a
+            self.write(inst, d, w, r & mask)
+        elif m.rstrip("bwlq") == "cmp" or m == "cmp":
+            src, dst = ops
+            self.set_flags_sub(self.read(inst, dst, w),
+                               self.read(inst, src, w), w)
+        elif m.rstrip("bwlq") == "test" or m == "test":
+            a, b = ops
+            self.set_flags_res(self.read(inst, a, w)
+                               & self.read(inst, b, w), w)
+        elif m in ("push", "pushq"):
+            v = self.read(inst, ops[0], 64)
+            self.reg[RSP] = (self.reg[RSP] - 8) & M64
+            self.store(self.reg[RSP], 8, v)
+        elif m in ("pop", "popq"):
+            v = self.load(self.reg[RSP], 8)
+            self.reg[RSP] = (self.reg[RSP] + 8) & M64
+            self.write(inst, ops[0], 64, v)
+        elif m in ("call", "callq"):
+            if ops and ops[0].kind == "imm":
+                target = ops[0].imm
+            elif ops and ops[0].kind == "reg" and ops[0].reg >= 0:
+                target = self.reg[ops[0].reg]
+            elif ops and ops[0].kind == "mem" and ops[0].base != -3:
+                target = self.load(self.ea(ops[0]), 8)
+            else:
+                raise StopEmu("call target")
+            self.reg[RSP] = (self.reg[RSP] - 8) & M64
+            self.store(self.reg[RSP], 8, next_pc)
+            next_pc = target & M64
+        elif m in ("ret", "retq"):
+            next_pc = self.load(self.reg[RSP], 8)
+            self.reg[RSP] = (self.reg[RSP] + 8) & M64
+        elif m == "leave":
+            self.reg[RSP] = self.reg[RBP]
+            self.reg[RBP] = self.load(self.reg[RSP], 8)
+            self.reg[RSP] = (self.reg[RSP] + 8) & M64
+        elif m in ("jmp", "jmpq"):
+            if ops and ops[0].kind == "imm":
+                next_pc = ops[0].imm & M64
+            elif ops and ops[0].kind == "reg" and ops[0].reg >= 0:
+                next_pc = self.reg[ops[0].reg]
+            else:
+                raise StopEmu("indirect jmp form")
+        elif m in _JCC:
+            if self.cond(_JCC[m]):
+                next_pc = ops[0].imm & M64
+        elif m.startswith("cmov"):
+            base = m if m in _CMOV else m.rstrip("lqw")
+            if base not in _CMOV:
+                raise StopEmu(f"cmov {m}")
+            src, dst = ops
+            if self.cond(_LIFT_COND[_CMOV[base]]):
+                self.write(inst, dst, w, self.read(inst, src, w))
+        elif m in ("cltq", "cdqe"):
+            self.reg[RAX] = sx(self.reg[RAX] & M32, 32) & M64
+        elif m in ("cwtl", "cwde"):
+            self.reg[RAX] = (self.reg[RAX] & ~M32) | (
+                sx(self.reg[RAX] & M16, 16) & M32)
+        elif m in ("cltd", "cdq"):
+            self.reg[RDX] = (self.reg[RDX] & ~M32) | (
+                M32 if self.reg[RAX] & 0x80000000 else 0)
+        elif m in ("cqto", "cqo"):
+            self.reg[RDX] = M64 if self.reg[RAX] >> 63 else 0
+        elif m.rstrip("lqwb") in ("div", "idiv"):
+            stem = m.rstrip("lqwb")
+            b = self.read(inst, ops[0], w)
+            if b == 0:
+                raise StopEmu("div by zero")
+            if w == 32:
+                a = ((self.reg[RDX] & M32) << 32) | (self.reg[RAX] & M32)
+            else:
+                a = ((self.reg[RDX] & M64) << 64) | (self.reg[RAX] & M64)
+            if stem == "idiv":
+                aa = a - (1 << (2 * w)) if a >> (2 * w - 1) else a
+                bb = sx(b, w)
+                q = abs(aa) // abs(bb)    # exact trunc-toward-zero
+                if (aa < 0) != (bb < 0):
+                    q = -q
+                r = aa - q * bb
+                if not (-(1 << (w - 1)) <= q <= (1 << (w - 1)) - 1):
+                    raise StopEmu("div overflow")   # x86 #DE
+            else:
+                q, r = divmod(a, b)
+                if q > (1 << w) - 1:
+                    raise StopEmu("div overflow")   # x86 #DE
+            if w == 32:
+                self.reg[RAX] = q & M32   # 32-bit writes zero-extend
+                self.reg[RDX] = r & M32
+            else:
+                self.reg[RAX] = q & M64
+                self.reg[RDX] = r & M64
+        elif m in ("xchg", "xchgl", "xchgq"):
+            a, b = ops
+            va = self.read(inst, a, w)
+            vb = self.read(inst, b, w)
+            self.write(inst, a, w, vb)
+            self.write(inst, b, w, va)
+        else:
+            raise StopEmu(f"unsupported {m}")
+        self.pc = next_pc & M64
+
+    # -- run ---------------------------------------------------------------
+
+    def canonical(self) -> np.ndarray:
+        row = np.zeros(18, dtype=np.uint64)
+        for i in range(16):
+            row[i] = self.reg[i]
+        row[16] = self.pc
+        row[17] = 0x202                   # IF set, DF clear
+        return row
+
+    def run(self, max_steps: int) -> EmuResult:
+        rows = [self.canonical()]
+        begin = self.pc
+        stop = "max_steps"
+        for _ in range(max_steps):
+            try:
+                self.step()
+            except StopEmu as e:
+                # rows[-1] is already the clean state AT the boundary (the
+                # unsupported instruction never executed) — exactly the
+                # NativeTrace contract's "last record = state at end"
+                stop = str(e)
+                break
+            rows.append(self.canonical())
+        steps = np.stack(rows)
+        regions = [(r.vaddr, bytes(r.buf)) for r in self.regions]
+        # NativeTrace contract: steps[n_macro] is the state at the end
+        # marker; regions snapshot the *initial* image — rebuild from the
+        # originals the caller seeded (they were copied into Region bufs),
+        # so hand back the caller's originals via from_snapshot instead.
+        nt = NativeTrace(begin=begin, end=int(steps[-1][16]),
+                         steps=steps, regions=regions)
+        return EmuResult(nt=nt, steps=len(steps) - 1, stop_reason=stop,
+                         stop_pc=int(steps[-1][16]))
+
+
+def emulate_window(binary: str, regs: np.ndarray,
+                   regions: list[tuple[int, bytes]], pc: int,
+                   max_steps: int = 200_000,
+                   insts: "dict[int, Inst] | None" = None) -> EmuResult:
+    """Decode + run; regions are (vaddr, bytes) of the initial image.
+
+    ``insts`` accepts a pre-parsed static decode so callers that also lift
+    (warm.window_from_snapshot_lifted) disassemble once.
+
+    NOTE the returned ``nt.regions`` must be the INITIAL image (the lifter
+    snapshots memory at window start); Emulator.run hands back post-run
+    buffers, so re-seed them here."""
+    if insts is None:
+        insts = static_decode(binary)
+    emu = Emulator(insts, regs, regions, pc)
+    res = emu.run(max_steps)
+    return res._replace(nt=res.nt._replace(
+        regions=[(v, d) for v, d in regions]))

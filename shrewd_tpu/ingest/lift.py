@@ -55,6 +55,7 @@ from typing import NamedTuple
 import numpy as np
 
 from shrewd_tpu.isa import uops as U
+from shrewd_tpu.isa import semantics
 from shrewd_tpu.trace.format import Trace
 
 # canonical register order (tools/ptrace_common.h): x86-64 encoding order
@@ -260,6 +261,7 @@ class LiftStats:
     branches_dropped: int = 0
     mem_accesses: int = 0
     mem_dropped: int = 0        # byte/unmappable accesses skipped
+    clusters_dropped: int = 0   # low-32-colliding / wrapping clusters
     uops: int = 0
     opaque_mnemonics: dict = field(default_factory=dict)
 
@@ -270,7 +272,8 @@ class LiftStats:
     def to_dict(self) -> dict:
         d = {k: getattr(self, k) for k in (
             "macro_ops", "lifted", "opaque", "branches", "branches_lifted",
-            "branches_dropped", "mem_accesses", "mem_dropped", "uops")}
+            "branches_dropped", "mem_accesses", "mem_dropped",
+            "clusters_dropped", "uops")}
         d["lift_rate"] = self.lift_rate
         d["opaque_mnemonics"] = dict(sorted(
             self.opaque_mnemonics.items(), key=lambda kv: -kv[1])[:12])
@@ -394,21 +397,37 @@ class Lifter:
                 clusters_raw.append([ea])
             else:
                 clusters_raw[-1].append(ea)
-        # layout: each cluster padded, word-aligned, with a 16-word margin
-        word_off = 0
-        self.clusters = []
-        for c in clusters_raw:
+        # Layout: each cluster padded, word-aligned, 16-word margin.  The
+        # replay address space is the low-32 projection, so clusters whose
+        # projected ranges collide cannot coexist — keep the heaviest
+        # (most-touched) clusters and DROP the rest: a dropped cluster's
+        # accesses demote to opaque via pc_cluster=None (stray one-off EAs
+        # in the libc exit tail were colliding and failing whole lifts).
+        weights = [len(c) for c in clusters_raw]
+        order = sorted(range(len(clusters_raw)), key=lambda i: -weights[i])
+        kept: list[tuple[int, int]] = []       # (lo32, hi32) accepted
+        kept_idx = []
+        for ci in order:
+            c = clusters_raw[ci]
             lo = (c[0] & ~0x3F)                  # 64-byte align down
             hi = ((c[-1] + 8 + 0x3F) & ~0x3F) + 64
             lo32, hi32 = lo & M32, hi & M32
-            if hi32 < lo32:
-                raise ValueError("cluster wraps the 32-bit space")
-            self.clusters.append(Cluster(lo32, hi32, word_off))
+            if hi32 < lo32:                      # wraps the 32-bit space
+                self.stats.clusters_dropped += 1
+                continue
+            if any(lo32 < h and ll < hi32 for ll, h in kept):
+                self.stats.clusters_dropped += 1
+                continue
+            kept.append((lo32, hi32))
+            kept_idx.append(ci)
+        word_off = 0
+        self.clusters = []
+        for ci in sorted(kept_idx):
+            c = clusters_raw[ci]
+            lo = (c[0] & ~0x3F)
+            hi = ((c[-1] + 8 + 0x3F) & ~0x3F) + 64
+            self.clusters.append(Cluster(lo & M32, hi & M32, word_off))
             word_off += (hi - lo) // 4
-        # 32-bit disjointness (the replay address space is the projection)
-        for a, b in zip(self.clusters, self.clusters[1:]):
-            if b.lo < a.hi:
-                raise ValueError("clusters overlap in low-32 projection")
         self.mem_words = 1 << int(np.ceil(np.log2(max(word_off, 64))))
         self.mem = np.zeros(self.mem_words, dtype=np.uint32)
         # fill from the snapshot regions
@@ -429,8 +448,11 @@ class Lifter:
         self.pc_cluster: dict[int, Cluster | None] = {}
         for pc, eas in touched.items():
             cls = {self._cluster_of(ea & M32) for ea in eas}
-            cls.discard(None)
-            self.pc_cluster[pc] = cls.pop() if len(cls) == 1 else None
+            # None (an EA in a DROPPED cluster) must demote the pc, not be
+            # discarded: folding a kept cluster's remap into a dropped
+            # cluster's EA would store through a wrong replay word
+            self.pc_cluster[pc] = (cls.pop() if len(cls) == 1
+                                   and None not in cls else None)
 
     def _cluster_of(self, ea32: int) -> Cluster | None:
         for cl in self.clusters:
@@ -493,6 +515,8 @@ class Lifter:
             res = int(self._s32(a) < self._s32(b))
         elif op == U.SLTU:
             res = int(a < b)
+        elif op in (U.DIV, U.REM, U.DIVU, U.REMU):
+            res = semantics.alu(op, a, b, imm)
         elif op == U.LOAD:
             addr = (a + imm) & M32
             res = int(self.mem[(addr >> 2) & (self.mem_words - 1)]) \
@@ -666,6 +690,41 @@ class Lifter:
         if neg:
             self._emit(U.XORI, out_reg, out_reg, ZERO, 1)
         return out_reg
+
+    def _subword_alu(self, opcode: int, src: Operand, dst: Operand,
+                     pc: int, regs: np.ndarray, width: int) -> bool:
+        """Byte/halfword ALU with a register destination: compute on
+        sign-extended operands (bitwise low bits coincide; add/sub wrap at
+        merge), merge into dst's low byte/word, and record sub-word flags
+        — SUB keeps exact ("cmpb") compare flags, the rest expose ZF/SF of
+        the sign-extended result."""
+        if dst.kind != "reg" or dst.reg < 0 or opcode == U.MUL:
+            return False
+        msk = 0xFF if width == 1 else 0xFFFF
+        sbit = msk ^ (msk >> 1)
+        self._extend_reg(dst.reg, width, True, T2)
+        if src.kind == "imm":
+            v = src.imm & msk
+            v = v - (msk + 1) if v & sbit else v
+            self._const(v & M32, TCMP)
+        elif src.kind == "reg" and src.reg >= 0:
+            self._extend_reg(src.reg, width, True, TCMP)
+        elif src.kind == "mem":
+            if not self._subword_load_value(src, pc, regs, width, True,
+                                            TCMP):
+                return False
+        else:
+            return False
+        self._emit(opcode, T5, T2, TCMP)
+        self._emit(U.ANDI, T6, T5, ZERO, msk)
+        self._emit(U.ANDI, dst.reg, dst.reg, ZERO, (~msk) & M32)
+        self._emit(U.OR, dst.reg, dst.reg, T6)
+        if opcode == U.SUB:
+            self.flags_src = ("cmpb", T2, TCMP)
+        else:
+            self._extend_reg(T5, width, True, T1)
+            self.flags_src = ("res", T1)
+        return True
 
     def _lift_one(self, i: int, inst: Inst, regs: np.ndarray,
                   next_regs: np.ndarray, next_pc: int) -> bool:
@@ -884,6 +943,20 @@ class Lifter:
         stem = m.rstrip("lqwb") if m not in _ALU2 else m
         if m in _ALU2 or stem in _ALU2:
             opcode = _ALU2.get(m, _ALU2.get(stem))
+            rws = [abs(o.width) for o in ops
+                   if o.kind == "reg" and o.reg >= 0 and o.width]
+            sfx = m[-1] if m not in _ALU2 else ""   # "subb" → 'b'; "sub" → ""
+            sub_w = 0
+            if sfx == "b" or (rws and min(rws) == 8):
+                sub_w = 1
+            elif sfx == "w" or (rws and min(rws) == 16):
+                sub_w = 2
+            if sub_w and len(ops) == 2:
+                if any(o.kind == "reg" and o.reg >= 0 and o.width < 0
+                       for o in ops):
+                    return False              # %ah-family
+                return self._subword_alu(opcode, ops[0], ops[1], pc, regs,
+                                         sub_w)
             if len(ops) == 3 and m.startswith("imul"):
                 # imul $imm, src, dst
                 immv, src, dst = ops
@@ -1108,9 +1181,42 @@ class Lifter:
             self.stats.branches_dropped += 1
             return True
 
-        if m in ("nop", "nopw", "nopl", "endbr64", "cltd", "cqo", "cdq"):
-            # cltd/cdq/cqo write rdx from rax's sign: demote unless rdx
-            # matches (self-check handles); nops are free
+        if m in ("cltd", "cdq"):
+            # edx = sign-fill of eax: SRA by 31 (cdq sets no flags, so T6)
+            c31 = self._const(31, T6)
+            self._emit(U.SRA, 2, 0, c31)
+            return True
+
+        # --- 32-bit division: edx:eax / src → eax=quot, edx=rem.  The
+        # 32-bit projection computes eax/src directly; the edx:eax
+        # precondition (cltd sign-fill / xor-zeroed) is validated by the
+        # register self-check — a genuinely 64-bit dividend demotes. ---
+        if m in ("idiv", "idivl", "div", "divl"):
+            if len(ops) != 1:
+                return False
+            o = ops[0]
+            signed = m.startswith("i")
+            if o.kind == "reg" and o.reg >= 0 and abs(o.width) == 32:
+                breg = o.reg
+            elif o.kind == "mem" and self._mem_width(inst, o) >= 4 \
+                    and not m.endswith(("q",)):
+                a = self._addr_uops(o, pc, T0)
+                if a is None:
+                    return False
+                self._emit(U.LOAD, T6, a[0], ZERO, a[1])
+                breg = T6
+            else:
+                return False
+            q_op, r_op = (U.DIV, U.REM) if signed else (U.DIVU, U.REMU)
+            self._emit(r_op, T5, 0, breg)      # remainder from original rax
+            self._emit(q_op, 0, 0, breg)       # rax = quotient
+            self._emit(U.ADD, 2, T5, ZERO)     # rdx = remainder
+            return True
+
+        if m in ("nop", "nopw", "nopl", "endbr64", "cqo", "cqto"):
+            # cqo writes rdx from rax bit 63 — outside the 32-bit
+            # projection: demote unless rdx happens to match (self-check);
+            # nops are free
             return m.startswith(("nop", "endbr"))
 
         return False

@@ -12,8 +12,15 @@ module does the same on the framework side:
    CheckerCPU analog) so the window starts from a warmed state,
 3. emit a ``Trace`` whose window begins post-warmup.
 
-The µop *stream* itself is synthesized to a configurable mix until a real
-macro-op lifter lands; the state it runs over is the ingested golden state.
+Two window sources over the ingested state:
+
+- ``window_from_snapshot_lifted`` — the REAL stream: the snapshot-seeded
+  x86 emulator (ingest/emu.py) runs forward from the checkpoint PC over
+  the checkpointed memory image, and the macro→µop lifter
+  (ingest/lift.py) lifts that stream — restore-then-rewarm with the
+  emulator standing in for the host CPU;
+- ``window_from_snapshot`` — a synthetic stream over the snapshot state,
+  for artifact-free runs (no binary available) and load benchmarks.
 """
 
 from __future__ import annotations
@@ -23,6 +30,44 @@ import numpy as np
 from shrewd_tpu.ingest.cpt import ArchSnapshot
 from shrewd_tpu.trace import synth
 from shrewd_tpu.trace.format import Trace
+
+
+def window_from_snapshot_lifted(snap: ArchSnapshot, binary: str,
+                                max_steps: int = 200_000,
+                                max_uops: int | None = None
+                                ) -> tuple[Trace, dict]:
+    """Checkpoint → emulate forward from ``snap.pc`` → lift the real stream.
+
+    Needs the checkpoint's region vaddrs (the config.json sidecar written
+    by ``write_arch_snapshot``; the reference equivalently needs config.ini
+    to place its stores).  Returns (trace, lift-meta); meta additionally
+    records the emulator's stop point."""
+    from shrewd_tpu.ingest.emu import emulate_window
+    from shrewd_tpu.ingest.lift import lift, static_decode
+
+    if not snap.regions:
+        raise ValueError(
+            "checkpoint lacks region vaddrs (config.json sidecar) — the "
+            "lifted restore path cannot address the memory image; "
+            "re-checkpoint via write_arch_snapshot or use the synthetic "
+            "window_from_snapshot")
+    if snap.int_regs.size < 16:
+        raise ValueError(f"{snap.int_regs.size} integer registers in "
+                         "checkpoint; need the 16 x86-64 GPRs")
+    regions = []
+    off = 0
+    for vaddr, size in snap.regions:
+        regions.append((int(vaddr), snap.mem[off:off + size].tobytes()))
+        off += size
+    insts = static_decode(binary)
+    res = emulate_window(binary, snap.int_regs, regions, snap.pc, max_steps,
+                         insts=insts)
+    trace, meta = lift("<emu>", binary, max_uops=max_uops, nt=res.nt,
+                       insts=insts)
+    meta["emu_steps"] = res.steps
+    meta["emu_stop_reason"] = res.stop_reason
+    meta["emu_stop_pc"] = res.stop_pc
+    return trace, meta
 
 
 def lift_registers(snap: ArchSnapshot, nphys: int) -> np.ndarray:

@@ -68,6 +68,21 @@ def alu(op: int, a: int, b: int, imm: int) -> int:
         return 1 if _s32(a) < _s32(b) else 0
     if op == U.SLTU:
         return 1 if a < b else 0
+    if op in (U.DIV, U.REM):
+        # x86 #DE cases (b==0, INT_MIN/-1) are TRAPS, resolved by the
+        # kernels' trap path; the ALU result for them is defined as 0 so
+        # every backend computes identically on the dead lane
+        if b == 0 or (a == 0x80000000 and b == M32):
+            return 0
+        sa, sb = _s32(a), _s32(b)
+        q = abs(sa) // abs(sb)
+        if (sa < 0) != (sb < 0):
+            q = -q
+        return (q if op == U.DIV else sa - q * sb) & M32
+    if op in (U.DIVU, U.REMU):
+        if b == 0:
+            return 0
+        return (a // b if op == U.DIVU else a % b) & M32
     if op in (U.LOAD, U.STORE):
         return (a + imm) & M32          # effective address
     if op == U.BEQ:

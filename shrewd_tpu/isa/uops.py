@@ -43,18 +43,26 @@ LUI = 13     # rd = imm
 MUL = 14     # rd = low32(rs1 * rs2)
 SLT = 15     # rd = (signed) rs1 < rs2
 SLTU = 16    # rd = (unsigned) rs1 < rs2
-LOAD = 17    # rd = mem[rs1 + imm]
-STORE = 18   # mem[rs1 + imm] = rs2
-BEQ = 19     # branch if rs1 == rs2
-BNE = 20
-BLT = 21     # signed
-BGE = 22     # signed
+# Division µops carry x86 #DE semantics: rs2 == 0 (and signed overflow
+# INT_MIN/-1) TRAPS the trial (DUE) — the host oracle sees SIGFPE there
+# (tools/hostsfi.cc), so faithful classification requires a real trap.
+DIV = 17     # rd = (signed) rs1 / rs2, trunc toward zero
+REM = 18     # rd = (signed) rs1 % rs2 (sign of dividend)
+DIVU = 19    # rd = (unsigned) rs1 / rs2
+REMU = 20    # rd = (unsigned) rs1 % rs2
+LOAD = 21    # rd = mem[rs1 + imm]
+STORE = 22   # mem[rs1 + imm] = rs2
+BEQ = 23     # branch if rs1 == rs2
+BNE = 24
+BLT = 25     # signed
+BGE = 26     # signed
 
-N_OPCODES = 23
+N_OPCODES = 27
 
 OPCODE_NAMES = [
     "nop", "add", "sub", "and", "or", "xor", "sll", "srl", "sra",
     "addi", "andi", "ori", "xori", "lui", "mul", "slt", "sltu",
+    "div", "rem", "divu", "remu",
     "load", "store", "beq", "bne", "blt", "bge",
 ]
 
@@ -76,6 +84,8 @@ _OPCLASS_TABLE = np.array([
     OC_INT_ALU, OC_INT_ALU, OC_INT_ALU, OC_INT_ALU, OC_INT_ALU,   # imm ops
     OC_INT_MULT,                                  # MUL
     OC_INT_ALU, OC_INT_ALU,                       # SLT/SLTU
+    OC_INT_MULT, OC_INT_MULT, OC_INT_MULT, OC_INT_MULT,  # DIV..REMU
+    # (the reference's IntMultDiv unit executes both, FuncUnitConfig.py)
     OC_MEM_READ, OC_MEM_WRITE,                    # LOAD/STORE
     OC_INT_ALU, OC_INT_ALU, OC_INT_ALU, OC_INT_ALU,  # branches
 ], dtype=np.int32)
@@ -90,7 +100,12 @@ def opclass_of(opcodes: np.ndarray) -> np.ndarray:
 
 def writes_dest(op: np.ndarray) -> np.ndarray:
     op = np.asarray(op)
-    return ((op >= ADD) & (op <= SLTU)) | (op == LOAD)
+    return ((op >= ADD) & (op <= REMU)) | (op == LOAD)
+
+
+def is_div(op):
+    op = np.asarray(op)
+    return (op >= DIV) & (op <= REMU)
 
 
 def is_load(op):
@@ -119,4 +134,4 @@ def uses_src1(op):
 def uses_src2(op):
     op = np.asarray(op)
     return (((op >= ADD) & (op <= SRA)) | (op == MUL) | (op == SLT)
-            | (op == SLTU) | (op == STORE) | is_branch(op))
+            | (op == SLTU) | is_div(op) | (op == STORE) | is_branch(op))

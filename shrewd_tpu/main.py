@@ -1,0 +1,163 @@
+"""The framework CLI — the ``m5.main`` analog.
+
+``python -m shrewd_tpu <subcommand>`` is the user-facing entry point the
+reference exposes as ``gem5.opt <config.py> --flags``
+(``/root/reference/src/python/m5/main.py:387``, options ``:227-248``).  A
+campaign is reproducible from its config dump alone:
+
+    python -m shrewd_tpu run plan.json --outdir out --debug-flags Campaign
+    python -m shrewd_tpu resume out/campaign_ckpt --outdir out2
+    python -m shrewd_tpu hostdiff --trials 1000 --workload workloads/sort.c
+    python -m shrewd_tpu bench --quick
+
+Run artifacts land in ``--outdir`` as ``config.json`` / ``stats.txt`` /
+``stats.json`` (``python/m5/main.py:227-248`` m5out analog).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def _log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def _apply_common(args) -> None:
+    from shrewd_tpu.utils import debug
+
+    if args.debug_flags:
+        debug.enable(*args.debug_flags.split(","))
+    if getattr(args, "platform", None):
+        import jax
+        jax.config.update("jax_platforms", args.platform)
+
+
+def _drive(orch, args) -> int:
+    """Drive the orchestrator's event loop to completion (the stdlib
+    Simulator.run analog: typed exit events → handlers,
+    ``python/gem5/simulate/simulator.py:530``)."""
+    from shrewd_tpu.sim.exit_event import ExitEvent
+
+    t0 = time.monotonic()
+    n_batches = 0
+    ckpt_every = orch.plan.checkpoint_every
+    for event, payload in orch.events():
+        if event == ExitEvent.BATCH_COMPLETE:
+            n_batches += 1
+            if ckpt_every and n_batches % ckpt_every == 0:
+                orch.checkpoint()
+        elif event in (ExitEvent.CI_CONVERGED, ExitEvent.MAX_TRIALS):
+            r = payload
+            hw = (r.avf_interval.hi - r.avf_interval.lo) / 2
+            _log(f"  {r.simpoint}/{r.structure}: trials={r.trials} "
+                 f"avf={r.avf:.4f} ±{hw:.4f}"
+                 + ("" if r.converged else " (trial cap, unconverged)"))
+        elif event == ExitEvent.SIMPOINT_COMPLETE:
+            _log(f"simpoint {payload}: done")
+        elif event == ExitEvent.CAMPAIGN_COMPLETE:
+            break
+    orch.write_outputs()
+    if orch.outdir:
+        orch.checkpoint()
+    _log(f"campaign complete: {n_batches} batches in "
+         f"{time.monotonic() - t0:.1f}s"
+         + (f" → {orch.outdir}" if orch.outdir else ""))
+    return 0
+
+
+def cmd_run(args) -> int:
+    from shrewd_tpu.campaign.orchestrator import Orchestrator
+    from shrewd_tpu.campaign.plan import CampaignPlan
+
+    with open(args.plan) as f:
+        plan = CampaignPlan.from_dict(json.load(f))
+    orch = Orchestrator(plan, outdir=args.outdir)
+    return _drive(orch, args)
+
+
+def cmd_resume(args) -> int:
+    from shrewd_tpu.campaign.orchestrator import Orchestrator
+
+    orch = Orchestrator.resume(args.ckpt_dir, outdir=args.outdir)
+    return _drive(orch, args)
+
+
+def cmd_hostdiff(args) -> int:
+    from shrewd_tpu.ingest import hostdiff as hd
+
+    rep = hd.run_diff(args.trials, args.seed, args.workload, mode=args.mode)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(rep, f, indent=1)
+    print(json.dumps({k: rep[k] for k in
+                      ("trials", "host_avf", "device_avf", "avf_abs_err",
+                       "agreement_exact", "agreement_vulnerable",
+                       "cis_overlap")}))
+    return 0
+
+
+def cmd_bench(args) -> int:
+    """Re-exec the repo-root bench supervisor (it must own the process: it
+    re-execs per platform with hard timeouts)."""
+    bench = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "bench.py")
+    if not os.path.exists(bench):
+        _log(f"bench.py not found at {bench}")
+        return 2
+    argv = [sys.executable, bench]
+    if args.quick:
+        argv.append("--quick")
+    os.execv(sys.executable, argv)
+    return 0   # unreachable
+
+
+def main(argv: list[str] | None = None) -> int:
+    common = argparse.ArgumentParser(add_help=False)
+    common.add_argument("--debug-flags", default=os.environ.get(
+        "SHREWD_DEBUG_FLAGS", ""), help="comma-separated debug flags "
+        "(the reference's --debug-flags, python/m5/main.py)")
+    ap = argparse.ArgumentParser(
+        prog="python -m shrewd_tpu",
+        description="TPU-native statistical fault-injection framework",
+        parents=[common])
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("run", help="run a campaign plan to completion",
+                       parents=[common])
+    p.add_argument("plan", help="CampaignPlan config.json")
+    p.add_argument("--outdir", default="m5out",
+                   help="artifact directory (config.json/stats.txt/json)")
+    p.add_argument("--platform", default=None,
+                   help="jax platform override (cpu/tpu/axon)")
+    p.set_defaults(fn=cmd_run)
+
+    p = sub.add_parser("resume", help="resume a checkpointed campaign",
+                       parents=[common])
+    p.add_argument("ckpt_dir", help="campaign_ckpt directory")
+    p.add_argument("--outdir", default="m5out")
+    p.add_argument("--platform", default=None)
+    p.set_defaults(fn=cmd_resume)
+
+    p = sub.add_parser("hostdiff", parents=[common],
+                       help="host-silicon differential AVF campaign")
+    p.add_argument("--trials", type=int, default=1000)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--workload", default="workloads/sort.c")
+    p.add_argument("--mode", default="output",
+                   choices=("output", "liveness", "abi"))
+    p.add_argument("--out", default="")
+    p.set_defaults(fn=cmd_hostdiff)
+
+    p = sub.add_parser("bench", parents=[common],
+                       help="headline benchmark (one JSON line)")
+    p.add_argument("--quick", action="store_true")
+    p.set_defaults(fn=cmd_bench)
+
+    args = ap.parse_args(argv)
+    _apply_common(args)
+    return args.fn(args)

@@ -77,15 +77,35 @@ def _signed_lt(a: jax.Array, b: jax.Array) -> jax.Array:
     return ai < bi
 
 
+def _div4(a: jax.Array, b: jax.Array):
+    """(div, rem, divu, remu) with x86 #DE lanes forced to 0 (the trap
+    path handles them; a defined dead-lane value keeps every backend
+    bit-identical).  Returns the four results plus the two trap predicates."""
+    ai = jax.lax.bitcast_convert_type(a, i32)
+    bi = jax.lax.bitcast_convert_type(b, i32)
+    bad_s = (bi == 0) | ((ai == i32(-(1 << 31))) & (bi == i32(-1)))
+    bs = jnp.where(bad_s, i32(1), bi)
+    q = jax.lax.div(ai, bs)                  # trunc toward zero
+    r = jax.lax.rem(ai, bs)
+    div = jax.lax.bitcast_convert_type(jnp.where(bad_s, i32(0), q), u32)
+    rem = jax.lax.bitcast_convert_type(jnp.where(bad_s, i32(0), r), u32)
+    bad_u = b == u32(0)
+    bu = jnp.where(bad_u, u32(1), b)
+    divu = jnp.where(bad_u, u32(0), jax.lax.div(a, bu))
+    remu = jnp.where(bad_u, u32(0), jax.lax.rem(a, bu))
+    return div, rem, divu, remu, bad_s, bad_u
+
+
 def _alu(op: jax.Array, a: jax.Array, b: jax.Array, imm: jax.Array) -> jax.Array:
     """Branchless µop evaluation: compute all candidates, select by opcode.
 
-    23 candidate lanes of VPU work per step — cheap relative to the gathers;
+    27 candidate lanes of VPU work per step — cheap relative to the gathers;
     keeps the scan body completely control-flow-free.
     """
     sh = (b & u32(31)).astype(u32)
     zero = jnp.zeros_like(a)
     one = jnp.ones_like(a)
+    div, rem, divu, remu, _, _ = _div4(a, b)
     cand = jnp.stack([
         zero,                       # NOP
         a + b, a - b, a & b, a | b, a ^ b,
@@ -94,6 +114,7 @@ def _alu(op: jax.Array, a: jax.Array, b: jax.Array, imm: jax.Array) -> jax.Array
         a * b,
         jnp.where(_signed_lt(a, b), one, zero),
         jnp.where(a < b, one, zero),
+        div, rem, divu, remu,
         a + imm, a + imm,           # LOAD / STORE effective address
         jnp.where(a == b, one, zero),
         jnp.where(a != b, one, zero),
@@ -160,7 +181,12 @@ def replay(tr: TraceArrays, init_reg: jax.Array, init_mem: jax.Array,
         addr = eff ^ jnp.where((fault.kind == KIND_LSQ_ADDR) & at_uop,
                                bitmask, u32(0))
         valid = ((addr & u32(3)) == 0) & ((addr >> u32(2)) < u32(mem_words))
-        trapped_now = (is_mem_op & ~valid & live) | illegal_now
+        # x86 #DE: div-by-zero / INT_MIN÷-1 ends the program (SIGFPE on the
+        # host oracle) — a corrupted divisor must classify DUE, not SDC
+        _, _, _, _, bad_s, bad_u = _div4(a, b)
+        div_trap = ((((op == U.DIV) | (op == U.REM)) & bad_s)
+                    | (((op == U.DIVU) | (op == U.REMU)) & bad_u)) & live
+        trapped_now = (is_mem_op & ~valid & live) | illegal_now | div_trap
         slot = (addr >> u32(2)).astype(i32) & i32(mem_words - 1)
         ldval = mem[slot]
         st_data = b ^ jnp.where((fault.kind == KIND_LSQ_DATA) & at_uop,
@@ -180,7 +206,7 @@ def replay(tr: TraceArrays, init_reg: jax.Array, init_mem: jax.Array,
         de = jnp.where((fault.kind == KIND_ROB_DST) & at_uop,
                        dstr ^ fault.bit_as_index_mask(), dstr) & idx_mask
         result = jnp.where(is_ld, ldval, eff)
-        writes = (((op >= U.ADD) & (op <= U.SLTU)) | is_ld) & live_next
+        writes = (((op >= U.ADD) & (op <= U.REMU)) | is_ld) & live_next
         reg = reg.at[de].set(jnp.where(writes, result, reg[de]))
         do_store = is_st & valid & live_next
         mem = mem.at[slot].set(jnp.where(do_store, st_data, mem[slot]))

@@ -36,7 +36,7 @@ from shrewd_tpu.models.o3 import (Fault, KIND_FU, KIND_IQ_SRC1, KIND_IQ_SRC2,
                                   KIND_LSQ_ADDR, KIND_LSQ_DATA, KIND_REGFILE,
                                   KIND_ROB_DST)
 from shrewd_tpu.ops import classify as C
-from shrewd_tpu.ops.replay import TraceArrays, _alu
+from shrewd_tpu.ops.replay import TraceArrays, _alu, _div4
 
 u32 = jnp.uint32
 i32 = jnp.int32
@@ -93,7 +93,7 @@ def record_golden(tr: TraceArrays, init_reg: jax.Array, init_mem: jax.Array,
         ldval = st_old                     # pre-store content == load value
         res = jnp.where(is_ld, ldval, eff)
         dst_old = reg[dstr]
-        writes = ((op >= U.ADD) & (op <= U.SLTU)) | is_ld
+        writes = ((op >= U.ADD) & (op <= U.REMU)) | is_ld
         ys = (a, b, eff, res, st_old, dst_old) \
             + ((reg,) if reg_timeline else ()) \
             + ((mem,) if mem_timeline else ())
@@ -208,7 +208,7 @@ def setup_scan(tr: TraceArrays, init_reg: jax.Array, init_mem: jax.Array,
         mem_words = mem.shape[0]
         slot = (eff >> u32(2)).astype(i32) & i32(mem_words - 1)
         res = jnp.where(is_ld, mem[slot], eff)
-        writes = ((op >= U.ADD) & (op <= U.SLTU)) | is_ld
+        writes = ((op >= U.ADD) & (op <= U.REMU)) | is_ld
         reg = reg.at[dstr].set(jnp.where(writes, res, reg[dstr]))
         mem = mem.at[slot].set(jnp.where(is_st, b, mem[slot]))
         return (reg, mem, gaf, alt1, alt2), None
@@ -300,7 +300,10 @@ def taint_replay(gold: GoldenRecord, tr: TraceArrays, fault: Fault,
         addr = eff ^ jnp.where((fault.kind == KIND_LSQ_ADDR) & at_uop,
                                bitmask, u32(0))
         valid = ((addr & u32(3)) == 0) & ((addr >> u32(2)) < u32(mem_words))
-        trapped_now = (is_mem_op & ~valid & live) | illegal_now
+        _, _, _, _, bad_s, bad_u = _div4(a, b)
+        div_trap = ((((op == U.DIV) | (op == U.REM)) & bad_s)
+                    | (((op == U.DIVU) | (op == U.REMU)) & bad_u)) & live
+        trapped_now = (is_mem_op & ~valid & live) | illegal_now | div_trap
         slot = (addr >> u32(2)).astype(i32) & i32(mem_words - 1)
         slot_g = (g_ea >> u32(2)).astype(i32) & i32(mem_words - 1)
         mtag = i32(nphys) + slot
@@ -347,7 +350,7 @@ def taint_replay(gold: GoldenRecord, tr: TraceArrays, fault: Fault,
 
         # 6. writeback (ROB dest-index fault redirects the write)
         rob_here = (fault.kind == KIND_ROB_DST) & at_uop
-        writes_t = (((op >= U.ADD) & (op <= U.SLTU)) | is_ld) & live_next
+        writes_t = (((op >= U.ADD) & (op <= U.REMU)) | is_ld) & live_next
         result = jnp.where(is_ld, ldval, eff)
         wtag = jnp.where(rob_here, (dstr ^ index_mask) & idx_mask, dstr)
         same_dst = wtag == dstr

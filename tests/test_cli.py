@@ -1,0 +1,58 @@
+"""CLI end-to-end: ``python -m shrewd_tpu run/resume`` (the m5.main analog,
+/root/reference/src/python/m5/main.py:387) — a campaign is reproducible
+from its plan JSON alone, artifacts land in --outdir, and resume restores
+the checkpointed state."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _plan_doc():
+    from shrewd_tpu.campaign.plan import CampaignPlan, WorkloadSpec
+    from shrewd_tpu.trace.synth import WorkloadConfig
+
+    return CampaignPlan(
+        simpoints=[WorkloadSpec(name="w0", workload=WorkloadConfig(
+            n=64, nphys=32, mem_words=64, working_set_words=32, seed=3))],
+        structures=["regfile"], batch_size=128, max_trials=512,
+        min_trials=256, target_halfwidth=0.5, checkpoint_every=1).to_dict()
+
+
+def _run_cli(args, cwd):
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    env.pop("PYTEST_CURRENT_TEST", None)
+    return subprocess.run([sys.executable, "-m", "shrewd_tpu"] + args,
+                          capture_output=True, text=True, env=env,
+                          cwd=str(cwd), timeout=420)
+
+
+def test_run_and_resume(tmp_path):
+    plan_path = tmp_path / "plan.json"
+    plan_path.write_text(json.dumps(_plan_doc()))
+    out = tmp_path / "out"
+    r = _run_cli(["run", str(plan_path), "--outdir", str(out),
+                  "--debug-flags", "Campaign"], tmp_path)
+    assert r.returncode == 0, r.stderr[-800:]
+    for art in ("config.json", "stats.txt", "stats.json",
+                "campaign_ckpt/campaign.json"):
+        assert (out / art).exists(), art
+    # the dumped config round-trips into an identical plan (the
+    # m5.instantiate reproducibility contract)
+    dumped = json.loads((out / "config.json").read_text())
+    assert dumped["structures"] == ["regfile"]
+    # resume of a finished campaign restores state and runs zero batches
+    out2 = tmp_path / "out2"
+    r2 = _run_cli(["resume", str(out / "campaign_ckpt"),
+                   "--outdir", str(out2)], tmp_path)
+    assert r2.returncode == 0, r2.stderr[-800:]
+    assert "0 batches" in r2.stderr
+    assert (out2 / "stats.txt").exists()
+
+
+def test_bad_subcommand_fails():
+    r = _run_cli(["frobnicate"], REPO)
+    assert r.returncode != 0

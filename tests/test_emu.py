@@ -1,0 +1,101 @@
+"""Snapshot-seeded emulation + lifted checkpoint restore (ingest/emu.py,
+warm.window_from_snapshot_lifted).
+
+The strongest check pins the emulator's step stream bit-for-bit against a
+REAL ptrace capture of the same window: two independent executions of the
+same program (host silicon vs emulator) must produce identical per-step
+register files.  The checkpoint round-trip then proves the full
+restore-then-rewarm path: capture → m5.cpt (+config.json sidecar) →
+restore → emulate forward → lift → golden replay, with the lifted golden
+matching the emulator's final state.  Reference:
+restore-then-rewarm (``/root/reference/src/cpu/o3/cpu.cc:706-799``),
+CheckerCPU lockstep oracle (``/root/reference/src/cpu/checker/cpu.hh``).
+"""
+
+import shutil
+
+import numpy as np
+import pytest
+
+from shrewd_tpu.ingest import hostdiff as hd
+from shrewd_tpu.ingest.cpt import (load_arch_snapshot, snapshot_from_capture,
+                                   write_arch_snapshot)
+from shrewd_tpu.ingest.emu import emulate_window
+from shrewd_tpu.ingest.lift import read_nativetrace
+from shrewd_tpu.ingest.warm import window_from_snapshot_lifted
+
+pytestmark = pytest.mark.skipif(
+    shutil.which("gcc") is None or shutil.which("objdump") is None,
+    reason="host toolchain required")
+
+
+@pytest.fixture(scope="module")
+def capture(tmp_path_factory):
+    """A real ptrace capture of sort.c's marker window."""
+    import subprocess
+
+    paths = hd.build_tools()
+    bd = tmp_path_factory.mktemp("emu")
+    trace_bin = bd / "sort_win.bin"
+    subprocess.run([str(paths.tracer), str(trace_bin), f"{paths.begin:x}",
+                    f"{paths.end:x}", "2000000", str(paths.workload)],
+                   check=True, capture_output=True, text=True)
+    return paths, read_nativetrace(trace_bin)
+
+
+def test_emulator_matches_host_capture(capture):
+    """Emulator seeded from the capture's initial state reproduces the
+    host CPU's per-step register stream exactly."""
+    paths, nt = capture
+    n = len(nt.steps) - 1
+    res = emulate_window(str(paths.workload), nt.steps[0][:16],
+                         [(v, d) for v, d in nt.regions],
+                         int(nt.steps[0][16]), max_steps=n)
+    assert res.steps == n, res.stop_reason
+    # regs + pc columns must match the silicon bit-for-bit, every step
+    assert np.array_equal(res.nt.steps[:, :17], nt.steps[:, :17])
+
+
+def test_checkpoint_roundtrip_lifted_window(capture, tmp_path):
+    """capture → m5.cpt → restore → emulate+lift → clean golden replay
+    whose final registers equal the emulator's."""
+    import jax
+
+    from shrewd_tpu.models.o3 import O3Config
+    from shrewd_tpu.ops.trial import TrialKernel
+
+    paths, nt = capture
+    cpt_dir = tmp_path / "cpt"
+    write_arch_snapshot(str(cpt_dir), snapshot_from_capture(nt))
+    snap = load_arch_snapshot(str(cpt_dir))
+    assert snap.regions, "config.json sidecar must carry region vaddrs"
+    assert snap.pc == int(nt.steps[0][16])
+    assert np.array_equal(snap.int_regs[:16], nt.steps[0][:16])
+
+    n = len(nt.steps) - 1
+    trace, meta = window_from_snapshot_lifted(
+        snap, str(paths.workload), max_steps=n)
+    assert meta["emu_steps"] == n
+    assert meta["stats"]["lift_rate"] >= 0.95
+
+    k = TrialKernel(trace, O3Config(enable_shrewd=False))
+    g = k.golden
+    assert not bool(g.diverged) and not bool(g.trapped)
+    # golden replay final regs == capture's final regs (32-bit projection)
+    exp = nt.steps[n][:16].astype(np.uint64) & np.uint64(0xFFFFFFFF)
+    got = np.asarray(g.reg)[:16]
+    assert np.array_equal(got, exp.astype(np.uint32))
+
+
+def test_checkpoint_spec_builds_lifted_trace(capture, tmp_path):
+    """CheckpointSpec(binary=...) end-to-end through the campaign config."""
+    from shrewd_tpu.campaign.plan import CheckpointSpec
+
+    paths, nt = capture
+    cpt_dir = tmp_path / "cpt2"
+    write_arch_snapshot(str(cpt_dir), snapshot_from_capture(nt))
+    spec = CheckpointSpec(cpt_dir=str(cpt_dir), binary=str(paths.workload),
+                          max_steps=500)
+    trace = spec.build_trace()
+    assert trace.opcode.shape[0] > 0
+    trace.validate()

@@ -14,7 +14,7 @@
 // Ground truth here is the x86 ISA + OS as implemented by the hardware —
 // not any model of this framework — so AVF numbers from the TPU replay
 // kernel can be differentially tested against physical reality
-// (tests/test_hostsfi.py, tools/diff_avf.py).
+// (driver: shrewd_tpu/ingest/hostdiff.py, CI gate: tests/test_hostsfi.py).
 //
 // Usage:
 //   hostsfi <coords.txt> <results.jsonl> <begin_hex> <end_hex> <prog>

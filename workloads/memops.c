@@ -1,0 +1,71 @@
+/* memcpy/memset-style loop workload (lifter-hardening tier).
+ *
+ * Word-granular copy, fill, and reverse loops — the streaming-store
+ * pattern of memcpy/strcpy rewritten over int32 (open-coded, no libc, so
+ * the window stays in lifted territory rather than rep-string microcode).
+ * Contract as sort.c: markers, one write(2) checksum.
+ */
+
+#include <unistd.h>
+
+#define N 256
+
+static unsigned int src[N];
+static unsigned int dst[N];
+static unsigned int scratch[N];
+static volatile int sink;
+
+static unsigned int rng_state = 0x0DDBA11u;
+static unsigned int xorshift(void) {
+    unsigned int x = rng_state;
+    x ^= x << 13;
+    x ^= x >> 17;
+    x ^= x << 5;
+    rng_state = x;
+    return x;
+}
+
+__attribute__((noinline)) void kernel_begin(void) { __asm__ volatile(""); }
+__attribute__((noinline)) void kernel_end(void)   { __asm__ volatile(""); }
+
+__attribute__((noinline)) static void mem_kernel(void) {
+    /* copy forward */
+    for (int i = 0; i < N; i++)
+        dst[i] = src[i];
+    /* fill a strided pattern */
+    for (int i = 0; i < N; i += 4)
+        scratch[i] = 0xA5A5A5A5u ^ (unsigned int)i;
+    /* reverse copy with rotate-by-word mixing */
+    for (int i = 0; i < N; i++) {
+        unsigned int v = dst[N - 1 - i];
+        scratch[i] = (scratch[i] + v) ^ (v >> 7);
+    }
+    /* overlapped shift-down (memmove-shaped) */
+    for (int i = 0; i + 8 < N; i++)
+        dst[i] = dst[i + 8] + scratch[i];
+}
+
+static void emit_checksum(void) {
+    unsigned int h = 2166136261u;
+    for (int i = 0; i < N; i++)
+        h = (h ^ dst[i]) * 16777619u;
+    char buf[16];
+    for (int i = 7; i >= 0; i--) {
+        unsigned int nib = h & 0xfu;
+        buf[i] = (char)(nib < 10 ? '0' + nib : 'a' + nib - 10);
+        h >>= 4;
+    }
+    buf[8] = '\n';
+    write(1, buf, 9);
+}
+
+int main(void) {
+    for (int i = 0; i < N; i++)
+        src[i] = xorshift();
+    kernel_begin();
+    mem_kernel();
+    kernel_end();
+    emit_checksum();
+    sink = (int)dst[0];
+    return 0;
+}

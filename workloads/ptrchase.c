@@ -1,0 +1,83 @@
+/* Pointer-chasing workload (lifter-hardening tier).
+ *
+ * A shuffled singly-linked ring walked with data-dependent loads (the
+ * classic latency microbenchmark shape), plus an index-indirection pass —
+ * address formation from loaded values is the pattern that stresses the
+ * lifter's EA handling and the replay's load-value taint routing.
+ * Contract as sort.c: markers, one write(2) checksum, int32 data.
+ */
+
+#include <unistd.h>
+
+#define N 128
+
+static int next_idx[N];          /* ring successor per slot */
+static unsigned int payload[N];
+static unsigned int order[N];
+static volatile int sink;
+
+static unsigned int rng_state = 0xC0FFEE11u;
+static unsigned int xorshift(void) {
+    unsigned int x = rng_state;
+    x ^= x << 13;
+    x ^= x >> 17;
+    x ^= x << 5;
+    rng_state = x;
+    return x;
+}
+
+__attribute__((noinline)) void kernel_begin(void) { __asm__ volatile(""); }
+__attribute__((noinline)) void kernel_end(void)   { __asm__ volatile(""); }
+
+__attribute__((noinline)) static void chase_kernel(void) {
+    /* walk the ring 3*N hops, mixing payloads along the way */
+    unsigned int h = 0x811c9dc5u;
+    int p = 0;
+    for (int hop = 0; hop < 3 * N; hop++) {
+        h = (h ^ payload[p]) * 16777619u;
+        payload[p] = h;
+        p = next_idx[p];
+    }
+    /* index indirection: order[] permutes reads of payload[] */
+    for (int i = 0; i < N; i++) {
+        unsigned int j = order[i] & (N - 1);
+        payload[i] ^= payload[j] >> 5;
+    }
+    sink = p;
+}
+
+static void emit_checksum(void) {
+    unsigned int h = 2166136261u;
+    for (int i = 0; i < N; i++)
+        h = (h ^ payload[i]) * 16777619u;
+    char buf[16];
+    for (int i = 7; i >= 0; i--) {
+        unsigned int nib = h & 0xfu;
+        buf[i] = (char)(nib < 10 ? '0' + nib : 'a' + nib - 10);
+        h >>= 4;
+    }
+    buf[8] = '\n';
+    write(1, buf, 9);
+}
+
+int main(void) {
+    /* Sattolo shuffle → one N-cycle, so the chase visits every slot */
+    for (int i = 0; i < N; i++)
+        next_idx[i] = i;
+    for (int i = N - 1; i > 0; i--) {
+        int j = (int)(xorshift() % (unsigned int)i);
+        int t = next_idx[i];
+        next_idx[i] = next_idx[j];
+        next_idx[j] = t;
+    }
+    for (int i = 0; i < N; i++) {
+        payload[i] = xorshift();
+        order[i] = xorshift();
+    }
+    kernel_begin();
+    chase_kernel();
+    kernel_end();
+    emit_checksum();
+    sink ^= (int)payload[0];
+    return 0;
+}
