@@ -21,11 +21,13 @@ enum Opcode : int32_t {
   OP_ADDI, OP_ANDI, OP_ORI, OP_XORI, OP_LUI, OP_MUL, OP_SLT, OP_SLTU,
   OP_DIV, OP_REM, OP_DIVU, OP_REMU,
   OP_LOAD, OP_STORE, OP_BEQ, OP_BNE, OP_BLT, OP_BGE,
+  OP_FADD, OP_FSUB, OP_FMUL, OP_FDIV,
   N_OPCODES
 };
 
 enum OpClass : int32_t {
   OC_INT_ALU = 0, OC_INT_MULT, OC_MEM_READ, OC_MEM_WRITE, OC_NONE,
+  OC_FP_ALU, OC_FP_MULT,
   N_OPCLASSES
 };
 
@@ -139,6 +141,24 @@ inline uint32_t shrewd_alu(int32_t op, uint32_t a, uint32_t b, uint32_t imm) {
     case OP_BNE:  return a != b;
     case OP_BLT:  return static_cast<int32_t>(a) < static_cast<int32_t>(b);
     case OP_BGE:  return static_cast<int32_t>(a) >= static_cast<int32_t>(b);
+    case OP_FADD: case OP_FSUB: case OP_FMUL: case OP_FDIV: {
+      // uops.py FP contract: IEEE RN, FTZ on inputs/outputs, canonical qNaN
+      auto flush = [](uint32_t v) -> uint32_t {
+        const uint32_t mag = v & 0x7FFFFFFFu;
+        return (mag > 0 && mag < 0x00800000u) ? (v & 0x80000000u) : v;
+      };
+      float af, bf;
+      uint32_t fa = flush(a), fb = flush(b);
+      __builtin_memcpy(&af, &fa, 4);
+      __builtin_memcpy(&bf, &fb, 4);
+      float r = op == OP_FADD ? af + bf
+              : op == OP_FSUB ? af - bf
+              : op == OP_FMUL ? af * bf : af / bf;
+      if (r != r) return 0x7FC00000u;        // canonical quiet NaN
+      uint32_t bits;
+      __builtin_memcpy(&bits, &r, 4);
+      return flush(bits);
+    }
     default:      return 0;
   }
 }
@@ -148,6 +168,8 @@ inline int32_t shrewd_opclass(int32_t op) {
     case OP_NOP:   return OC_NONE;
     case OP_MUL: case OP_DIV: case OP_REM: case OP_DIVU: case OP_REMU:
       return OC_INT_MULT;  // the reference's IntMultDiv unit
+    case OP_FADD: case OP_FSUB: return OC_FP_ALU;
+    case OP_FMUL: case OP_FDIV: return OC_FP_MULT;
     case OP_LOAD:  return OC_MEM_READ;
     case OP_STORE: return OC_MEM_WRITE;
     default:       return OC_INT_ALU;

@@ -38,7 +38,44 @@ from shrewd_tpu.utils import debug, prng
 
 debug.register_flag("Campaign", "orchestrator progress")
 
-CKPT_VERSION = 1
+CKPT_VERSION = 2
+
+# Campaign-checkpoint upgraders — the ``util/cpt_upgraders/`` analog
+# (reference keeps one script per version tag and applies them in sequence
+# until the checkpoint reaches the current version).  Each entry maps
+# version N → a function upgrading a version-N document IN PLACE to N+1.
+#
+# v1 → v2: v2 adds the per-structure escape-rate observability counters
+# ("escapes"/"taint_trials" — previously lost across resume); v1 documents
+# upgrade by defaulting them to zero (the counters are diagnostics, not
+# inputs to the stopping rule, so zero is the faithful unknown).
+
+
+def _upgrade_v1(doc: dict) -> None:
+    for per_structure in doc.get("state", {}).values():
+        for st_doc in per_structure.values():
+            st_doc.setdefault("escapes", 0)
+            st_doc.setdefault("taint_trials", 0)
+    doc["version"] = 2
+
+
+CKPT_UPGRADERS = {1: _upgrade_v1}
+
+
+def upgrade_checkpoint(doc: dict) -> dict:
+    """Apply upgraders in sequence until ``doc`` reaches CKPT_VERSION."""
+    v = doc.get("version")
+    while v != CKPT_VERSION:
+        up = CKPT_UPGRADERS.get(v)
+        if up is None:
+            raise ValueError(
+                f"campaign checkpoint version {v} has no upgrade path to "
+                f"{CKPT_VERSION} (register one in CKPT_UPGRADERS)")
+        debug.dprintf("Campaign", "upgrading checkpoint v%s -> v%s",
+                      v, v + 1 if isinstance(v, int) else "?")
+        up(doc)
+        v = doc.get("version")
+    return doc
 
 
 class BatchInfo(NamedTuple):
@@ -70,6 +107,10 @@ class _State:
         self.next_batch = 0
         self.converged = False
         self.done = False
+        # v2: taint-path observability survives resume (escape-rate stats
+        # were silently zeroed across checkpoints before)
+        self.escapes = 0
+        self.taint_trials = 0
 
     @property
     def trials(self) -> int:
@@ -78,7 +119,8 @@ class _State:
     def to_dict(self) -> dict:
         return {"tallies": self.tallies.tolist(),
                 "next_batch": self.next_batch,
-                "converged": self.converged, "done": self.done}
+                "converged": self.converged, "done": self.done,
+                "escapes": self.escapes, "taint_trials": self.taint_trials}
 
     @classmethod
     def from_dict(cls, d: dict) -> "_State":
@@ -87,6 +129,8 @@ class _State:
         st.next_batch = int(d["next_batch"])
         st.converged = bool(d["converged"])
         st.done = bool(d["done"])
+        st.escapes = int(d["escapes"])
+        st.taint_trials = int(d["taint_trials"])
         return st
 
 
@@ -204,9 +248,17 @@ class Orchestrator:
 
             keys = prng.trial_keys(prng.batch_key(sk, st.next_batch),
                                    plan.batch_size)
+            # per-structure DELTAS of the kernel's shared running escape
+            # counters (one kernel serves every structure of a simpoint,
+            # and resume restores prior counts — assignment would clobber)
+            esc0 = int(getattr(camp.kernel, "escapes", 0))
+            tt0 = int(getattr(camp.kernel, "taint_trials", 0))
             tally = np.asarray(camp.tally_batch(keys), dtype=np.int64)
             st.tallies += tally
             st.next_batch += 1
+            st.escapes += int(getattr(camp.kernel, "escapes", 0)) - esc0
+            st.taint_trials += (int(getattr(camp.kernel, "taint_trials", 0))
+                                - tt0)
             sg.trials += plan.batch_size
             sg.outcomes += tally
             avf_live = float(C.avf(st.tallies))
@@ -264,10 +316,7 @@ class Orchestrator:
                outdir: str | None = None) -> "Orchestrator":
         with open(os.path.join(ckpt_dir, "campaign.json")) as f:
             doc = json.load(f)
-        if doc.get("version") != CKPT_VERSION:
-            raise ValueError(
-                f"campaign checkpoint version {doc.get('version')} != "
-                f"{CKPT_VERSION} (write an upgrader — cpt_upgraders analog)")
+        upgrade_checkpoint(doc)
         plan = CampaignPlan.from_dict(doc["plan"])
         orch = cls(plan, mesh=mesh, outdir=outdir)
         for spn, per_structure in doc["state"].items():

@@ -39,9 +39,11 @@ from shrewd_tpu.ingest.lift import (Inst, NativeTrace, Operand, _CMOV,
 
 M8, M16, M32, M64 = 0xFF, 0xFFFF, 0xFFFFFFFF, 0xFFFFFFFFFFFFFFFF
 RAX, RCX, RDX, RBX, RSP, RBP, RSI, RDI = range(8)
+R11 = 11
 
 _ALU = {"add", "sub", "and", "or", "xor", "imul"}
-_SHIFT = {"shl": "shl", "sal": "shl", "shr": "shr", "sar": "sar"}
+_SHIFT = {"shl": "shl", "sal": "shl", "shr": "shr", "sar": "sar",
+          "rol": "rol", "ror": "ror"}
 
 _JCC = {"je": "e", "jz": "e", "jne": "ne", "jnz": "ne",
         "jb": "b", "jnae": "b", "jae": "ae", "jnb": "ae",
@@ -55,18 +57,68 @@ _LIFT_COND = {"eq": "e", "ne": "ne", "lt": "l", "ge": "ge",
               "swap_lt": "g", "swap_ge": "le", "sign": "s", "nsign": "ns",
               "ub": "b", "uae": "ae", "ua": "a", "ube": "be"}
 
+# setcc suffix → condition code (sete, setnz, setbe, …)
+_JCC_SET = {k[1:]: v for k, v in _JCC.items()}
+
 
 class StopEmu(Exception):
     """Window boundary: unsupported instruction / memory miss / syscall."""
 
 
 class Region:
-    def __init__(self, vaddr: int, data: bytes):
+    def __init__(self, vaddr: int, data: bytes, read_only: bool = False):
         self.vaddr = vaddr
         self.buf = bytearray(data)
+        self.read_only = read_only
 
     def contains(self, addr: int, size: int) -> bool:
         return self.vaddr <= addr and addr + size <= self.vaddr + len(self.buf)
+
+
+def elf_regions(binary: str) -> list:
+    """PT_LOAD segments of a static non-PIE ELF as (vaddr, bytes, ro)
+    triples — the read-only text/rodata backing a whole-program emulation
+    needs beyond the writable-memory snapshot (a store into one is a fault
+    on real hardware and classifies DUE here)."""
+    import struct as _struct
+
+    with open(binary, "rb") as f:
+        blob = f.read()
+    if blob[:4] != b"\x7fELF" or blob[4] != 2:
+        raise ValueError("need a 64-bit ELF")
+    e_phoff, = _struct.unpack_from("<Q", blob, 0x20)
+    e_phentsize, = _struct.unpack_from("<H", blob, 0x36)
+    e_phnum, = _struct.unpack_from("<H", blob, 0x38)
+    loads = []
+    relro = []                            # GNU_RELRO: rw in phdrs, ro live
+    for i in range(e_phnum):
+        off = e_phoff + i * e_phentsize
+        p_type, p_flags = _struct.unpack_from("<II", blob, off)
+        p_offset, p_vaddr, _p_paddr, p_filesz, p_memsz = \
+            _struct.unpack_from("<5Q", blob, off + 8)
+        if p_type == 0x6474E552:
+            relro.append((p_vaddr, p_vaddr + p_memsz))
+        if p_type != 1:                   # PT_LOAD
+            continue
+        data = blob[p_offset:p_offset + p_filesz]
+        if p_memsz > p_filesz:            # bss zero-fill
+            data = data + b"\x00" * (p_memsz - p_filesz)
+        loads.append((p_vaddr, data, not (p_flags & 0x2)))   # PF_W
+    out = []
+    for vaddr, data, ro in loads:
+        if ro:
+            out.append((vaddr, data, True))
+            continue
+        # split the writable segment at RELRO boundaries (mprotected
+        # read-only after startup — a store there is a fault on hardware)
+        cut = vaddr
+        for lo, hi in relro:
+            if lo <= vaddr and cut < hi <= vaddr + len(data):
+                out.append((cut, data[cut - vaddr:hi - vaddr], True))
+                cut = hi
+        if cut < vaddr + len(data):
+            out.append((cut, data[cut - vaddr:], False))
+    return out
 
 
 class EmuResult(NamedTuple):
@@ -76,15 +128,36 @@ class EmuResult(NamedTuple):
     stop_pc: int
 
 
+class ExitedEmu(Exception):
+    """Clean program exit (exit/exit_group syscall) in do_syscalls mode."""
+
+    def __init__(self, code: int):
+        super().__init__(f"exit({code})")
+        self.code = code
+
+
 class Emulator:
     def __init__(self, insts: dict[int, Inst], regs: np.ndarray,
-                 regions: list[tuple[int, bytes]], pc: int):
+                 regions: list[tuple[int, bytes]], pc: int,
+                 do_syscalls: bool = False, fs_base: int = 0):
+        """``do_syscalls=True`` executes write/exit syscalls instead of
+        ending the window: stdout bytes accumulate in ``self.stdout`` and
+        exit raises ExitedEmu — the mode used for whole-program perturbed
+        re-execution (64-bit fault classification, CheckerCPU role)."""
         self.insts = insts
         self.reg = [int(x) & M64 for x in regs[:16]]
-        self.regions = [Region(v, d) for v, d in regions]
+        # snapshot regions first (they win on overlap), then any read-only
+        # ELF fallbacks appended by the caller as (vaddr, data, True)
+        self.regions = [Region(*r) for r in regions]
         self.pc = int(pc)
         self.flags = ("res", 0, 64, 0)   # kind, operands..., width
         self.stop_reason = "max_steps"
+        self.do_syscalls = do_syscalls
+        self.stdout = bytearray()
+        self.fs_base = fs_base or self.FS_BASE
+        if do_syscalls and not fs_base:
+            self.regions.append(Region(self.FS_BASE - 0x1000,
+                                       bytes(0x2000)))
 
     # -- memory ------------------------------------------------------------
 
@@ -101,6 +174,8 @@ class Emulator:
 
     def store(self, addr: int, size: int, value: int) -> None:
         r = self._region(addr, size)
+        if r.read_only:
+            raise StopEmu(f"store to read-only {addr:#x}")   # host: SIGSEGV
         off = addr - r.vaddr
         r.buf[off:off + size] = (value & ((1 << (8 * size)) - 1)).to_bytes(
             size, "little")
@@ -141,9 +216,18 @@ class Emulator:
 
     # -- operands ----------------------------------------------------------
 
+    FS_BASE = 0x7000_0000_0000       # synthetic fallback (no capture)
+
     def ea(self, op: Operand) -> int:
         if op.base == -3:
             raise StopEmu("unparsed mem operand")
+        if op.base == -4:
+            # %fs:disp — TLS-relative.  With a captured fs_base the real
+            # TLS block is in the writable-memory snapshot (pointer guard
+            # included, so glibc's mangled function pointers demangle
+            # correctly); without one, a zeroed synthetic block gives
+            # single-threaded defaults.
+            return (self.fs_base + op.disp) & M64
         if op.rip_rel:
             return op.disp & M64
         a = op.disp
@@ -296,10 +380,16 @@ class Emulator:
                 r = a << sh
             elif stem == "shr":
                 r = a >> sh
-            else:
+            elif stem == "sar":
                 r = (sx(a, w) >> sh) & mask
+            elif stem == "rol":
+                sh %= w
+                r = (a << sh) | (a >> (w - sh)) if sh else a
+            else:                                 # ror
+                sh %= w
+                r = (a >> sh) | (a << (w - sh)) if sh else a
             self.write(inst, dst, w, r & mask)
-            if sh:
+            if sh and stem not in ("rol", "ror"):
                 self.set_flags_res(r & mask, w)
         elif m.rstrip("lqwb") in ("inc", "dec", "neg", "not"):
             stem = m.rstrip("lqwb")
@@ -407,12 +497,43 @@ class Emulator:
             else:
                 self.reg[RAX] = q & M64
                 self.reg[RDX] = r & M64
+        elif m.startswith("cmpxchg") and len(ops) == 2:
+            # if rax(w) == dst: dst := src, ZF=1  else rax := dst, ZF=0
+            # (cmpxchg8b/16b take one operand and fall through to StopEmu)
+            src, dst = ops
+            cur = self.read(inst, dst, w)
+            acc = self.reg[RAX] & mask
+            self.set_flags_sub(acc, cur, w)
+            if acc == cur:
+                self.write(inst, dst, w, self.read(inst, src, w))
+            else:
+                self.rset(Operand("reg", reg=RAX, width=w), cur)
+        elif m.startswith("set") and m[3:] in _JCC_SET:
+            v = 1 if self.cond(_JCC_SET[m[3:]]) else 0
+            self.write(inst, ops[0], 8, v)
         elif m in ("xchg", "xchgl", "xchgq"):
             a, b = ops
             va = self.read(inst, a, w)
             vb = self.read(inst, b, w)
             self.write(inst, a, w, vb)
             self.write(inst, b, w, va)
+        elif m == "syscall" and self.do_syscalls:
+            nr = self.reg[RAX]
+            if nr == 1 and self.reg[RDI] == 1:            # write(1, buf, n)
+                n = self.reg[RDX]
+                if n > (1 << 20):
+                    raise StopEmu("write size")
+                buf = bytes(self.load(self.reg[RSI] + i, 1)
+                            for i in range(n))
+                self.stdout += buf
+                self.reg[RAX] = n
+            elif nr in (60, 231):                          # exit/exit_group
+                raise ExitedEmu(self.reg[RDI] & 0xFF)
+            else:
+                raise StopEmu(f"syscall {nr}")
+            # kernel return clobbers: rcx = rip after syscall, r11 = rflags
+            self.reg[RCX] = next_pc & M64
+            self.reg[R11] = 0x202
         else:
             raise StopEmu(f"unsupported {m}")
         self.pc = next_pc & M64
@@ -451,6 +572,44 @@ class Emulator:
                          steps=steps, regions=regions)
         return EmuResult(nt=nt, steps=len(steps) - 1, stop_reason=stop,
                          stop_pc=int(steps[-1][16]))
+
+
+class ProgramResult(NamedTuple):
+    kind: str            # "exit" | "hang" | "stop:<reason>"
+    stdout: bytes
+    exit_code: int | None
+    steps: int
+
+
+def run_program(insts: dict[int, Inst], regs: np.ndarray,
+                regions: list[tuple[int, bytes]], pc: int,
+                max_steps: int = 2_000_000,
+                fault: "tuple | None" = None,
+                fs_base: int = 0) -> ProgramResult:
+    """Whole-program (perturbed) re-execution to exit — the 64-bit
+    CheckerCPU: classify a fault by the same program-outcome criteria the
+    host-silicon oracle uses (stdout + exit status, tools/hostsfi.cc),
+    with wrong paths executed for real rather than frozen.
+
+    ``fault`` = (step, reg, bit) flips GPR ``reg`` bit ``bit`` (bit ∈
+    [0,64) — the full 64-bit register, including the upper half the
+    32-bit replay projection cannot track) after ``step`` dynamic
+    instructions, exactly like the ptrace oracle's PTRACE_SETREGS flip."""
+    emu = Emulator(insts, regs, regions, pc, do_syscalls=True,
+                   fs_base=fs_base)
+    steps = 0
+    try:
+        for i in range(max_steps):
+            if fault is not None and i == fault[0]:
+                emu.reg[fault[1]] ^= (1 << fault[2])
+                emu.reg[fault[1]] &= M64
+            emu.step()
+            steps += 1
+        return ProgramResult("hang", bytes(emu.stdout), None, steps)
+    except ExitedEmu as e:
+        return ProgramResult("exit", bytes(emu.stdout), e.code, steps)
+    except StopEmu as e:
+        return ProgramResult(f"stop:{e}", bytes(emu.stdout), None, steps)
 
 
 def emulate_window(binary: str, regs: np.ndarray,

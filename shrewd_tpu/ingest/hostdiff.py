@@ -187,14 +187,16 @@ def capture_and_lift_to_output(paths: BuildPaths,
     return trace, meta
 
 
-def sample_coords(n_trials: int, window: int, seed: int = 0) -> np.ndarray:
-    """(step, reg, bit) samples — bits restricted to the low 32 (the replay
-    datapath's 32-bit projection tracks no higher bits)."""
+def sample_coords(n_trials: int, window: int, seed: int = 0,
+                  bit_range: int = 32) -> np.ndarray:
+    """(step, reg, bit) samples.  ``bit_range=32`` restricts to the low
+    half (the TPU replay's 32-bit projection); ``bit_range=64`` samples
+    the full register, for the emu64 whole-program re-execution path."""
     rng = np.random.default_rng(seed)
     return np.stack([
         rng.integers(0, window, n_trials),
         rng.integers(0, 16, n_trials),
-        rng.integers(0, 32, n_trials),
+        rng.integers(0, bit_range, n_trials),
     ], axis=1).astype(np.int64)
 
 
@@ -315,6 +317,58 @@ def run_device(trace, meta: dict, coords: np.ndarray,
     return np.asarray(outcomes(faults))
 
 
+def run_device_emu64(paths: BuildPaths, coords: np.ndarray,
+                     max_steps: int = 4_000_000) -> np.ndarray:
+    """The 64-bit classification path: perturbed whole-program re-execution
+    on the snapshot-seeded emulator (ingest/emu.py run_program), classified
+    by the host oracle's own criteria (stdout + exit status).  Covers the
+    upper register halves and real wrong-path execution — the two things
+    the 32-bit window replay cannot track."""
+    import subprocess
+
+    from shrewd_tpu.ingest.emu import elf_regions, run_program
+    from shrewd_tpu.ingest.lift import read_nativetrace, static_decode
+
+    bd = paths.workload.parent
+    trace_bin = bd / f"{paths.workload.name}_emu64.{os.getpid()}.bin"
+    try:
+        proc = subprocess.run(
+            [str(paths.tracer), str(trace_bin), f"{paths.begin:x}", "0",
+             "1", str(paths.workload)],     # 1 step: snapshot only
+            capture_output=True, text=True)
+        if proc.returncode not in (0, 1) or not trace_bin.exists():
+            raise RuntimeError(f"snapshot capture failed: {proc.stderr}")
+        nt = read_nativetrace(trace_bin)
+    finally:
+        trace_bin.unlink(missing_ok=True)
+    insts = static_decode(str(paths.workload))
+    regs0 = nt.steps[0][:16]
+    # snapshot regions first (writable, current values — they win on
+    # overlap), then ALL of the binary's segments as fallback: text/rodata
+    # plus the RELRO slice the writable-only snapshot cannot see
+    regions = [(v, d) for v, d in nt.regions]
+    regions += elf_regions(str(paths.workload))
+    pc0 = int(nt.steps[0][16])
+
+    golden = run_program(insts, regs0, regions, pc0, max_steps,
+                         fs_base=nt.fs_base)
+    if golden.kind != "exit" or golden.exit_code != 0:
+        raise RuntimeError(f"golden emu run failed: {golden.kind}")
+
+    out = np.zeros(len(coords), dtype=np.int32)
+    for i, (step, reg, bit) in enumerate(coords):
+        r = run_program(insts, regs0, regions, pc0, max_steps,
+                        fault=(int(step), int(reg), int(bit)),
+                        fs_base=nt.fs_base)
+        if r.kind != "exit" or r.exit_code != 0:
+            out[i] = HOST_OUTCOME["due"]
+        elif r.stdout != golden.stdout:
+            out[i] = HOST_OUTCOME["sdc"]
+        else:
+            out[i] = HOST_OUTCOME["masked"]
+    return out
+
+
 def wilson(successes: int, n: int, confidence: float = 0.95):
     from shrewd_tpu.parallel.stopping import wilson as _w
     return _w(successes, n, confidence)
@@ -362,13 +416,19 @@ def run_diff(n_trials: int = 500, seed: int = 0,
       - "liveness": [kernel_begin, kernel_end) window with measured
         post-window first-access liveness masks (ingest/liveness.py);
       - "abi": static callee-saved-register heuristic (the r2 baseline,
-        kept for comparison — known to over-report).
+        kept for comparison — known to over-report);
+      - "emu64": perturbed whole-program re-execution on the 64-bit
+        emulator, sampling the FULL bit range [0,64) — upper register
+        halves and wrong paths included.
     """
     from shrewd_tpu.ingest.lift import GPR_NAMES_64
 
     paths = build_tools(workload_c)
     lv = None
-    if mode == "output":
+    if mode == "emu64":
+        trace = meta = None
+        window = None      # window measured below from the host capture
+    elif mode == "output":
         trace, meta = capture_and_lift_to_output(paths)
         window = meta["window_macro_ops"]
     else:
@@ -377,9 +437,17 @@ def run_diff(n_trials: int = 500, seed: int = 0,
         if mode == "liveness":
             from shrewd_tpu.ingest.liveness import post_window_liveness
             lv = post_window_liveness(paths, meta["clusters"])
-    coords = sample_coords(n_trials, window, seed)
-    host = run_host(paths, coords)
-    dev = run_device(trace, meta, coords, liveness=lv)
+    if mode == "emu64":
+        # window length from a quick marker-to-marker capture
+        trace, meta = capture_and_lift(paths)
+        window = meta["macro_ops"]
+        coords = sample_coords(n_trials, window, seed, bit_range=64)
+        host = run_host(paths, coords)
+        dev = run_device_emu64(paths, coords)
+    else:
+        coords = sample_coords(n_trials, window, seed)
+        host = run_host(paths, coords)
+        dev = run_device(trace, meta, coords, liveness=lv)
     rep = compare(host, dev)
     rep["workload"] = workload_c
     rep["seed"] = seed
@@ -411,7 +479,7 @@ if __name__ == "__main__":
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--workload", default="workloads/sort.c")
     ap.add_argument("--mode", default="output",
-                    choices=("output", "liveness", "abi"))
+                    choices=("output", "liveness", "abi", "emu64"))
     ap.add_argument("--out", default=str(REPO / "DIFF_AVF.json"))
     a = ap.parse_args()
     rep = run_diff(a.trials, a.seed, a.workload, mode=a.mode)

@@ -104,14 +104,17 @@ class NativeTrace(NamedTuple):
     end: int
     steps: np.ndarray           # uint64[n_steps+1, 18] (last = state at end)
     regions: list               # [(vaddr, bytes)] memory snapshot at begin
+    fs_base: int = 0            # TLS base (SHTRACE2+); 0 if unrecorded
 
 
 def read_nativetrace(path) -> NativeTrace:
     with open(path, "rb") as f:
         magic = f.read(8)
-        if magic != b"SHTRACE1":
+        if magic not in (b"SHTRACE1", b"SHTRACE2"):
             raise ValueError(f"bad magic {magic!r}")
         begin, end, n_steps, n_regions = struct.unpack("<4Q", f.read(32))
+        fs_base = (struct.unpack("<Q", f.read(8))[0]
+                   if magic == b"SHTRACE2" else 0)
         regions = []
         for _ in range(n_regions):
             vaddr, size = struct.unpack("<2Q", f.read(16))
@@ -123,7 +126,7 @@ def read_nativetrace(path) -> NativeTrace:
         n_rec, 18)
     if n_rec not in (n_steps, n_steps + 1):
         raise ValueError(f"step records {n_rec} != n_steps {n_steps}(+1)")
-    return NativeTrace(begin, end, steps, regions)
+    return NativeTrace(begin, end, steps, regions, fs_base)
 
 
 # --- static decode via objdump --------------------------------------------
@@ -169,9 +172,24 @@ def _parse_operand(tok: str, comment_addr: int | None) -> Operand | None:
             return Operand("reg", reg=idx, width=width)
         if name == "rip":
             return None
+        if name.startswith(("fs:", "gs:")):
+            # TLS-relative absolute ("%fs:0x30"): base=-4 marks a
+            # segment-relative address — unmappable for the lifter (demote)
+            # but emulable against a synthetic TLS block (ingest/emu.py)
+            try:
+                return Operand("mem", base=-4, disp=int(name[3:], 0))
+            except ValueError:
+                return Operand("mem", base=-3)
         return Operand("reg", reg=-2)           # non-GPR (xmm, seg, ...)
     if tok.startswith("*"):
-        return Operand("mem", base=-3)          # indirect target, unhandled
+        # indirect target: "*%rax", "*(%rip)", "*0x0(%rbp,%rbx,8)" — parse
+        # the inner operand (the emulator executes these; the lifter's
+        # call/jmp handling never needs the target, control follows the
+        # captured stream)
+        inner = _parse_operand(tok[1:], comment_addr)
+        if inner is not None and inner.kind in ("mem", "reg"):
+            return inner
+        return Operand("mem", base=-3)
     m = _MEM_RE.match(tok)
     if m:
         disp = int(m.group(1), 0) if m.group(1) else 0
@@ -228,7 +246,21 @@ def static_decode(binary: str) -> dict[int, Inst]:
     txt = subprocess.run(["objdump", "-d", binary], capture_output=True,
                          text=True, check=True).stdout
     out: dict[int, Inst] = {}
+    last_pc: int | None = None
+    hexpair = re.compile(r"^[0-9a-f]{2}$")
     for line in txt.splitlines():
+        # objdump wraps long encodings onto bytes-only continuation lines;
+        # fold their byte count into the previous instruction's length (a
+        # short length corrupts every pc+len computation: fall-through
+        # targets, call return addresses).  A continuation line is exactly
+        # "pc:" + 2-hex-char byte tokens — a real mnemonic token ("fadd")
+        # is longer than a byte pair, so it cannot be mistaken for one.
+        toks = line.split()
+        if (last_pc is not None and len(toks) >= 2 and toks[0].endswith(":")
+                and all(hexpair.match(t) for t in toks[1:])):
+            prev = out[last_pc]
+            out[last_pc] = prev._replace(length=prev.length + len(toks) - 1)
+            continue
         m = _LINE_RE.match(line)
         if not m:
             continue
@@ -243,9 +275,18 @@ def static_decode(binary: str) -> dict[int, Inst]:
                 comment_addr = int(cm.group(1), 16)
         rest = rest.split("<")[0].strip()      # drop symbol annotations
         mnem = m.group(3)
+        # objdump tokenizes prefix bytes as the mnemonic ("lock decl …");
+        # fold ignorable-here prefixes into the real instruction (lock is
+        # meaningless to a single-context interpretation; its atomicity is
+        # what the reference's MemChecker polices, not dataflow)
+        while mnem in ("lock", "bnd", "notrack", "data16") and rest:
+            parts = rest.split(None, 1)
+            mnem = parts[0]
+            rest = parts[1] if len(parts) > 1 else ""
         ops = [o for o in (_parse_operand(t, comment_addr)
                            for t in _split_operands(rest)) if o is not None]
         out[pc] = Inst(pc, length, mnem, ops, comment_addr)
+        last_pc = pc
     return out
 
 
@@ -337,7 +378,7 @@ class Lifter:
 
     def _ea_of(self, op: Operand, regs: np.ndarray) -> int | None:
         """Full-64-bit effective address from captured registers."""
-        if op.base == -3:
+        if op.base in (-3, -4):
             return None
         ea = op.disp
         if op.rip_rel:
@@ -378,7 +419,7 @@ class Lifter:
             if inst.mnemonic in ("pop", "popq"):
                 touched.setdefault(pc, set()).add(int(steps[i][4]))
             for op in inst.operands:
-                if op.kind != "mem" or op.base == -3:
+                if op.kind != "mem" or op.base in (-3, -4):
                     continue
                 ea = self._ea_of(op, steps[i])
                 if ea is not None:
@@ -430,9 +471,12 @@ class Lifter:
             word_off += (hi - lo) // 4
         self.mem_words = 1 << int(np.ceil(np.log2(max(word_off, 64))))
         self.mem = np.zeros(self.mem_words, dtype=np.uint32)
-        # fill from the snapshot regions
+        # Fill from the snapshot regions.  Reverse order so that on
+        # overlap the EARLIEST region wins (its write lands last) — the
+        # same first-match precedence the emulator uses, where live
+        # snapshot regions precede read-only ELF fallback segments.
         for cl in self.clusters:
-            for vaddr, data in self.nt.regions:
+            for vaddr, data in reversed(self.nt.regions):
                 va32 = vaddr & M32
                 end32 = va32 + len(data)
                 lo = max(cl.lo, va32)

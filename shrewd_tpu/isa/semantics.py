@@ -25,6 +25,36 @@ def _s32(x: int) -> int:
     return x - (1 << 32) if x & 0x80000000 else x
 
 
+_QNAN = 0x7FC00000
+_FLT_MIN_EXP = 0x00800000       # smallest normal magnitude, as bits
+
+
+def _fp_flush(bits: int) -> int:
+    """Subnormal → signed zero (the FTZ half of the FP µop contract)."""
+    if 0 < (bits & 0x7FFFFFFF) < _FLT_MIN_EXP:
+        return bits & 0x80000000
+    return bits
+
+
+def _fp_op(op: int, a: int, b: int) -> int:
+    """f32 bits × f32 bits → canonical f32 bits (see uops.py FP contract:
+    IEEE RN, FTZ on inputs and outputs, canonical quiet NaN)."""
+    af = np.uint32(_fp_flush(a)).view(np.float32)
+    bf = np.uint32(_fp_flush(b)).view(np.float32)
+    with np.errstate(all="ignore"):
+        if op == U.FADD:
+            r = np.float32(af + bf)
+        elif op == U.FSUB:
+            r = np.float32(af - bf)
+        elif op == U.FMUL:
+            r = np.float32(af * bf)
+        else:
+            r = np.float32(np.divide(af, bf, dtype=np.float32))
+    if np.isnan(r):
+        return _QNAN
+    return _fp_flush(int(np.float32(r).view(np.uint32)))
+
+
 def alu(op: int, a: int, b: int, imm: int) -> int:
     """Compute the µop's primary result (uint32).
 
@@ -83,6 +113,8 @@ def alu(op: int, a: int, b: int, imm: int) -> int:
         if b == 0:
             return 0
         return (a // b if op == U.DIVU else a % b) & M32
+    if U.FADD <= op <= U.FDIV:
+        return _fp_op(op, a, b)
     if op in (U.LOAD, U.STORE):
         return (a + imm) & M32          # effective address
     if op == U.BEQ:

@@ -56,26 +56,40 @@ BEQ = 23     # branch if rs1 == rs2
 BNE = 24
 BLT = 25     # signed
 BGE = 26     # signed
+# FP µops: f32 values in the same u32 register file (bitcast).  Semantics
+# are IEEE round-to-nearest with two platform-independence canonicalizations
+# so every backend (XLA CPU, TPU, C++ golden, scalar python) computes the
+# same BITS: subnormal inputs/outputs flush to signed zero (the accelerator
+# FTZ behavior) and every NaN result is the canonical quiet NaN 0x7FC00000
+# (x86 would propagate payloads; payload propagation is not portable).
+FADD = 27
+FSUB = 28
+FMUL = 29
+FDIV = 30    # IEEE: x/0 = ±inf, 0/0 = NaN — no trap (unlike integer DIV)
 
-N_OPCODES = 27
+N_OPCODES = 31
 
 OPCODE_NAMES = [
     "nop", "add", "sub", "and", "or", "xor", "sll", "srl", "sra",
     "addi", "andi", "ori", "xori", "lui", "mul", "slt", "sltu",
     "div", "rem", "divu", "remu",
     "load", "store", "beq", "bne", "blt", "bge",
+    "fadd", "fsub", "fmul", "fdiv",
 ]
 
 # --- op classes (shadow-FU capability granularity) -------------------------
 
 OC_INT_ALU = 0    # add/sub/logic/shift/compare/branch-compare
-OC_INT_MULT = 1   # MUL
+OC_INT_MULT = 1   # MUL + the DIV family (the reference's IntMultDiv unit)
 OC_MEM_READ = 2   # LOAD (address-generation + access)
 OC_MEM_WRITE = 3  # STORE
 OC_NONE = 4       # NOP
+OC_FP_ALU = 5     # FADD/FSUB (reference FP_ALU, FuncUnitConfig.py)
+OC_FP_MULT = 6    # FMUL/FDIV (reference FP_MultDiv)
 
-N_OPCLASSES = 5
-OPCLASS_NAMES = ["IntAlu", "IntMult", "MemRead", "MemWrite", "No_OpClass"]
+N_OPCLASSES = 7
+OPCLASS_NAMES = ["IntAlu", "IntMult", "MemRead", "MemWrite", "No_OpClass",
+                 "FloatAdd", "FloatMultDiv"]
 
 _OPCLASS_TABLE = np.array([
     OC_NONE,                                      # NOP
@@ -88,6 +102,7 @@ _OPCLASS_TABLE = np.array([
     # (the reference's IntMultDiv unit executes both, FuncUnitConfig.py)
     OC_MEM_READ, OC_MEM_WRITE,                    # LOAD/STORE
     OC_INT_ALU, OC_INT_ALU, OC_INT_ALU, OC_INT_ALU,  # branches
+    OC_FP_ALU, OC_FP_ALU, OC_FP_MULT, OC_FP_MULT,    # FADD..FDIV
 ], dtype=np.int32)
 
 
@@ -100,12 +115,17 @@ def opclass_of(opcodes: np.ndarray) -> np.ndarray:
 
 def writes_dest(op: np.ndarray) -> np.ndarray:
     op = np.asarray(op)
-    return ((op >= ADD) & (op <= REMU)) | (op == LOAD)
+    return ((op >= ADD) & (op <= REMU)) | (op == LOAD) | is_fp(op)
 
 
 def is_div(op):
     op = np.asarray(op)
     return (op >= DIV) & (op <= REMU)
+
+
+def is_fp(op):
+    op = np.asarray(op)
+    return (op >= FADD) & (op <= FDIV)
 
 
 def is_load(op):
@@ -134,4 +154,5 @@ def uses_src1(op):
 def uses_src2(op):
     op = np.asarray(op)
     return (((op >= ADD) & (op <= SRA)) | (op == MUL) | (op == SLT)
-            | (op == SLTU) | is_div(op) | (op == STORE) | is_branch(op))
+            | (op == SLTU) | is_div(op) | is_fp(op) | (op == STORE)
+            | is_branch(op))

@@ -70,6 +70,26 @@ class IntMultDiv(FUDesc):
     capabilities = VectorParam(int, [U.OC_INT_MULT], "OpClass codes executed")
 
 
+class FP_ALU(FUDesc):
+    """Reference ``FP_ALU`` (count 4, FloatAdd/Cmp/Cvt ops,
+    ``src/cpu/FuncUnitConfig.py``) — the unit class the SHREWD shadow
+    story chiefly targets (``fu_pool.cc:177-294``); can approximately
+    check FP multiplies when claimed as a shadow."""
+    count = Param(int, 4, "number of units of this type")
+    op_lat = Param(int, 2, "operation latency in cycles")
+    capabilities = VectorParam(int, [U.OC_FP_ALU], "OpClass codes executed")
+    approx_capabilities = VectorParam(
+        int, [U.OC_FP_MULT], "OpClass codes checkable approximately")
+
+
+class FP_MultDiv(FUDesc):
+    """Reference ``FP_MultDiv`` (count 2, FloatMult/Div/Sqrt)."""
+    count = Param(int, 2, "number of units of this type")
+    op_lat = Param(int, 4, "operation latency in cycles")
+    capabilities = VectorParam(int, [U.OC_FP_MULT],
+                               "OpClass codes executed")
+
+
 class RdWrPort(FUDesc):
     """Reference ``RdWrPort`` (count 4): the load/store AGU+port units.
     Memory µops are not shadow-eligible (SHREWD re-executes ALU/FP work;
@@ -85,9 +105,11 @@ class FUPoolConfig(ConfigObject):
 
     int_alu = Child(IntALU)
     int_mult = Child(IntMultDiv)
+    fp_alu = Child(FP_ALU)
+    fp_mult = Child(FP_MultDiv)
     mem_port = Child(RdWrPort)
     shadow_eligible = VectorParam(
-        int, [U.OC_INT_ALU, U.OC_INT_MULT],
+        int, [U.OC_INT_ALU, U.OC_INT_MULT, U.OC_FP_ALU, U.OC_FP_MULT],
         "OpClasses that request shadow re-execution when issued")
     approx_coverage = Param(
         float, 1.0, "detection probability when the shadow runs on an "
@@ -96,7 +118,8 @@ class FUPoolConfig(ConfigObject):
     def descs(self) -> list[FUDesc]:
         """Pool scan order — declaration order, like the reference's
         ``fuPerCapList`` walk in ``FUPool::getUnit``."""
-        return [self.int_alu, self.int_mult, self.mem_port]
+        return [self.int_alu, self.int_mult, self.fp_alu, self.fp_mult,
+                self.mem_port]
 
 
 class FUPoolModel:

@@ -1,0 +1,425 @@
+"""Two-core MESI coherence with protocol-STATE fault injection.
+
+The reference's cache-tier SFI target is protocol state proper: the per-line
+MESI state field of the SLICC-generated L1 controllers
+(``/root/reference/src/mem/ruby/protocol/MESI_Two_Level-L1cache.sm``) held
+in ``CacheMemory`` entry arrays (``mem/ruby/structures/CacheMemory.hh:70``)
+over ``DataBlock`` lines (``mem/ruby/common/DataBlock.hh:61``).  A flipped
+state bit does not just lose a line — it mis-steers the protocol (a dirty M
+silently demoted to S skips its writeback; an I flipped valid serves stale
+hits; a flipped tag aliases another address), and the outcome depends on
+the subsequent coherence traffic.
+
+TPU-first design (the ops/replay.py stance applied to coherence): the MESI
+state machine itself is the dense kernel — one ``lax.scan`` over the
+interleaved two-core access stream carrying (state, tag, data, LRU) arrays
+for both L1s plus the shared L2 image, with the fault landing as a bit
+flip in the state/tag array at its cycle.  Faulty and golden runs execute
+the SAME machine, so outcomes are protocol-accurate by construction;
+divergent protocol walks are just different data flow (no control-flow
+divergence problem — the machine is total over corrupted states).
+``scalar_mesi`` is the independent host oracle (CheckerCPU pattern) the
+kernel is differentially tested against (tests/test_mesi.py).
+
+Classification is program-visible, matching the framework's output-boundary
+stance: SDC ⇔ any LOADED value differs from golden, or the final flushed
+memory image differs.  Parity/ECC on the state/tag arrays (CacheConfig-
+style protection) maps to DETECTED/MASKED exactly as in models/ruby.py.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from shrewd_tpu.models.ruby import PROT_ECC, PROT_NONE, PROT_PARITY
+from shrewd_tpu.ops import classify as C
+from shrewd_tpu.utils.config import ConfigObject, Param
+
+u32 = jnp.uint32
+i32 = jnp.int32
+
+# MESI encoding: the 2-bit state field under fault.  Bit 0 distinguishes
+# within {clean, dirty} pairs; the encoding is part of the fault model the
+# same way the .sm enum ordering is part of the reference's.
+ST_I, ST_S, ST_E, ST_M = 0, 1, 2, 3
+
+# fault targets
+TGT_STATE = 0
+TGT_TAG = 1
+
+
+class MesiConfig(ConfigObject):
+    """Two-core private-L1 / shared-L2 geometry + protection."""
+
+    n_cores = Param(int, 2, "cores (private L1 each)")
+    n_sets = Param(int, 4, "L1 sets (power of two)")
+    n_ways = Param(int, 2, "L1 associativity")
+    words_per_line = Param(int, 2, "32-bit words per line (power of two)")
+    tag_bits = Param(int, 16, "tag field width (fault-targetable)")
+    state_protection = Param(str, PROT_NONE,
+                             "none | parity | ecc on the state/tag arrays")
+
+    def validate(self) -> None:
+        for f in ("n_sets", "words_per_line"):
+            v = getattr(self, f)
+            if v & (v - 1):
+                raise ValueError(f"{f}={v} must be a power of two")
+        if self.n_cores != 2:
+            raise ValueError("the protocol walk is specialized to 2 cores")
+        if self.state_protection not in (PROT_NONE, PROT_PARITY, PROT_ECC):
+            raise ValueError(
+                f"unknown state_protection {self.state_protection!r}")
+
+
+class MesiFault(NamedTuple):
+    """One trial's coordinates (vmapped leaves)."""
+
+    target: jax.Array    # TGT_STATE | TGT_TAG
+    core: jax.Array
+    mset: jax.Array
+    way: jax.Array
+    bit: jax.Array       # state: [0,2); tag: [0,tag_bits)
+    cycle: jax.Array     # access index at which the flip lands
+
+
+class AccessTrace(NamedTuple):
+    """Interleaved two-core access stream (device arrays)."""
+
+    core: jax.Array      # i32[A]
+    word: jax.Array      # i32[A] global word address
+    is_store: jax.Array  # bool[A]
+    value: jax.Array     # u32[A] store data (ignored for loads)
+
+
+def torture_stream(cfg: MesiConfig, n_accesses: int, mem_words: int,
+                   seed: int = 0, sharing: float = 0.5) -> AccessTrace:
+    """RubyTester-style random coherence torture: two cores hammering a
+    small shared footprint (``sharing`` controls contention)."""
+    rng = np.random.default_rng(seed)
+    core = rng.integers(0, cfg.n_cores, n_accesses)
+    shared = rng.random(n_accesses) < sharing
+    span = max(cfg.n_sets * cfg.words_per_line, 4)
+    word = np.where(shared, rng.integers(0, span, n_accesses),
+                    rng.integers(0, mem_words, n_accesses))
+    return AccessTrace(
+        core=jnp.asarray(core, i32),
+        word=jnp.asarray(word, i32),
+        is_store=jnp.asarray(rng.random(n_accesses) < 0.4),
+        value=jnp.asarray(
+            rng.integers(0, 1 << 32, n_accesses, dtype=np.uint64)
+            .astype(np.uint32)))
+
+
+# --------------------------------------------------------------------------
+# scalar oracle — an independent MESI implementation (CheckerCPU pattern)
+# --------------------------------------------------------------------------
+
+def scalar_mesi(trace: AccessTrace, cfg: MesiConfig, init_mem: np.ndarray,
+                fault: "tuple | None" = None):
+    """Python reference walk.  ``fault`` = (target, core, mset, way, bit,
+    cycle) or None.  Returns (loads, final_mem) — every loaded value plus
+    the final flushed memory image (the program-visible surface)."""
+    wpl = cfg.words_per_line
+    n_lines = len(init_mem) // wpl
+    state = np.zeros((2, cfg.n_sets, cfg.n_ways), dtype=np.int64)
+    tag = np.zeros((2, cfg.n_sets, cfg.n_ways), dtype=np.int64)
+    data = np.zeros((2, cfg.n_sets, cfg.n_ways, wpl), dtype=np.uint32)
+    age = np.zeros((2, cfg.n_sets, cfg.n_ways), dtype=np.int64)
+    mem = init_mem.copy()
+    loads = []
+    core_np = np.asarray(trace.core)
+    word_np = np.asarray(trace.word)
+    st_np = np.asarray(trace.is_store)
+    val_np = np.asarray(trace.value)
+
+    def wb(c, s, w):
+        """Write line back to L2 iff it claims dirty."""
+        if state[c, s, w] == ST_M:
+            base = (tag[c, s, w] * cfg.n_sets + s) * wpl
+            if 0 <= base < len(mem) - wpl + 1:
+                mem[base:base + wpl] = data[c, s, w]
+
+    def find(c, s, t):
+        for w in range(cfg.n_ways):
+            if state[c, s, w] != ST_I and tag[c, s, w] == t:
+                return w
+        return -1
+
+    for i in range(len(core_np)):
+        if fault is not None and fault[5] == i:
+            tgt, fc, fs, fw, fb, _ = fault
+            if tgt == TGT_STATE:
+                state[fc, fs, fw] ^= (1 << fb)
+            else:
+                tag[fc, fs, fw] ^= (1 << fb)
+        c = int(core_np[i])
+        o = 1 - c
+        wd = int(word_np[i])
+        line = wd // wpl
+        s = line % cfg.n_sets
+        t = line // cfg.n_sets
+        off = wd % wpl
+        w = find(c, s, t)
+        ow = find(o, s, t)
+        if not st_np[i]:                      # -------- load --------
+            if w < 0:
+                # other core holds it dirty → writeback + downgrade
+                if ow >= 0 and state[o, s, ow] == ST_M:
+                    wb(o, s, ow)
+                    state[o, s, ow] = ST_S
+                # victim (LRU way)
+                w = int(np.argmin(age[c, s]))
+                wb(c, s, w)
+                base = line * wpl
+                data[c, s, w] = (mem[base:base + wpl]
+                                 if base + wpl <= len(mem) else 0)
+                tag[c, s, w] = t
+                state[c, s, w] = ST_S if ow >= 0 else ST_E
+                if ow >= 0 and state[o, s, ow] == ST_E:
+                    state[o, s, ow] = ST_S
+            loads.append(int(data[c, s, w][off]))
+        else:                                 # -------- store -------
+            if w >= 0 and state[c, s, w] != ST_S:
+                state[c, s, w] = ST_M
+            else:
+                if ow >= 0:
+                    wb(o, s, ow)              # M writes back on invalidate
+                    state[o, s, ow] = ST_I
+                if w < 0:
+                    w = int(np.argmin(age[c, s]))
+                    wb(c, s, w)
+                    base = line * wpl
+                    data[c, s, w] = (mem[base:base + wpl]
+                                     if base + wpl <= len(mem) else 0)
+                    tag[c, s, w] = t
+                state[c, s, w] = ST_M
+            data[c, s, w][off] = np.uint32(val_np[i])
+        age[c, s] -= 1
+        age[c, s, w] = 0
+
+    # final flush: every line claiming M writes back (program-visible end
+    # state; a falsely-clean dirty line is lost here — the M→S/E SDC)
+    for c in range(2):
+        for s in range(cfg.n_sets):
+            for w in range(cfg.n_ways):
+                wb(c, s, w)
+    _ = n_lines
+    return np.asarray(loads, dtype=np.uint32), mem
+
+
+# --------------------------------------------------------------------------
+# device kernel — the same machine as a lax.scan (batched via vmap)
+# --------------------------------------------------------------------------
+
+def mesi_replay(trace: AccessTrace, cfg: MesiConfig, init_mem: jax.Array,
+                fault: MesiFault):
+    """One trial's protocol walk → (loads u32[A], final mem u32[n]).
+
+    jit/vmap-safe; a ``fault`` with cycle < 0 is the golden run."""
+    wpl = cfg.words_per_line
+    n_sets, n_ways = cfg.n_sets, cfg.n_ways
+    mem_words = init_mem.shape[0]
+
+    def step(carry, xs):
+        state, tagv, data, age, mem = carry
+        i, c, wd, is_st, val = xs
+        o = 1 - c
+
+        # fault landing: flip a bit of the state or tag array entry
+        land = i == fault.cycle
+        st_flip = jnp.zeros((2, n_sets, n_ways), i32)
+        st_flip = st_flip.at[fault.core, fault.mset, fault.way].set(
+            jnp.where(land & (fault.target == TGT_STATE),
+                      i32(1) << fault.bit, 0))
+        state = state ^ st_flip
+        tg_flip = jnp.zeros((2, n_sets, n_ways), i32)
+        tg_flip = tg_flip.at[fault.core, fault.mset, fault.way].set(
+            jnp.where(land & (fault.target == TGT_TAG),
+                      i32(1) << fault.bit, 0))
+        tagv = tagv ^ tg_flip
+
+        line = wd // wpl
+        s = line % n_sets
+        t = line // n_sets
+        off = wd % wpl
+
+        def find(core_idx):
+            hits = (state[core_idx, s] != ST_I) & (tagv[core_idx, s] == t)
+            return jnp.where(hits.any(),
+                             jnp.argmax(hits).astype(i32), i32(-1))
+
+        w = find(c)
+        ow = find(o)
+        have = w >= 0
+        ohave = ow >= 0
+
+        def wb_line(mem, core_idx, way):
+            """Write (core, s, way) back iff it claims M."""
+            dirty = state[core_idx, s, way] == ST_M
+            base = (tagv[core_idx, s, way] * n_sets + s) * wpl
+            okrange = (base >= 0) & (base + wpl <= mem_words)
+            idx = jnp.clip(base + jnp.arange(wpl), 0, mem_words - 1)
+            new = jnp.where(dirty & okrange, data[core_idx, s, way],
+                            mem[idx])
+            return mem.at[idx].set(new)
+
+        victim = jnp.argmin(age[c, s]).astype(i32)
+        w_eff = jnp.where(have, w, victim)
+
+        # ---- load path ----
+        other_m = ohave & (state[o, s, jnp.maximum(ow, 0)] == ST_M)
+        mem_l = jnp.where(other_m & ~have & ~is_st,
+                          wb_line(mem, o, jnp.maximum(ow, 0)), mem)
+        # miss: victim writeback then fill from L2
+        mem_l = jnp.where(~have & ~is_st, wb_line(mem_l, c, victim), mem_l)
+        base = line * wpl
+        fill_ok = base + wpl <= mem_words
+        fill = jnp.where(fill_ok,
+                         mem_l[jnp.clip(base + jnp.arange(wpl), 0,
+                                        mem_words - 1)],
+                         jnp.zeros(wpl, u32))
+        data_l = data.at[c, s, w_eff].set(
+            jnp.where(~have, fill, data[c, s, w_eff]))
+        tag_l = tagv.at[c, s, w_eff].set(
+            jnp.where(~have, t, tagv[c, s, w_eff]))
+        st_l = state.at[c, s, w_eff].set(
+            jnp.where(have, state[c, s, w_eff],
+                      jnp.where(ohave, ST_S, ST_E)))
+        # my load miss downgrades the other core's copy (M and E → S; an
+        # S copy just stays S)
+        st_l = st_l.at[o, s, jnp.maximum(ow, 0)].set(
+            jnp.where(ohave & ~have, ST_S,
+                      st_l[o, s, jnp.maximum(ow, 0)]))
+        ld_val = data_l[c, s, w_eff, off]
+
+        # ---- store path ----
+        silent = have & (state[c, s, jnp.maximum(w, 0)] != ST_S)
+        # upgrade/fetch-exclusive: other core writes back if M, then I
+        mem_s = jnp.where(is_st & ~silent & ohave,
+                          wb_line(mem, o, jnp.maximum(ow, 0)), mem)
+        mem_s = jnp.where(is_st & ~silent & ~have,
+                          wb_line(mem_s, c, victim), mem_s)
+        fill_s = jnp.where(fill_ok,
+                           mem_s[jnp.clip(base + jnp.arange(wpl), 0,
+                                          mem_words - 1)],
+                           jnp.zeros(wpl, u32))
+        data_s = data.at[c, s, w_eff].set(
+            jnp.where(have, data[c, s, w_eff], fill_s))
+        data_s = data_s.at[c, s, w_eff, off].set(val)
+        tag_s = tagv.at[c, s, w_eff].set(
+            jnp.where(have, tagv[c, s, w_eff], t))
+        st_s = state.at[c, s, w_eff].set(ST_M)
+        st_s = st_s.at[o, s, jnp.maximum(ow, 0)].set(
+            jnp.where(ohave & ~silent, ST_I,
+                      st_s[o, s, jnp.maximum(ow, 0)]))
+
+        state = jnp.where(is_st, st_s, st_l)
+        tagv = jnp.where(is_st, tag_s, tag_l)
+        data = jnp.where(is_st, data_s, data_l)
+        mem = jnp.where(is_st, mem_s, mem_l)
+        ld_out = jnp.where(is_st, u32(0), ld_val)
+
+        age = age.at[c, s].add(-1)
+        age = age.at[c, s, w_eff].set(0)
+        return (state, tagv, data, age, mem), ld_out
+
+    A = trace.core.shape[0]
+    # derive the init carry from the fault so its "varying" type under
+    # shard_map matches the step outputs (ops/replay.py does the same)
+    vz = fault.cycle * 0
+    vzu = vz.astype(u32)
+    init = (jnp.zeros((2, n_sets, n_ways), i32) + vz,
+            jnp.zeros((2, n_sets, n_ways), i32) + vz,
+            jnp.zeros((2, n_sets, n_ways, wpl), u32) + vzu,
+            jnp.zeros((2, n_sets, n_ways), i32) + vz,
+            init_mem.astype(u32) + vzu)
+    xs = (jnp.arange(A, dtype=i32), trace.core, trace.word,
+          trace.is_store, trace.value)
+    (state, tagv, data, age, mem), loads = jax.lax.scan(step, init, xs)
+
+    # final flush of every line claiming M
+    def flush(mem, cw):
+        c, s, w = cw
+        dirty = state[c, s, w] == ST_M
+        base = (tagv[c, s, w] * n_sets + s) * wpl
+        okrange = (base >= 0) & (base + wpl <= mem_words)
+        idx = jnp.clip(base + jnp.arange(wpl), 0, mem_words - 1)
+        return mem.at[idx].set(
+            jnp.where(dirty & okrange, data[c, s, w], mem[idx])), None
+
+    coords = [(c, s, w) for c in range(2) for s in range(n_sets)
+              for w in range(n_ways)]
+    for cw in coords:
+        mem, _ = flush(mem, cw)
+    return loads, mem
+
+
+class MesiKernel:
+    """Campaign-facing kernel: the same protocol as TrialKernel exposes for
+    O3 structures (``outcomes_from_keys``/``run_keys``), so the sharded
+    campaign layer and orchestrator drive MESI state faults unchanged.
+    Structures: ``"state"``, ``"tag"``."""
+
+    def __init__(self, trace: AccessTrace, cfg: MesiConfig,
+                 init_mem: np.ndarray):
+        cfg.validate()
+        self.cfg = cfg
+        self.trace = trace
+        self.init_mem = jnp.asarray(init_mem, u32)
+        gold_fault = MesiFault(*(i32(0),) * 5, i32(-1))
+        self.golden_loads, self.golden_mem = jax.jit(
+            lambda: mesi_replay(trace, cfg, self.init_mem, gold_fault))()
+
+    def sample_batch(self, keys: jax.Array, structure: str) -> MesiFault:
+        cfg = self.cfg
+        n_bits = 2 if structure == "state" else cfg.tag_bits
+        tgt = TGT_STATE if structure == "state" else TGT_TAG
+        A = self.trace.core.shape[0]
+
+        def one(key):
+            ks = jax.random.split(key, 5)
+            return MesiFault(
+                target=i32(tgt),
+                core=jax.random.randint(ks[0], (), 0, cfg.n_cores, i32),
+                mset=jax.random.randint(ks[1], (), 0, cfg.n_sets, i32),
+                way=jax.random.randint(ks[2], (), 0, cfg.n_ways, i32),
+                bit=jax.random.randint(ks[3], (), 0, n_bits, i32),
+                cycle=jax.random.randint(ks[4], (), 0, A, i32))
+
+        return jax.vmap(one)(keys)
+
+    def sampler(self, structure: str):
+        k = self
+
+        class _S:
+            def sample_batch(self, keys):
+                return k.sample_batch(keys, structure)
+
+        return _S()
+
+    def _classify(self, fault: MesiFault) -> jax.Array:
+        loads, mem = mesi_replay(self.trace, self.cfg, self.init_mem, fault)
+        sdc = (jnp.any(loads != self.golden_loads)
+               | jnp.any(mem != self.golden_mem))
+        prot = self.cfg.state_protection
+        out = jnp.where(sdc, i32(C.OUTCOME_SDC), i32(C.OUTCOME_MASKED))
+        if prot == PROT_PARITY:
+            # parity detects the flip when the entry is next referenced but
+            # cannot correct it: detected-uncorrectable = DUE, the same
+            # mapping as models/ruby.py (so cross-model AVF, which counts
+            # SDC+DUE, compares apples to apples)
+            out = jnp.where(sdc, i32(C.OUTCOME_DUE), out)
+        elif prot == PROT_ECC:
+            out = i32(C.OUTCOME_MASKED)        # single-bit corrected
+        return out
+
+    def outcomes_from_keys(self, keys: jax.Array, structure: str) -> jax.Array:
+        faults = self.sample_batch(keys, structure)
+        return jax.vmap(lambda f: self._classify(f))(faults)
+
+    def run_keys(self, keys: jax.Array, structure: str) -> jax.Array:
+        return C.tally(self.outcomes_from_keys(keys, structure))

@@ -91,6 +91,24 @@ def _div4_i(a, b):
             bad_s, bad_u)
 
 
+def _fp4_i(a, b):
+    """(fadd, fsub, fmul, fdiv) canonical f32 bits on i32 values — the
+    same FTZ + canonical-NaN contract as ops.replay._fp4."""
+    def flush(bits):
+        mag = bits & i32(0x7FFFFFFF)
+        sub = (mag > 0) & (mag < i32(0x00800000))
+        return jnp.where(sub, _s(_u(bits) & u32(0x80000000)), bits)
+
+    af = jax.lax.bitcast_convert_type(flush(a), jnp.float32)
+    bf = jax.lax.bitcast_convert_type(flush(b), jnp.float32)
+
+    def canon(r):
+        bits = flush(jax.lax.bitcast_convert_type(r, i32))
+        return jnp.where(jnp.isnan(r), i32(0x7FC00000), bits)
+
+    return canon(af + bf), canon(af - bf), canon(af * bf), canon(af / bf)
+
+
 def _alu_switch(op, a, b, imm):
     """Scalar-opcode ALU: one branch executes (a/b/imm are lane vectors)."""
     sh = b & i32(31)
@@ -120,6 +138,8 @@ def _alu_switch(op, a, b, imm):
         lambda _: jnp.where(a != b, one, zero),
         lambda _: jnp.where(a < b, one, zero),
         lambda _: jnp.where(a >= b, one, zero),
+        lambda _: _fp4_i(a, b)[0], lambda _: _fp4_i(a, b)[1],
+        lambda _: _fp4_i(a, b)[2], lambda _: _fp4_i(a, b)[3],
     ]
     return jax.lax.switch(op, branches, None)
 
@@ -162,6 +182,7 @@ def _alu_vec(op, a, b, imm):
         jnp.where(a != b, one, zero),
         jnp.where(a < b, one, zero),
         jnp.where(a >= b, one, zero),
+        *_fp4_i(a, b),
     ]
     out = zero
     for c, cand in enumerate(cands):
@@ -303,7 +324,8 @@ def _make_kernel(n: int, k: int, nphys: int, mem_words: int, may_latch: bool):
             is_ld = opv == U.LOAD
             is_st = opv == U.STORE
             is_br = (opv >= U.BEQ) & (opv <= U.BGE)
-            writes_op = ((opv >= U.ADD) & (opv <= U.REMU))
+            writes_op = (((opv >= U.ADD) & (opv <= U.REMU))
+                             | ((opv >= U.FADD) & (opv <= U.FDIV)))
             is_div_s = (opv == U.DIV) | (opv == U.REM)
             is_div_u = (opv == U.DIVU) | (opv == U.REMU)
         else:
@@ -311,7 +333,8 @@ def _make_kernel(n: int, k: int, nphys: int, mem_words: int, may_latch: bool):
             is_ld = jnp.full((1, B), op0 == U.LOAD)
             is_st = jnp.full((1, B), op0 == U.STORE)
             is_br = jnp.full((1, B), (op0 >= U.BEQ) & (op0 <= U.BGE))
-            writes_op = jnp.full((1, B), (op0 >= U.ADD) & (op0 <= U.REMU))
+            writes_op = jnp.full((1, B), ((op0 >= U.ADD) & (op0 <= U.REMU))
+                                 | ((op0 >= U.FADD) & (op0 <= U.FDIV)))
             is_div_s = jnp.full((1, B), (op0 == U.DIV) | (op0 == U.REM))
             is_div_u = jnp.full((1, B), (op0 == U.DIVU)
                                 | (op0 == U.REMU))

@@ -96,16 +96,42 @@ def _div4(a: jax.Array, b: jax.Array):
     return div, rem, divu, remu, bad_s, bad_u
 
 
+_QNAN = 0x7FC00000
+
+
+def _fp_flush_bits(bits: jax.Array) -> jax.Array:
+    """Subnormal f32 bit-patterns → signed zero (FTZ, uops.py FP contract)."""
+    mag = bits & u32(0x7FFFFFFF)
+    sub = (mag > 0) & (mag < u32(0x00800000))
+    return jnp.where(sub, bits & u32(0x80000000), bits)
+
+
+def _fp4(a: jax.Array, b: jax.Array):
+    """(fadd, fsub, fmul, fdiv) canonical result bits — IEEE RN with FTZ
+    inputs/outputs and canonical quiet NaN, so XLA CPU, TPU, the C++
+    golden, and the scalar python semantics agree bit-for-bit."""
+    af = jax.lax.bitcast_convert_type(_fp_flush_bits(a), jnp.float32)
+    bf = jax.lax.bitcast_convert_type(_fp_flush_bits(b), jnp.float32)
+
+    def canon(r):
+        bits = jax.lax.bitcast_convert_type(r, u32)
+        bits = _fp_flush_bits(bits)
+        return jnp.where(jnp.isnan(r), u32(_QNAN), bits)
+
+    return canon(af + bf), canon(af - bf), canon(af * bf), canon(af / bf)
+
+
 def _alu(op: jax.Array, a: jax.Array, b: jax.Array, imm: jax.Array) -> jax.Array:
     """Branchless µop evaluation: compute all candidates, select by opcode.
 
-    27 candidate lanes of VPU work per step — cheap relative to the gathers;
+    31 candidate lanes of VPU work per step — cheap relative to the gathers;
     keeps the scan body completely control-flow-free.
     """
     sh = (b & u32(31)).astype(u32)
     zero = jnp.zeros_like(a)
     one = jnp.ones_like(a)
     div, rem, divu, remu, _, _ = _div4(a, b)
+    fadd, fsub, fmul, fdiv = _fp4(a, b)
     cand = jnp.stack([
         zero,                       # NOP
         a + b, a - b, a & b, a | b, a ^ b,
@@ -120,6 +146,7 @@ def _alu(op: jax.Array, a: jax.Array, b: jax.Array, imm: jax.Array) -> jax.Array
         jnp.where(a != b, one, zero),
         jnp.where(_signed_lt(a, b), one, zero),
         jnp.where(~_signed_lt(a, b), one, zero),
+        fadd, fsub, fmul, fdiv,
     ])
     return cand[op]
 
@@ -206,7 +233,8 @@ def replay(tr: TraceArrays, init_reg: jax.Array, init_mem: jax.Array,
         de = jnp.where((fault.kind == KIND_ROB_DST) & at_uop,
                        dstr ^ fault.bit_as_index_mask(), dstr) & idx_mask
         result = jnp.where(is_ld, ldval, eff)
-        writes = (((op >= U.ADD) & (op <= U.REMU)) | is_ld) & live_next
+        writes = (((op >= U.ADD) & (op <= U.REMU)) | is_ld
+                  | ((op >= U.FADD) & (op <= U.FDIV))) & live_next
         reg = reg.at[de].set(jnp.where(writes, result, reg[de]))
         do_store = is_st & valid & live_next
         mem = mem.at[slot].set(jnp.where(do_store, st_data, mem[slot]))

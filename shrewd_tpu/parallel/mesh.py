@@ -49,10 +49,22 @@ def make_mesh(devices=None) -> Mesh:
 
 
 def shard_keys(mesh: Mesh, keys: jax.Array) -> jax.Array:
-    """Place a per-trial key batch sharded across the trial axis."""
+    """Place a per-trial key batch sharded across the trial axis.
+
+    Multi-host: every process computes the same (deterministic) global key
+    batch, and each contributes its addressable shards — the data-placement
+    half of what dist-gem5 does with explicit TCP packet forwarding
+    (``dev/net/dist_iface.hh:102``); typed PRNG keys go through
+    key_data/wrap_key_data since process-local assembly needs a raw view."""
     n = keys.shape[0]
     if n % mesh.size:
         raise ValueError(f"batch size {n} not divisible by mesh size {mesh.size}")
+    if jax.process_count() > 1:
+        data = np.asarray(jax.random.key_data(keys))
+        spec = P(TRIAL_AXIS, *([None] * (data.ndim - 1)))
+        arr = jax.make_array_from_callback(
+            data.shape, NamedSharding(mesh, spec), lambda idx: data[idx])
+        return jax.random.wrap_key_data(arr)
     return jax.device_put(keys, NamedSharding(mesh, P(TRIAL_AXIS)))
 
 

@@ -27,6 +27,7 @@ _ALU_OPS = np.array([U.ADD, U.SUB, U.AND, U.OR, U.XOR, U.SLL, U.SRL, U.SRA,
                      U.ADDI, U.ANDI, U.ORI, U.XORI, U.LUI, U.SLT, U.SLTU],
                     dtype=np.int32)
 _BRANCH_OPS = np.array([U.BEQ, U.BNE, U.BLT, U.BGE], dtype=np.int32)
+_FP_OPS = np.array([U.FADD, U.FSUB, U.FMUL, U.FDIV], dtype=np.int32)
 
 
 class WorkloadConfig(ConfigObject):
@@ -41,6 +42,8 @@ class WorkloadConfig(ConfigObject):
     frac_load = Param(float, 0.20, "load fraction")
     frac_store = Param(float, 0.12, "store fraction")
     frac_branch = Param(float, 0.08, "branch fraction")
+    frac_fp = Param(float, 0.0, "FP fraction (FADD/FSUB/FMUL/FDIV on f32 "
+                    "bit patterns in the integer register file)")
     # remaining fraction is NOPs
     locality = Param(float, 0.8, "P(src comes from recently-written regs)")
     reuse_geo_p = Param(float, 0.3, "geometric reuse-distance parameter")
@@ -92,10 +95,10 @@ def generate(cfg: WorkloadConfig, init_reg: np.ndarray | None = None,
         return int(rng.integers(nphys))
 
     probs = np.array([cfg.frac_alu, cfg.frac_mul, cfg.frac_load,
-                      cfg.frac_store, cfg.frac_branch])
+                      cfg.frac_store, cfg.frac_branch, cfg.frac_fp])
     if probs.sum() > 1.0 + 1e-9:
         raise ValueError("instruction-mix fractions exceed 1")
-    kinds = rng.choice(6, size=n, p=np.append(probs, 1.0 - probs.sum()))
+    kinds = rng.choice(7, size=n, p=np.append(probs, 1.0 - probs.sum()))
 
     captured: tuple[np.ndarray, np.ndarray] | None = None
     for i in range(n):
@@ -120,6 +123,10 @@ def generate(cfg: WorkloadConfig, init_reg: np.ndarray | None = None,
         elif kind == 4:               # branch
             op = int(_BRANCH_OPS[rng.integers(len(_BRANCH_OPS))])
             s1, s2, d = pick_src(), pick_src(), 0
+            im = 0
+        elif kind == 5:               # FP (values are f32 bit patterns)
+            op = int(_FP_OPS[rng.integers(len(_FP_OPS))])
+            s1, s2, d = pick_src(), pick_src(), int(rng.integers(nphys))
             im = 0
         else:                         # NOP
             op, s1, s2, d, im = U.NOP, 0, 0, 0, 0

@@ -138,11 +138,30 @@ def test_checkpoint_resume_bitwise_equal(tmp_path):
         assert final_a[k].trials == final_c[k].trials
 
 
-def test_resume_rejects_bad_version(tmp_path):
+def test_resume_rejects_unknown_version(tmp_path):
     orch = Orchestrator(_tiny_plan(), outdir=str(tmp_path))
     ckpt = orch.checkpoint()
     doc = json.loads((tmp_path / "campaign_ckpt" / "campaign.json").read_text())
     doc["version"] = 99
     (tmp_path / "campaign_ckpt" / "campaign.json").write_text(json.dumps(doc))
-    with pytest.raises(ValueError, match="version"):
+    with pytest.raises(ValueError, match="upgrade path"):
         Orchestrator.resume(ckpt)
+
+
+def test_resume_upgrades_v1_checkpoint(tmp_path):
+    """A version-1 campaign checkpoint (no escape counters) upgrades in
+    sequence and resumes — the util/cpt_upgraders contract, working
+    instead of a raise (VERDICT r2 weak #10)."""
+    orch = Orchestrator(_tiny_plan(), outdir=str(tmp_path))
+    ckpt = orch.checkpoint()
+    path = tmp_path / "campaign_ckpt" / "campaign.json"
+    doc = json.loads(path.read_text())
+    doc["version"] = 1
+    for per_structure in doc["state"].values():
+        for st_doc in per_structure.values():
+            st_doc.pop("escapes", None)
+            st_doc.pop("taint_trials", None)
+    path.write_text(json.dumps(doc))
+    orch2 = Orchestrator.resume(ckpt)
+    for st in orch2.state.values():
+        assert st.escapes == 0 and st.taint_trials == 0

@@ -1,0 +1,135 @@
+"""FP µop datapath (FADD/FSUB/FMUL/FDIV) across every backend.
+
+The FP contract (isa/uops.py): IEEE round-to-nearest with FTZ on inputs
+and outputs plus canonical quiet NaN, so the XLA dense kernel, the taint
+kernel, the C++ golden oracle, and the scalar python semantics produce
+identical BITS — making FP fault trials classifiable bit-exactly, the way
+the reference's shadow-FU detection chiefly targets FP units
+(/root/reference/src/cpu/FuncUnitConfig.py, fu_pool.cc:177-294).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from shrewd_tpu import native
+from shrewd_tpu.isa import semantics, uops as U
+from shrewd_tpu.models.o3 import Fault, KIND_FU, KIND_REGFILE, O3Config
+from shrewd_tpu.ops import classify as C
+from shrewd_tpu.ops.trial import TrialKernel
+from shrewd_tpu.trace.synth import WorkloadConfig, generate
+from shrewd_tpu.utils import prng
+
+
+def _fp_trace(n=160, seed=11):
+    return generate(WorkloadConfig(
+        n=n, nphys=32, mem_words=64, working_set_words=32, seed=seed,
+        frac_alu=0.3, frac_mul=0.05, frac_load=0.1, frac_store=0.1,
+        frac_branch=0.05, frac_fp=0.35))
+
+
+def test_fp_trace_contains_fp_ops():
+    t = _fp_trace()
+    assert int(U.is_fp(t.opcode).sum()) > 20
+
+
+def test_scalar_semantics_bits():
+    f = np.float32
+    bits = lambda x: int(np.float32(x).view(np.uint32))
+    # exact IEEE results
+    assert semantics.alu(U.FADD, bits(f(1.5)), bits(f(2.25)), 0) \
+        == bits(f(3.75))
+    assert semantics.alu(U.FMUL, bits(f(3.0)), bits(f(-2.0)), 0) \
+        == bits(f(-6.0))
+    # x/0 = inf (no trap, unlike integer DIV)
+    assert semantics.alu(U.FDIV, bits(f(1.0)), 0, 0) == bits(np.inf)
+    # 0/0 = canonical quiet NaN
+    assert semantics.alu(U.FDIV, 0, 0, 0) == 0x7FC00000
+    # subnormal result flushes to signed zero
+    tiny = bits(f(2.0 ** -130))      # already subnormal input → flushed
+    assert semantics.alu(U.FADD, tiny, 0, 0) == 0
+    # NaN payloads canonicalize
+    assert semantics.alu(U.FADD, 0x7F800001, bits(f(1.0)), 0) == 0x7FC00000
+
+
+def test_dense_matches_scalar_golden():
+    """Fault-free dense replay == scalar semantics on an FP-heavy trace."""
+    t = _fp_trace()
+    k = TrialKernel(t, O3Config(enable_shrewd=False))
+    reg = t.init_reg.copy()
+    mem = t.init_mem.copy()
+    semantics.scalar_replay(t, reg, mem)
+    assert np.array_equal(np.asarray(k.golden.reg), reg)
+    assert np.array_equal(np.asarray(k.golden.mem), mem)
+
+
+def test_native_golden_matches_device_on_fp():
+    """C++ golden oracle vs device kernel, FP trace, sampled faults."""
+    t = _fp_trace()
+    k = TrialKernel(t, O3Config(shadow_coverage=[0.4] * U.N_OPCLASSES))
+    keys = prng.trial_keys(prng.campaign_key(4), 256)
+    faults = k.sampler("regfile").sample_batch(keys)
+    fk, fc, fe, fb, fs = (np.asarray(x) for x in faults)
+    cov = np.asarray(k.shadow_cov)
+    base = native.golden_trials(t, fk, fc, fe, fb, fs, cov)
+    dev = np.asarray(k.run_batch(faults))
+    assert np.array_equal(base, dev)
+
+
+def test_taint_hybrid_matches_dense_on_fp():
+    t = _fp_trace()
+    k = TrialKernel(t, O3Config())
+    keys = prng.trial_keys(prng.campaign_key(6), 128)
+    faults = k.sample_batch(keys, "regfile")
+    hybrid = k.run_batch_hybrid(faults, may_latch=False)
+    dense = np.asarray(k.run_batch(faults))
+    assert np.array_equal(hybrid, dense)
+
+
+def test_fp_fault_propagates_to_sdc():
+    """A flipped mantissa bit feeding an FMUL chain must reach SDC."""
+    from shrewd_tpu.trace.format import Trace
+
+    bits = lambda x: np.uint32(np.float32(x).view(np.uint32))
+    init_reg = np.zeros(32, dtype=np.uint32)
+    init_reg[1] = bits(1.5)
+    init_reg[2] = bits(2.0)
+    t = Trace(opcode=np.array([U.FMUL, U.FADD], np.int32),
+              dst=np.array([3, 4], np.int32),
+              src1=np.array([1, 3], np.int32),
+              src2=np.array([2, 3], np.int32),
+              imm=np.zeros(2, np.uint32), taken=np.zeros(2, np.int32),
+              init_reg=init_reg, init_mem=np.zeros(64, np.uint32))
+    k = TrialKernel(t, O3Config(enable_shrewd=False))
+    f = Fault(kind=jnp.int32(KIND_REGFILE), cycle=jnp.int32(0),
+              entry=jnp.int32(1), bit=jnp.int32(20),
+              shadow_u=jnp.float32(1.0))
+    r = jax.jit(k._replay_one)(f)
+    assert int(C.classify(r, k.golden)) == C.OUTCOME_SDC
+
+
+def test_fp_shadow_fu_detects():
+    """A FU fault on an FP µop is caught by the FP shadow units when
+    coverage is full — the FP half of the SHREWD detection story."""
+    t = _fp_trace(n=64, seed=3)
+    k = TrialKernel(t, O3Config(shadow_coverage=[1.0] * U.N_OPCLASSES))
+    fp_idx = int(np.nonzero(U.is_fp(t.opcode))[0][0])
+    f = Fault(kind=jnp.int32(KIND_FU), cycle=jnp.int32(fp_idx),
+              entry=jnp.int32(fp_idx), bit=jnp.int32(3),
+              shadow_u=jnp.float32(0.0))
+    r = jax.jit(k._replay_one)(f)
+    assert bool(r.detected)
+
+
+def test_fp_opclasses_cover_reference_fu_classes():
+    """The FU pool models the reference's FP unit classes with shadow
+    eligibility (FuncUnitConfig.py FP_ALU / FP_MultDiv)."""
+    from shrewd_tpu.models.fupool import FUPoolConfig
+
+    cfg = FUPoolConfig()
+    caps = {c for d in cfg.descs() for c in d.capabilities}
+    assert U.OC_FP_ALU in caps and U.OC_FP_MULT in caps
+    assert U.OC_FP_ALU in cfg.shadow_eligible
+    assert U.OC_FP_MULT in cfg.shadow_eligible
+    # FP_ALU can approximately check FP multiplies as a shadow
+    assert U.OC_FP_MULT in cfg.fp_alu.approx_capabilities

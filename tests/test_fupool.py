@@ -112,7 +112,8 @@ def test_compute_shadow_cov_paths():
                                 working_set_words=32, seed=3))
     oc = U.opclass_of(t.opcode)
     # coverage model: straight per-OpClass gather
-    cfg = O3Config(shadow_coverage=[0.3, 0.5, 0.0, 0.0, 0.0])
+    cfg = O3Config(shadow_coverage=[0.3, 0.5, 0.0, 0.0, 0.0, 0.0,
+                                    0.0])
     cov, m = compute_shadow_cov(oc, cfg)
     assert m is None
     np.testing.assert_allclose(

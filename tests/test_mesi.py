@@ -1,0 +1,190 @@
+"""MESI protocol-state fault model (models/mesi.py).
+
+Differential contract: the lax.scan device kernel walks the identical
+protocol as the independent scalar oracle, golden and under injected
+state/tag faults (the CheckerCPU pattern).  Directed scenarios pin the
+protocol-accurate outcomes the reference's .sm state machine implies:
+a dirty M silently demoted loses its writeback (SDC), an I flipped valid
+serves a stale hit (SDC), a tag flip aliases another address.
+Reference: MESI_Two_Level-L1cache.sm, CacheMemory.hh:70, DataBlock.hh:61.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from shrewd_tpu.models import mesi as M
+from shrewd_tpu.models.mesi import (AccessTrace, MesiConfig, MesiFault,
+                                    MesiKernel, ST_I, ST_M, TGT_STATE,
+                                    TGT_TAG, mesi_replay, scalar_mesi,
+                                    torture_stream)
+from shrewd_tpu.ops import classify as C
+
+i32 = jnp.int32
+MEM_WORDS = 64
+
+
+def _cfg(**kw):
+    return MesiConfig(**{**dict(n_sets=4, n_ways=2, words_per_line=2), **kw})
+
+
+def _mem():
+    rng = np.random.default_rng(9)
+    return rng.integers(0, 1 << 32, MEM_WORDS, dtype=np.uint64).astype(
+        np.uint32)
+
+
+def _fault(target=TGT_STATE, core=0, mset=0, way=0, bit=0, cycle=-1):
+    return MesiFault(target=i32(target), core=i32(core), mset=i32(mset),
+                     way=i32(way), bit=i32(bit), cycle=i32(cycle))
+
+
+def _stream(events):
+    """events: (core, word, is_store, value)"""
+    c, w, s, v = zip(*events)
+    return AccessTrace(core=jnp.asarray(c, i32), word=jnp.asarray(w, i32),
+                       is_store=jnp.asarray(s, bool),
+                       value=jnp.asarray(np.asarray(v, dtype=np.uint32)))
+
+
+def test_golden_kernel_matches_scalar_oracle():
+    cfg = _cfg()
+    mem = _mem()
+    tr = torture_stream(cfg, 200, MEM_WORDS, seed=3)
+    loads_s, mem_s = scalar_mesi(tr, cfg, mem)
+    loads_d, mem_d = jax.jit(
+        lambda: mesi_replay(tr, cfg, jnp.asarray(mem), _fault()))()
+    ld = np.asarray(loads_d)[~np.asarray(tr.is_store)]
+    assert np.array_equal(ld, loads_s)
+    assert np.array_equal(np.asarray(mem_d), mem_s)
+
+
+@pytest.mark.parametrize("target,nbits", [(TGT_STATE, 2), (TGT_TAG, 6)])
+def test_faulty_kernel_matches_scalar_oracle(target, nbits):
+    """Paired trials: every (site, bit, cycle) sample classifies identically
+    on the device kernel and the perturbed scalar oracle."""
+    cfg = _cfg()
+    mem = _mem()
+    tr = torture_stream(cfg, 120, MEM_WORDS, seed=5)
+    rng = np.random.default_rng(11)
+    mismatches = 0
+    for _ in range(40):
+        co = (int(rng.integers(0, 2)), int(rng.integers(0, cfg.n_sets)),
+              int(rng.integers(0, cfg.n_ways)), int(rng.integers(0, nbits)),
+              int(rng.integers(0, 120)))
+        loads_s, mem_s = scalar_mesi(
+            tr, cfg, mem, fault=(target, *co))
+        loads_d, mem_d = mesi_replay(
+            tr, cfg, jnp.asarray(mem),
+            _fault(target, co[0], co[1], co[2], co[3], co[4]))
+        ld = np.asarray(loads_d)[~np.asarray(tr.is_store)]
+        if not (np.array_equal(ld, loads_s)
+                and np.array_equal(np.asarray(mem_d), mem_s)):
+            mismatches += 1
+    assert mismatches == 0
+
+
+def test_m_demoted_to_s_loses_dirty_writeback():
+    # core0 stores to word 0 (line 0 → set 0): line becomes M with the only
+    # up-to-date copy.  Flip state bit 1 (M=3 → S=1): the final flush skips
+    # the writeback and memory keeps the stale value → SDC.
+    cfg = _cfg()
+    mem = _mem()
+    tr = _stream([(0, 0, True, 0xDEAD0001), (0, 1, False, 0)])
+    k = MesiKernel(tr, cfg, mem)
+    out = jax.vmap(lambda f: k._classify(f))(
+        jax.tree.map(lambda x: jnp.asarray(x)[None],
+                     _fault(TGT_STATE, 0, 0, 0, 1, 1)))
+    assert int(out[0]) == C.OUTCOME_SDC
+    # and the failure is exactly the lost store
+    _, mem_f = mesi_replay(tr, cfg, jnp.asarray(mem),
+                           _fault(TGT_STATE, 0, 0, 0, 1, 1))
+    assert int(np.asarray(mem_f)[0]) == int(mem[0])          # stale
+    assert int(np.asarray(k.golden_mem)[0]) == 0xDEAD0001
+
+
+def test_i_flipped_valid_serves_stale_hit():
+    # core0 loads word 8 (set 0 under 4-set/2-word lines), line later
+    # invalidated by core1's store; core0's I entry flipped back valid
+    # serves the STALE value on the next load → SDC.
+    cfg = _cfg()
+    mem = _mem()
+    tr = _stream([
+        (0, 8, False, 0),              # core0 fills line (set 0)
+        (1, 8, True, 0xBEEF0002),      # core1 store → invalidates core0
+        (0, 8, False, 0),              # golden: coherence miss → fresh value
+    ])
+    k = MesiKernel(tr, cfg, mem)
+    golden = np.asarray(k.golden_loads)
+    assert int(golden[2]) == 0xBEEF0002
+    # flip core0's entry (set 0, way 0) I→S just before the last load
+    loads_f, _ = mesi_replay(tr, cfg, jnp.asarray(mem),
+                             _fault(TGT_STATE, 0, 0, 0, 0, 2))
+    assert int(np.asarray(loads_f)[2]) == int(mem[8])        # stale hit
+    out = k._classify(_fault(TGT_STATE, 0, 0, 0, 0, 2))
+    assert int(out) == C.OUTCOME_SDC
+
+
+def test_tag_fault_aliases_wrong_line():
+    # dirty line's tag flipped: the final writeback lands at the aliased
+    # address → BOTH the home word (stale) and the aliased word (clobbered)
+    cfg = _cfg()
+    mem = _mem()
+    # second access touches set 2 only — it exists so the cycle-1 flip has
+    # a step to land on (flips apply at access boundaries)
+    tr = _stream([(0, 0, True, 0x12340003), (0, 12, False, 0)])
+    k = MesiKernel(tr, cfg, mem)
+    _, mem_f = mesi_replay(tr, cfg, jnp.asarray(mem),
+                           _fault(TGT_TAG, 0, 0, 0, 0, 1))
+    mem_f = np.asarray(mem_f)
+    assert mem_f[0] == mem[0]                                # stale home
+    # tag 0 ^ 1 = 1 → line 1*4+0 = set 0, tag 1 → words 8..9
+    assert mem_f[8] == 0x12340003                            # clobbered
+    assert int(k._classify(_fault(TGT_TAG, 0, 0, 0, 0, 1))) == C.OUTCOME_SDC
+
+
+def test_untouched_way_fault_is_masked():
+    cfg = _cfg()
+    mem = _mem()
+    tr = _stream([(0, 0, False, 0), (0, 1, False, 0)])
+    k = MesiKernel(tr, cfg, mem)
+    # set 3 never touched: flips there change nothing program-visible...
+    # but a spurious valid line could still write back garbage; state bit 0
+    # on an I line makes it S (clean) → no writeback → masked
+    assert int(k._classify(_fault(TGT_STATE, 1, 3, 1, 0, 1))) \
+        == C.OUTCOME_MASKED
+
+
+def test_protection_transforms_outcomes():
+    cfg = _cfg(state_protection="parity")
+    mem = _mem()
+    tr = _stream([(0, 0, True, 0xDEAD0001), (0, 1, False, 0)])
+    k = MesiKernel(tr, cfg, mem)
+    # parity = detected-uncorrectable = DUE (the models/ruby.py mapping)
+    assert int(k._classify(_fault(TGT_STATE, 0, 0, 0, 1, 1))) \
+        == C.OUTCOME_DUE
+    cfg2 = _cfg(state_protection="ecc")
+    k2 = MesiKernel(tr, cfg2, mem)
+    assert int(k2._classify(_fault(TGT_STATE, 0, 0, 0, 1, 1))) \
+        == C.OUTCOME_MASKED
+
+
+def test_campaign_protocol_and_sharded_run():
+    """MesiKernel speaks the campaign protocol: run_keys tallies and the
+    sharded campaign drives it over the 8-device mesh."""
+    from shrewd_tpu.parallel.campaign import ShardedCampaign
+    from shrewd_tpu.parallel.mesh import make_mesh
+    from shrewd_tpu.utils import prng
+
+    cfg = _cfg()
+    tr = torture_stream(cfg, 64, MEM_WORDS, seed=7)
+    k = MesiKernel(tr, cfg, _mem())
+    keys = prng.trial_keys(prng.campaign_key(2), 32)
+    t = np.asarray(k.run_keys(keys, "state"))
+    assert t.sum() == 32
+    camp = ShardedCampaign(k, make_mesh(), "state")
+    keys8 = prng.trial_keys(prng.campaign_key(3), 64)
+    t8 = np.asarray(camp.tally_batch(keys8))
+    assert t8.sum() == 64
+    _ = M

@@ -17,12 +17,12 @@ from tests.test_replay import fault, mini_trace, run
 
 
 def test_opcode_flip_to_illegal_is_due():
-    # ORI (11) with bit 4 flipped → 27 ≥ N_OPCODES → illegal µop → DUE
+    # SLT (15) with bit 4 flipped → 31 ≥ N_OPCODES → illegal µop → DUE
     t = mini_trace([
-        (U.ORI, 1, 2, 3, 0, 0),
+        (U.SLT, 1, 2, 3, 0, 0),
         (U.ADD, 4, 1, 2, 0, 0),
     ])
-    assert U.ORI ^ (1 << 4) >= U.N_OPCODES
+    assert U.SLT ^ (1 << 4) >= U.N_OPCODES
     r = run(t, fault(kind=KIND_LATCH_OP, cycle=0, entry=0, bit=4))
     assert bool(r.trapped)
     golden = run(t, fault())

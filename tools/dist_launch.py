@@ -1,0 +1,187 @@
+"""Multi-process campaign launcher — the gem5-dist analog on localhost.
+
+The reference runs multi-node simulations as N gem5 processes + a switch
+process glued by a hand-rolled TCP barrier layer, launched over ssh
+(``/root/reference/util/dist/gem5-dist.sh:227-321``,
+``dev/net/dist_iface.hh:102``).  The TPU-native equivalent is
+``jax.distributed``: N processes join one coordinator, the device mesh
+spans all of them, and the psum tally reduction IS the barrier
+(SURVEY §5.8).  This launcher demonstrates it on localhost with the CPU
+backend (the dist-gem5-on-localhost testing posture, SURVEY §4 tier 5):
+
+    python tools/dist_launch.py --num-processes 2 --local-devices 4
+
+Each worker runs the SAME sharded campaign batch over the global mesh and
+prints its replicated tally; the supervisor checks all workers agree and
+that the tally equals a single-process run of the same batch bit-for-bit
+(placement must not change outcomes — every trial's fate is a pure
+function of its PRNG key).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _worker_env(local_devices: int) -> dict:
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                        f" --xla_force_host_platform_device_count="
+                        f"{local_devices}").strip()
+    return env
+
+
+def run_campaign_batch(batch: int, n_uops: int, seed: int):
+    """One dense sharded batch on whatever mesh this process sees."""
+    import numpy as np
+
+    from shrewd_tpu.models.o3 import O3Config
+    from shrewd_tpu.ops.trial import TrialKernel
+    from shrewd_tpu.parallel.campaign import ShardedCampaign
+    from shrewd_tpu.parallel.mesh import make_mesh
+    from shrewd_tpu.trace.synth import WorkloadConfig, generate
+    from shrewd_tpu.utils import prng
+
+    trace = generate(WorkloadConfig(
+        n=n_uops, nphys=32, mem_words=64, working_set_words=32, seed=seed))
+    # dense: the taint/hybrid escape resolution is host-driven and NOT yet
+    # multi-host-safe (each process would re-run escapes redundantly)
+    kernel = TrialKernel(trace, O3Config(replay_kernel="dense"))
+    mesh = make_mesh()
+    camp = ShardedCampaign(kernel, mesh, "regfile")
+    keys = prng.trial_keys(prng.campaign_key(seed), batch)
+    return np.asarray(camp.tally_batch(keys)), mesh.size
+
+
+def worker(args) -> int:
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=f"localhost:{args.port}",
+        num_processes=args.num_processes,
+        process_id=args.process_id)
+    tally, mesh_size = run_campaign_batch(args.batch, args.uops, args.seed)
+    print(json.dumps({
+        "process_id": args.process_id,
+        "process_count": jax.process_count(),
+        "mesh_size": mesh_size,
+        "tally": tally.tolist(),
+    }), flush=True)
+    return 0
+
+
+def supervise(args) -> int:
+    env = _worker_env(args.local_devices)
+    procs = []
+    for pid in range(args.num_processes):
+        procs.append(subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--role", "worker",
+             "--process-id", str(pid),
+             "--num-processes", str(args.num_processes),
+             "--port", str(args.port), "--batch", str(args.batch),
+             "--uops", str(args.uops), "--seed", str(args.seed)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True))
+    tallies = {}
+    ok = True
+    for pid, p in enumerate(procs):
+        try:
+            out, err = p.communicate(timeout=args.timeout)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, err = p.communicate()
+            print(f"worker {pid}: TIMEOUT\n{err[-500:]}", file=sys.stderr)
+            ok = False
+            continue
+        if p.returncode != 0:
+            print(f"worker {pid}: rc={p.returncode}\n{err[-800:]}",
+                  file=sys.stderr)
+            ok = False
+            continue
+        line = next((ln for ln in out.splitlines() if ln.startswith("{")),
+                    None)
+        if line is None:
+            print(f"worker {pid}: no result line\n{err[-500:]}",
+                  file=sys.stderr)
+            ok = False
+            continue
+        tallies[pid] = json.loads(line)
+    if not ok or len(tallies) != args.num_processes:
+        print(json.dumps({"ok": False, "error": "worker failure"}))
+        return 1
+
+    vals = [tuple(t["tally"]) for t in tallies.values()]
+    agree = len(set(vals)) == 1
+    # single-process reference on the same global batch (same seed): the
+    # tally must be placement-invariant, bit for bit
+    total_dev = args.num_processes * args.local_devices
+    ref_env = _worker_env(total_dev)
+    ref_tally = None
+    try:
+        ref = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--role",
+             "reference", "--batch", str(args.batch), "--uops",
+             str(args.uops), "--seed", str(args.seed)],
+            env=ref_env, capture_output=True, text=True,
+            timeout=args.timeout)
+    except subprocess.TimeoutExpired:
+        print("reference run: TIMEOUT", file=sys.stderr)
+        ref = None
+    if ref is not None and ref.returncode == 0:
+        line = next((ln for ln in ref.stdout.splitlines()
+                     if ln.startswith("{")), None)
+        if line is not None:
+            ref_tally = json.loads(line)["tally"]
+    result = {
+        "ok": agree and ref_tally == list(vals[0]),
+        "num_processes": args.num_processes,
+        "global_devices": tallies[0]["mesh_size"],
+        "workers_agree": agree,
+        "tally": list(vals[0]),
+        "single_process_tally": ref_tally,
+        "matches_single_process": ref_tally == list(vals[0]),
+    }
+    print(json.dumps(result))
+    return 0 if result["ok"] else 1
+
+
+def reference(args) -> int:
+    import numpy as np  # noqa: F401
+
+    tally, mesh_size = run_campaign_batch(args.batch, args.uops, args.seed)
+    print(json.dumps({"tally": tally.tolist(), "mesh_size": mesh_size}),
+          flush=True)
+    return 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--role", default="supervisor",
+                    choices=("supervisor", "worker", "reference"))
+    ap.add_argument("--num-processes", type=int, default=2)
+    ap.add_argument("--process-id", type=int, default=0)
+    ap.add_argument("--local-devices", type=int, default=4)
+    ap.add_argument("--port", type=int, default=47211)
+    ap.add_argument("--batch", type=int, default=256)
+    ap.add_argument("--uops", type=int, default=96)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--timeout", type=float, default=420.0)
+    args = ap.parse_args()
+    if args.role == "worker":
+        return worker(args)
+    if args.role == "reference":
+        return reference(args)
+    return supervise(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -18,8 +18,11 @@
 // at the SimPoint": registers + memory image, sim/serialize.hh semantics).
 //
 // Output format (little-endian):
-//   magic  "SHTRACE1" (8 bytes)
-//   u64 begin, u64 end, u64 n_steps (patched at close), u64 n_regions
+//   magic  "SHTRACE2" (8 bytes)
+//   u64 begin, u64 end, u64 n_steps (patched at close), u64 n_regions,
+//   u64 fs_base   (TLS base — the %fs segment; the TLS block itself is a
+//                  writable mapping and lands in the region snapshot, so
+//                  fs_base makes %fs:disp accesses resolvable offline)
 //   per region: u64 vaddr, u64 size, size bytes
 //   per step:   18 × u64  (rax rcx rdx rbx rsp rbp rsi rdi r8..r15 rip
 //                          eflags; encoding order — see ptrace_common.h)
@@ -110,12 +113,13 @@ int main(int argc, char **argv) {
 
   FILE *f = fopen(outpath, "wb");
   if (!f) { perror(outpath); return 2; }
-  fwrite("SHTRACE1", 8, 1, f);
+  fwrite("SHTRACE2", 8, 1, f);
   put_u64(f, begin);
   put_u64(f, end);
   long n_steps_off = ftell(f);
   put_u64(f, 0);  // n_steps, patched below
   put_u64(f, regions.size());
+  put_u64(f, (uint64_t)regs.fs_base);
   for (const Region &r : regions) {
     put_u64(f, r.vaddr);
     put_u64(f, r.size);
