@@ -101,6 +101,38 @@ def cmd_hostdiff(args) -> int:
     return 0
 
 
+def cmd_trace(args) -> int:
+    """Dump an Exec-style instruction trace of a replay window
+    (trace/exec_trace.py; the reference's --debug-flags Exec family,
+    src/cpu/exetrace.cc)."""
+    from shrewd_tpu.models.o3 import O3Config
+    from shrewd_tpu.ops.trial import TrialKernel
+    from shrewd_tpu.trace.exec_trace import exec_trace
+    from shrewd_tpu.utils import debug
+
+    if not debug.enabled("Exec"):
+        debug.enable("ExecAll" if args.all else "Exec")
+    if args.results:
+        debug.enable("ExecResult")
+    if args.workload:
+        from shrewd_tpu.ingest import hostdiff as hd
+
+        paths = hd.build_tools(workload_c=args.workload)
+        tr, _meta = hd.capture_and_lift(paths)
+    else:
+        from shrewd_tpu.trace.synth import WorkloadConfig, generate
+
+        tr = generate(WorkloadConfig(n=args.n or 256, nphys=64,
+                                     mem_words=1024,
+                                     working_set_words=256,
+                                     seed=args.seed))
+    kern = TrialKernel(tr, O3Config(pallas="off"))
+    n = exec_trace(tr, kern.golden_rec, out=sys.stdout, start=args.start,
+                   count=args.n)
+    _log(f"traced {n} µops")
+    return 0
+
+
 def cmd_bench(args) -> int:
     """Re-exec the repo-root bench supervisor (it must own the process: it
     re-execs per platform with hard timeouts)."""
@@ -152,6 +184,18 @@ def main(argv: list[str] | None = None) -> int:
                    choices=("output", "liveness", "abi", "emu64"))
     p.add_argument("--out", default="")
     p.set_defaults(fn=cmd_hostdiff)
+
+    p = sub.add_parser("trace", parents=[common],
+                       help="Exec-style instruction trace of a window")
+    p.add_argument("--workload", default="",
+                   help="C workload to capture+lift (default: synth trace)")
+    p.add_argument("--start", type=int, default=0)
+    p.add_argument("-n", type=int, default=64, help="µops to print")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--all", action="store_true",
+                   help="ExecAll (results + opclasses)")
+    p.add_argument("--results", action="store_true", help="ExecResult")
+    p.set_defaults(fn=cmd_trace)
 
     p = sub.add_parser("bench", parents=[common],
                        help="headline benchmark (one JSON line)")

@@ -1,0 +1,485 @@
+"""NoC (network-on-chip) fault model: per-router fault probabilities plus a
+message-level fault-injection path for the coherence interconnect.
+
+Reference role: gem5's garnet ``FaultModel``
+(src/mem/ruby/network/fault_model/FaultModel.hh:59-126, FaultModel.cc:136-276)
+— a per-router probability calculator over ten variation-induced fault types,
+looked up from a pre-characterized database keyed by (VCs, buffers/VC) and
+scaled by a temperature-weight table; garnet queries ``fault_vector`` /
+``fault_prob`` per router at runtime.
+
+TPU-native redesign (NOT a translation):
+
+- the database lookup is replaced by a **parametric area model**: each fault
+  type's per-cycle probability is proportional to the number of susceptible
+  storage/logic bits implied by the declared router geometry (buffer SRAM
+  bits, credit counters, allocator state, route-compute logic), times a
+  per-bit base rate, times an Arrhenius-style temperature acceleration
+  factor clamped to the same [0, 125] °C range the reference enforces
+  (FaultModel.cc:189-201).  This keeps the *shape* of the reference's
+  interface — heterogeneous routers, per-type vectors, temperature scaling —
+  with an original, documented closed form instead of a copied table.
+- probabilities for ALL routers at ALL queried temperatures are computed as
+  one vectorized jnp expression (``fault_vectors``), not a per-router loop.
+- on top of the calculator, a **message-level injection kernel**
+  (``NocKernel``) routes the MESI tier's coherence traffic over an X-Y mesh
+  and classifies per-(router, cycle, type) faults into the standard outcome
+  taxonomy, vmapped over trial batches like every other kernel.  garnet's
+  FaultModel stops at probabilities; the injection path is what a SER
+  campaign actually needs and reuses this framework's outcome machinery.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from shrewd_tpu.models.mesi import AccessTrace, MesiConfig
+from shrewd_tpu.ops import classify as C
+from shrewd_tpu.utils.config import ConfigObject, Param
+
+i32 = jnp.int32
+
+# fault-type indices (same ten categories as FaultModel.hh:71-84)
+FT_DATA_FEW_BITS = 0      # data corruption: few bits of a flit
+FT_DATA_ALL_BITS = 1      # data corruption: a whole flit
+FT_FLIT_DUP = 2           # flit conservation: duplication
+FT_FLIT_LOSS = 3          # flit conservation: loss or split
+FT_MISROUTE = 4           # misrouting
+FT_CREDIT_GEN = 5         # credit conservation: spurious credit
+FT_CREDIT_LOSS = 6        # credit conservation: credit loss
+FT_ALLOC_VC = 7           # erroneous VC allocation
+FT_ALLOC_SW = 8           # erroneous switch allocation
+FT_ARBITRATION = 9        # unfair arbitration
+N_FAULT_TYPES = 10
+
+FAULT_TYPE_NAMES = (
+    "data_corruption__few_bits", "data_corruption__all_bits",
+    "flit_conservation__flit_duplication", "flit_conservation__flit_loss_or_split",
+    "misrouting", "credit_conservation__credit_generation",
+    "credit_conservation__credit_loss", "erroneous_allocation__VC",
+    "erroneous_allocation__switch", "unfair_arbitration",
+)
+
+# per-bit base probability of an upset per cycle at the baseline
+# temperature, by susceptibility class.  The absolute scale is arbitrary
+# (the reference's database is likewise unitless per-cycle probability);
+# what the model preserves is the *relative* structure: SRAM buffer cells
+# dominate, control FSM bits are rarer, combinational logic rarer still.
+_RATE_SRAM = 1e-12        # buffer storage cells
+_RATE_FSM = 3e-13         # sequential control state (credits, allocator)
+_RATE_LOGIC = 1e-13       # combinational (route compute, arbiter muxes)
+
+BASELINE_TEMPERATURE_C = 71.0     # FaultModel.hh:45
+_TEMP_SCALE_C = 18.0              # e-fold per 18 °C (Arrhenius-like slope)
+
+
+def fault_type_to_string(idx: int) -> str:
+    return FAULT_TYPE_NAMES[idx]
+
+
+class NocConfig(ConfigObject):
+    """X-Y mesh interconnect geometry (garnet-style parameters)."""
+
+    mesh_x = Param(int, 2, "mesh columns")
+    mesh_y = Param(int, 2, "mesh rows")
+    n_vnets = Param(int, 3, "virtual networks (req/fwd/resp)")
+    vcs_per_vnet = Param(int, 4, "virtual channels per vnet")
+    buffers_per_data_vc = Param(int, 4, "flit buffers per data VC")
+    buffers_per_ctrl_vc = Param(int, 1, "flit buffers per control VC")
+    flit_bits = Param(int, 128, "bits per flit")
+    temperature_c = Param(float, BASELINE_TEMPERATURE_C, "die temperature")
+
+    def validate(self) -> None:
+        if self.mesh_x < 1 or self.mesh_y < 1:
+            raise ValueError("mesh dims must be >= 1")
+
+    @property
+    def n_routers(self) -> int:
+        return self.mesh_x * self.mesh_y
+
+
+class RouterGeom(NamedTuple):
+    """One router's declared geometry (FaultModel::declare_router args)."""
+
+    n_inputs: int
+    n_outputs: int
+    vcs_per_vnet: int
+    n_vnets: int
+    buffers_per_data_vc: int
+    buffers_per_ctrl_vc: int
+    flit_bits: int
+
+
+def _geom_bits(g: RouterGeom) -> np.ndarray:
+    """Susceptible-bit counts per fault type for one router geometry.
+
+    data faults ∝ buffer SRAM bits; flit-conservation and credit faults ∝
+    per-VC sequential state; misrouting ∝ route-compute logic; allocation
+    and arbitration ∝ allocator/arbiter state.  One data vnet carries wide
+    buffers; the remaining vnets are control-sized (the reference makes the
+    same data/ctrl VC split in its conf records, FaultModel.hh:88-95)."""
+    vcs = g.vcs_per_vnet * g.n_vnets
+    data_vcs = g.vcs_per_vnet                 # one data-class vnet
+    ctrl_vcs = vcs - data_vcs
+    buf_bits = g.n_inputs * g.flit_bits * (
+        data_vcs * g.buffers_per_data_vc + ctrl_vcs * g.buffers_per_ctrl_vc)
+    vc_state = g.n_inputs * vcs * 8           # per-VC FSM + pointers
+    credit_bits = g.n_outputs * vcs * 4       # credit counters
+    route_logic = g.n_inputs * max(1, g.n_outputs).bit_length() * 4
+    alloc_state = (g.n_inputs * vcs) + (g.n_inputs * g.n_outputs)
+    arb_state = g.n_outputs * vcs             # round-robin priority
+    return np.array([
+        buf_bits * 0.75,          # few-bit data corruption
+        buf_bits * 0.25,          # whole-flit corruption (clustered upset)
+        vc_state * 0.5,           # duplication (read-pointer state)
+        vc_state * 0.5,           # loss/split (write-pointer state)
+        route_logic,              # misrouting
+        credit_bits * 0.5,        # spurious credit
+        credit_bits * 0.5,        # credit loss
+        alloc_state * 0.6,        # VC allocation
+        alloc_state * 0.4,        # switch allocation
+        arb_state,                # unfair arbitration
+    ], dtype=np.float64)
+
+
+_CLASS_RATE = np.array([
+    _RATE_SRAM, _RATE_SRAM, _RATE_FSM, _RATE_FSM, _RATE_LOGIC,
+    _RATE_FSM, _RATE_FSM, _RATE_FSM, _RATE_FSM, _RATE_LOGIC,
+], dtype=np.float64)
+
+
+def temperature_factor(temp_c) -> np.ndarray:
+    """Arrhenius-style acceleration, clamped to the reference's supported
+    [0, 125] °C range (out-of-range queries clamp rather than fail, the
+    same recovery FaultModel.cc:189-201 applies).  float64 throughout: the
+    per-type probabilities are ~1e-7/cycle, below float32's epsilon around
+    1.0, so the union 1-∏(1-p) would round to zero in single precision."""
+    t = np.clip(np.asarray(temp_c, np.float64), 0.0, 125.0)
+    return np.exp((t - BASELINE_TEMPERATURE_C) / _TEMP_SCALE_C)
+
+
+class FaultModel:
+    """Per-router fault-probability calculator (garnet FaultModel parity).
+
+    Routers are declared with their geometry (heterogeneous meshes are
+    fine); queries are vectorized over routers and temperatures."""
+
+    def __init__(self) -> None:
+        self._geoms: list[RouterGeom] = []
+        self._base: np.ndarray | None = None     # (R, 10) at baseline temp
+
+    def declare_router(self, n_inputs: int, n_outputs: int,
+                       vcs_per_vnet: int, buffers_per_data_vc: int,
+                       buffers_per_ctrl_vc: int, n_vnets: int = 3,
+                       flit_bits: int = 128) -> int:
+        """Returns the router id (FaultModel.cc:136-146 contract; invalid
+        geometry raises instead of fatal())."""
+        if min(n_inputs, n_outputs, vcs_per_vnet) < 1 or min(
+                buffers_per_data_vc, buffers_per_ctrl_vc) < 1:
+            raise ValueError("declare_router: non-positive geometry")
+        self._geoms.append(RouterGeom(n_inputs, n_outputs, vcs_per_vnet,
+                                      n_vnets, buffers_per_data_vc,
+                                      buffers_per_ctrl_vc, flit_bits))
+        self._base = None
+        return len(self._geoms) - 1
+
+    @classmethod
+    def for_mesh(cls, cfg: NocConfig) -> "FaultModel":
+        """Declare every router of an X-Y mesh (5-port interior routers,
+        fewer ports on edges/corners — heterogeneity the reference's
+        nearest-configuration matching also models)."""
+        fm = cls()
+        for y in range(cfg.mesh_y):
+            for x in range(cfg.mesh_x):
+                ports = 1 + (x > 0) + (x < cfg.mesh_x - 1) \
+                          + (y > 0) + (y < cfg.mesh_y - 1)
+                fm.declare_router(ports, ports, cfg.vcs_per_vnet,
+                                  cfg.buffers_per_data_vc,
+                                  cfg.buffers_per_ctrl_vc,
+                                  n_vnets=cfg.n_vnets,
+                                  flit_bits=cfg.flit_bits)
+        return fm
+
+    @property
+    def n_routers(self) -> int:
+        return len(self._geoms)
+
+    def _baseline(self) -> np.ndarray:
+        if self._base is None:
+            rows = [_geom_bits(g) * _CLASS_RATE for g in self._geoms]
+            self._base = np.stack(rows) if rows else np.zeros((0, 10))
+        return self._base
+
+    def fault_vectors(self, temp_c=BASELINE_TEMPERATURE_C) -> np.ndarray:
+        """(R, 10) per-cycle fault probabilities for every router at once;
+        ``temp_c`` may be a scalar or a per-router (R,) vector.  Computed
+        host-side in float64 (see temperature_factor)."""
+        base = self._baseline()
+        f = np.broadcast_to(np.atleast_1d(temperature_factor(temp_c)),
+                            (self.n_routers,))
+        return base * f[:, None]
+
+    def fault_vector(self, router_id: int,
+                     temp_c=BASELINE_TEMPERATURE_C) -> np.ndarray:
+        return self.fault_vectors(temp_c)[router_id]
+
+    def fault_prob(self, router_id: int,
+                   temp_c=BASELINE_TEMPERATURE_C) -> float:
+        """Aggregate per-cycle fault probability (any type) for one router:
+        1 - ∏(1 - p_i), the exact union rather than the reference's sum."""
+        v = self.fault_vector(router_id, temp_c)
+        return float(1.0 - np.prod(1.0 - v))
+
+    def aggregate_prob(self, temp_c=BASELINE_TEMPERATURE_C) -> float:
+        """Whole-network per-cycle fault probability."""
+        v = np.asarray(self.fault_vectors(temp_c), np.float64)
+        return float(1.0 - np.prod(1.0 - v))
+
+    def mtbf_cycles(self, temp_c=BASELINE_TEMPERATURE_C) -> float:
+        p = self.aggregate_prob(temp_c)
+        return math.inf if p <= 0 else 1.0 / p
+
+    def summary(self) -> str:
+        lines = [f"FaultModel: {self.n_routers} routers"]
+        for r in range(self.n_routers):
+            v = self.fault_vector(r)
+            lines.append(f"  router {r}: aggregate/cycle "
+                         f"{self.fault_prob(r):.3e} "
+                         f"(max type {FAULT_TYPE_NAMES[int(v.argmax())]})")
+        return "\n".join(lines)
+
+
+# --------------------------------------------------------------------------
+# message-level injection over the MESI tier's traffic
+# --------------------------------------------------------------------------
+
+# message kinds
+MSG_REQ = 0          # L1 → home L2 request (GETS/GETX): control
+MSG_RESP = 1         # home L2 → L1 data response: data for a load/store miss
+MSG_WB = 2           # L1 → home L2 writeback (dirty eviction): data
+
+
+class MessageTrace(NamedTuple):
+    """Golden coherence traffic flattened to device arrays.
+
+    ``route`` holds the router ids each message traverses (X-Y dimension-
+    order routing), padded with -1; message m occupies ``route[m, h]`` at
+    cycle ``depart[m] + h``.  ``access`` is the AccessTrace index the
+    message serves (-1 for writebacks), ``is_load`` whether that access was
+    a load (its response value is architecturally consumed)."""
+
+    kind: jax.Array      # i32[M]
+    route: jax.Array     # i32[M, H] router ids, -1 padded
+    hops: jax.Array      # i32[M]
+    depart: jax.Array    # i32[M] network-entry cycle
+    access: jax.Array    # i32[M]
+    is_load: jax.Array   # bool[M]
+
+
+def _xy_route(src: int, dst: int, mesh_x: int) -> list[int]:
+    """Dimension-order (X then Y) route, inclusive of both endpoints."""
+    sx, sy = src % mesh_x, src // mesh_x
+    dx, dy = dst % mesh_x, dst // mesh_x
+    path = [src]
+    x, y = sx, sy
+    while x != dx:
+        x += 1 if dx > x else -1
+        path.append(y * mesh_x + x)
+    while y != dy:
+        y += 1 if dy > y else -1
+        path.append(y * mesh_x + x)
+    return path
+
+
+def build_message_trace(trace: AccessTrace, mesi_cfg: MesiConfig,
+                        noc_cfg: NocConfig,
+                        cycles_per_access: int = 4) -> MessageTrace:
+    """Replay the private-L1 hit/miss behavior of ``trace`` (same geometry
+    as the MESI tier) and emit the golden request/response/writeback
+    traffic.  Cores sit at routers 0..n_cores-1; a line's home L2 slice is
+    address-interleaved across all routers (the standard S-NUCA layout)."""
+    mesi_cfg.validate()
+    noc_cfg.validate()
+    core = np.asarray(trace.core)
+    word = np.asarray(trace.word)
+    is_store = np.asarray(trace.is_store)
+    wpl = mesi_cfg.words_per_line
+    n_routers = noc_cfg.n_routers
+    if mesi_cfg.n_cores > n_routers:
+        raise ValueError("more cores than mesh routers")
+
+    # per-core set-associative LRU directory of resident lines
+    tags = np.full((mesi_cfg.n_cores, mesi_cfg.n_sets, mesi_cfg.n_ways), -1,
+                   np.int64)
+    dirty = np.zeros_like(tags, dtype=bool)
+    lru = np.zeros_like(tags)
+    tick = 0
+
+    kind, routes, depart, access, is_load = [], [], [], [], []
+
+    def emit(k, src, dst, cyc, acc, ld):
+        kind.append(k)
+        routes.append(_xy_route(src, dst, noc_cfg.mesh_x))
+        depart.append(cyc)
+        access.append(acc)
+        is_load.append(ld)
+
+    for a in range(len(core)):
+        c = int(core[a])
+        line = int(word[a]) // wpl
+        s = line % mesi_cfg.n_sets
+        t = line // mesi_cfg.n_sets
+        cyc = a * cycles_per_access
+        home = line % n_routers
+        ways = tags[c, s]
+        hit = np.nonzero(ways == t)[0]
+        tick += 1
+        if hit.size:
+            w = int(hit[0])
+        else:
+            w = int(lru[c, s].argmin())
+            if tags[c, s, w] >= 0 and dirty[c, s, w]:
+                emit(MSG_WB, c, int(tags[c, s, w] * mesi_cfg.n_sets + s)
+                     % n_routers, cyc, -1, False)
+            emit(MSG_REQ, c, home, cyc, a, not bool(is_store[a]))
+            emit(MSG_RESP, home, c, cyc + 1, a, not bool(is_store[a]))
+            tags[c, s, w] = t
+            dirty[c, s, w] = False
+        if is_store[a]:
+            dirty[c, s, w] = True
+        lru[c, s, w] = tick
+
+    if not kind:       # all-hit stream: one NOP message keeps shapes static
+        emit(MSG_REQ, 0, 0, 0, -1, False)
+    hops = np.array([len(r) for r in routes], np.int32)
+    H = int(hops.max())
+    route = np.full((len(routes), H), -1, np.int32)
+    for m, r in enumerate(routes):
+        route[m, :len(r)] = r
+    return MessageTrace(
+        kind=jnp.asarray(kind, i32), route=jnp.asarray(route),
+        hops=jnp.asarray(hops), depart=jnp.asarray(depart, i32),
+        access=jnp.asarray(access, i32), is_load=jnp.asarray(is_load))
+
+
+class NocFault(NamedTuple):
+    """One trial: a fault of ``ftype`` at ``router`` on cycle ``cycle``."""
+
+    router: jax.Array
+    cycle: jax.Array
+    ftype: jax.Array
+
+
+# outcome of a fault type *given it hits a message*, by message kind.
+# Rationale (docstring of NocKernel): data corruption of a consumed data
+# payload is silent corruption; corrupted/lost/duplicated control and lost
+# credits surface as protocol assertions or timeouts (DUE); misrouting and
+# allocation/arbitration faults cost latency only (masked).
+_HIT_OUTCOME = np.zeros((N_FAULT_TYPES, 3), np.int32)
+_HIT_OUTCOME[FT_DATA_FEW_BITS] = (C.OUTCOME_DUE,   # malformed request
+                                  C.OUTCOME_SDC, C.OUTCOME_SDC)
+_HIT_OUTCOME[FT_DATA_ALL_BITS] = (C.OUTCOME_DUE,
+                                  C.OUTCOME_SDC, C.OUTCOME_SDC)
+_HIT_OUTCOME[FT_FLIT_DUP] = (C.OUTCOME_MASKED,     # TBE filters re-delivery
+                             C.OUTCOME_MASKED, C.OUTCOME_MASKED)
+_HIT_OUTCOME[FT_FLIT_LOSS] = (C.OUTCOME_DUE,       # timeout on every kind
+                              C.OUTCOME_DUE, C.OUTCOME_DUE)
+_HIT_OUTCOME[FT_MISROUTE] = (C.OUTCOME_MASKED,) * 3
+_HIT_OUTCOME[FT_CREDIT_GEN] = (C.OUTCOME_MASKED,) * 3
+_HIT_OUTCOME[FT_CREDIT_LOSS] = (C.OUTCOME_DUE,) * 3   # starves → deadlock
+_HIT_OUTCOME[FT_ALLOC_VC] = (C.OUTCOME_MASKED,) * 3
+_HIT_OUTCOME[FT_ALLOC_SW] = (C.OUTCOME_MASKED,) * 3
+_HIT_OUTCOME[FT_ARBITRATION] = (C.OUTCOME_MASKED,) * 3
+
+# response data for a store miss is overwritten by the store for the
+# faulted word often enough that treating it identically to a load would
+# over-report; the framework still calls it SDC only when architecturally
+# consumed — store-miss responses fill the rest of the line, so they stay
+# SDC.  Loads are unambiguous.
+
+
+class NocKernel:
+    """Campaign-facing NoC fault-injection kernel (run_keys/sampler
+    protocol, structure ``"router"``).
+
+    A trial samples (router, cycle, fault type) — router weighted uniformly,
+    type weighted by the FaultModel's per-router probabilities at the
+    configured temperature — and classifies it against the golden message
+    trace: a fault that coincides with no traversing message is masked;
+    otherwise the (type, message-kind) table above maps it to
+    masked/SDC/DUE."""
+
+    def __init__(self, msgs: MessageTrace, noc_cfg: NocConfig,
+                 fault_model: FaultModel | None = None):
+        noc_cfg.validate()
+        self.cfg = noc_cfg
+        self.msgs = msgs
+        self.fm = fault_model or FaultModel.for_mesh(noc_cfg)
+        self.n_cycles = int(np.asarray(msgs.depart).max()
+                            + np.asarray(msgs.hops).max() + 1)
+        # per-router type distribution (normalized fault vector)
+        fv = np.asarray(self.fm.fault_vectors(noc_cfg.temperature_c),
+                        np.float64)
+        self._type_cdf = jnp.asarray(
+            np.cumsum(fv / fv.sum(axis=1, keepdims=True), axis=1),
+            jnp.float32)
+
+    def sample_batch(self, keys: jax.Array, structure: str = "router"
+                     ) -> NocFault:
+        if structure != "router":
+            raise ValueError(f"unknown NoC structure {structure!r}")
+        cfg = self.cfg
+        cdf = self._type_cdf
+
+        def one(key):
+            ks = jax.random.split(key, 3)
+            r = jax.random.randint(ks[0], (), 0, cfg.n_routers, i32)
+            cyc = jax.random.randint(ks[1], (), 0, self.n_cycles, i32)
+            u = jax.random.uniform(ks[2], ())
+            ftype = jnp.sum(u >= cdf[r]).astype(i32)
+            return NocFault(router=r, cycle=cyc,
+                            ftype=jnp.minimum(ftype, N_FAULT_TYPES - 1))
+
+        return jax.vmap(one)(keys)
+
+    def sampler(self, structure: str = "router"):
+        k = self
+
+        class _S:
+            def sample_batch(self, keys):
+                return k.sample_batch(keys, structure)
+
+        return _S()
+
+    def _classify(self, f: NocFault) -> jax.Array:
+        m = self.msgs
+        # message m occupies route[m, h] at depart[m] + h
+        h = f.cycle - m.depart[:, None]
+        H = m.route.shape[1]
+        in_hop = (h >= 0) & (h < m.hops[:, None])
+        at_router = m.route == f.router
+        hit_pos = in_hop & at_router & (
+            jax.lax.broadcasted_iota(i32, m.route.shape, 1)
+            == jnp.clip(h, 0, H - 1))
+        hit_m = hit_pos.any(axis=1)
+        any_hit = hit_m.any()
+        # first (lowest-index) hit message decides the outcome
+        first = jnp.argmax(hit_m)
+        kind = m.kind[first]
+        table = jnp.asarray(_HIT_OUTCOME)
+        out = table[f.ftype, kind]
+        return jnp.where(any_hit, out, i32(C.OUTCOME_MASKED))
+
+    def outcomes_from_keys(self, keys: jax.Array,
+                           structure: str = "router") -> jax.Array:
+        faults = self.sample_batch(keys, structure)
+        return jax.vmap(self._classify)(faults)
+
+    def run_keys(self, keys: jax.Array, structure: str = "router"
+                 ) -> jax.Array:
+        return C.tally(self.outcomes_from_keys(keys, structure))

@@ -45,6 +45,11 @@ i32 = jnp.int32
 u32 = jnp.uint32
 
 LANE = 128          # TPU lane width; B_TILE and n must be multiples
+S_CHUNK = 128       # per-step golden streams arrive in (15, S_CHUNK) SMEM
+                    # blocks: the lowering block-shape check requires the
+                    # last dim divisible by 128 (a (15, 1) block is
+                    # rejected), and SMEM scalar reads take dynamic column
+                    # indices, so the kernel reads column i % S_CHUNK
 
 
 def _u(x):
@@ -193,10 +198,11 @@ def _alu_vec(op, a, b, imm):
 def _make_kernel(n: int, k: int, nphys: int, mem_words: int, may_latch: bool):
     """Grid-over-steps kernel: grid = (lane_tiles, n) with the step (µop)
     axis as the LAST, sequential ("arbitrary") grid dimension — the Pallas
-    pipeline delivers each step's golden scalars as a (15, 1)/(1, 1) SMEM
-    block, so there is no dynamic indexing anywhere in the body (Mosaic
-    rejects dynamic lane-dim loads, and a 4096-step ``fori_loop`` with this
-    body either hung or crashed the Mosaic pass — VERDICT r2 weak #1).
+    pipeline delivers the golden scalars as (15, S_CHUNK)/(1, S_CHUNK) SMEM
+    blocks and each step reads its column as SMEM scalars (dynamic SMEM
+    column indices are fine; it was dynamic *lane-dim VMEM* loads that
+    Mosaic rejected, and a 4096-step ``fori_loop`` with this body either
+    hung or crashed the Mosaic pass — VERDICT r2 weak #1).
     Deviation sets and outcome masks persist across steps in VMEM scratch;
     outputs are flushed on the final step of each lane tile."""
     idx_mask = nphys - 1          # python ints: no captured traced constants
@@ -212,6 +218,7 @@ def _make_kernel(n: int, k: int, nphys: int, mem_words: int, may_latch: bool):
         # (1, B) broadcasts cleanly against the (k, B) sets.
         B = kind_r.shape[1]
         i = pl.program_id(1)
+        j = i % S_CHUNK               # column inside the current SMEM block
         kind = kind_r[...]
         cycle = cycle_r[...]
         entry = entry_r[...]
@@ -260,8 +267,8 @@ def _make_kernel(n: int, k: int, nphys: int, mem_words: int, may_latch: bool):
         def remove(tags, tag, en):
             return jnp.where((tags == tag) & en, EMPTY_C, tags)
 
-        # per-step golden scalars (one (15,1) SMEM block per grid step;
-        # ordering matches _STREAM_ROWS in taint_fast_pallas)
+        # per-step golden scalars (column j of the (15, S_CHUNK) SMEM
+        # block; ordering matches the sv stack in taint_fast_pallas)
         tags = tags_sc[...]
         vals = vals_sc[...]
         live = live_sc[...] != 0
@@ -270,22 +277,22 @@ def _make_kernel(n: int, k: int, nphys: int, mem_words: int, may_latch: bool):
         div_i = div_sc[...]
         esc_i = esc_sc[...]
         ovf_i = ovf_sc[...]
-        op0 = sv_s[0, 0]
-        dstr = sv_s[1, 0]
-        s1 = sv_s[2, 0]
-        s2 = sv_s[3, 0]
-        imm0 = sv_s[4, 0]
-        tk = sv_s[5, 0]
-        g_a = sv_s[6, 0]
-        g_b = sv_s[7, 0]
-        g_ea = sv_s[8, 0]
-        g_res = sv_s[9, 0]
-        g_st_old = sv_s[10, 0]
-        g_dst_old = sv_s[11, 0]
-        g_wr = sv_s[12, 0] != 0
-        g_ld = sv_s[13, 0] != 0
-        g_st = sv_s[14, 0] != 0
-        sc = sc_s[0, 0]
+        op0 = sv_s[0, j]
+        dstr = sv_s[1, j]
+        s1 = sv_s[2, j]
+        s2 = sv_s[3, j]
+        imm0 = sv_s[4, j]
+        tk = sv_s[5, j]
+        g_a = sv_s[6, j]
+        g_b = sv_s[7, j]
+        g_ea = sv_s[8, j]
+        g_res = sv_s[9, j]
+        g_st_old = sv_s[10, j]
+        g_dst_old = sv_s[11, j]
+        g_wr = sv_s[12, j] != 0
+        g_ld = sv_s[13, j] != 0
+        g_st = sv_s[14, j] != 0
+        sc = sc_s[0, j]
 
         at_uop = entry == i
 
@@ -455,11 +462,12 @@ def taint_fast_pallas(gold: GoldenRecord, opcode, dst, src1, src2, imm,
     B = int(faults.kind.shape[0])
     B_pad = -(-B // b_tile) * b_tile
 
-    # Per-step golden scalars, packed (15, n) so each grid step fetches ONE
-    # (15, 1) SMEM block — scalar reads at constant indices, which is the
-    # only per-step access pattern Mosaic accepts (VERDICT r2 weak #1: the
-    # dynamic lane-dim VMEM reads were the "multiple of 128" compile
-    # failure on real TPU).  _make_kernel documents the row order.
+    # Per-step golden scalars, packed (15, n_pad): the grid pipeline hands
+    # the kernel (15, S_CHUNK) SMEM blocks (the smallest last-dim the
+    # lowering block-shape check admits) and each step reads its column as
+    # SMEM scalars — dynamic *lane-dim VMEM* reads were the "multiple of
+    # 128" Mosaic failure on real TPU (VERDICT r2 weak #1).
+    # _make_kernel documents the row order.
     sv = jnp.stack([
         jnp.asarray(opcode, i32), jnp.asarray(dst, i32),
         jnp.asarray(src1, i32), jnp.asarray(src2, i32),
@@ -470,6 +478,9 @@ def taint_fast_pallas(gold: GoldenRecord, opcode, dst, src1, src2, imm,
         gold.is_st.astype(i32),
     ])
     sc = jnp.asarray(shadow_cov, jnp.float32).reshape(1, -1)
+    n_pad = -(-n // S_CHUNK) * S_CHUNK
+    sv = jnp.pad(sv, ((0, 0), (0, n_pad - n)))
+    sc = jnp.pad(sc, ((0, 0), (0, n_pad - n)))
 
     def pad_lane(x, dtype=i32):
         x = jnp.asarray(x).astype(dtype).reshape(1, -1)
@@ -485,9 +496,9 @@ def taint_fast_pallas(gold: GoldenRecord, opcode, dst, src1, src2, imm,
 
     kernel = _make_kernel(n, k, nphys, mem_words, may_latch)
     grid = (B_pad // b_tile, n)
-    sv_spec = pl.BlockSpec((15, 1), lambda b, i: (0, i),
+    sv_spec = pl.BlockSpec((15, S_CHUNK), lambda b, i: (0, i // S_CHUNK),
                            memory_space=pltpu.SMEM)
-    sc_spec = pl.BlockSpec((1, 1), lambda b, i: (0, i),
+    sc_spec = pl.BlockSpec((1, S_CHUNK), lambda b, i: (0, i // S_CHUNK),
                            memory_space=pltpu.SMEM)
     lane_spec = pl.BlockSpec((1, b_tile), lambda b, i: (0, b),
                              memory_space=pltpu.VMEM)

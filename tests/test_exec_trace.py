@@ -1,0 +1,89 @@
+"""Exec-style tracer (trace/exec_trace.py; reference src/cpu/exetrace.cc)."""
+
+import io
+
+import numpy as np
+
+from shrewd_tpu.isa import uops as U
+from shrewd_tpu.trace import exec_trace as X
+from shrewd_tpu.trace.synth import WorkloadConfig, generate
+from shrewd_tpu.utils import debug
+
+
+def _trace(n=64, seed=2):
+    return generate(WorkloadConfig(n=n, nphys=64, mem_words=256,
+                                   working_set_words=64, seed=seed))
+
+
+def teardown_function(_fn):
+    debug.disable("Exec", "ExecResult", "ExecOpClass")
+
+
+def test_flag_gated_noop():
+    tr = _trace()
+    buf = io.StringIO()
+    assert X.exec_trace(tr, out=buf) == 0
+    assert buf.getvalue() == ""
+
+
+def test_basic_lines():
+    tr = _trace()
+    debug.enable("Exec")
+    buf = io.StringIO()
+    n = X.exec_trace(tr, out=buf, count=10)
+    lines = buf.getvalue().splitlines()
+    assert n == 10 and len(lines) == 10
+    assert lines[0].startswith("     0:")
+
+
+def test_execall_appends_results_and_opclass():
+    from shrewd_tpu.models.o3 import O3Config
+    from shrewd_tpu.ops.trial import TrialKernel
+
+    tr = _trace(n=32)
+    kern = TrialKernel(tr, O3Config(pallas="off"))
+    debug.enable("ExecAll")
+    buf = io.StringIO()
+    X.exec_trace(tr, kern.golden_rec, out=buf)
+    text = buf.getvalue()
+    assert "IntAlu" in text or "MemRead" in text
+    assert "D=0x" in text
+
+
+def test_disassemble_forms():
+    tr = _trace()
+    op = np.asarray(tr.opcode).copy()
+    op[0] = U.LOAD
+    op[1] = U.STORE
+    op[2] = U.ADDI
+    op[3] = U.NOP
+    tr = tr._replace(opcode=op)
+    assert X.disassemble(tr, 0).startswith("load")
+    assert "[r" in X.disassemble(tr, 1)
+    assert X.disassemble(tr, 2).startswith("addi")
+    assert X.disassemble(tr, 3) == "nop"
+
+
+def test_fault_annotation():
+    import jax.numpy as jnp
+
+    from shrewd_tpu.models.o3 import Fault, KIND_FU
+
+    tr = _trace(n=16)
+    debug.enable("Exec")
+    f = Fault(kind=jnp.int32(KIND_FU), cycle=jnp.int32(5),
+              entry=jnp.int32(5), bit=jnp.int32(3),
+              shadow_u=jnp.float32(1.0))
+    buf = io.StringIO()
+    X.exec_trace(tr, fault=f, out=buf)
+    marked = [ln for ln in buf.getvalue().splitlines() if "<-- fault" in ln]
+    assert len(marked) == 1 and marked[0].startswith("     5:")
+
+
+def test_cli_trace_subcommand(capsys):
+    from shrewd_tpu.main import main
+
+    rc = main(["trace", "-n", "8", "--all"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert len(out.splitlines()) == 8

@@ -1,0 +1,146 @@
+"""Memory-ordering checker (utils/memchecker.py; reference
+src/mem/mem_checker.hh readable-set semantics)."""
+
+import numpy as np
+import pytest
+
+from shrewd_tpu.models.mesi import MesiConfig, scalar_mesi, torture_stream
+from shrewd_tpu.utils import memchecker as MC
+from shrewd_tpu.trace.synth import WorkloadConfig, generate
+
+
+def _trace(n=256, seed=4):
+    return generate(WorkloadConfig(n=n, nphys=64, mem_words=256,
+                                   working_set_words=64, seed=seed))
+
+
+class TestSingleStream:
+    def test_golden_replay_is_clean(self):
+        from shrewd_tpu.isa.semantics import scalar_replay
+
+        tr = _trace()
+        reg = np.asarray(tr.init_reg, np.uint32).copy()
+        mem = np.asarray(tr.init_mem, np.uint32).copy()
+        observed = []
+        from shrewd_tpu.isa import uops as U
+        for i, ldv in _walk_loads(tr):
+            observed.append(ldv)
+        r = MC.check_trace(tr, observed_loads=np.asarray(observed,
+                                                         np.uint32))
+        assert r.n_violations == 0
+        assert r.n_loads > 0
+
+    def test_corrupted_load_detected(self):
+        tr = _trace()
+        observed = np.asarray([v for _, v in _walk_loads(tr)], np.uint32)
+        observed = observed.copy()
+        observed[len(observed) // 2] ^= 0x4
+        r = MC.check_trace(tr, observed_loads=observed)
+        assert r.n_violations >= 1
+        assert r.first_violation >= 0
+        assert "expected" in r.detail
+
+    def test_device_golden_record_is_clean(self):
+        """The device replay's golden record passes the checker — the
+        framework self-check this module exists for."""
+        from shrewd_tpu.models.o3 import O3Config
+        from shrewd_tpu.ops.trial import TrialKernel
+
+        tr = _trace(n=128, seed=6)
+        kern = TrialKernel(tr, O3Config())
+        r = MC.check_trace(tr, golden_record=kern.golden_rec)
+        assert r.n_violations == 0, r.detail
+
+
+def _walk_loads(tr):
+    """Independent helper: yields (µop, value) per load via scalar_replay's
+    contract (separate from expected_load_values' own walk)."""
+    from shrewd_tpu.isa import uops as U
+    from shrewd_tpu.isa.semantics import scalar_replay
+
+    reg = np.asarray(tr.init_reg, np.uint32).copy()
+    mem = np.asarray(tr.init_mem, np.uint32).copy()
+    rec = []
+    scalar_replay(tr, reg, mem, record_mem=rec)
+    # re-walk to capture values: simplest is a second pass recording loads
+    reg = np.asarray(tr.init_reg, np.uint32).copy()
+    mem = np.asarray(tr.init_mem, np.uint32).copy()
+    out = []
+    from shrewd_tpu.isa.semantics import alu
+    for i in range(tr.n):
+        op = int(tr.opcode[i])
+        a, b = int(reg[tr.src1[i]]), int(reg[tr.src2[i]])
+        res = alu(op, a, b, int(tr.imm[i]))
+        if op == U.LOAD:
+            v = int(mem[res >> 2])
+            out.append((i, v))
+            reg[tr.dst[i]] = v
+        elif op == U.STORE:
+            mem[res >> 2] = b
+        elif U.writes_dest(np.int64(op)):
+            reg[tr.dst[i]] = res
+    return out
+
+
+class TestTransactionWindows:
+    def test_simple_read_after_write(self):
+        mc = MC.MemChecker(np.zeros(4, np.uint32))
+        s = mc.start_write(0, 1, 0xAB)
+        mc.complete_write(s, 1, 1)
+        r = mc.start_read(2, 1)
+        assert mc.complete_read(r, 3, 1, 0xAB)
+        assert not mc.violations
+
+    def test_stale_read_flagged(self):
+        mc = MC.MemChecker(np.zeros(4, np.uint32))
+        s = mc.start_write(0, 1, 0xAB)
+        mc.complete_write(s, 1, 1)
+        r = mc.start_read(5, 1)
+        assert not mc.complete_read(r, 6, 1, 0x0)   # init value now stale
+        assert mc.violations
+        with pytest.raises(MC.MemoryViolation):
+            mc.assert_clean()
+
+    def test_overlapping_write_makes_both_values_legal(self):
+        mc = MC.MemChecker(np.zeros(4, np.uint32))
+        s1 = mc.start_write(0, 2, 0x11)
+        mc.complete_write(s1, 1, 2)
+        s2 = mc.start_write(2, 2, 0x22)        # overlaps the read below
+        r = mc.start_read(3, 2)
+        ok_either = mc.complete_read(r, 4, 2, 0x22)
+        assert ok_either                        # in-flight write readable
+        mc.complete_write(s2, 10, 2)
+        r2 = mc.start_read(11, 2)
+        assert mc.complete_read(r2, 12, 2, 0x22)
+        r3 = mc.start_read(13, 2)
+        assert not mc.complete_read(r3, 14, 2, 0x11)  # now stale
+
+    def test_initial_value_readable_before_any_write(self):
+        mc = MC.MemChecker(np.array([7, 8, 9], np.uint32))
+        r = mc.start_read(0, 2)
+        assert mc.complete_read(r, 1, 2, 9)
+
+    def test_unknown_serial_raises(self):
+        mc = MC.MemChecker()
+        with pytest.raises(KeyError):
+            mc.complete_write(99, 1, 0)
+
+
+class TestMesiIntegration:
+    def test_mesi_golden_loads_serializable(self):
+        cfg = MesiConfig()
+        tr = torture_stream(cfg, 128, mem_words=64, seed=2)
+        init = np.arange(64, dtype=np.uint32)
+        loads, _mem = scalar_mesi(tr, cfg, init)
+        assert MC.check_mesi_trace(tr, cfg, init, loads) == 0
+
+    def test_mesi_corrupted_load_caught(self):
+        cfg = MesiConfig()
+        tr = torture_stream(cfg, 128, mem_words=64, seed=2)
+        init = np.arange(64, dtype=np.uint32)
+        loads, _ = scalar_mesi(tr, cfg, init)
+        loads = np.asarray(loads, np.uint32).copy()
+        if loads.size == 0:
+            pytest.skip("no loads in stream")
+        loads[0] ^= 0x100
+        assert MC.check_mesi_trace(tr, cfg, init, loads) >= 1

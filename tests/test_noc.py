@@ -1,0 +1,196 @@
+"""NoC fault model: probability calculator + message-level injection
+(models/noc.py; reference garnet FaultModel.hh:59-126)."""
+
+import numpy as np
+import pytest
+
+from shrewd_tpu.models import noc as N
+from shrewd_tpu.models.mesi import MesiConfig, torture_stream
+from shrewd_tpu.ops import classify as C
+from shrewd_tpu.utils import prng
+
+
+def _model(**kw):
+    cfg = N.NocConfig(**kw)
+    return cfg, N.FaultModel.for_mesh(cfg)
+
+
+class TestFaultModel:
+    def test_mesh_declares_every_router(self):
+        cfg, fm = _model(mesh_x=3, mesh_y=2)
+        assert fm.n_routers == 6
+
+    def test_fault_vector_shape_and_range(self):
+        _, fm = _model()
+        v = fm.fault_vector(0)
+        assert v.shape == (N.N_FAULT_TYPES,)
+        assert (v > 0).all() and (v < 1e-3).all()
+
+    def test_vectorized_matches_scalar(self):
+        cfg, fm = _model(mesh_x=3, mesh_y=3)
+        all_v = np.asarray(fm.fault_vectors(80.0))
+        for r in range(fm.n_routers):
+            np.testing.assert_allclose(all_v[r], fm.fault_vector(r, 80.0),
+                                       rtol=1e-6)
+
+    def test_temperature_monotone_and_clamped(self):
+        _, fm = _model()
+        cold = fm.fault_prob(0, 10.0)
+        base = fm.fault_prob(0, N.BASELINE_TEMPERATURE_C)
+        hot = fm.fault_prob(0, 120.0)
+        assert cold < base < hot
+        # out-of-range clamps (FaultModel.cc:189-201 recovery, not a fail)
+        assert fm.fault_prob(0, 500.0) == fm.fault_prob(0, 125.0)
+        assert fm.fault_prob(0, -40.0) == fm.fault_prob(0, 0.0)
+
+    def test_bigger_buffers_raise_data_corruption(self):
+        _, small = _model(buffers_per_data_vc=1)
+        _, big = _model(buffers_per_data_vc=8)
+        assert (big.fault_vector(0)[N.FT_DATA_FEW_BITS]
+                > small.fault_vector(0)[N.FT_DATA_FEW_BITS])
+
+    def test_corner_router_less_vulnerable_than_interior(self):
+        cfg, fm = _model(mesh_x=3, mesh_y=3)
+        corner, interior = 0, 4           # (0,0) vs (1,1)
+        assert fm.fault_prob(corner) < fm.fault_prob(interior)
+
+    def test_aggregate_and_mtbf(self):
+        cfg, fm = _model()
+        agg = fm.aggregate_prob()
+        assert 0 < agg < 1
+        assert abs(fm.mtbf_cycles() * agg - 1.0) < 1e-6
+        assert fm.mtbf_cycles(120.0) < fm.mtbf_cycles(40.0)
+
+    def test_declare_router_validates(self):
+        fm = N.FaultModel()
+        with pytest.raises(ValueError):
+            fm.declare_router(0, 5, 4, 4, 1)
+
+    def test_type_names_cover_all(self):
+        assert len(N.FAULT_TYPE_NAMES) == N.N_FAULT_TYPES
+        assert N.fault_type_to_string(N.FT_MISROUTE) == "misrouting"
+
+
+def _msgs(n_accesses=64, seed=3, **noc_kw):
+    mcfg = MesiConfig()
+    ncfg = N.NocConfig(**noc_kw)
+    trace = torture_stream(mcfg, n_accesses, mem_words=64, seed=seed)
+    return trace, mcfg, ncfg, N.build_message_trace(trace, mcfg, ncfg)
+
+
+class TestMessageTrace:
+    def test_routes_are_adjacent_xy_paths(self):
+        _, _, ncfg, msgs = _msgs(mesh_x=3, mesh_y=2)
+        route = np.asarray(msgs.route)
+        hops = np.asarray(msgs.hops)
+        for m in range(route.shape[0]):
+            r = route[m, :hops[m]]
+            assert (r >= 0).all() and (r < ncfg.n_routers).all()
+            for a, b in zip(r, r[1:]):
+                ax, ay = a % ncfg.mesh_x, a // ncfg.mesh_x
+                bx, by = b % ncfg.mesh_x, b // ncfg.mesh_x
+                assert abs(ax - bx) + abs(ay - by) == 1
+            assert (route[m, hops[m]:] == -1).all()
+
+    def test_misses_emit_request_and_response(self):
+        _, _, _, msgs = _msgs()
+        kind = np.asarray(msgs.kind)
+        assert (kind == N.MSG_REQ).sum() == (kind == N.MSG_RESP).sum()
+        assert (kind == N.MSG_REQ).sum() > 0
+
+    def test_repeat_access_hits_after_fill(self):
+        """The same core touching the same word twice misses only once."""
+        import jax.numpy as jnp
+        mcfg = MesiConfig()
+        ncfg = N.NocConfig()
+        trace_args = dict(
+            core=jnp.zeros(2, jnp.int32), word=jnp.zeros(2, jnp.int32),
+            is_store=jnp.zeros(2, bool), value=jnp.zeros(2, jnp.uint32))
+        from shrewd_tpu.models.mesi import AccessTrace
+        msgs = N.build_message_trace(AccessTrace(**trace_args), mcfg, ncfg)
+        assert (np.asarray(msgs.kind) == N.MSG_REQ).sum() == 1
+
+
+class TestNocKernel:
+    def test_tally_sums_to_batch(self):
+        _, _, ncfg, msgs = _msgs()
+        kern = N.NocKernel(msgs, ncfg)
+        keys = prng.trial_keys(prng.campaign_key(0), 128)
+        tally = np.asarray(kern.run_keys(keys))
+        assert tally.sum() == 128
+
+    def test_fault_off_route_is_masked(self):
+        _, _, ncfg, msgs = _msgs(mesh_x=4, mesh_y=4)
+        kern = N.NocKernel(msgs, ncfg)
+        route = np.asarray(msgs.route)
+        used = set(route[route >= 0].ravel().tolist())
+        idle = [r for r in range(ncfg.n_routers) if r not in used]
+        if not idle:
+            pytest.skip("every router carries traffic")
+        import jax.numpy as jnp
+        f = N.NocFault(router=jnp.int32(idle[0]), cycle=jnp.int32(1),
+                       ftype=jnp.int32(N.FT_FLIT_LOSS))
+        assert int(kern._classify(f)) == C.OUTCOME_MASKED
+
+    def test_flit_loss_on_message_is_due(self):
+        import jax.numpy as jnp
+        _, _, ncfg, msgs = _msgs()
+        kern = N.NocKernel(msgs, ncfg)
+        r0 = int(np.asarray(msgs.route)[0, 0])
+        c0 = int(np.asarray(msgs.depart)[0])
+        f = N.NocFault(router=jnp.int32(r0), cycle=jnp.int32(c0),
+                       ftype=jnp.int32(N.FT_FLIT_LOSS))
+        assert int(kern._classify(f)) == C.OUTCOME_DUE
+
+    def test_data_corruption_on_response_is_sdc(self):
+        import jax.numpy as jnp
+        _, _, ncfg, msgs = _msgs()
+        kind = np.asarray(msgs.kind)
+        resp = int(np.nonzero(kind == N.MSG_RESP)[0][0])
+        kern = N.NocKernel(msgs, ncfg)
+        f = N.NocFault(
+            router=jnp.int32(np.asarray(msgs.route)[resp, 0]),
+            cycle=jnp.int32(np.asarray(msgs.depart)[resp]),
+            ftype=jnp.int32(N.FT_DATA_FEW_BITS))
+        out = int(kern._classify(f))
+        assert out in (C.OUTCOME_SDC, C.OUTCOME_DUE)  # DUE if a REQ shares
+        # pin the unambiguous case: isolate on a cycle/router where only
+        # the response sits
+        route = np.asarray(msgs.route)
+        depart = np.asarray(msgs.depart)
+        hops = np.asarray(msgs.hops)
+        for h in range(int(hops[resp])):
+            r, c = int(route[resp, h]), int(depart[resp]) + h
+            others = [m for m in range(route.shape[0]) if m != resp
+                      and 0 <= c - depart[m] < hops[m]
+                      and route[m, c - depart[m]] == r]
+            if not others:
+                f = N.NocFault(router=jnp.int32(r), cycle=jnp.int32(c),
+                               ftype=jnp.int32(N.FT_DATA_FEW_BITS))
+                assert int(kern._classify(f)) == C.OUTCOME_SDC
+                return
+        pytest.skip("response never alone at a router")
+
+    def test_type_distribution_follows_fault_vector(self):
+        """Sampled fault types should favor the dominant (SRAM) classes."""
+        _, _, ncfg, msgs = _msgs()
+        kern = N.NocKernel(msgs, ncfg)
+        keys = prng.trial_keys(prng.campaign_key(7), 512)
+        f = kern.sample_batch(keys)
+        types = np.asarray(f.ftype)
+        assert (types >= 0).all() and (types < N.N_FAULT_TYPES).all()
+        data_frac = ((types == N.FT_DATA_FEW_BITS)
+                     | (types == N.FT_DATA_ALL_BITS)).mean()
+        assert data_frac > 0.5        # buffer SRAM dominates the area model
+
+    def test_hot_die_raises_aggregate_but_not_distribution_shape(self):
+        _, _, ncfg, msgs = _msgs()
+        hot_cfg = N.NocConfig(temperature_c=110.0)
+        kern_hot = N.NocKernel(msgs, hot_cfg)
+        kern_base = N.NocKernel(msgs, ncfg)
+        # scaling is uniform across types → sampled distribution unchanged
+        np.testing.assert_allclose(np.asarray(kern_hot._type_cdf),
+                                   np.asarray(kern_base._type_cdf),
+                                   atol=1e-6)
+        assert (kern_hot.fm.aggregate_prob(110.0)
+                > kern_base.fm.aggregate_prob())

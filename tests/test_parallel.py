@@ -107,3 +107,65 @@ def test_trials_needed_monotone():
     assert (stopping.trials_needed(0.5, 0.01)
             > stopping.trials_needed(0.5, 0.02)
             > stopping.trials_needed(0.05, 0.02))
+
+
+class TestDeviceResolution:
+    """In-graph budgeted escape resolution (VERDICT r2 weak #9)."""
+
+    def _kernel(self, **cfg_kw):
+        from shrewd_tpu.models.o3 import O3Config
+        from shrewd_tpu.ops.trial import TrialKernel
+        from shrewd_tpu.trace.synth import WorkloadConfig, generate
+
+        tr = generate(WorkloadConfig(n=192, nphys=64, mem_words=128,
+                                     working_set_words=32, seed=13))
+        return TrialKernel(tr, O3Config(replay_kernel="hybrid", **cfg_kw))
+
+    def test_device_matches_host_resolution(self):
+        from shrewd_tpu.parallel import make_mesh
+        mesh8 = make_mesh()
+        import numpy as np
+
+        from shrewd_tpu.parallel.campaign import ShardedCampaign
+        from shrewd_tpu.utils import prng
+
+        kernel = self._kernel()
+        keys = prng.trial_keys(prng.campaign_key(5), 512)
+        dev = ShardedCampaign(kernel, mesh8, "lsq", resolution="device")
+        host = ShardedCampaign(self._kernel(), mesh8, "lsq",
+                               resolution="host")
+        t_dev = np.asarray(dev.tally_batch(keys))
+        t_host = np.asarray(host.tally_batch(keys))
+        assert t_dev.sum() == t_host.sum() == 512
+        np.testing.assert_array_equal(t_dev, t_host)
+
+    def test_zero_budget_is_conservative(self):
+        import numpy as np
+
+        from shrewd_tpu.ops import classify as C
+        from shrewd_tpu.utils import prng
+
+        kernel = self._kernel(escape_budget=0)
+        exact = self._kernel()
+        keys = prng.trial_keys(prng.campaign_key(6), 256)
+        t0, n0 = (np.asarray(x) for x in kernel.run_keys_device(keys, "lsq"))
+        t1, n1 = (np.asarray(x) for x in exact.run_keys_device(keys, "lsq"))
+        assert t0.sum() == t1.sum() == 256
+        assert n0 == n1                       # same faults, same escapes
+        # conservative path can only move mass INTO the SDC bucket
+        assert t0[C.OUTCOME_SDC] >= t1[C.OUTCOME_SDC]
+
+    def test_device_matches_single_chip_hybrid(self):
+        from shrewd_tpu.parallel import make_mesh
+        mesh8 = make_mesh()
+        import numpy as np
+
+        from shrewd_tpu.parallel.campaign import ShardedCampaign
+        from shrewd_tpu.utils import prng
+
+        kernel = self._kernel()
+        keys = prng.trial_keys(prng.campaign_key(7), 256)
+        camp = ShardedCampaign(kernel, mesh8, "regfile")
+        sharded = np.asarray(camp.tally_batch(keys))
+        single = np.asarray(self._kernel().run_keys(keys, "regfile"))
+        np.testing.assert_array_equal(sharded, single)
