@@ -35,7 +35,7 @@ from typing import NamedTuple
 import numpy as np
 
 from shrewd_tpu.ingest.lift import (Inst, NativeTrace, Operand, _CMOV,
-                                    static_decode)
+                                    static_decode, stem_of)
 
 M8, M16, M32, M64 = 0xFF, 0xFFFF, 0xFFFFFFFF, 0xFFFFFFFFFFFFFFFF
 RAX, RCX, RDX, RBX, RSP, RBP, RSI, RDI = range(8)
@@ -44,6 +44,11 @@ R11 = 11
 _ALU = {"add", "sub", "and", "or", "xor", "imul"}
 _SHIFT = {"shl": "shl", "sal": "shl", "shr": "shr", "sar": "sar",
           "rol": "rol", "ror": "ror"}
+
+# one shared suffix-strip rule with the lifter (lift.stem_of): the rstrip
+# bug this replaced existed in both files precisely because the logic was
+# duplicated
+_stem = stem_of
 
 _JCC = {"je": "e", "jz": "e", "jne": "ne", "jnz": "ne",
         "jb": "b", "jnae": "b", "jae": "ae", "jnb": "ae",
@@ -221,6 +226,11 @@ class Emulator:
     def ea(self, op: Operand) -> int:
         if op.base == -3:
             raise StopEmu("unparsed mem operand")
+        if op.base == -5:
+            # %gs:disp — the capture records fs_base only; resolving gs
+            # against fs_base would silently read the wrong TLS block, so
+            # stop loudly (the trial classifies DUE, never silent skew)
+            raise StopEmu("gs-relative access (no gs_base captured)")
         if op.base == -4:
             # %fs:disp — TLS-relative.  With a captured fs_base the real
             # TLS block is in the writable-memory snapshot (pointer guard
@@ -345,8 +355,7 @@ class Emulator:
         elif m in ("lea", "leaq", "leal"):
             src, dst = ops
             self.write(inst, dst, w, self.ea(src) & mask)
-        elif m.rstrip("bwlq") in _ALU or m in _ALU:
-            stem = m if m in _ALU else m.rstrip("bwlq")
+        elif (stem := _stem(m, _ALU)) is not None:
             if stem == "imul" and len(ops) == 3:
                 immv, src, dst = ops
                 r = sx(self.read(inst, src, w), w) * immv.imm
@@ -369,8 +378,8 @@ class Emulator:
                     r = {"and": a & b, "or": a | b, "xor": a ^ b}[stem]
                     self.set_flags_res(r & mask, w)
                 self.write(inst, dst, w, r & mask)
-        elif m.rstrip("bwlq") in _SHIFT or m in _SHIFT:
-            stem = _SHIFT[m if m in _SHIFT else m.rstrip("bwlq")]
+        elif (sh_stem := _stem(m, _SHIFT)) is not None:
+            stem = _SHIFT[sh_stem]
             if len(ops) == 1:
                 ops = [Operand("imm", imm=1)] + ops
             src, dst = ops
@@ -391,8 +400,7 @@ class Emulator:
             self.write(inst, dst, w, r & mask)
             if sh and stem not in ("rol", "ror"):
                 self.set_flags_res(r & mask, w)
-        elif m.rstrip("lqwb") in ("inc", "dec", "neg", "not"):
-            stem = m.rstrip("lqwb")
+        elif (stem := _stem(m, ("inc", "dec", "neg", "not"))) is not None:
             d = ops[0]
             a = self.read(inst, d, w)
             if stem == "inc":
@@ -407,11 +415,11 @@ class Emulator:
             else:
                 r = ~a
             self.write(inst, d, w, r & mask)
-        elif m.rstrip("bwlq") == "cmp" or m == "cmp":
+        elif _stem(m, ("cmp",)) is not None:
             src, dst = ops
             self.set_flags_sub(self.read(inst, dst, w),
                                self.read(inst, src, w), w)
-        elif m.rstrip("bwlq") == "test" or m == "test":
+        elif _stem(m, ("test",)) is not None:
             a, b = ops
             self.set_flags_res(self.read(inst, a, w)
                                & self.read(inst, b, w), w)

@@ -83,19 +83,47 @@ def build_tools(workload_c: str = "workloads/sort.c",
                       syms["kernel_end"])
 
 
-def capture_and_lift(paths: BuildPaths, build_dir: Path | None = None,
-                     max_steps: int = 2_000_000):
-    from shrewd_tpu.ingest.lift import lift
+def _capture(paths: BuildPaths, suffix: str, consume,
+             build_dir: Path | None = None, max_steps: int = 2_000_000):
+    """Run the ptrace capture tool into a temp file and hand the file to
+    ``consume`` (deleted afterwards) — the one place that knows the tracer
+    CLI contract."""
     bd = build_dir or (REPO / "tests" / "_build")
-    trace_bin = bd / f"{paths.workload.name}_trace.{os.getpid()}.bin"
+    trace_bin = bd / f"{paths.workload.name}_{suffix}.{os.getpid()}.bin"
     try:
         subprocess.run([str(paths.tracer), str(trace_bin),
                         f"{paths.begin:x}", f"{paths.end:x}",
                         str(max_steps), str(paths.workload)],
                        check=True, capture_output=True, text=True)
-        return lift(str(trace_bin), str(paths.workload))
+        return consume(trace_bin)
     finally:
         trace_bin.unlink(missing_ok=True)
+
+
+def capture_and_lift(paths: BuildPaths, build_dir: Path | None = None,
+                     max_steps: int = 2_000_000):
+    from shrewd_tpu.ingest.lift import lift
+    return _capture(paths, "trace",
+                    lambda p: lift(str(p), str(paths.workload)),
+                    build_dir, max_steps)
+
+
+def capture_window_macro_ops(paths: BuildPaths,
+                             build_dir: Path | None = None,
+                             max_steps: int = 2_000_000) -> int:
+    """Marker-to-marker macro-op count from a raw capture — no lift.
+
+    The emu64 mode replays the raw capture itself, so paying the full
+    operand-parse + dataflow-lift + self-check pass of ``lift()`` just to
+    learn the window length wasted the dominant share of its setup time."""
+    from shrewd_tpu.ingest.lift import read_nativetrace
+
+    def count(p):
+        # the trailing record is state-at-end, not an executed step (the
+        # same convention lift() uses: n_macro = len(steps) - 1)
+        return max(len(read_nativetrace(p).steps) - 1, 0)
+
+    return _capture(paths, "win", count, build_dir, max_steps)
 
 
 def capture_and_lift_to_output(paths: BuildPaths,
@@ -425,26 +453,24 @@ def run_diff(n_trials: int = 500, seed: int = 0,
 
     paths = build_tools(workload_c)
     lv = None
+    meta = None
     if mode == "emu64":
-        trace = meta = None
-        window = None      # window measured below from the host capture
-    elif mode == "output":
-        trace, meta = capture_and_lift_to_output(paths)
-        window = meta["window_macro_ops"]
-    else:
-        trace, meta = capture_and_lift(paths)
-        window = meta["macro_ops"]
-        if mode == "liveness":
-            from shrewd_tpu.ingest.liveness import post_window_liveness
-            lv = post_window_liveness(paths, meta["clusters"])
-    if mode == "emu64":
-        # window length from a quick marker-to-marker capture
-        trace, meta = capture_and_lift(paths)
-        window = meta["macro_ops"]
+        # the emulator replays the raw capture — only the marker-window
+        # *length* is needed, not a full lift of the window
+        window = capture_window_macro_ops(paths)
         coords = sample_coords(n_trials, window, seed, bit_range=64)
         host = run_host(paths, coords)
         dev = run_device_emu64(paths, coords)
     else:
+        if mode == "output":
+            trace, meta = capture_and_lift_to_output(paths)
+            window = meta["window_macro_ops"]
+        else:
+            trace, meta = capture_and_lift(paths)
+            window = meta["macro_ops"]
+            if mode == "liveness":
+                from shrewd_tpu.ingest.liveness import post_window_liveness
+                lv = post_window_liveness(paths, meta["clusters"])
         coords = sample_coords(n_trials, window, seed)
         host = run_host(paths, coords)
         dev = run_device(trace, meta, coords, liveness=lv)
@@ -452,7 +478,9 @@ def run_diff(n_trials: int = 500, seed: int = 0,
     rep["workload"] = workload_c
     rep["seed"] = seed
     rep["mode"] = mode
-    rep["lift_stats"] = meta["stats"]
+    if meta is not None:
+        rep["lift_stats"] = meta["stats"]
+    rep["window_macro_ops_sampled"] = window
     if mode == "output":
         rep["window_macro_ops"] = window
         rep["output_words"] = len(meta["output_words"])

@@ -173,11 +173,16 @@ def _parse_operand(tok: str, comment_addr: int | None) -> Operand | None:
         if name == "rip":
             return None
         if name.startswith(("fs:", "gs:")):
-            # TLS-relative absolute ("%fs:0x30"): base=-4 marks a
-            # segment-relative address — unmappable for the lifter (demote)
-            # but emulable against a synthetic TLS block (ingest/emu.py)
+            # Segment-relative absolute ("%fs:0x30"): base=-4 marks an
+            # fs-relative address — unmappable for the lifter (demote) but
+            # emulable against the captured fs_base (ingest/emu.py).
+            # %gs: gets its OWN code (-5): no gs_base is captured, and
+            # resolving it against fs_base would silently read the wrong
+            # TLS block — the emulator stops loudly instead.
             try:
-                return Operand("mem", base=-4, disp=int(name[3:], 0))
+                return Operand("mem",
+                               base=-4 if name.startswith("fs:") else -5,
+                               disp=int(name[3:], 0))
             except ValueError:
                 return Operand("mem", base=-3)
         return Operand("reg", reg=-2)           # non-GPR (xmm, seg, ...)
@@ -327,6 +332,23 @@ _ALU2 = {  # mnemonic stem -> opcode for reg/reg (dst = dst OP src)
     "add": U.ADD, "sub": U.SUB, "and": U.AND, "or": U.OR, "xor": U.XOR,
     "imul": U.MUL,
 }
+
+
+def stem_of(m: str, *tables) -> str | None:
+    """objdump size-suffix stripping, shared by the lifter and the
+    emulator: strip at most ONE trailing b/w/l/q, and only when the
+    remainder is in one of ``tables``.  ``rstrip("bwlq")`` eats stem
+    letters — "subl" → "su", "roll" → "ro", "imulq" → "imu" — silently
+    demoting suffixed memory-operand forms."""
+    for t in tables:
+        if m in t:
+            return m
+    if len(m) > 1 and m[-1] in "bwlq":
+        c = m[:-1]
+        for t in tables:
+            if c in t:
+                return c
+    return None
 _SHIFTS = {"shl": U.SLL, "sal": U.SLL, "shr": U.SRL, "sar": U.SRA}
 
 _JCC_SIGNED = {  # cond after cmp(src=b, dst=a): flags of a-b
@@ -378,7 +400,7 @@ class Lifter:
 
     def _ea_of(self, op: Operand, regs: np.ndarray) -> int | None:
         """Full-64-bit effective address from captured registers."""
-        if op.base in (-3, -4):
+        if op.base in (-3, -4, -5):
             return None
         ea = op.disp
         if op.rip_rel:
@@ -419,7 +441,7 @@ class Lifter:
             if inst.mnemonic in ("pop", "popq"):
                 touched.setdefault(pc, set()).add(int(steps[i][4]))
             for op in inst.operands:
-                if op.kind != "mem" or op.base in (-3, -4):
+                if op.kind != "mem" or op.base in (-3, -4, -5):
                     continue
                 ea = self._ea_of(op, steps[i])
                 if ea is not None:
@@ -984,7 +1006,7 @@ class Lifter:
             return True
 
         # --- two-operand ALU ---
-        stem = m.rstrip("lqwb") if m not in _ALU2 else m
+        stem = stem_of(m, _ALU2, _SHIFTS) or m
         if m in _ALU2 or stem in _ALU2:
             opcode = _ALU2.get(m, _ALU2.get(stem))
             rws = [abs(o.width) for o in ops

@@ -97,8 +97,13 @@ def choose_simpoints(profile: BBVProfile, k: int,
     for _ in range(k - 1):
         d2 = np.min(
             [((x - c) ** 2).sum(axis=1) for c in centers], axis=0)
-        p = d2 / max(d2.sum(), 1e-12)
-        centers.append(x[int(rng.choice(n_iv, p=p))])
+        tot = float(d2.sum())
+        if tot <= 0.0:
+            # every interval coincides with an existing center (phase-
+            # homogeneous workload): fewer clusters than requested
+            break
+        centers.append(x[int(rng.choice(n_iv, p=d2 / tot))])
+    k = len(centers)
     c = np.stack(centers)
     labels = np.zeros(n_iv, dtype=np.int64)
     for _ in range(iters):
@@ -114,10 +119,16 @@ def choose_simpoints(profile: BBVProfile, k: int,
     for j in range(k):
         sel = np.nonzero(labels == j)[0]
         if len(sel) == 0:
-            reps[j] = int(d[:, j].argmin())
-            continue
+            continue                  # dropped below (weight stays 0)
         reps[j] = sel[d[sel, j].argmin()]
         weights[j] = len(sel) / n_iv
+    # drop empty clusters: a zero-weight representative contributes nothing
+    # to the weighted AVF but would still cost an emulate+lift pass
+    keep = np.nonzero(weights > 0)[0]
+    remap = np.full(k, -1, dtype=np.int64)
+    remap[keep] = np.arange(len(keep))
+    reps, weights = reps[keep], weights[keep]
+    labels = remap[labels]            # empty clusters had no members
     weights /= max(weights.sum(), 1e-12)
     return SimPoints(intervals=reps, weights=weights, labels=labels)
 

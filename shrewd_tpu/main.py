@@ -110,8 +110,10 @@ def cmd_trace(args) -> int:
     from shrewd_tpu.trace.exec_trace import exec_trace
     from shrewd_tpu.utils import debug
 
-    if not debug.enabled("Exec"):
-        debug.enable("ExecAll" if args.all else "Exec")
+    if args.all:
+        debug.enable("ExecAll")
+    elif not debug.enabled("Exec"):
+        debug.enable("Exec")
     if args.results:
         debug.enable("ExecResult")
     if args.workload:
@@ -122,7 +124,7 @@ def cmd_trace(args) -> int:
     else:
         from shrewd_tpu.trace.synth import WorkloadConfig, generate
 
-        tr = generate(WorkloadConfig(n=args.n or 256, nphys=64,
+        tr = generate(WorkloadConfig(n=args.window, nphys=64,
                                      mem_words=1024,
                                      working_set_words=256,
                                      seed=args.seed))
@@ -191,6 +193,8 @@ def main(argv: list[str] | None = None) -> int:
                    help="C workload to capture+lift (default: synth trace)")
     p.add_argument("--start", type=int, default=0)
     p.add_argument("-n", type=int, default=64, help="µops to print")
+    p.add_argument("--window", type=int, default=256,
+                   help="synthetic window length (independent of -n)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--all", action="store_true",
                    help="ExecAll (results + opclasses)")

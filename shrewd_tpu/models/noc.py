@@ -269,16 +269,16 @@ class MessageTrace(NamedTuple):
 
     ``route`` holds the router ids each message traverses (X-Y dimension-
     order routing), padded with -1; message m occupies ``route[m, h]`` at
-    cycle ``depart[m] + h``.  ``access`` is the AccessTrace index the
-    message serves (-1 for writebacks), ``is_load`` whether that access was
-    a load (its response value is architecturally consumed)."""
+    cycle ``depart[m] + h``.  Outcomes depend only on the message *kind*:
+    both load- and store-miss responses carry line data whose corruption
+    is architecturally consumed (the store overwrites at most its own
+    word; the rest of the fill stays live), so no per-access metadata is
+    kept."""
 
     kind: jax.Array      # i32[M]
     route: jax.Array     # i32[M, H] router ids, -1 padded
     hops: jax.Array      # i32[M]
     depart: jax.Array    # i32[M] network-entry cycle
-    access: jax.Array    # i32[M]
-    is_load: jax.Array   # bool[M]
 
 
 def _xy_route(src: int, dst: int, mesh_x: int) -> list[int]:
@@ -320,14 +320,12 @@ def build_message_trace(trace: AccessTrace, mesi_cfg: MesiConfig,
     lru = np.zeros_like(tags)
     tick = 0
 
-    kind, routes, depart, access, is_load = [], [], [], [], []
+    kind, routes, depart = [], [], []
 
-    def emit(k, src, dst, cyc, acc, ld):
+    def emit(k, src, dst, cyc):
         kind.append(k)
         routes.append(_xy_route(src, dst, noc_cfg.mesh_x))
         depart.append(cyc)
-        access.append(acc)
-        is_load.append(ld)
 
     for a in range(len(core)):
         c = int(core[a])
@@ -345,9 +343,9 @@ def build_message_trace(trace: AccessTrace, mesi_cfg: MesiConfig,
             w = int(lru[c, s].argmin())
             if tags[c, s, w] >= 0 and dirty[c, s, w]:
                 emit(MSG_WB, c, int(tags[c, s, w] * mesi_cfg.n_sets + s)
-                     % n_routers, cyc, -1, False)
-            emit(MSG_REQ, c, home, cyc, a, not bool(is_store[a]))
-            emit(MSG_RESP, home, c, cyc + 1, a, not bool(is_store[a]))
+                     % n_routers, cyc)
+            emit(MSG_REQ, c, home, cyc)
+            emit(MSG_RESP, home, c, cyc + 1)
             tags[c, s, w] = t
             dirty[c, s, w] = False
         if is_store[a]:
@@ -355,7 +353,7 @@ def build_message_trace(trace: AccessTrace, mesi_cfg: MesiConfig,
         lru[c, s, w] = tick
 
     if not kind:       # all-hit stream: one NOP message keeps shapes static
-        emit(MSG_REQ, 0, 0, 0, -1, False)
+        emit(MSG_REQ, 0, 0, 0)
     hops = np.array([len(r) for r in routes], np.int32)
     H = int(hops.max())
     route = np.full((len(routes), H), -1, np.int32)
@@ -363,8 +361,7 @@ def build_message_trace(trace: AccessTrace, mesi_cfg: MesiConfig,
         route[m, :len(r)] = r
     return MessageTrace(
         kind=jnp.asarray(kind, i32), route=jnp.asarray(route),
-        hops=jnp.asarray(hops), depart=jnp.asarray(depart, i32),
-        access=jnp.asarray(access, i32), is_load=jnp.asarray(is_load))
+        hops=jnp.asarray(hops), depart=jnp.asarray(depart, i32))
 
 
 class NocFault(NamedTuple):
@@ -395,13 +392,6 @@ _HIT_OUTCOME[FT_CREDIT_LOSS] = (C.OUTCOME_DUE,) * 3   # starves → deadlock
 _HIT_OUTCOME[FT_ALLOC_VC] = (C.OUTCOME_MASKED,) * 3
 _HIT_OUTCOME[FT_ALLOC_SW] = (C.OUTCOME_MASKED,) * 3
 _HIT_OUTCOME[FT_ARBITRATION] = (C.OUTCOME_MASKED,) * 3
-
-# response data for a store miss is overwritten by the store for the
-# faulted word often enough that treating it identically to a load would
-# over-report; the framework still calls it SDC only when architecturally
-# consumed — store-miss responses fill the rest of the line, so they stay
-# SDC.  Loads are unambiguous.
-
 
 class NocKernel:
     """Campaign-facing NoC fault-injection kernel (run_keys/sampler

@@ -190,7 +190,8 @@ class FaultSampler:
     LSQ faults) from the trace on the host.
     """
 
-    def __init__(self, trace: Trace, structure: str, cfg: O3Config):
+    def __init__(self, trace: Trace, structure: str, cfg: O3Config,
+                 scoreboard=None):
         if structure not in STRUCTURES:
             raise KeyError(f"unknown structure {structure!r} "
                            f"(known: {sorted(STRUCTURES)})")
@@ -216,12 +217,15 @@ class FaultSampler:
         self._res: ResidencySampler | None = None
         if cfg.timing == "scoreboard" and structure in ("rob", "iq", "lsq",
                                                         "fu"):
-            sb = compute_scoreboard(trace, cfg.timing_cfg)
+            # the scoreboard is per-(trace, timing_cfg); TrialKernel passes
+            # its cached one so four samplers don't redo the O(n) host walk
+            sb = scoreboard if scoreboard is not None else \
+                compute_scoreboard(trace, cfg.timing_cfg)
             mem_mask = np.asarray(U.is_mem(trace.opcode))
             start, end = sb.occupancy(structure,
                                       mem_mask if structure == "lsq"
                                       else None)
-            self._res = ResidencySampler(start, end, sb.issue)
+            self._res = ResidencySampler(start, end)
             self._store_mask = jnp.asarray(U.is_store(trace.opcode))
 
     def sample(self, key: jax.Array) -> Fault:

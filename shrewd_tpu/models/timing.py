@@ -198,31 +198,26 @@ def compute_scoreboard(trace, cfg: TimingConfig | None = None) -> Scoreboard:
 
 
 class ResidencySampler:
-    """Occupancy-weighted (µop, landing-step) draws on device.
+    """Occupancy-weighted µop draws on device.
 
     A draw is uniform over the structure's total residency mass
-    Σᵢ(endᵢ - startᵢ): one randint + two searchsorteds.  The landing *step*
-    (program-order replay index) for the struck cycle t is the number of
-    µops issued at or before t — issue times are nearly monotone in program
-    order, so this is the program-order point at which the corruption
-    becomes visible to later readers."""
+    Σᵢ(endᵢ - startᵢ): one randint + one searchsorted into the cumulative
+    table.  The returned landing step equals the struck µop — every
+    non-REGFILE fault kind applies when its µop executes (``at_uop`` in the
+    replay kernels), so that is the program-order point the corruption
+    takes effect."""
 
-    def __init__(self, start: np.ndarray, end: np.ndarray,
-                 issue: np.ndarray):
+    def __init__(self, start: np.ndarray, end: np.ndarray):
         length = np.maximum(np.asarray(end) - np.asarray(start), 0)
         if length.sum() == 0:
             length = np.ones_like(length)        # degenerate: uniform
         self.cum = jnp.asarray(np.cumsum(length), i32)
         self.total = int(length.sum())
-        self.start = jnp.asarray(start, i32)
-        self.issue_sorted = jnp.asarray(np.sort(issue), i32)
         self.n = int(length.shape[0])
 
     def sample(self, key: jax.Array) -> tuple[jax.Array, jax.Array]:
-        """→ (entry, step): the struck µop and the replay step index."""
+        """→ (entry, step): the struck µop, residency-mass weighted; the
+        replay landing step is the µop itself."""
         u = jax.random.randint(key, (), 0, self.total, dtype=i32)
         entry = jnp.searchsorted(self.cum, u, side="right").astype(i32)
-        prev = jnp.where(entry > 0, self.cum[jnp.maximum(entry - 1, 0)], 0)
-        t = self.start[entry] + (u - prev)
-        step = jnp.searchsorted(self.issue_sorted, t, side="right")
-        return entry, jnp.clip(step.astype(i32), 0, self.n - 1)
+        return entry, entry

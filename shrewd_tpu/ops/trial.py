@@ -52,6 +52,7 @@ class TrialKernel:
         self._golden_rec = None         # taint-kernel streams, lazy
         self._samplers: dict = {}
         self._sample_jits: dict = {}
+        self._scoreboard = None     # timing="scoreboard": shared per kernel
         # taint observability: escape counts feed campaign stats
         self.escapes = 0
         self.taint_trials = 0
@@ -98,8 +99,15 @@ class TrialKernel:
                     self._samplers[structure] = MinorFaultSampler(
                         self.trace, self.minor_cfg)
                 else:
+                    if (self.cfg.timing == "scoreboard"
+                            and self._scoreboard is None):
+                        from shrewd_tpu.models.timing import \
+                            compute_scoreboard
+                        self._scoreboard = compute_scoreboard(
+                            self.trace, self.cfg.timing_cfg)
                     self._samplers[structure] = FaultSampler(
-                        self.trace, structure, self.cfg)
+                        self.trace, structure, self.cfg,
+                        scoreboard=self._scoreboard)
         return self._samplers[structure]
 
     def outcomes_from_keys(self, keys: jax.Array, structure: str) -> jax.Array:

@@ -91,7 +91,8 @@ def exec_trace(trace, golden_rec=None, fault=None, out: IO = None,
     if not debug.enabled("Exec"):
         return 0
     out = out or sys.stderr
-    end = trace.n if count is None else min(trace.n, start + count)
+    start = min(max(start, 0), trace.n)
+    end = trace.n if count is None else min(trace.n, start + max(count, 0))
     for i in range(start, end):
         print(format_line(trace, golden_rec, i, fault), file=out)
     return end - start

@@ -199,11 +199,16 @@ class _WordTracker:
                 vals.add(w.data)
         return vals
 
-    def complete_read(self, serial: int, complete: int, data: int) -> bool:
+    def complete_read(self, serial: int, complete: int, data: int
+                      ) -> tuple[bool, set]:
+        """→ (serializable?, the readable set checked against) — the set is
+        computed from the read's REAL start tick, so a violation message
+        shows exactly what was legal."""
         if serial not in self.outstanding_reads:
             raise KeyError(f"completeRead: unknown serial {serial}")
         start, _ = self.outstanding_reads.pop(serial)
-        return data in self.readable_set(start, complete)
+        vals = self.readable_set(start, complete)
+        return data in vals, vals
 
 
 class MemChecker:
@@ -246,12 +251,13 @@ class MemChecker:
         """True iff ``data`` is serializable; records a violation detail
         otherwise (the reference's getErrorMessage contract)."""
         t = self._tracker(word)
-        ok = t.complete_read(serial, complete, int(data) & 0xFFFFFFFF)
+        ok, legal = t.complete_read(serial, complete,
+                                    int(data) & 0xFFFFFFFF)
         if not ok:
             self.violations.append(
                 f"word {word}: read (serial {serial}) returned "
                 f"{data:#010x} not in readable set "
-                f"{sorted(t.readable_set(0, complete))} at tick {complete}")
+                f"{sorted(legal)} at tick {complete}")
         return ok
 
     def assert_clean(self) -> None:

@@ -99,3 +99,45 @@ def test_checkpoint_spec_builds_lifted_trace(capture, tmp_path):
     trace = spec.build_trace()
     assert trace.opcode.shape[0] > 0
     trace.validate()
+
+
+class TestSuffixStems:
+    """Size-suffix stripping must take at most ONE suffix char and only
+    with a known remainder (review r3: rstrip ate stem letters — "subl" →
+    "su", "roll" → "ro", "imulq" → "imu" — demoting those forms to the
+    unsupported-mnemonic path)."""
+
+    def test_stem_strips_one_known_suffix(self):
+        from shrewd_tpu.ingest.emu import _ALU, _SHIFT, _stem
+
+        assert _stem("subl", _ALU) == "sub"
+        assert _stem("subb", _ALU) == "sub"
+        assert _stem("imulq", _ALU) == "imul"
+        assert _stem("roll", _SHIFT) == "rol"
+        assert _stem("shlb", _SHIFT) == "shl"
+        assert _stem("sall", _SHIFT) == "sal"
+
+    def test_stem_never_eats_stem_letters(self):
+        from shrewd_tpu.ingest.emu import _ALU, _SHIFT, _stem
+
+        assert _stem("shl", _SHIFT) == "shl"      # bare stem untouched
+        assert _stem("sub", _ALU) == "sub"
+        assert _stem("su", _ALU) is None
+        assert _stem("xyzzy", _ALU) is None
+
+    def test_gs_relative_stops_loudly(self):
+        """%gs: must not silently resolve against fs_base."""
+        from shrewd_tpu.ingest.emu import Emulator, StopEmu
+        from shrewd_tpu.ingest.lift import Operand, _parse_operand
+
+        op = _parse_operand("%gs:0x28", None)
+        assert op.base == -5
+        fs = _parse_operand("%fs:0x28", None)
+        assert fs.base == -4
+        import numpy as np
+
+        emu = Emulator({}, np.zeros(18, np.uint64), [], pc=0)
+        with pytest.raises(StopEmu, match="gs-relative"):
+            emu.ea(op)
+        # fs still resolves (synthetic fallback base)
+        assert emu.ea(fs) == emu.fs_base + 0x28

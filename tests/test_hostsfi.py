@@ -95,3 +95,13 @@ def test_diff_avf_artifact_schema(tmp_path):
     assert rep["trials"] >= 5000
     assert rep["avf_abs_err"] <= 0.02
     assert rep["agreement_vulnerable"] >= 0.97
+
+
+def test_capture_window_matches_lift():
+    """capture_window_macro_ops (the emu64 fast path) must agree with the
+    full lift's macro-op count (review r3: emu64 paid a whole lift pass
+    for this one integer)."""
+    paths = hd.build_tools()
+    w = hd.capture_window_macro_ops(paths)
+    _tr, meta = hd.capture_and_lift(paths)
+    assert w == meta["macro_ops"] > 0

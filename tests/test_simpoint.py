@@ -78,3 +78,40 @@ def test_simpoint_windows_lift_and_replay():
         assert not bool(k.golden.diverged)
         assert not bool(k.golden.trapped)
         assert 0.0 < meta["simpoint_weight"] <= 1.0
+
+
+def test_phase_homogeneous_stream_does_not_crash():
+    """All-identical BBVs made k-means++ pass an all-zero probability
+    vector to rng.choice (review r3); now the init stops early and the
+    single phase yields one full-weight cluster."""
+    import numpy as np
+
+    from shrewd_tpu.ingest.simpoint import BBVProfile, choose_simpoints
+
+    n_iv = 20
+    bbvs = np.tile(np.ones(8), (n_iv, 1))
+    heads = np.arange(8, dtype=np.uint64)
+    sp = choose_simpoints(
+        BBVProfile(bbvs=bbvs, block_heads=heads, interval=160), k=3)
+    assert len(sp.intervals) >= 1
+    assert (sp.weights > 0).all()
+    assert abs(sp.weights.sum() - 1.0) < 1e-9
+
+
+def test_empty_clusters_are_dropped():
+    """Zero-weight representatives must not survive (they cost an
+    emulate+lift pass and contribute nothing to the weighted AVF)."""
+    import numpy as np
+
+    from shrewd_tpu.ingest.simpoint import BBVProfile, choose_simpoints
+
+    # two distinct phases, k=3 → at most 2 non-empty clusters
+    a = np.zeros((6, 8)); a[:, 0] = 100
+    b = np.zeros((6, 8)); b[:, 7] = 100
+    sp = choose_simpoints(BBVProfile(
+        bbvs=np.concatenate([a, b]),
+        block_heads=np.arange(8, dtype=np.uint64), interval=64),
+        k=3, seed=1)
+    assert (sp.weights > 0).all()
+    assert len(sp.intervals) <= 2
+    assert (sp.labels >= 0).all()

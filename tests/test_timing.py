@@ -126,7 +126,7 @@ class TestResidencySampler:
 
         start = np.array([0, 10, 20], np.int64)
         end = np.array([1, 19, 21], np.int64)      # lengths 1, 9, 1
-        s = ResidencySampler(start, end, issue=np.array([0, 10, 20]))
+        s = ResidencySampler(start, end)
         keys = prng.trial_keys(prng.campaign_key(0), 4096)
         entries, steps = jax.vmap(s.sample)(keys)
         counts = np.bincount(np.asarray(entries), minlength=3)
@@ -139,21 +139,23 @@ class TestResidencySampler:
 
         start = np.array([0, 5, 9], np.int64)
         end = np.array([4, 5, 12], np.int64)       # middle has zero mass
-        s = ResidencySampler(start, end, issue=np.array([0, 5, 9]))
+        s = ResidencySampler(start, end)
         keys = prng.trial_keys(prng.campaign_key(1), 512)
         entries, _ = jax.vmap(s.sample)(keys)
         assert not (np.asarray(entries) == 1).any()
 
-    def test_step_maps_time_to_program_order(self):
-        import jax.numpy as jnp
+    def test_step_equals_struck_entry(self):
+        """Non-REGFILE faults apply at their µop (at_uop); the sampler's
+        landing step is the entry itself."""
+        import jax
 
         start = np.array([0, 4, 8], np.int64)
         end = np.array([4, 8, 12], np.int64)
-        s = ResidencySampler(start, end, issue=np.array([1, 5, 9]))
-        # u = 5 → entry 1, t = 4+1 = 5 → issued at/before 5: µops {0, 1}
-        import jax
-        entry = int(jnp.searchsorted(s.cum, jnp.int32(5), side="right"))
-        assert entry == 1
+        s = ResidencySampler(start, end)
+        keys = prng.trial_keys(prng.campaign_key(9), 64)
+        entries, steps = jax.vmap(s.sample)(keys)
+        np.testing.assert_array_equal(np.asarray(entries),
+                                      np.asarray(steps))
 
 
 class TestO3Integration:
