@@ -286,6 +286,11 @@ class Orchestrator:
             statsmod.dump_text(self.stats, f)
         with open(os.path.join(self.outdir, "stats.json"), "w") as f:
             statsmod.dump_json(self.stats, f)
+        try:
+            statsmod.dump_hdf5(self.stats,
+                               os.path.join(self.outdir, "stats.h5"))
+        except ImportError:        # h5py is optional (env without HDF5)
+            pass
 
     # --- campaign checkpoint/resume ---
 

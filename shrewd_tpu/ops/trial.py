@@ -100,7 +100,8 @@ class TrialKernel:
                         self.trace, self.minor_cfg)
                 else:
                     if (self.cfg.timing == "scoreboard"
-                            and self._scoreboard is None):
+                            and self._scoreboard is None
+                            and structure in ("rob", "iq", "lsq", "fu")):
                         from shrewd_tpu.models.timing import \
                             compute_scoreboard
                         self._scoreboard = compute_scoreboard(

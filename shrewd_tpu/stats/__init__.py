@@ -369,3 +369,46 @@ def dump_json(group: Group, fileobj=None) -> str:
     if fileobj is not None:
         fileobj.write(text)
     return text
+
+
+def dump_hdf5(group: Group, path: str) -> None:
+    """HDF5 dump (the reference's ``--stats-file=h5://`` backend,
+    ``src/base/stats/hdf5.cc``): one HDF5 group per stats Group, one
+    dataset per stat.  Scalars/Formulas land as 0-d float datasets,
+    Vectors as 1-d arrays with a ``subnames`` attribute, Distributions/
+    Histograms as bucket-count arrays with lo/hi/underflow/overflow/
+    moment attributes.  One dump per call (overwrite semantics)."""
+    import h5py
+
+    def write_group(h5g, g: Group) -> None:
+        for s in g._stats.values():
+            if isinstance(s, Distribution):      # includes Histogram
+                v = s.to_value()
+                ds = h5g.create_dataset(
+                    s.name, data=np.asarray(v["counts"], np.float64))
+                for key in ("lo", "hi", "underflow", "overflow",
+                            "samples", "mean", "stdev"):
+                    ds.attrs[key] = float(v[key])
+            elif isinstance(s, Vector):
+                ds = h5g.create_dataset(
+                    s.name, data=np.asarray(s.value, np.float64))
+                if s.subnames:
+                    ds.attrs["subnames"] = [str(x) for x in s.subnames]
+            else:                                 # Scalar / Formula
+                v = s.to_value()
+                if isinstance(v, dict):           # dict-valued Formula
+                    sub = h5g.require_group(s.name)
+                    for key, val in v.items():
+                        sub.create_dataset(str(key), data=float(val))
+                else:
+                    h5g.create_dataset(s.name, data=float(v))
+            h5g[s.name].attrs["description"] = s.desc
+        for sub in g._groups.values():
+            write_group(h5g.require_group(sub.name), sub)
+
+    with h5py.File(path, "w") as f:
+        root = f.require_group(group.name) if group.name else f["/"]
+        write_group(root, group)
+
+
+__all__.append("dump_hdf5")

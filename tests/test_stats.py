@@ -162,3 +162,50 @@ def test_json_dump():
     assert d["trials"] == 4
     assert d["outcomes"]["total"] == 0
     assert "inject_cycle" in d["o3"]
+
+
+def test_dump_hdf5_roundtrip(tmp_path):
+    """HDF5 backend (reference src/base/stats/hdf5.cc analog)."""
+    import numpy as np
+
+    h5py = pytest.importorskip("h5py")
+    from shrewd_tpu.stats import (Distribution, Formula, Group, Scalar,
+                                  Vector, dump_hdf5)
+
+    g = Group("campaign")
+    g.trials = Scalar("trials", "total trials")
+    g.trials += 128
+    g.outcomes = Vector("outcomes", 4, "tallies",
+                        subnames=["masked", "sdc", "due", "detected"])
+    g.outcomes += np.array([100, 20, 7, 1])
+    g.lat = Distribution("lat", 0, 10, 5, "latency")
+    g.lat.sample(np.array([1.0, 9.0]))
+    g.avf = Formula("avf", lambda: (g.outcomes[1] + g.outcomes[2])
+                    / g.trials.value)
+    sub = Group("o3")
+    g.o3 = sub
+    sub.escapes = Scalar("escapes", "escapes")
+    path = tmp_path / "stats.h5"
+    dump_hdf5(g, str(path))
+    with h5py.File(path) as f:
+        assert float(f["campaign/trials"][()]) == 128
+        assert list(f["campaign/outcomes"][:]) == [100, 20, 7, 1]
+        assert list(f["campaign/outcomes"].attrs["subnames"])[1] == "sdc"
+        assert f["campaign/lat"].attrs["samples"] == 2
+        assert abs(float(f["campaign/avf"][()]) - 27 / 128) < 1e-12
+        assert float(f["campaign/o3/escapes"][()]) == 0
+
+
+def test_dump_hdf5_dict_formula(tmp_path):
+    """Dict-valued Formulas land as a subgroup of scalars (the text/json
+    backends already support them)."""
+    h5py = pytest.importorskip("h5py")
+    from shrewd_tpu.stats import Formula, Group, dump_hdf5
+
+    g = Group("x")
+    g.ratios = Formula("ratios", lambda: {"a": 0.25, "b": 0.75}, "split")
+    path = tmp_path / "d.h5"
+    dump_hdf5(g, str(path))
+    with h5py.File(path) as f:
+        assert float(f["x/ratios/a"][()]) == 0.25
+        assert float(f["x/ratios/b"][()]) == 0.75
