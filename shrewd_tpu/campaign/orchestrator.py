@@ -134,10 +134,29 @@ class _State:
         return st
 
 
+# Frozen PRNG ids: NEVER renumber — checkpoints derive batch keys from
+# these, so an edit here silently changes the sampled faults of resumed
+# campaigns.  New structures append with fresh ids.
+_STRUCTURE_IDS = {
+    "regfile": 0, "fu": 1, "rob": 2, "iq": 3, "lsq": 4, "latch": 5,
+    "cache:data": 6, "cache:tag": 7, "cache:state": 8,
+    "mesi:state": 9, "mesi:tag": 10, "noc:router": 11,
+}
+
+# pseudo-simpoint id for the plan-level coherence tiers (mesi:/noc: do not
+# depend on any simpoint's trace, so they run once per plan)
+_COHERENCE_SP_ID = 1_000_000
+COHERENCE_SP_NAME = "coherence"
+
+
 def _structure_id(structure: str) -> int:
-    """Canonical id independent of plan ordering (PRNG stability across
-    resumes and plan edits)."""
-    return list(STRUCTURES).index(structure)
+    """Canonical frozen id (PRNG stability across resumes / plan edits /
+    structure additions)."""
+    return _STRUCTURE_IDS[structure]
+
+
+def _is_plan_level(structure: str) -> bool:
+    return structure.split(":", 1)[0] in ("mesi", "noc")
 
 
 class Orchestrator:
@@ -145,11 +164,17 @@ class Orchestrator:
         self.plan = plan
         self.mesh = mesh if mesh is not None else make_mesh()
         self.outdir = outdir
+        self._per_sp = [s for s in plan.structures if not _is_plan_level(s)]
+        self._plan_level = [s for s in plan.structures if _is_plan_level(s)]
         self.state: dict[tuple[str, str], _State] = {
             (sp.name, s): _State()
-            for sp in plan.simpoints for s in plan.structures}
+            for sp in plan.simpoints for s in self._per_sp}
+        for s in self._plan_level:
+            self.state[(COHERENCE_SP_NAME, s)] = _State()
         self.results: dict[tuple[str, str], StructureResult] = {}
         self._kernels: dict[int, TrialKernel] = {}
+        self._traces: dict[int, object] = {}
+        self._tier_kernels: dict = {}
         self._campaigns: dict[tuple[int, str], ShardedCampaign] = {}
         self._build_stats()
 
@@ -157,17 +182,20 @@ class Orchestrator:
 
     def _build_stats(self) -> None:
         self.stats = statsmod.Group("campaign")
-        for sp in self.plan.simpoints:
-            g = statsmod.Group(sp.name)
-            setattr(self.stats, f"sp_{sp.name}", g)
-            for s in self.plan.structures:
+        sweep = [(sp.name, self._per_sp) for sp in self.plan.simpoints]
+        if self._plan_level:
+            sweep.append((COHERENCE_SP_NAME, self._plan_level))
+        for sp_name, structures in sweep:
+            g = statsmod.Group(sp_name)
+            setattr(self.stats, f"sp_{sp_name}", g)
+            for s in structures:
                 sg = statsmod.Group(s)
                 setattr(g, f"st_{s}", sg)
                 sg.trials = statsmod.Scalar("trials", "trials run")
                 sg.outcomes = statsmod.Vector(
                     "outcomes", C.N_OUTCOMES, "outcome tally",
                     subnames=list(C.OUTCOME_NAMES))
-                st = self.state[(sp.name, s)]
+                st = self.state[(sp_name, s)]
                 sg.avf = statsmod.Formula(
                     "avf", lambda st=st: float(C.avf(st.tallies)),
                     "(SDC+DUE)/trials")
@@ -180,17 +208,70 @@ class Orchestrator:
 
     # --- lazy elaboration ---
 
+    def trace(self, sp_idx: int):
+        if sp_idx not in self._traces:
+            self._traces[sp_idx] = self.plan.simpoints[sp_idx].build_trace()
+        return self._traces[sp_idx]
+
     def kernel(self, sp_idx: int) -> TrialKernel:
         if sp_idx not in self._kernels:
-            trace = self.plan.simpoints[sp_idx].build_trace()
-            self._kernels[sp_idx] = TrialKernel(trace, self.plan.machine)
+            self._kernels[sp_idx] = TrialKernel(self.trace(sp_idx),
+                                                self.plan.machine)
         return self._kernels[sp_idx]
+
+    def kernel_for(self, sp_idx: int, structure: str):
+        """→ (kernel, substructure): O3/Minor structures go to the trial
+        kernel; tier-qualified names route to the cache / MESI / NoC fault
+        kernels (plan.TIER_STRUCTURES)."""
+        tier, _, sub = structure.partition(":")
+        if not sub:
+            return self.kernel(sp_idx), structure
+        if tier == "cache":
+            key = ("cache", sp_idx)
+            if key not in self._tier_kernels:
+                from shrewd_tpu.models.ruby import (CacheKernel,
+                                                    golden_access_stream,
+                                                    simulate_cache)
+                # the cache tier needs only the simpoint's access stream —
+                # not the O3 trial kernel (whose construction compiles a
+                # full golden dense replay a cache-only campaign never uses)
+                trace = self.trace(sp_idx)
+                tl, _miss = simulate_cache(golden_access_stream(trace),
+                                           self.plan.cache,
+                                           n_cycles=trace.n)
+                self._tier_kernels[key] = CacheKernel(tl, self.plan.cache)
+            return self._tier_kernels[key], sub
+        if tier in ("mesi", "noc"):
+            if "mesi_trace" not in self._tier_kernels:
+                from shrewd_tpu.models.mesi import torture_stream
+                self._tier_kernels["mesi_trace"] = torture_stream(
+                    self.plan.mesi, self.plan.coherence_accesses,
+                    self.plan.coherence_mem_words, seed=self.plan.seed)
+            stream = self._tier_kernels["mesi_trace"]
+            if tier == "mesi":
+                if "mesi" not in self._tier_kernels:
+                    from shrewd_tpu.models.mesi import MesiKernel
+                    rng = np.random.default_rng(self.plan.seed)
+                    init = rng.integers(
+                        0, 1 << 32, self.plan.coherence_mem_words,
+                        dtype=np.uint64).astype(np.uint32)
+                    self._tier_kernels["mesi"] = MesiKernel(
+                        stream, self.plan.mesi, init)
+                return self._tier_kernels["mesi"], sub
+            if "noc" not in self._tier_kernels:
+                from shrewd_tpu.models.noc import (NocKernel,
+                                                   build_message_trace)
+                msgs = build_message_trace(stream, self.plan.mesi,
+                                           self.plan.noc)
+                self._tier_kernels["noc"] = NocKernel(msgs, self.plan.noc)
+            return self._tier_kernels["noc"], sub
+        raise KeyError(f"unknown structure tier {tier!r}")
 
     def campaign(self, sp_idx: int, structure: str) -> ShardedCampaign:
         key = (sp_idx, structure)
         if key not in self._campaigns:
-            self._campaigns[key] = ShardedCampaign(
-                self.kernel(sp_idx), self.mesh, structure)
+            kernel, sub = self.kernel_for(sp_idx, structure)
+            self._campaigns[key] = ShardedCampaign(kernel, self.mesh, sub)
         return self._campaigns[key]
 
     # --- the drive loop ---
@@ -199,12 +280,22 @@ class Orchestrator:
         """Advance the whole plan, yielding control at every typed event."""
         plan = self.plan
         for sp_idx, sp in enumerate(plan.simpoints):
-            for structure in plan.structures:
+            for structure in self._per_sp:
                 st = self.state[(sp.name, structure)]
                 if st.done:
                     continue
                 yield from self._run_structure(sp_idx, sp.name, structure, st)
             yield ExitEvent.SIMPOINT_COMPLETE, sp.name
+        if self._plan_level:
+            # coherence tiers (mesi:/noc:) measure plan-level synthetic
+            # traffic, independent of every simpoint's trace — run ONCE
+            for structure in self._plan_level:
+                st = self.state[(COHERENCE_SP_NAME, structure)]
+                if st.done:
+                    continue
+                yield from self._run_structure(
+                    _COHERENCE_SP_ID, COHERENCE_SP_NAME, structure, st)
+            yield ExitEvent.SIMPOINT_COMPLETE, COHERENCE_SP_NAME
         yield ExitEvent.CAMPAIGN_COMPLETE, dict(self.results)
 
     def _run_structure(self, sp_idx: int, sp_name: str, structure: str,

@@ -16,7 +16,10 @@ SimPoint sources polymorph over ``SimPointSpec``:
 
 from __future__ import annotations
 
+from shrewd_tpu.models.mesi import MesiConfig
+from shrewd_tpu.models.noc import NocConfig
 from shrewd_tpu.models.o3 import O3Config, STRUCTURES
+from shrewd_tpu.models.ruby import CacheConfig
 from shrewd_tpu.trace import synth
 from shrewd_tpu.trace.format import Trace
 from shrewd_tpu.utils.config import (Child, ConfigObject, Param, VectorParam)
@@ -79,8 +82,22 @@ class CheckpointSpec(SimPointSpec):
         return window_from_snapshot(snap, self.workload, self.warmup)
 
 
+# Tier-qualified structures route to the non-O3 fault kernels
+# (campaign/orchestrator.py kernel_for): the cache-lifetime tier
+# (models/ruby.py, driven by the simpoint's own access stream), the
+# two-core MESI protocol tier, and the NoC tier (models/mesi.py /
+# models/noc.py, driven by a seeded coherence torture stream — the
+# RubyTester posture: the reference's protocol campaigns run synthetic
+# coherence traffic, not SPEC).
+TIER_STRUCTURES = (
+    "cache:data", "cache:tag", "cache:state",
+    "mesi:state", "mesi:tag",
+    "noc:router",
+)
+
+
 def _valid_structures(names: list[str]) -> bool:
-    return all(n in STRUCTURES for n in names)
+    return all(n in STRUCTURES or n in TIER_STRUCTURES for n in names)
 
 
 class CampaignPlan(ConfigObject):
@@ -99,6 +116,17 @@ class CampaignPlan(ConfigObject):
     checkpoint_every = Param(int, 0,
                              "batches between campaign checkpoints (0=off)")
     machine = Child(O3Config)
+    # non-O3 fault tiers (used only when a tier-qualified structure is in
+    # ``structures``)
+    cache = Child(CacheConfig)
+    mesi = Child(MesiConfig)
+    noc = Child(NocConfig)
+    coherence_accesses = Param(int, 512,
+                               "torture-stream length for mesi:/noc: tiers",
+                               check=lambda v: v > 0)
+    coherence_mem_words = Param(int, 256,
+                                "memory words behind the coherence stream",
+                                check=lambda v: v > 0)
 
     def __init__(self, simpoints: list[SimPointSpec] | None = None, **kw):
         super().__init__(**kw)

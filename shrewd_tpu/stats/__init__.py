@@ -387,7 +387,7 @@ def dump_hdf5(group: Group, path: str) -> None:
                 ds = h5g.create_dataset(
                     s.name, data=np.asarray(v["counts"], np.float64))
                 for key in ("lo", "hi", "underflow", "overflow",
-                            "samples", "mean", "stdev"):
+                            "samples", "mean", "stdev", "min", "max"):
                     ds.attrs[key] = float(v[key])
             elif isinstance(s, Vector):
                 ds = h5g.create_dataset(

@@ -165,3 +165,116 @@ def test_resume_upgrades_v1_checkpoint(tmp_path):
     orch2 = Orchestrator.resume(ckpt)
     for st in orch2.state.values():
         assert st.escapes == 0 and st.taint_trials == 0
+
+
+def test_tier_structures_run_to_completion(tmp_path):
+    """Tier-qualified structures (cache:/mesi:/noc:) route to the cache,
+    MESI, and NoC fault kernels through the same plan/orchestrator path as
+    the O3 structures (campaign/orchestrator.py kernel_for)."""
+    from shrewd_tpu.campaign.orchestrator import Orchestrator
+    from shrewd_tpu.campaign.plan import CampaignPlan, WorkloadSpec
+    from shrewd_tpu.sim.exit_event import ExitEvent
+
+    plan = CampaignPlan(
+        simpoints=[WorkloadSpec(
+            name="w0",
+            workload=WorkloadConfig(n=128, nphys=64, mem_words=64,
+                                    working_set_words=32, seed=3))],
+        structures=["regfile", "cache:data", "mesi:state", "noc:router"],
+        batch_size=64, max_trials=128, min_trials=64,
+        target_halfwidth=0.5, coherence_accesses=96,
+        coherence_mem_words=64)
+    orch = Orchestrator(plan, outdir=str(tmp_path))
+    results = {}
+    for event, payload in orch.events():
+        if event in (ExitEvent.CI_CONVERGED, ExitEvent.MAX_TRIALS):
+            results[payload.structure] = payload
+        elif event == ExitEvent.CAMPAIGN_COMPLETE:
+            break
+    assert set(results) == {"regfile", "cache:data", "mesi:state",
+                            "noc:router"}
+    for r in results.values():
+        assert r.trials >= 64
+        assert r.tallies.sum() == r.trials
+        assert 0.0 <= r.avf <= 1.0
+    orch.write_outputs()
+    assert (tmp_path / "stats.txt").exists()
+    text = (tmp_path / "stats.txt").read_text()
+    assert "noc:router" in text and "mesi:state" in text
+
+
+def test_plan_roundtrip_with_tier_structures():
+    from shrewd_tpu.campaign.plan import CampaignPlan, WorkloadSpec
+
+    plan = CampaignPlan(
+        simpoints=[WorkloadSpec(name="a",
+                                workload=WorkloadConfig(n=64))],
+        structures=["lsq", "cache:tag", "noc:router"],
+        coherence_accesses=32)
+    d = plan.to_dict()
+    back = CampaignPlan.from_dict(d)
+    assert back.structures == ["lsq", "cache:tag", "noc:router"]
+    assert back.coherence_accesses == 32
+    assert back.noc.mesh_x == plan.noc.mesh_x
+
+
+def test_invalid_tier_structure_rejected():
+    import pytest
+
+    from shrewd_tpu.campaign.plan import CampaignPlan, WorkloadSpec
+
+    with pytest.raises(ValueError):
+        CampaignPlan(simpoints=[WorkloadSpec(
+            name="a", workload=WorkloadConfig(n=64))],
+                     structures=["cache:bogus"])
+
+
+def test_structure_ids_frozen_and_complete():
+    """Every drivable structure has a frozen PRNG id; the map must cover
+    the O3 set and the tier set exactly once each (renumbering would
+    silently change resumed campaigns' fault samples)."""
+    from shrewd_tpu.campaign.orchestrator import _STRUCTURE_IDS
+    from shrewd_tpu.campaign.plan import TIER_STRUCTURES
+    from shrewd_tpu.models.o3 import STRUCTURES
+
+    universe = set(STRUCTURES) | set(TIER_STRUCTURES)
+    assert set(_STRUCTURE_IDS) == universe
+    ids = list(_STRUCTURE_IDS.values())
+    assert len(ids) == len(set(ids))
+
+
+def test_plan_level_tiers_run_once_across_simpoints():
+    """mesi:/noc: tiers measure plan-level synthetic traffic: with two
+    simpoints they run ONCE (under the 'coherence' pseudo-simpoint), not
+    once per simpoint."""
+    from shrewd_tpu.campaign.orchestrator import Orchestrator
+    from shrewd_tpu.campaign.plan import CampaignPlan, WorkloadSpec
+    from shrewd_tpu.sim.exit_event import ExitEvent
+
+    plan = CampaignPlan(
+        simpoints=[
+            WorkloadSpec(name="w0",
+                         workload=WorkloadConfig(n=96, nphys=32,
+                                                 mem_words=64,
+                                                 working_set_words=32,
+                                                 seed=1)),
+            WorkloadSpec(name="w1",
+                         workload=WorkloadConfig(n=96, nphys=32,
+                                                 mem_words=64,
+                                                 working_set_words=32,
+                                                 seed=2))],
+        structures=["regfile", "mesi:state"],
+        batch_size=64, max_trials=64, min_trials=64,
+        target_halfwidth=0.5, coherence_accesses=64,
+        coherence_mem_words=64)
+    orch = Orchestrator(plan)
+    done = []
+    for event, payload in orch.events():
+        if event in (ExitEvent.CI_CONVERGED, ExitEvent.MAX_TRIALS):
+            done.append((payload.simpoint, payload.structure))
+        elif event == ExitEvent.CAMPAIGN_COMPLETE:
+            break
+    assert done.count(("coherence", "mesi:state")) == 1
+    assert ("w0", "regfile") in done and ("w1", "regfile") in done
+    assert not any(sp in ("w0", "w1") and s == "mesi:state"
+                   for sp, s in done)
