@@ -26,7 +26,7 @@ from typing import Iterator, NamedTuple
 import numpy as np
 
 from shrewd_tpu import stats as statsmod
-from shrewd_tpu.campaign.plan import CampaignPlan
+from shrewd_tpu.campaign.plan import COHERENCE_SP_NAME, CampaignPlan
 from shrewd_tpu.models.o3 import STRUCTURES
 from shrewd_tpu.ops import classify as C
 from shrewd_tpu.ops.trial import TrialKernel
@@ -34,6 +34,7 @@ from shrewd_tpu.parallel import stopping
 from shrewd_tpu.parallel.campaign import ShardedCampaign
 from shrewd_tpu.parallel.mesh import make_mesh
 from shrewd_tpu.sim.exit_event import ExitEvent
+from shrewd_tpu.utils import probes
 from shrewd_tpu.utils import debug, prng
 
 debug.register_flag("Campaign", "orchestrator progress")
@@ -144,9 +145,9 @@ _STRUCTURE_IDS = {
 }
 
 # pseudo-simpoint id for the plan-level coherence tiers (mesi:/noc: do not
-# depend on any simpoint's trace, so they run once per plan)
+# depend on any simpoint's trace, so they run once per plan); the reserved
+# NAME lives in plan.py, where construction rejects real simpoints using it
 _COHERENCE_SP_ID = 1_000_000
-COHERENCE_SP_NAME = "coherence"
 
 
 def _structure_id(structure: str) -> int:
@@ -176,6 +177,13 @@ class Orchestrator:
         self._traces: dict[int, object] = {}
         self._tier_kernels: dict = {}
         self._campaigns: dict[tuple[int, str], ShardedCampaign] = {}
+        # probe points (utils/probes; gem5 ProbePoint pattern): listeners
+        # attach without the orchestrator knowing who observes.  Payloads
+        # are batch-granular — BatchInfo / StructureResult / ckpt path.
+        self.probes = probes.ProbeManager("campaign")
+        self.pp_batch = self.probes.add_point("BatchComplete")
+        self.pp_structure = self.probes.add_point("StructureComplete")
+        self.pp_checkpoint = self.probes.add_point("Checkpoint")
         self._build_stats()
 
     # --- stats tree (statistics::Group bound to the object tree) ---
@@ -333,6 +341,7 @@ class Orchestrator:
                     converged=converged,
                     wall_seconds=time.monotonic() - t0)
                 self.results[(sp_name, structure)] = result
+                self.pp_structure.notify(result)
                 yield (ExitEvent.CI_CONVERGED if converged
                        else ExitEvent.MAX_TRIALS), result
                 return
@@ -356,13 +365,17 @@ class Orchestrator:
             debug.dprintf("Campaign", "%s/%s batch %d: trials=%d avf=%.4f",
                           sp_name, structure, st.next_batch, st.trials,
                           avf_live)
-            yield ExitEvent.BATCH_COMPLETE, BatchInfo(
+            info = BatchInfo(
                 sp_name, structure, st.next_batch - 1, st.trials,
                 st.tallies.copy(), avf_live)
+            self.pp_batch.notify(info)
+            yield ExitEvent.BATCH_COMPLETE, info
 
             if (plan.checkpoint_every and self.outdir and
                     st.next_batch % plan.checkpoint_every == 0):
-                yield ExitEvent.CHECKPOINT, self.checkpoint()
+                ckpt = self.checkpoint()
+                self.pp_checkpoint.notify(ckpt)
+                yield ExitEvent.CHECKPOINT, ckpt
 
     # --- outputs (the m5out contract) ---
 

@@ -95,6 +95,11 @@ TIER_STRUCTURES = (
     "noc:router",
 )
 
+# reserved pseudo-simpoint under which the plan-level coherence tiers
+# (mesi:/noc:) report; a real simpoint may not take this name (state and
+# stats would silently merge)
+COHERENCE_SP_NAME = "coherence"
+
 
 def _valid_structures(names: list[str]) -> bool:
     return all(n in STRUCTURES or n in TIER_STRUCTURES for n in names)
@@ -131,6 +136,11 @@ class CampaignPlan(ConfigObject):
     def __init__(self, simpoints: list[SimPointSpec] | None = None, **kw):
         super().__init__(**kw)
         self.simpoints: list[SimPointSpec] = list(simpoints or [])
+        for sp in self.simpoints:
+            if sp.name == COHERENCE_SP_NAME:
+                raise ValueError(
+                    f"simpoint name {COHERENCE_SP_NAME!r} is reserved for "
+                    "the plan-level coherence tiers (mesi:/noc:)")
 
     # simpoints are a variable-length polymorphic list, which the static
     # Child-slot system doesn't model; extend the dump/load round-trip.

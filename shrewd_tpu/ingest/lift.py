@@ -82,6 +82,7 @@ for _i, _n in enumerate(["ah", "ch", "dh", "bh"]):
     _REGMAP[_n] = (_i, -8)
 
 N_GPR = 16
+RCX_ARCH = 1       # x86 encoding order: rcx is the shift/rotate count reg
 # physical register layout of the lifted trace
 ZERO = 16          # always-0 register (never written)
 TCMP = 17          # cmp-immediate staging (live cmp → jcc only)
@@ -899,6 +900,58 @@ class Lifter:
                                                 signed, dst.reg)
             return False
 
+        # --- xchg: three-move swap (lock prefix already folded away —
+        # atomicity is meaningless to a single-context replay) ---
+        if m in ("xchg", "xchgl", "xchgq") and len(ops) == 2:
+            a_op, b_op = ops
+            if all(o.kind == "reg" and o.reg >= 0 and abs(o.width) >= 32
+                   for o in ops):
+                # xchg writes no flags: scratch must stay off T1/T2/TCMP
+                self._emit(U.ADD, T6, a_op.reg, ZERO)
+                self._emit(U.ADD, a_op.reg, b_op.reg, ZERO)
+                self._emit(U.ADD, b_op.reg, T6, ZERO)
+                return True
+            mem = next((o for o in ops if o.kind == "mem"), None)
+            reg = next((o for o in ops if o.kind == "reg" and o.reg >= 0
+                        and abs(o.width) >= 32), None)
+            if mem is not None and reg is not None \
+                    and self._mem_width(inst, mem) >= 4:
+                a = self._addr_uops(mem, pc, T0)
+                if a is None:
+                    return False
+                self._emit(U.LOAD, T6, a[0], ZERO, a[1])
+                self._emit(U.STORE, 0, a[0], reg.reg, a[1])
+                self._emit(U.ADD, reg.reg, T6, ZERO)
+                return True
+            return False
+
+        # --- 32-bit rotates: two shifts + OR.  64-bit rotates cross the
+        # uint32 projection boundary (high bits rotate into the tracked
+        # low word) and demote; the count is masked &31 exactly as x86
+        # masks 32-bit rotate counts, and count==0 degenerates to
+        # r | (r << 32&31) == r, so no special case is needed ---
+        if m in ("rol", "roll", "ror", "rorl"):
+            if len(ops) == 1:
+                ops = [Operand("imm", imm=1)] + ops
+            if len(ops) != 2:
+                return False
+            src, dst = ops
+            if dst.kind != "reg" or dst.reg < 0 or abs(dst.width) != 32:
+                return False
+            if src.kind == "imm":
+                self._emit(U.LUI, T3, ZERO, ZERO, src.imm & 31)
+            elif src.kind == "reg" and src.reg == RCX_ARCH:
+                self._emit(U.ANDI, T3, RCX_ARCH, ZERO, 31)
+            else:
+                return False
+            self._emit(U.LUI, T4, ZERO, ZERO, 32)
+            self._emit(U.SUB, T4, T4, T3)
+            right_first = m.startswith("ror")
+            self._emit(U.SRL if right_first else U.SLL, T5, dst.reg, T3)
+            self._emit(U.SLL if right_first else U.SRL, T6, dst.reg, T4)
+            self._emit(U.OR, dst.reg, T5, T6)
+            return True
+
         # --- cmov: branch-free select (value-faithful under faults) ---
         if m.startswith("cmov"):
             base = m if m in _CMOV else m.rstrip("lqw")
@@ -1155,13 +1208,41 @@ class Lifter:
             self.flags_src = ("cmp", areg, breg)
             return True
         if m.startswith("test"):
-            if len(ops) != 2 or any(o.kind != "reg" or o.reg < 0
-                                    for o in ops):
+            if len(ops) != 2:
                 return False
-            if ops[0].reg == ops[1].reg:
-                self.flags_src = ("res", ops[0].reg)
+            if any(o.kind == "reg" and o.reg >= 0 and o.width < 0
+                   for o in ops):
+                return False                      # %ah-family
+            a, b = ops
+            widths = [abs(o.width) // 8 for o in ops
+                      if o.kind == "reg" and o.reg >= 0 and o.width]
+            w = min(widths) if widths else 4
+            if w < 4:
+                # objdump spells sub-word tests either "testb $1,…" or
+                # plain "test $1,%sil" — route both through the sub-word
+                # handling (mask, then sign-extend so SF is faithful)
+                msk = 0xFF if w == 1 else 0xFFFF
+                if a.kind == "imm" and b.kind == "reg" and b.reg >= 0:
+                    self._emit(U.ANDI, T2, b.reg, ZERO, a.imm & msk)
+                elif a.kind == "reg" and a.reg >= 0 \
+                        and b.kind == "reg" and b.reg >= 0:
+                    self._emit(U.AND, T2, a.reg, b.reg)
+                    self._emit(U.ANDI, T2, T2, ZERO, msk)
+                else:
+                    return False
+                self._extend_reg(T2, w, True, T2)
+                self.flags_src = ("res", T2)
+                return True
+            if a.kind == "imm" and b.kind == "reg" and b.reg >= 0:
+                self._emit(U.ANDI, T2, b.reg, ZERO, a.imm & M32)
+                self.flags_src = ("res", T2)
+                return True
+            if any(o.kind != "reg" or o.reg < 0 for o in ops):
+                return False
+            if a.reg == b.reg:
+                self.flags_src = ("res", a.reg)
             else:
-                self._emit(U.AND, T2, ops[0].reg, ops[1].reg)
+                self._emit(U.AND, T2, a.reg, b.reg)
                 self.flags_src = ("res", T2)
             return True
 

@@ -155,6 +155,8 @@ def main(argv: list[str] | None = None) -> int:
     common.add_argument("--debug-flags", default=os.environ.get(
         "SHREWD_DEBUG_FLAGS", ""), help="comma-separated debug flags "
         "(the reference's --debug-flags, python/m5/main.py)")
+    common.add_argument("--platform", default=None,
+                        help="jax platform override (cpu/tpu/axon)")
     ap = argparse.ArgumentParser(
         prog="python -m shrewd_tpu",
         description="TPU-native statistical fault-injection framework",
@@ -166,15 +168,12 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("plan", help="CampaignPlan config.json")
     p.add_argument("--outdir", default="m5out",
                    help="artifact directory (config.json/stats.txt/json)")
-    p.add_argument("--platform", default=None,
-                   help="jax platform override (cpu/tpu/axon)")
     p.set_defaults(fn=cmd_run)
 
     p = sub.add_parser("resume", help="resume a checkpointed campaign",
                        parents=[common])
     p.add_argument("ckpt_dir", help="campaign_ckpt directory")
     p.add_argument("--outdir", default="m5out")
-    p.add_argument("--platform", default=None)
     p.set_defaults(fn=cmd_resume)
 
     p = sub.add_parser("hostdiff", parents=[common],

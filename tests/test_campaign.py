@@ -278,3 +278,34 @@ def test_plan_level_tiers_run_once_across_simpoints():
     assert ("w0", "regfile") in done and ("w1", "regfile") in done
     assert not any(sp in ("w0", "w1") and s == "mesi:state"
                    for sp, s in done)
+
+
+def test_orchestrator_probe_points():
+    """Orchestrator probe points fire for listeners (utils/probes; the
+    gem5 ProbePoint pattern — instrumentation without coupling)."""
+    from shrewd_tpu.campaign.orchestrator import Orchestrator
+    from shrewd_tpu.sim.exit_event import ExitEvent
+
+    plan = _tiny_plan(structures=["regfile"], max_trials=64, min_trials=64)
+    orch = Orchestrator(plan)
+    batches, structures = [], []
+    orch.pp_batch.connect(batches.append)
+    orch.pp_structure.connect(structures.append)
+    for event, _ in orch.events():
+        if event == ExitEvent.CAMPAIGN_COMPLETE:
+            break
+    assert len(batches) >= 1
+    assert len(structures) == len(plan.simpoints)   # one per (sp, regfile)
+    assert all(b.structure == "regfile" for b in batches)
+    assert {s.simpoint for s in structures} == {"w0", "w1"}
+
+
+def test_coherence_simpoint_name_reserved():
+    import pytest
+
+    from shrewd_tpu.campaign.plan import CampaignPlan, WorkloadSpec
+
+    with pytest.raises(ValueError, match="reserved"):
+        CampaignPlan(simpoints=[WorkloadSpec(
+            name="coherence", workload=WorkloadConfig(n=64))],
+            structures=["regfile"])

@@ -142,3 +142,17 @@ def test_trace_roundtrip_with_meta(lifted, tmp_path):
     tr2, meta2 = TF.load(p)
     np.testing.assert_array_equal(tr2.opcode, trace.opcode)
     assert meta2["source"] == "nativetrace"
+
+
+def test_rotate_xchg_subword_test_lift_clean():
+    """rol/ror (32-bit), xchg (reg/reg and reg/mem), and plain-mnemonic
+    sub-word tests ("test $1,%sil") lift without demotion — the r3 lifter
+    additions, self-checked against the captured register stream on the
+    rotmix torture workload."""
+    from shrewd_tpu.ingest import hostdiff as hd
+
+    paths = hd.build_tools("workloads/rotmix.c")
+    _trace, meta = hd.capture_and_lift(paths)
+    st = meta["stats"]
+    assert st["lift_rate"] == 1.0, st["opaque_mnemonics"]
+    assert st["branches_dropped"] == 0

@@ -1,0 +1,40 @@
+/* Rotate + exchange torture: exercises the rol/ror and xchg lifts
+ * (ingest/lift.py) on 32-bit registers and memory operands.  Same
+ * marker contract as the other workloads (kernel_begin/kernel_end). */
+#include <stdint.h>
+#include <stdio.h>
+
+#define N 96
+
+static uint32_t buf[N];
+
+__attribute__((noinline)) void kernel_begin(void) { __asm__ volatile(""); }
+__attribute__((noinline)) void kernel_end(void) { __asm__ volatile(""); }
+
+__attribute__((noinline)) static uint32_t rotmix(void) {
+    uint32_t h = 0x9E3779B9u;
+    for (int i = 0; i < N; i++) {
+        uint32_t v = buf[i];
+        __asm__("roll $7, %0" : "+r"(v));
+        h ^= v;
+        __asm__("rorl %%cl, %0" : "+r"(h) : "c"(i & 31));
+        __asm__("xchgl %0, %1" : "+r"(h), "+r"(v));
+        h += v;
+        if (i & 1)
+            __asm__("xchgl %0, %1" : "+r"(h), "+m"(buf[i]));
+    }
+    return h;
+}
+
+int main(void) {
+    uint32_t s = 12345;
+    for (int i = 0; i < N; i++) {
+        s = s * 1103515245u + 12345u;
+        buf[i] = s;
+    }
+    kernel_begin();
+    uint32_t h = rotmix();
+    kernel_end();
+    printf("%08x\n", h);
+    return 0;
+}
