@@ -61,6 +61,15 @@ def tally(outcomes: jax.Array) -> jax.Array:
         jax.nn.one_hot(outcomes, N_OUTCOMES, dtype=jnp.int32), axis=0)
 
 
+def tally_stratified(outcomes: jax.Array, strata: jax.Array,
+                     n_strata: int) -> jax.Array:
+    """Per-stratum outcome counts, shape (n_strata, N_OUTCOMES) — the
+    psum-reducible tally of the post-stratified estimator
+    (parallel/stopping.post_stratified).  One scatter-add, traceable."""
+    t = jnp.zeros((n_strata, N_OUTCOMES), jnp.int32)
+    return t.at[strata, outcomes].add(1)
+
+
 def avf(tallies: jax.Array) -> jax.Array:
     """Architectural vulnerability factor: P(visible error | fault) =
     (SDC + DUE) / trials.  Detected faults are *covered*, not vulnerable."""

@@ -43,13 +43,22 @@ class ShardedCampaign:
     """
 
     def __init__(self, kernel, mesh, structure: str,
-                 resolution: str = "device"):
+                 resolution: str = "device", stratify: bool = False):
         if resolution not in ("device", "host"):
             raise ValueError(f"unknown resolution {resolution!r}")
+        if stratify and not hasattr(kernel, "run_keys_stratified"):
+            raise ValueError(
+                f"{type(kernel).__name__} has no stratified tally path")
+        if stratify and resolution != "device":
+            # the stratified step uses the budgeted device resolution; a
+            # host-resolution campaign would make summed strata disagree
+            # with tally_batch on over-budget batches
+            raise ValueError("stratify=True requires resolution='device'")
         self.kernel = kernel
         self.mesh = mesh
         self.structure = structure
         self.resolution = resolution
+        self.stratify = stratify
         self.mode = getattr(getattr(kernel, "cfg", None),
                             "replay_kernel", "dense")
         may_latch = structure == "latch"
@@ -66,6 +75,17 @@ class ShardedCampaign:
 
         self._taint_step = None
         self._device_step = None
+        self._strat_step = None
+        if stratify:
+            def strat_step(keys):
+                tally_h, n_unres = kernel.run_keys_stratified(keys,
+                                                              structure)
+                return (jax.lax.psum(tally_h, TRIAL_AXIS),
+                        jax.lax.psum(n_unres, TRIAL_AXIS))
+
+            self._strat_step = jax.jit(jax.shard_map(
+                strat_step, mesh=mesh,
+                in_specs=P(TRIAL_AXIS), out_specs=(P(), P())))
         if self.mode != "dense":
             _ = kernel.golden_rec     # materialize before tracing
             if resolution == "device":
@@ -87,6 +107,18 @@ class ShardedCampaign:
                     taint_step, mesh=mesh,
                     in_specs=P(TRIAL_AXIS),
                     out_specs=(P(TRIAL_AXIS),) * 3))
+
+    def tally_batch_stratified(self, keys: jax.Array) -> jax.Array:
+        """Sharded keys (B,) → replicated (N_STRATA, N_OUTCOMES) tally for
+        the post-stratified estimator; summing over strata reproduces
+        ``tally_batch`` exactly (same outcomes, same resolution)."""
+        if self._strat_step is None:
+            raise ValueError("campaign built without stratify=True")
+        tally_h, n_unres = self._strat_step(shard_keys(self.mesh, keys))
+        if self.mode != "dense":    # dense replay has no escape machinery
+            self.kernel.escapes += int(n_unres)
+            self.kernel.taint_trials += int(keys.shape[0])
+        return tally_h
 
     def tally_batch(self, keys: jax.Array) -> jax.Array:
         """Sharded keys (B,) → replicated tally (N_OUTCOMES,)."""
@@ -128,6 +160,7 @@ class CampaignResult(NamedTuple):
     wall_seconds: float
     trials_per_second: float
     converged: bool
+    strata_tallies: np.ndarray | None = None   # (N_STRATA, N_OUTCOMES)
 
 
 def run_until_ci(campaign: ShardedCampaign, *, seed: int, simpoint_id: int,
@@ -135,22 +168,46 @@ def run_until_ci(campaign: ShardedCampaign, *, seed: int, simpoint_id: int,
                  target_halfwidth: float = 0.01, confidence: float = 0.95,
                  max_trials: int = 1_000_000, min_trials: int = 1000,
                  start_batch: int = 0,
-                 initial_tallies: np.ndarray | None = None) -> CampaignResult:
+                 initial_tallies: np.ndarray | None = None,
+                 initial_strata: np.ndarray | None = None) -> CampaignResult:
     """Accumulate batches until the AVF CI is tight enough (the north-star
-    wall-clock loop).  ``start_batch``/``initial_tallies`` resume a
-    checkpointed campaign without replaying old batches."""
+    wall-clock loop).  ``start_batch``/``initial_tallies`` (and, for a
+    stratified campaign, ``initial_strata``) resume a checkpointed campaign
+    without replaying old batches.  A stratified run resumed WITHOUT its
+    strata (or capped before its first batch) falls back to the pooled
+    Wilson interval over everything it has, so the reported interval always
+    covers every counted trial."""
     sk = prng.structure_key(
         prng.simpoint_key(prng.campaign_key(seed), simpoint_id), structure_id)
+    stratified = campaign.stratify
     tallies = (np.zeros(C.N_OUTCOMES, dtype=np.int64)
                if initial_tallies is None
                else np.asarray(initial_tallies, dtype=np.int64).copy())
+    strata = None
+    if stratified:
+        from shrewd_tpu.ops.trial import N_STRATA
+        strata = (np.zeros((N_STRATA, C.N_OUTCOMES), dtype=np.int64)
+                  if initial_strata is None
+                  else np.asarray(initial_strata, dtype=np.int64).copy())
     trials = int(tallies.sum())
     batch_id = start_batch
     t0 = time.monotonic()
     converged = False
+
+    def _strata_pairs():
+        vul_h = strata[:, C.OUTCOME_SDC] + strata[:, C.OUTCOME_DUE]
+        n_h = strata.sum(axis=1)
+        return list(zip(vul_h.tolist(), n_h.tolist()))
+
     while trials < max_trials:
         keys = prng.trial_keys(prng.batch_key(sk, batch_id), batch_size)
-        t = np.asarray(campaign.tally_batch(keys), dtype=np.int64)
+        if stratified:
+            th = np.asarray(campaign.tally_batch_stratified(keys),
+                            dtype=np.int64)
+            strata += th
+            t = th.sum(axis=0)
+        else:
+            t = np.asarray(campaign.tally_batch(keys), dtype=np.int64)
         tallies += t
         trials += batch_size
         batch_id += 1
@@ -158,8 +215,17 @@ def run_until_ci(campaign: ShardedCampaign, *, seed: int, simpoint_id: int,
         debug.dprintf("CampaignStep", "%s batch %d: trials=%d avf=%.4f",
                       campaign.structure, batch_id, trials,
                       vulnerable / max(trials, 1))
-        if stopping.should_stop(vulnerable, trials, target_halfwidth,
-                                confidence, min_trials):
+        # strata cover every counted trial only when the whole history ran
+        # stratified (fresh run, or resume that passed initial_strata)
+        strata_complete = stratified and int(strata.sum()) == trials
+        if strata_complete:
+            if stopping.should_stop_stratified(
+                    _strata_pairs(), target_halfwidth, confidence,
+                    min_trials):
+                converged = True
+                break
+        elif stopping.should_stop(vulnerable, trials, target_halfwidth,
+                                  confidence, min_trials):
             converged = True
             break
     wall = time.monotonic() - t0
@@ -170,7 +236,9 @@ def run_until_ci(campaign: ShardedCampaign, *, seed: int, simpoint_id: int,
         trials=trials,
         batches=batch_id - start_batch,
         avf=vulnerable / max(trials, 1),
-        avf_interval=stopping.wilson(vulnerable, trials, confidence),
+        avf_interval=(stopping.post_stratified(_strata_pairs(), confidence)
+                      if stratified and int(strata.sum()) == trials
+                      else stopping.wilson(vulnerable, trials, confidence)),
         sdc_interval=stopping.wilson(
             int(tallies[C.OUTCOME_SDC]), trials, confidence),
         wall_seconds=wall,
@@ -178,4 +246,5 @@ def run_until_ci(campaign: ShardedCampaign, *, seed: int, simpoint_id: int,
                                         else initial_tallies.sum())) / wall
         if wall > 0 else float("inf"),
         converged=converged,
+        strata_tallies=strata,
     )

@@ -71,3 +71,45 @@ def trials_needed(p_guess: float, target_halfwidth: float,
     z = z_value(confidence)
     p = min(max(p_guess, 1e-12), 1 - 1e-12)
     return int(math.ceil(z * z * p * (1 - p) / (target_halfwidth ** 2)))
+
+
+def post_stratified(tallies_h, confidence: float = 0.95) -> Interval:
+    """Post-stratified proportion estimate from per-stratum (vulnerable,
+    trials) counts: ``tallies_h`` is a sequence of (successes_h, n_h).
+
+    Stratum weights are the OBSERVED allocation shares W_h = n_h / n (the
+    sampler draws strata at their natural rates, so the observed shares are
+    unbiased weights); the estimator is p̂ = Σ W_h p̂_h with variance
+    Σ W_h² p̃_h(1-p̃_h)/n_h — ≤ the pooled binomial variance when
+    per-stratum rates differ (classic post-stratification; normal-approx
+    interval, adequate at campaign trial counts).  The variance uses the
+    Agresti-Coull-adjusted p̃_h = (s_h+2)/(n_h+4), never the raw p̂_h: a
+    tiny stratum with all-vulnerable or all-masked trials would otherwise
+    contribute ZERO variance and stop the campaign before the claimed
+    coverage holds.  Empty strata contribute nothing."""
+    n = sum(nh for _s, nh in tallies_h)
+    if n <= 0:
+        return Interval(float("nan"), 0.0, 1.0)
+    z = z_value(confidence)
+    p = 0.0
+    var = 0.0
+    for s_h, n_h in tallies_h:
+        if n_h <= 0:
+            continue
+        w = n_h / n
+        p += w * (s_h / n_h)
+        pt = (s_h + 2.0) / (n_h + 4.0)
+        var += w * w * pt * (1.0 - pt) / n_h
+    margin = z * math.sqrt(var)
+    return Interval(p, max(0.0, p - margin), min(1.0, p + margin))
+
+
+def should_stop_stratified(tallies_h, target_halfwidth: float,
+                           confidence: float = 0.95,
+                           min_trials: int = 1000) -> bool:
+    """Stratified stopping rule (post_stratified interval vs target)."""
+    n = sum(nh for _s, nh in tallies_h)
+    if n < min_trials:
+        return False
+    return post_stratified(tallies_h,
+                           confidence).halfwidth <= target_halfwidth
