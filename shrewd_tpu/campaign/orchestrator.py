@@ -39,7 +39,7 @@ from shrewd_tpu.utils import debug, prng
 
 debug.register_flag("Campaign", "orchestrator progress")
 
-CKPT_VERSION = 2
+CKPT_VERSION = 3
 
 # Campaign-checkpoint upgraders — the ``util/cpt_upgraders/`` analog
 # (reference keeps one script per version tag and applies them in sequence
@@ -60,7 +60,19 @@ def _upgrade_v1(doc: dict) -> None:
     doc["version"] = 2
 
 
-CKPT_UPGRADERS = {1: _upgrade_v1}
+def _upgrade_v2(doc: dict) -> None:
+    """v2 → v3: per-(simpoint, structure) strata tallies for the
+    post-stratified estimator (parallel/stopping.post_stratified).  Old
+    checkpoints carry none — a campaign resumed from one stays on the
+    pooled Wilson rule for good (its strata can never cover the
+    pre-upgrade trials), which is the conservative correct reading."""
+    for per_structure in doc.get("state", {}).values():
+        for st_doc in per_structure.values():
+            st_doc.setdefault("strata", None)
+    doc["version"] = 3
+
+
+CKPT_UPGRADERS = {1: _upgrade_v1, 2: _upgrade_v2}
 
 
 def upgrade_checkpoint(doc: dict) -> dict:
@@ -112,6 +124,9 @@ class _State:
         # were silently zeroed across checkpoints before)
         self.escapes = 0
         self.taint_trials = 0
+        # v3: strata history for the post-stratified estimator (None when
+        # the campaign runs unstratified or predates v3)
+        self.strata: np.ndarray | None = None
 
     @property
     def trials(self) -> int:
@@ -121,7 +136,9 @@ class _State:
         return {"tallies": self.tallies.tolist(),
                 "next_batch": self.next_batch,
                 "converged": self.converged, "done": self.done,
-                "escapes": self.escapes, "taint_trials": self.taint_trials}
+                "escapes": self.escapes, "taint_trials": self.taint_trials,
+                "strata": (None if self.strata is None
+                           else self.strata.tolist())}
 
     @classmethod
     def from_dict(cls, d: dict) -> "_State":
@@ -132,6 +149,8 @@ class _State:
         st.done = bool(d["done"])
         st.escapes = int(d["escapes"])
         st.taint_trials = int(d["taint_trials"])
+        if d.get("strata") is not None:
+            st.strata = np.asarray(d["strata"], dtype=np.int64)
         return st
 
 
@@ -279,7 +298,10 @@ class Orchestrator:
         key = (sp_idx, structure)
         if key not in self._campaigns:
             kernel, sub = self.kernel_for(sp_idx, structure)
-            self._campaigns[key] = ShardedCampaign(kernel, self.mesh, sub)
+            stratify = (self.plan.stratify
+                        and hasattr(kernel, "run_keys_stratified"))
+            self._campaigns[key] = ShardedCampaign(kernel, self.mesh, sub,
+                                                   stratify=stratify)
         return self._campaigns[key]
 
     # --- the drive loop ---
@@ -322,9 +344,19 @@ class Orchestrator:
             vulnerable = int(st.tallies[C.OUTCOME_SDC] +
                              st.tallies[C.OUTCOME_DUE])
             avf_now = vulnerable / max(st.trials, 1)
-            converged = st.trials > 0 and stopping.should_stop(
-                vulnerable, st.trials, plan.target_halfwidth,
-                plan.confidence, plan.min_trials)
+            # strata cover every counted trial only when the whole history
+            # ran stratified (v3 fresh run or faithful resume)
+            strata_ok = camp.stratify and stopping.strata_cover_trials(
+                st.strata, st.trials)
+            if strata_ok:
+                pairs = stopping.pairs_from_strata(st.strata)
+                converged = st.trials > 0 and stopping.should_stop_stratified(
+                    pairs, plan.target_halfwidth, plan.confidence,
+                    plan.min_trials)
+            else:
+                converged = st.trials > 0 and stopping.should_stop(
+                    vulnerable, st.trials, plan.target_halfwidth,
+                    plan.confidence, plan.min_trials)
             capped = st.trials >= plan.max_trials
             if converged or capped:
                 st.converged = converged
@@ -333,8 +365,10 @@ class Orchestrator:
                     simpoint=sp_name, structure=structure,
                     tallies=st.tallies.copy(), trials=st.trials,
                     avf=avf_now,
-                    avf_interval=stopping.wilson(vulnerable, st.trials,
-                                                 plan.confidence),
+                    avf_interval=(stopping.post_stratified(
+                        pairs, plan.confidence) if strata_ok
+                        else stopping.wilson(vulnerable, st.trials,
+                                             plan.confidence)),
                     sdc_interval=stopping.wilson(
                         int(st.tallies[C.OUTCOME_SDC]), st.trials,
                         plan.confidence),
@@ -353,7 +387,15 @@ class Orchestrator:
             # and resume restores prior counts — assignment would clobber)
             esc0 = int(getattr(camp.kernel, "escapes", 0))
             tt0 = int(getattr(camp.kernel, "taint_trials", 0))
-            tally = np.asarray(camp.tally_batch(keys), dtype=np.int64)
+            if camp.stratify:
+                th = np.asarray(camp.tally_batch_stratified(keys),
+                                dtype=np.int64)
+                if st.strata is None:
+                    st.strata = np.zeros_like(th)
+                st.strata += th
+                tally = th.sum(axis=0)
+            else:
+                tally = np.asarray(camp.tally_batch(keys), dtype=np.int64)
             st.tallies += tally
             st.next_batch += 1
             st.escapes += int(getattr(camp.kernel, "escapes", 0)) - esc0

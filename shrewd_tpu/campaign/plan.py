@@ -126,6 +126,11 @@ class CampaignPlan(ConfigObject):
     cache = Child(CacheConfig)
     mesi = Child(MesiConfig)
     noc = Child(NocConfig)
+    stratify = Param(bool, False,
+                     "post-stratified AVF estimation for the O3/Minor "
+                     "structures (parallel/stopping.post_stratified): "
+                     "~1.2-1.3x fewer trials to the CI target; tier "
+                     "kernels without a stratified path run unstratified")
     coherence_accesses = Param(int, 512,
                                "torture-stream length for mesi:/noc: tiers",
                                check=lambda v: v > 0)

@@ -195,9 +195,7 @@ def run_until_ci(campaign: ShardedCampaign, *, seed: int, simpoint_id: int,
     converged = False
 
     def _strata_pairs():
-        vul_h = strata[:, C.OUTCOME_SDC] + strata[:, C.OUTCOME_DUE]
-        n_h = strata.sum(axis=1)
-        return list(zip(vul_h.tolist(), n_h.tolist()))
+        return stopping.pairs_from_strata(strata)
 
     while trials < max_trials:
         keys = prng.trial_keys(prng.batch_key(sk, batch_id), batch_size)
@@ -217,7 +215,8 @@ def run_until_ci(campaign: ShardedCampaign, *, seed: int, simpoint_id: int,
                       vulnerable / max(trials, 1))
         # strata cover every counted trial only when the whole history ran
         # stratified (fresh run, or resume that passed initial_strata)
-        strata_complete = stratified and int(strata.sum()) == trials
+        strata_complete = stratified and stopping.strata_cover_trials(
+            strata, trials)
         if strata_complete:
             if stopping.should_stop_stratified(
                     _strata_pairs(), target_halfwidth, confidence,
@@ -237,7 +236,8 @@ def run_until_ci(campaign: ShardedCampaign, *, seed: int, simpoint_id: int,
         batches=batch_id - start_batch,
         avf=vulnerable / max(trials, 1),
         avf_interval=(stopping.post_stratified(_strata_pairs(), confidence)
-                      if stratified and int(strata.sum()) == trials
+                      if stratified and stopping.strata_cover_trials(
+                          strata, trials)
                       else stopping.wilson(vulnerable, trials, confidence)),
         sdc_interval=stopping.wilson(
             int(tallies[C.OUTCOME_SDC]), trials, confidence),

@@ -113,3 +113,23 @@ def should_stop_stratified(tallies_h, target_halfwidth: float,
         return False
     return post_stratified(tallies_h,
                            confidence).halfwidth <= target_halfwidth
+
+
+def pairs_from_strata(strata) -> list:
+    """(N_STRATA, N_OUTCOMES) tally → [(vulnerable_h, n_h), ...] for
+    post_stratified/should_stop_stratified.  The single definition of
+    "vulnerable" for stratified stopping — the orchestrator and
+    run_until_ci must not diverge on it."""
+    from shrewd_tpu.ops import classify as C
+
+    import numpy as np
+    s = np.asarray(strata)
+    vul_h = s[:, C.OUTCOME_SDC] + s[:, C.OUTCOME_DUE]
+    return list(zip(vul_h.tolist(), s.sum(axis=1).tolist()))
+
+
+def strata_cover_trials(strata, trials: int) -> bool:
+    """True iff the strata history accounts for every counted trial (the
+    gate for using the stratified rule over pooled Wilson)."""
+    import numpy as np
+    return strata is not None and int(np.asarray(strata).sum()) == trials

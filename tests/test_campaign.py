@@ -309,3 +309,49 @@ def test_coherence_simpoint_name_reserved():
         CampaignPlan(simpoints=[WorkloadSpec(
             name="coherence", workload=WorkloadConfig(n=64))],
             structures=["regfile"])
+
+
+def test_stratified_plan_runs_and_checkpoints(tmp_path):
+    """plan.stratify=True: O3 structures use the post-stratified estimator
+    (tier kernels fall back to unstratified), strata survive
+    checkpoint/resume, and v2-era checkpoints upgrade to v3."""
+    import json
+
+    from shrewd_tpu.campaign.orchestrator import (CKPT_VERSION,
+                                                  Orchestrator,
+                                                  upgrade_checkpoint)
+    from shrewd_tpu.campaign.plan import CampaignPlan
+    from shrewd_tpu.sim.exit_event import ExitEvent
+
+    plan = _tiny_plan(structures=["regfile", "mesi:state"], stratify=True,
+                      max_trials=128, min_trials=64,
+                      checkpoint_every=1, coherence_accesses=64,
+                      coherence_mem_words=64)
+    orch = Orchestrator(plan, outdir=str(tmp_path))
+    for event, _ in orch.events():
+        if event == ExitEvent.CAMPAIGN_COMPLETE:
+            break
+    st = orch.state[("w0", "regfile")]
+    assert st.strata is not None
+    assert int(st.strata.sum()) == st.trials
+    np.testing.assert_array_equal(st.strata.sum(axis=0), st.tallies)
+    # mesi tier has no stratified path → unstratified state
+    assert orch.state[("coherence", "mesi:state")].strata is None
+
+    ckpt = orch.checkpoint()
+    orch2 = Orchestrator.resume(ckpt)
+    st2 = orch2.state[("w0", "regfile")]
+    np.testing.assert_array_equal(st2.strata, st.strata)
+
+    # v2-format document upgrades in place
+    with open(f"{ckpt}/campaign.json") as f:
+        doc = json.load(f)
+    assert doc["version"] == CKPT_VERSION
+    for per_s in doc["state"].values():
+        for st_doc in per_s.values():
+            st_doc.pop("strata")
+    doc["version"] = 2
+    upgrade_checkpoint(doc)
+    assert doc["version"] == CKPT_VERSION
+    assert all("strata" in st_doc for per_s in doc["state"].values()
+               for st_doc in per_s.values())
