@@ -128,6 +128,15 @@ def cmd_trace(args) -> int:
                                      mem_words=1024,
                                      working_set_words=256,
                                      seed=args.seed))
+    if args.pipeline:
+        from shrewd_tpu.models.timing import compute_scoreboard
+        from shrewd_tpu.trace.pipeview import dump_pipeview
+
+        sb = compute_scoreboard(tr)
+        n = dump_pipeview(tr, sb, out=sys.stdout, start=args.start,
+                          count=args.n)
+        _log(f"rendered {n} µops")
+        return 0
     kern = TrialKernel(tr, O3Config(pallas="off"))
     n = exec_trace(tr, kern.golden_rec, out=sys.stdout, start=args.start,
                    count=args.n)
@@ -198,6 +207,9 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--all", action="store_true",
                    help="ExecAll (results + opclasses)")
     p.add_argument("--results", action="store_true", help="ExecResult")
+    p.add_argument("--pipeline", action="store_true",
+                   help="render scoreboard pipeline timelines "
+                        "(the o3-pipeview analog) instead of exec lines")
     p.set_defaults(fn=cmd_trace)
 
     p = sub.add_parser("bench", parents=[common],

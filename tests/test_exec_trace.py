@@ -87,3 +87,47 @@ def test_cli_trace_subcommand(capsys):
     assert rc == 0
     out = capsys.readouterr().out
     assert len(out.splitlines()) == 8
+
+
+class TestPipeview:
+    """Pipeline-activity renderer (trace/pipeview.py; the o3-pipeview
+    analog over the scoreboard timing model)."""
+
+    def test_rows_render_markers_in_order(self):
+        from shrewd_tpu.models.timing import compute_scoreboard
+        from shrewd_tpu.trace.pipeview import dump_pipeview
+
+        tr = _trace(n=32)
+        sb = compute_scoreboard(tr)
+        buf = io.StringIO()
+        n = dump_pipeview(tr, sb, out=buf, count=16)
+        lines = buf.getvalue().splitlines()
+        assert n == 16 and len(lines) == 17      # header + rows
+        for ln in lines[1:]:
+            body = ln[ln.index("[") + 1:ln.index("]")]
+            for a, b in (("D", "I"), ("I", "W"), ("W", "C")):
+                if a in body and b in body:
+                    assert body.index(a) <= body.index(b), ln
+
+    def test_window_clamps_and_scales(self):
+        from shrewd_tpu.models.timing import compute_scoreboard
+        from shrewd_tpu.trace.pipeview import dump_pipeview
+
+        tr = _trace(n=64)
+        sb = compute_scoreboard(tr)
+        buf = io.StringIO()
+        assert dump_pipeview(tr, sb, out=buf, start=1000, count=8) == 0
+        buf = io.StringIO()
+        n = dump_pipeview(tr, sb, out=buf, count=64, max_width=20)
+        body = buf.getvalue().splitlines()[1]
+        assert n == 64
+        assert body.index("]") - body.index("[") <= 22   # compressed
+
+    def test_cli_pipeline_flag(self, capsys):
+        from shrewd_tpu.main import main
+
+        rc = main(["trace", "--pipeline", "-n", "6"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "D dispatch" in out
+        assert len(out.splitlines()) == 7
