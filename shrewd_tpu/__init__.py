@@ -14,16 +14,24 @@ AVF/SDC tallies reduced via ``psum``.
 
 Package layout
 --------------
-- ``shrewd_tpu.utils``    — typed params/config system, units, PRNG, debug
-- ``shrewd_tpu.stats``    — statistics framework (gem5 ``base/statistics.hh`` analog)
+- ``shrewd_tpu.utils``    — typed params/config, units, PRNG, debug flags,
+  probes, MemChecker
+- ``shrewd_tpu.stats``    — statistics framework with text/json/HDF5 dumps
 - ``shrewd_tpu.isa``      — the µop dataflow ISA used for trace replay
-- ``shrewd_tpu.trace``    — trace schema, synthetic workloads, native engine
-- ``shrewd_tpu.models``   — fault-target machine models (O3, Minor, Ruby, FUs)
+- ``shrewd_tpu.trace``    — trace schema, synthetic workloads, Exec tracer,
+  pipeline viewer
+- ``shrewd_tpu.models``   — fault-target machine models (O3 + scoreboard
+  timing, Minor latches, cache lifetime, MESI protocol, NoC, FU pool)
 - ``shrewd_tpu.ops``      — inject / replay / classify kernels (JAX + Pallas)
-- ``shrewd_tpu.parallel`` — mesh, sharded campaign step, CI stopping
-- ``shrewd_tpu.sim``      — Simulator / orchestrator / checkpointing
-- ``shrewd_tpu.ingest``   — gem5 artifact parsers (m5.cpt, config.ini, stats.txt)
+- ``shrewd_tpu.parallel`` — mesh, sharded campaign step (device escape
+  resolution, post-stratified estimation), CI stopping, multi-host init
+- ``shrewd_tpu.campaign`` — plans, orchestrator, checkpoint/resume+upgraders
+- ``shrewd_tpu.sim``      — Simulator / typed exit-event protocol
+- ``shrewd_tpu.ingest``   — real-workload path (ptrace capture, x86→µop
+  lifter, m5.cpt checkpoints, SimPoints, host-diff, 64-bit emulator)
 - ``shrewd_tpu.native``   — ctypes bindings to the C++ runtime (csrc/)
+
+Entry point: ``python -m shrewd_tpu`` (run/resume/hostdiff/trace/bench).
 """
 
 from shrewd_tpu._version import __version__

@@ -238,9 +238,15 @@ class Emulator:
             # correctly); without one, a zeroed synthetic block gives
             # single-threaded defaults.
             return (self.fs_base + op.disp) & M64
+        # segment overrides FIRST: a segment-prefixed rip-relative form
+        # must not slip past via the rip_rel early-return below (fs would
+        # silently read non-TLS memory; gs must stop loudly)
+        if op.seg == "gs":
+            raise StopEmu("gs-relative access (no gs_base captured)")
+        seg_base = self.fs_base if op.seg == "fs" else 0
         if op.rip_rel:
-            return op.disp & M64
-        a = op.disp
+            return (seg_base + op.disp) & M64
+        a = op.disp + seg_base
         if op.base >= 0:
             a += self.reg[op.base]
         if op.index >= 0:

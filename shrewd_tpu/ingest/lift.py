@@ -152,6 +152,7 @@ class Operand:
     scale: int = 1
     disp: int = 0
     rip_rel: bool = False
+    seg: str = ""               # segment override: "fs"/"gs" ("" = none)
 
 
 _LINE_RE = re.compile(
@@ -180,12 +181,25 @@ def _parse_operand(tok: str, comment_addr: int | None) -> Operand | None:
             # %gs: gets its OWN code (-5): no gs_base is captured, and
             # resolving it against fs_base would silently read the wrong
             # TLS block — the emulator stops loudly instead.
+            segname = name[:2]
+            rest = name[3:]
             try:
                 return Operand("mem",
-                               base=-4 if name.startswith("fs:") else -5,
-                               disp=int(name[3:], 0))
+                               base=-4 if segname == "fs" else -5,
+                               disp=int(rest, 0))
             except ValueError:
-                return Operand("mem", base=-3)
+                pass
+            # register-indirect segment forms ("%fs:(%rax)",
+            # "%fs:0x10(,%rbx,4)"): parse the inner mem operand and mark
+            # the override — the emulator adds fs_base to the computed ea
+            # (gs still stops loudly); the lifter demotes like the
+            # absolute forms
+            inner = _parse_operand(rest, comment_addr)
+            if inner is not None and inner.kind == "mem" \
+                    and inner.base != -3:
+                inner.seg = segname
+                return inner
+            return Operand("mem", base=-3)
         return Operand("reg", reg=-2)           # non-GPR (xmm, seg, ...)
     if tok.startswith("*"):
         # indirect target: "*%rax", "*(%rip)", "*0x0(%rbp,%rbx,8)" — parse
@@ -401,7 +415,7 @@ class Lifter:
 
     def _ea_of(self, op: Operand, regs: np.ndarray) -> int | None:
         """Full-64-bit effective address from captured registers."""
-        if op.base in (-3, -4, -5):
+        if op.base in (-3, -4, -5) or op.seg:
             return None
         ea = op.disp
         if op.rip_rel:
@@ -442,7 +456,7 @@ class Lifter:
             if inst.mnemonic in ("pop", "popq"):
                 touched.setdefault(pc, set()).add(int(steps[i][4]))
             for op in inst.operands:
-                if op.kind != "mem" or op.base in (-3, -4, -5):
+                if op.kind != "mem" or op.base in (-3, -4, -5) or op.seg:
                     continue
                 ea = self._ea_of(op, steps[i])
                 if ea is not None:

@@ -90,7 +90,7 @@ class Access(NamedTuple):
 
 
 def _ea(op: Operand, regs: np.ndarray) -> int | None:
-    if op.base in (-3, -4, -5):
+    if op.base in (-3, -4, -5) or op.seg:
         return None
     if op.rip_rel:
         return op.disp

@@ -217,7 +217,14 @@ class ResidencySampler:
 
     def sample(self, key: jax.Array) -> tuple[jax.Array, jax.Array]:
         """→ (entry, step): the struck µop, residency-mass weighted; the
-        replay landing step is the µop itself."""
+        replay landing step is the µop itself.
+
+        The cumulative lookup is a compare-sum rather than
+        ``jnp.searchsorted``: equivalent for u ∈ [0, total) (count of
+        cum ≤ u == right-bisection index), one elementwise op instead of
+        the nested scan-pjit searchsorted lowers to — which XLA's CPU
+        backend was observed to segfault on when compiled under vmap deep
+        into a long test session."""
         u = jax.random.randint(key, (), 0, self.total, dtype=i32)
-        entry = jnp.searchsorted(self.cum, u, side="right").astype(i32)
+        entry = jnp.sum(u >= self.cum).astype(i32)
         return entry, entry

@@ -27,3 +27,19 @@ jax.config.update("jax_platforms", "cpu")
 
 def pytest_report_header(config):
     return f"jax devices: {jax.device_count()} ({jax.default_backend()})"
+
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_between_modules():
+    """Bound accumulated XLA-CPU compile state: a full-suite run (~300
+    tests, hundreds of jit compilations on the 8-device host platform)
+    was observed to segfault inside ``backend_compile_and_load`` late in
+    the session (reproducibly at the same test in full-suite order, never
+    in any subset).  Dropping dead executables between modules keeps the
+    backend's live compilation state small; module-scoped fixtures die at
+    the same boundary, so almost nothing live gets recompiled."""
+    yield
+    jax.clear_caches()

@@ -141,3 +141,29 @@ class TestSuffixStems:
             emu.ea(op)
         # fs still resolves (synthetic fallback base)
         assert emu.ea(fs) == emu.fs_base + 0x28
+
+
+def test_fs_register_indirect_segment_override():
+    """'%fs:(%rax)'-style register-indirect TLS operands parse with the
+    seg override and resolve against fs_base (review r3: they previously
+    fell to base=-3 'unparsed' and killed whole-program emulation in
+    glibc's TLS-heavy paths)."""
+    import numpy as np
+
+    from shrewd_tpu.ingest.emu import Emulator
+    from shrewd_tpu.ingest.lift import _parse_operand
+
+    op = _parse_operand("%fs:(%rax)", None)
+    assert op.kind == "mem" and op.base == 0 and op.seg == "fs"
+    op2 = _parse_operand("%fs:0x10(,%rbx,8)", None)
+    assert op2.seg == "fs" and op2.index == 3 and op2.scale == 8 \
+        and op2.disp == 0x10
+    regs = np.zeros(18, np.uint64)
+    regs[0] = 0x40                         # rax
+    emu = Emulator({}, regs, [], pc=0)
+    assert emu.ea(op) == emu.fs_base + 0x40
+    gs = _parse_operand("%gs:(%rax)", None)
+    assert gs.seg == "gs"
+    from shrewd_tpu.ingest.emu import StopEmu
+    with pytest.raises(StopEmu, match="gs-relative"):
+        emu.ea(gs)

@@ -1,8 +1,11 @@
 /* Rotate + exchange torture: exercises the rol/ror and xchg lifts
  * (ingest/lift.py) on 32-bit registers and memory operands.  Same
  * marker contract as the other workloads (kernel_begin/kernel_end). */
+/* Output via one write(2) with a hand-rolled hex formatter, like the
+ * other workloads: no libc in the measured window or the output path
+ * (the 64-bit emulator replays these programs end-to-end). */
 #include <stdint.h>
-#include <stdio.h>
+#include <unistd.h>
 
 #define N 96
 
@@ -35,6 +38,12 @@ int main(void) {
     kernel_begin();
     uint32_t h = rotmix();
     kernel_end();
-    printf("%08x\n", h);
+    char buf[10];
+    for (int i = 0; i < 8; i++) {
+        unsigned d = (h >> (28 - 4 * i)) & 0xF;
+        buf[i] = d < 10 ? '0' + d : 'a' + (d - 10);
+    }
+    buf[8] = '\n';
+    write(1, buf, 9);
     return 0;
 }
