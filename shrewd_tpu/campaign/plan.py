@@ -127,10 +127,12 @@ class CampaignPlan(ConfigObject):
     mesi = Child(MesiConfig)
     noc = Child(NocConfig)
     stratify = Param(bool, False,
-                     "post-stratified AVF estimation for the O3/Minor "
-                     "structures (parallel/stopping.post_stratified): "
-                     "~1.2-1.3x fewer trials to the CI target; tier "
-                     "kernels without a stratified path run unstratified")
+                     "post-stratified AVF estimation "
+                     "(parallel/stopping.post_stratified) across every "
+                     "structure: cycle octiles for O3/Minor/cache/MESI, "
+                     "fault-type classes for the NoC; ~1.2-1.3x fewer "
+                     "trials to the CI target on the O3 structures, more "
+                     "where outcomes are stratum-determined (NoC)")
     coherence_accesses = Param(int, 512,
                                "torture-stream length for mesi:/noc: tiers",
                                check=lambda v: v > 0)

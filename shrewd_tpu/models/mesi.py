@@ -423,3 +423,18 @@ class MesiKernel:
 
     def run_keys(self, keys: jax.Array, structure: str) -> jax.Array:
         return C.tally(self.outcomes_from_keys(keys, structure))
+
+    def run_keys_stratified(self, keys: jax.Array, structure: str
+                            ) -> tuple[jax.Array, jax.Array]:
+        """Keys → ((N_STRATA, N_OUTCOMES) tally, 0): strata are landing-
+        access octiles over the stream (ops/trial.py contract) — late
+        protocol-state flips have fewer chances to be exercised before
+        the window ends, so per-octile rates differ."""
+        from shrewd_tpu.ops.trial import N_STRATA
+
+        faults = self.sample_batch(keys, structure)
+        out = jax.vmap(lambda f: self._classify(f))(faults)
+        A = int(self.trace.core.shape[0])
+        strata = jnp.clip(faults.cycle * N_STRATA // max(A, 1),
+                          0, N_STRATA - 1)
+        return C.tally_stratified(out, strata, N_STRATA), jnp.int32(0)

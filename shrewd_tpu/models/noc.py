@@ -65,6 +65,20 @@ FAULT_TYPE_NAMES = (
     "erroneous_allocation__switch", "unfair_arbitration",
 )
 
+# stratum class per fault type (post-stratified tallies,
+# run_keys_stratified): data / flit-conservation / misroute / credit /
+# allocation+arbitration.  Keyed by the FT_* constants so adding or
+# reordering a type without classifying it fails at import, not by a
+# silent clamped gather.
+_TYPE_CLASS = {FT_DATA_FEW_BITS: 0, FT_DATA_ALL_BITS: 0,
+               FT_FLIT_DUP: 1, FT_FLIT_LOSS: 1,
+               FT_MISROUTE: 2,
+               FT_CREDIT_GEN: 3, FT_CREDIT_LOSS: 3,
+               FT_ALLOC_VC: 4, FT_ALLOC_SW: 4, FT_ARBITRATION: 4}
+TYPE_CLASS_TABLE = np.array([_TYPE_CLASS[t] for t in range(N_FAULT_TYPES)],
+                            np.int32)
+N_TYPE_CLASSES = int(TYPE_CLASS_TABLE.max()) + 1
+
 # per-bit base probability of an upset per cycle at the baseline
 # temperature, by susceptibility class.  The absolute scale is arbitrary
 # (the reference's database is likewise unitless per-cycle probability);
@@ -473,3 +487,19 @@ class NocKernel:
     def run_keys(self, keys: jax.Array, structure: str = "router"
                  ) -> jax.Array:
         return C.tally(self.outcomes_from_keys(keys, structure))
+
+    def run_keys_stratified(self, keys: jax.Array,
+                            structure: str = "router"
+                            ) -> tuple[jax.Array, jax.Array]:
+        """Keys → ((N_STRATA, N_OUTCOMES) tally, 0): strata are fault-TYPE
+        classes (data / flit-conservation / misroute / credit /
+        allocation+arbitration) — the outcome is largely type-determined
+        (data hits → SDC, losses → DUE, arbitration → masked), so
+        within-stratum variance is small and the post-stratified CI
+        tightens far faster than the pooled one."""
+        from shrewd_tpu.ops.trial import N_STRATA
+
+        faults = self.sample_batch(keys, structure)
+        out = jax.vmap(self._classify)(faults)
+        strata = jnp.asarray(TYPE_CLASS_TABLE)[faults.ftype]
+        return C.tally_stratified(out, strata, N_STRATA), jnp.int32(0)

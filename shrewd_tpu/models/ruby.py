@@ -373,6 +373,23 @@ class CacheKernel:
     def run_keys(self, keys: jax.Array, structure: str) -> jax.Array:
         return C.tally(self.outcomes_from_keys(keys, structure))
 
+    def run_keys_stratified(self, keys: jax.Array, structure: str
+                            ) -> tuple[jax.Array, jax.Array]:
+        """Keys → ((N_STRATA, N_OUTCOMES) tally, 0): post-stratified tally
+        over fault-cycle octiles (ops/trial.py contract) — cache-line AVF
+        is strongly lifetime-position dependent (a flip just before the
+        next fill is almost always masked), so cycle strata separate
+        materially different rates."""
+        from shrewd_tpu.ops.trial import N_STRATA
+
+        faults = self.sampler(structure).sample_batch(keys)
+        fn = (self._classify_data if structure == "data"
+              else self._classify_line_meta)
+        out = jax.vmap(fn)(faults)
+        strata = jnp.clip(faults.cycle * N_STRATA
+                          // max(self.n_cycles, 1), 0, N_STRATA - 1)
+        return C.tally_stratified(out, strata, N_STRATA), jnp.int32(0)
+
 
 CACHE_STRUCTURES = ("data", "tag", "state")
 

@@ -313,7 +313,7 @@ def test_coherence_simpoint_name_reserved():
 
 def test_stratified_plan_runs_and_checkpoints(tmp_path):
     """plan.stratify=True: O3 structures use the post-stratified estimator
-    (tier kernels fall back to unstratified), strata survive
+    (every tier kernel now has one), strata survive
     checkpoint/resume, and v2-era checkpoints upgrade to v3."""
     import json
 
@@ -335,8 +335,11 @@ def test_stratified_plan_runs_and_checkpoints(tmp_path):
     assert st.strata is not None
     assert int(st.strata.sum()) == st.trials
     np.testing.assert_array_equal(st.strata.sum(axis=0), st.tallies)
-    # mesi tier has no stratified path → unstratified state
-    assert orch.state[("coherence", "mesi:state")].strata is None
+    # the MESI tier carries its own stratified path (landing-access
+    # octiles), so plan-level stratify covers it too
+    mst = orch.state[("coherence", "mesi:state")]
+    assert mst.strata is not None
+    assert int(mst.strata.sum()) == mst.trials
 
     ckpt = orch.checkpoint()
     orch2 = Orchestrator.resume(ckpt)

@@ -79,14 +79,12 @@ class TestDeviceTally:
         np.testing.assert_array_equal(sharded, single)
 
     def test_stratify_requires_capable_kernel(self):
-        from shrewd_tpu.models.mesi import (MesiConfig, MesiKernel,
-                                            torture_stream)
+        class Stub:
+            def outcomes_from_keys(self, keys, structure):
+                raise NotImplementedError
 
-        cfg = MesiConfig()
-        mk = MesiKernel(torture_stream(cfg, 32, 32, seed=1), cfg,
-                        np.arange(32, dtype=np.uint32))
         with pytest.raises(ValueError, match="stratified"):
-            ShardedCampaign(mk, make_mesh(), "state", stratify=True)
+            ShardedCampaign(Stub(), make_mesh(), "x", stratify=True)
 
 
 class TestRunUntilCI:
@@ -162,3 +160,70 @@ class TestReviewRegressions:
         with pytest.raises(ValueError, match="device"):
             ShardedCampaign(kernel, make_mesh(), "regfile",
                             resolution="host", stratify=True)
+
+
+class TestTierKernels:
+    """Tier kernels expose the same stratified-tally contract, so
+    plan.stratify covers them through the orchestrator automatically."""
+
+    def _mesi(self):
+        from shrewd_tpu.models.mesi import (MesiConfig, MesiKernel,
+                                            torture_stream)
+
+        cfg = MesiConfig()
+        return MesiKernel(torture_stream(cfg, 96, 64, seed=2), cfg,
+                          np.arange(64, dtype=np.uint32))
+
+    def test_mesi_strata_sum_matches_plain(self):
+        k = self._mesi()
+        keys = prng.trial_keys(prng.campaign_key(11), 128)
+        th, _ = k.run_keys_stratified(keys, "state")
+        t = k.run_keys(keys, "state")
+        np.testing.assert_array_equal(np.asarray(th).sum(axis=0),
+                                      np.asarray(t))
+
+    def test_cache_strata_sum_matches_plain(self):
+        from shrewd_tpu.models.ruby import (CacheConfig, CacheKernel,
+                                            golden_access_stream,
+                                            simulate_cache)
+        from shrewd_tpu.trace.synth import WorkloadConfig, generate
+
+        tr = generate(WorkloadConfig(n=128, nphys=32, mem_words=64,
+                                     working_set_words=32, seed=5))
+        cfg = CacheConfig(n_sets=4, n_ways=2, words_per_line=4)
+        tl, _ = simulate_cache(golden_access_stream(tr), cfg, n_cycles=tr.n)
+        k = CacheKernel(tl, cfg)
+        keys = prng.trial_keys(prng.campaign_key(12), 128)
+        for s in ("data", "tag", "state"):
+            th, _ = k.run_keys_stratified(keys, s)
+            t = k.run_keys(keys, s)
+            np.testing.assert_array_equal(np.asarray(th).sum(axis=0),
+                                          np.asarray(t))
+
+    def test_noc_strata_follow_type_classes(self):
+        from shrewd_tpu.models.mesi import MesiConfig, torture_stream
+        from shrewd_tpu.models.noc import (NocConfig, NocKernel,
+                                           build_message_trace)
+
+        mcfg = MesiConfig()
+        ncfg = NocConfig()
+        msgs = build_message_trace(torture_stream(mcfg, 96, 64, seed=3),
+                                   mcfg, ncfg)
+        k = NocKernel(msgs, ncfg)
+        keys = prng.trial_keys(prng.campaign_key(13), 256)
+        th, _ = k.run_keys_stratified(keys)
+        th = np.asarray(th)
+        t = np.asarray(k.run_keys(keys))
+        np.testing.assert_array_equal(th.sum(axis=0), t)
+        from shrewd_tpu.models.noc import N_TYPE_CLASSES
+        assert (th[:N_TYPE_CLASSES].sum(axis=1) > 0).sum() >= 2
+        assert th[N_TYPE_CLASSES:].sum() == 0
+
+    def test_sharded_campaign_accepts_tier_stratify(self):
+        from shrewd_tpu.parallel import ShardedCampaign, make_mesh
+
+        k = self._mesi()
+        camp = ShardedCampaign(k, make_mesh(), "state", stratify=True)
+        keys = prng.trial_keys(prng.campaign_key(14), 128)
+        th = np.asarray(camp.tally_batch_stratified(keys))
+        assert th.sum() == 128
