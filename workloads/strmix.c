@@ -1,0 +1,45 @@
+/* libc string-primitive torture: strlen/memcpy/memset on variable-length
+ * buffers — the calls resolve (via glibc's startup IFUNCs) to the SSE2/
+ * AVX2/erms variants, exercising the 64-bit emulator's SIMD + rep-string
+ * subset (ingest/emu.py).  Marker + write(2) contract as usual. */
+#include <stdint.h>
+#include <string.h>
+#include <unistd.h>
+
+#define N 192
+
+static char a[N + 1], b[N + 1];
+
+__attribute__((noinline)) void kernel_begin(void) { __asm__ volatile(""); }
+__attribute__((noinline)) void kernel_end(void) { __asm__ volatile(""); }
+
+__attribute__((noinline)) static uint32_t strmix(void) {
+    uint32_t h = 0x811C9DC5u;
+    uint32_t s = 424242;
+    for (int r = 0; r < 6; r++) {
+        s = s * 1103515245u + 12345u;
+        size_t n = 17 + (s % (N - 18));
+        memset(a, 'a' + (r % 7), n);
+        a[n] = 0;
+        h = (h ^ (uint32_t)strlen(a)) * 16777619u;
+        memcpy(b, a, n + 1);
+        h = (h ^ (uint32_t)strlen(b)) * 16777619u;
+        b[n / 2] = 0;
+        h = (h ^ (uint32_t)strlen(b)) * 16777619u;
+    }
+    return h;
+}
+
+int main(void) {
+    kernel_begin();
+    uint32_t h = strmix();
+    kernel_end();
+    char buf[10];
+    for (int i = 0; i < 8; i++) {
+        unsigned d = (h >> (28 - 4 * i)) & 0xF;
+        buf[i] = d < 10 ? '0' + d : 'a' + (d - 10);
+    }
+    buf[8] = '\n';
+    write(1, buf, 9);
+    return 0;
+}
