@@ -393,8 +393,8 @@ class Emulator:
             src, dst = ops
             v = self._simd_read(src, width)
             self._simd_write(dst, width, v)
-            if vex and dst.kind == "xmm" and width < 256:
-                self.xmm[dst.reg] &= (1 << width) - 1     # VEX zeroes upper
+            if vex and dst.kind == "xmm" and width < 512:
+                self.xmm[dst.reg] &= (1 << width) - 1   # VEX zeroes→MAXVL
             return
         if base in ("movd", "movq"):
             w = 32 if base == "movd" else 64
@@ -461,6 +461,10 @@ class Emulator:
                 and ops[-1].kind == "kreg":
             if base == "pcmpb":                 # predicate immediate form
                 pred, s2, s1, dst = ops
+                if pred.imm not in (0, 4):
+                    # 1 LT / 2 LE / 5 NLT / 6 NLE need signed per-byte
+                    # compares — stop loudly rather than mis-mask as EQ
+                    raise StopEmu(f"vpcmpb predicate imm {pred.imm}")
                 neq = pred.imm == 4
             else:
                 s2, s1, dst = ops
@@ -502,8 +506,11 @@ class Emulator:
                 r = self._per_byte(a, b, nb, lambda x, y: (x - y) & 0xFF)
             else:                                          # paddb
                 r = self._per_byte(a, b, nb, lambda x, y: (x + y) & 0xFF)
-            self._simd_write(dst, 256 if vex else width, r
-                             if not vex else r & ((1 << width) - 1))
+            # VEX/EVEX destination writes zero through MAXVL (bit 511) —
+            # `vpxor %xmm0,%xmm0,%xmm0` clears the whole zmm; SSE forms
+            # preserve everything above their width
+            self._simd_write(dst, 512 if vex else width,
+                             r & ((1 << width) - 1))
             return
         raise StopEmu(f"unsupported simd {m}")
 
@@ -562,20 +569,24 @@ class Emulator:
             src_o, dst = ops
             v = self.read(inst, src_o, w)
             if v == 0:
-                if m == "tzcnt":
-                    self.write(inst, dst, w, w)
-                elif m == "lzcnt":
+                res = w
+                if m in ("tzcnt", "lzcnt"):
                     self.write(inst, dst, w, w)
                 # bsf/bsr leave dst unchanged on zero
             else:
                 if m in ("bsf", "tzcnt"):
-                    idx = (v & -v).bit_length() - 1
+                    res = (v & -v).bit_length() - 1
                 elif m == "bsr":
-                    idx = v.bit_length() - 1
+                    res = v.bit_length() - 1
                 else:                                      # lzcnt
-                    idx = w - v.bit_length()
-                self.write(inst, dst, w, idx)
-            self.set_flags_res(v & mask, w)   # ZF tracks source == 0
+                    res = w - v.bit_length()
+                self.write(inst, dst, w, res)
+            if m in ("tzcnt", "lzcnt"):
+                # TZCNT/LZCNT define ZF from the *result* (BSF semantics
+                # — ZF = src==0 — would mis-steer branches after tzcnt)
+                self.set_flags_res(res & mask, w)
+            else:
+                self.set_flags_res(v & mask, w)   # bsf/bsr: ZF = src == 0
             self.pc = next_pc & M64
             return
         if m in ("nop", "nopw", "nopl", "endbr64") or m.startswith("nop"):

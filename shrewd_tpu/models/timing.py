@@ -208,9 +208,17 @@ class ResidencySampler:
     takes effect."""
 
     def __init__(self, start: np.ndarray, end: np.ndarray):
-        length = np.maximum(np.asarray(end) - np.asarray(start), 0)
+        length = np.maximum(
+            np.asarray(end, np.int64) - np.asarray(start, np.int64), 0)
         if length.sum() == 0:
             length = np.ones_like(length)        # degenerate: uniform
+        # The device draw is an i32 randint + i32 cumulative table; halve
+        # the mass (floor 1 for occupied entries, so none become
+        # unreachable) until it fits instead of silently wrapping.  The
+        # coarsening only perturbs weights by <2× on entries whose
+        # residency is ~1 cycle — negligible for stall-heavy structures.
+        while int(length.sum()) >= 2 ** 31:
+            length = np.where(length > 0, np.maximum(length >> 1, 1), 0)
         self.cum = jnp.asarray(np.cumsum(length), i32)
         self.total = int(length.sum())
         self.n = int(length.shape[0])

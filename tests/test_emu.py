@@ -273,3 +273,76 @@ class TestSimdSubset:
         coords = hd.sample_coords(1, 10, 0, bit_range=64)
         res = hd.run_device_emu64(paths, coords)
         assert res is not None                     # golden ran to exit 0
+
+    def test_evex_zmm_logical_writes_full_512(self):
+        """vpxord zmm,zmm,zmm self-zero must clear ALL 512 bits — a
+        256-bit write would leave stale bits 256-511 (glibc evex strlen
+        uses zmm vpminub/vpxor, so truncation skews host-diff silently)."""
+        e = self._emu()
+        e.xmm[5] = (1 << 511) | (1 << 300) | 0xDEAD
+        z5 = self._op("xmm", reg=5, width=512)
+        e._simd("vpxord", [z5, z5, z5])
+        assert e.xmm[5] == 0
+        # and vpminub at zmm width covers the full register too
+        e.xmm[6] = (0xFF << 504) | 0x01
+        e.xmm[7] = (0x02 << 504) | 0x05
+        z6 = self._op("xmm", reg=6, width=512)
+        z7 = self._op("xmm", reg=7, width=512)
+        e._simd("vpminub", [z6, z7, z7])
+        assert e.xmm[7] == (0x02 << 504) | 0x01
+
+    def test_vex128_zeroes_through_maxvl(self):
+        """The AVX-512 zeroing idiom `vpxor %xmm0,%xmm0,%xmm0` clears the
+        whole zmm (VEX/EVEX writes zero through MAXVL, bit 511) — zeroing
+        only to 255 would leave stale zmm bits for a later vpcmpb."""
+        e = self._emu()
+        e.xmm[3] = (0xAB << 500) | (0xCD << 128) | 0xF0
+        x3 = self._op("xmm", reg=3, width=128)
+        e._simd("vpxor", [x3, x3, x3])
+        assert e.xmm[3] == 0
+        # and a VEX.128 move zeroes 128..511 as well
+        e.xmm[4] = 1 << 300
+        e.xmm[5] = 0x42
+        e._simd("vmovdqu", [self._op("xmm", reg=5, width=128),
+                            self._op("xmm", reg=4, width=128)])
+        assert e.xmm[4] == 0x42
+
+    def test_vpcmpb_unsupported_predicate_stops_loudly(self):
+        from shrewd_tpu.ingest.emu import StopEmu
+
+        e = self._emu()
+        k0 = self._op("kreg", reg=0)
+        x0 = self._op("xmm", reg=0, width=128)
+        x1 = self._op("xmm", reg=1, width=128)
+        for imm in (1, 2, 5, 6):                   # LT/LE/NLT/NLE
+            with pytest.raises(StopEmu):
+                e._simd("vpcmpb", [self._op("imm", imm=imm), x0, x1, k0])
+
+    def test_tzcnt_zf_tracks_result_not_source(self):
+        """TZCNT ZF=1 iff result==0 (bit 0 set); BSF-style ZF=(src==0)
+        would invert the branch after `tzcnt; je`."""
+        import numpy as np
+
+        from shrewd_tpu.ingest.emu import Emulator
+        from shrewd_tpu.ingest.lift import Inst
+
+        e = Emulator({}, np.zeros(18, np.uint64), [], pc=0)
+        src = self._op("reg", reg=1, width=64)
+        dst = self._op("reg", reg=0, width=64)
+        e.reg[1] = 0b1                             # result 0 → ZF set
+        e.insts[0] = Inst(0, 3, "tzcnt", [src, dst], None)
+        e.step()
+        assert e.reg[0] == 0 and e.cond("e")
+        e.pc = 0
+        e.reg[1] = 0b1000                          # result 3 → ZF clear
+        e.step()
+        assert e.reg[0] == 3 and not e.cond("e")
+        e.pc = 0
+        e.reg[1] = 0                               # result 64 → ZF clear
+        e.step()
+        assert e.reg[0] == 64 and not e.cond("e")
+        # bsf keeps source-tracking ZF: src==0 → ZF set
+        e.pc = 0
+        e.insts[0] = Inst(0, 3, "bsf", [src, dst], None)
+        e.step()
+        assert e.cond("e")

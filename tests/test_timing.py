@@ -157,6 +157,25 @@ class TestResidencySampler:
         np.testing.assert_array_equal(np.asarray(entries),
                                       np.asarray(steps))
 
+    def test_overflowing_mass_rescales_instead_of_wrapping(self):
+        """A residency mass past 2^31 (large window × stall-heavy
+        structure) must coarsen, not wrap the i32 cumsum: the cumulative
+        table stays non-negative/monotone and zero-mass entries stay
+        unreachable."""
+        import jax
+
+        n = 64
+        start = np.zeros(n, np.int64)
+        end = np.full(n, 2 ** 26, np.int64)        # total 2^32 > i32
+        end[7] = 0                                 # one zero-mass entry
+        s = ResidencySampler(start, end)
+        cum = np.asarray(s.cum)
+        assert s.total < 2 ** 31 and s.total > 0
+        assert (np.diff(np.concatenate([[0], cum])) >= 0).all()
+        keys = prng.trial_keys(prng.campaign_key(3), 512)
+        entries, _ = jax.vmap(s.sample)(keys)
+        assert not (np.asarray(entries) == 7).any()
+
 
 class TestO3Integration:
     def test_scoreboard_sampler_runs_and_tallies(self):
