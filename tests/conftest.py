@@ -20,6 +20,17 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
 
+# Child interpreters (hostdiff tools, dist_launch, multihost tests) re-run
+# sitecustomize from PYTHONPATH; if that includes the axon TPU-tunnel site
+# and the relay is wedged, every child hangs at first device query.  Tests
+# are CPU-only by contract — scrub the tunnel site from what children see.
+_pp = [p for p in os.environ.get("PYTHONPATH", "").split(os.pathsep)
+       if p and "axon_site" not in p]
+if _pp:
+    os.environ["PYTHONPATH"] = os.pathsep.join(_pp)
+else:
+    os.environ.pop("PYTHONPATH", None)
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
@@ -30,6 +41,46 @@ def pytest_report_header(config):
 
 
 import pytest  # noqa: E402
+
+# --- quick/slow tiering (reference TESTING.md quick/long analog) ---------
+#
+# `pytest -m quick` is the <2-minute smoke tier for CI-on-every-push; the
+# full suite takes ~30 min on the 8-device virtual CPU mesh.  Because
+# almost every test pays a ~4 s XLA compile, the quick tier is a curated
+# WHITELIST (one representative per subsystem) rather than an exclusion
+# list — anything unlisted is slow, so the runtime bound holds as tests
+# are added.  SLOW_TESTS wins over a whole-module listing.
+
+QUICK_MODULES = {
+    # sub-second unit modules: host utilities, stats engine, m5.cpt
+    # ingest, trace format, the dedicated smoke module
+    "test_utils", "test_stats", "test_ingest", "test_trace",
+    "test_quick_smoke",
+}
+QUICK_TESTS = {
+    # one representative per subsystem (≈4-10 s each, compile-dominated)
+    "test_null_fault_is_masked",           # dense replay semantics
+    "test_regfile_fault_consumed_is_sdc",  # inject→propagate→classify
+    "test_unmapped_va_traps",              # VA crash model (MemMap)
+    "test_fp_fault_propagates_to_sdc",     # FP µop lanes
+    "test_lift_rate_is_high",              # capture → x86 lift
+}
+QUICK_CLASSES = {
+    "TestSuffixStems", "TestSimdSubset",   # emulator units, no capture
+}
+SLOW_TESTS = {
+    "test_strmix_emu64_runs_to_exit",      # whole-program emu, ~30 s
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        mod = item.module.__name__.rsplit(".", 1)[-1]
+        base = item.name.split("[", 1)[0]
+        cls = item.cls.__name__ if item.cls else ""
+        quick = (mod in QUICK_MODULES or base in QUICK_TESTS
+                 or cls in QUICK_CLASSES) and base not in SLOW_TESTS
+        item.add_marker(pytest.mark.quick if quick else pytest.mark.slow)
 
 
 @pytest.fixture(autouse=True, scope="module")

@@ -1,0 +1,25 @@
+"""Quick-tier end-to-end smoke: one tiny campaign through the real
+orchestrator, the thing every other quick test only exercises piecewise.
+The reference's quick tier runs miniature full configs the same way
+(TESTING.md); shapes here are chosen so the whole module stays under ~15 s
+on one CPU core, compile included."""
+
+from shrewd_tpu.campaign.orchestrator import Orchestrator
+from shrewd_tpu.campaign.plan import CampaignPlan, WorkloadSpec
+from shrewd_tpu.sim.exit_event import ExitEvent
+from shrewd_tpu.trace.synth import WorkloadConfig
+
+
+def test_tiny_campaign_end_to_end():
+    plan = CampaignPlan(
+        simpoints=[WorkloadSpec(
+            name="smoke",
+            workload=WorkloadConfig(n=64, nphys=32, mem_words=64,
+                                    working_set_words=32, seed=3))],
+        structures=["regfile"], batch_size=32, target_halfwidth=0.25,
+        confidence=0.95, max_trials=64, min_trials=32)
+    events = list(Orchestrator(plan).events())
+    assert events[-1][0] == ExitEvent.CAMPAIGN_COMPLETE
+    (res,) = events[-1][1].values()
+    assert res.trials >= 32 and res.tallies.sum() == res.trials
+    assert 0.0 <= res.avf <= 1.0
