@@ -17,6 +17,22 @@ axon → cpu; a wedged backend init is SIGKILLed and the next platform tried,
 so exactly one JSON line is always emitted (a diagnostic one in the worst
 case).  Progress and diagnostics go to stderr.
 
+Tunnel discipline (VERDICT r3 weak #1): before committing to the 420 s axon
+attempt, the supervisor health-probes the tunnel with a subprocess that
+either completes a trivial device op or *exits on its own* via a watchdog
+thread — it is never SIGKILLed mid-compile, which is exactly what wedges
+the relay for every later process.  A failed probe gets one recovery
+attempt (cool-down + re-probe) before falling back to CPU, and the emitted
+JSON carries a "tunnel" field so a CPU-fallback number is never mistaken
+for a healthy-tunnel measurement.
+
+Baseline discipline (VERDICT r3 weak #2: the serial baseline varied 2×
+between runs measured once from 2,048 trials): the serial C++ rate is
+measured with ≥5 repetitions/median, and if a pinned measurement exists at
+BASELINE_MEASURED.json (committed; produce with --pin-baseline) the
+headline vs_baseline is computed against the *pinned* median while the
+fresh one is reported alongside as vs_baseline_fresh.
+
 --quick shrinks shapes for CI smoke runs.
 """
 
@@ -31,6 +47,11 @@ import sys
 import time
 
 PLATFORM_TIMEOUTS = (("axon", 420.0), ("cpu", 600.0))
+PROBE_SELF_EXIT_S = 55.0       # watchdog inside the probe process
+PROBE_WAIT_S = 75.0            # supervisor grace = watchdog + margin
+PROBE_RETRY_COOLDOWN_S = 90.0  # one recovery attempt before CPU fallback
+BASELINE_PIN = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "BASELINE_MEASURED.json")
 
 
 def log(msg: str) -> None:
@@ -65,6 +86,33 @@ def _strip_axon_site(env: dict) -> dict:
     return env
 
 
+def probe_tunnel(plat: str = "axon") -> bool:
+    """One trivial-device-op probe subprocess; True iff it completed.
+
+    The probe has an internal watchdog thread that ``os._exit``s it after
+    PROBE_SELF_EXIT_S — so a wedged relay makes the probe *exit*, never
+    hang, and the supervisor never has to SIGKILL a process that is
+    mid-dial (the observed wedge mechanism: killed compiles leave the
+    relay unusable for every subsequent python, often for >1 h)."""
+    cmd = [sys.executable, os.path.abspath(__file__), "--probe",
+           "--platform", plat]
+    t0 = time.monotonic()
+    try:
+        proc = subprocess.run(cmd, timeout=PROBE_WAIT_S, capture_output=True,
+                              text=True, env=dict(os.environ))
+    except subprocess.TimeoutExpired:
+        # watchdog failed to fire (should not happen) — treat as wedged
+        log("bench supervisor: probe overran its own watchdog")
+        return False
+    ok = proc.returncode == 0 and "PROBE_OK" in proc.stdout
+    log(f"bench supervisor: tunnel probe rc={proc.returncode} "
+        f"in {time.monotonic() - t0:.0f}s → "
+        f"{'healthy' if ok else 'unhealthy'}")
+    if not ok and proc.stderr:
+        log(proc.stderr[-300:])
+    return ok
+
+
 def supervise(args) -> None:
     platforms = list(PLATFORM_TIMEOUTS)
     env_plat = args.platform or os.environ.get("JAX_PLATFORMS")
@@ -83,7 +131,35 @@ def supervise(args) -> None:
     if args.uops:
         worker_args += ["--uops", str(args.uops)]
     errors = []
+    tunnel = None
+
+    def reprint(line: str) -> None:
+        """Re-emit the worker's JSON line with the tunnel verdict folded
+        in, so a CPU fallback is self-describing in the official record."""
+        try:
+            obj = json.loads(line)
+            if tunnel is not None:
+                obj["tunnel"] = tunnel
+            print(json.dumps(obj))
+        except json.JSONDecodeError:
+            print(line)
+
     for plat, tmo in platforms:
+        if plat not in ("cpu",) and not args.no_probe:
+            if probe_tunnel(plat):
+                tunnel = "healthy"
+            else:
+                log("bench supervisor: probe failed — one recovery "
+                    f"attempt after {PROBE_RETRY_COOLDOWN_S:.0f}s cool-down")
+                time.sleep(PROBE_RETRY_COOLDOWN_S)
+                if probe_tunnel(plat):
+                    tunnel = "healthy-after-retry"
+                else:
+                    tunnel = "wedged"
+                    errors.append(f"{plat}: tunnel probe failed twice — "
+                                  "skipped (relay wedge suspected)")
+                    log(errors[-1])
+                    continue
         cmd = [sys.executable, os.path.abspath(__file__),
                "--worker", "--platform", plat] + worker_args
         env = dict(os.environ, JAX_PLATFORMS=plat)
@@ -110,7 +186,7 @@ def supervise(args) -> None:
             if line:
                 log(f"bench supervisor: platform={plat} timed out but "
                     "reported a provisional rate")
-                print(line)
+                reprint(line)
                 return
             errors.append(f"{plat}: timeout after {tmo:.0f}s (backend hang)")
             log(errors[-1])
@@ -124,19 +200,124 @@ def supervise(args) -> None:
             else:
                 log(f"bench supervisor: platform={plat} ok "
                     f"in {time.monotonic() - t0:.0f}s")
-            print(line)
+            reprint(line)
             return
         errors.append(f"{plat}: rc={proc.returncode} "
                       f"stdout={proc.stdout[-200:]!r}")
         log(errors[-1])
     # every platform failed: emit a diagnostic JSON line, not a crash
-    print(json.dumps({
+    out = {
         "metric": "sfi_trials_per_sec_per_chip",
         "value": 0.0,
         "unit": "trials/sec/chip",
         "vs_baseline": 0.0,
         "error": "; ".join(errors)[-500:],
-    }))
+    }
+    if tunnel is not None:
+        out["tunnel"] = tunnel
+    print(json.dumps(out))
+
+
+# --------------------------------------------------------------------------
+# probe: trivial device op with a self-exit watchdog (never killed)
+# --------------------------------------------------------------------------
+
+def run_probe(args) -> None:
+    import threading
+
+    def _watchdog():
+        time.sleep(PROBE_SELF_EXIT_S)
+        # main thread may be stuck inside a C-level relay dial where no
+        # signal/exception can reach it — _exit from a thread still works
+        sys.stderr.write("probe: watchdog fired — self-exiting\n")
+        sys.stderr.flush()
+        os._exit(3)
+
+    threading.Thread(target=_watchdog, daemon=True).start()
+    t0 = time.monotonic()
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    dev = jax.devices()[0]
+    val = int(jax.numpy.add(20, 22))           # one trivial device op
+    assert val == 42
+    print(f"PROBE_OK {dev.platform} {time.monotonic() - t0:.1f}s",
+          flush=True)
+
+
+# --------------------------------------------------------------------------
+# baseline pinning: serial C++ rate, many reps, committed artifact
+# --------------------------------------------------------------------------
+
+def _measure_serial_baseline(kernel, trace, keys, n_base: int, reps: int,
+                             native):
+    """Median serial C++ golden rate over ``reps`` repetitions →
+    (stats dict, sampled fault batch, last golden outcome array) — the
+    batch and outcomes let the caller cross-check without re-running the
+    sampler or a redundant oracle pass."""
+    import numpy as np
+
+    faults = kernel.sampler("regfile").sample_batch(keys[:n_base])
+    fk, fc, fe, fb, fs = (np.asarray(x) for x in faults)
+    cov = np.asarray(kernel.shadow_cov)
+    rates = []
+    base_out = None
+    for _ in range(reps):
+        t0 = time.monotonic()
+        base_out = native.golden_trials(trace, fk, fc, fe, fb, fs, cov)
+        rates.append(n_base / (time.monotonic() - t0))
+    stats = {"median": statistics.median(rates),
+             "min": min(rates), "max": max(rates),
+             "reps": reps, "trials": n_base}
+    return stats, faults, base_out
+
+
+def run_pin_baseline(args) -> None:
+    """Measure the serial baseline with ≥5 reps and write
+    BASELINE_MEASURED.json for committing — the stable denominator for
+    vs_baseline (the fresh per-run rate moved 2× between r3 runs)."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np  # noqa: F401
+
+    from shrewd_tpu import native
+    from shrewd_tpu.models.o3 import O3Config
+    from shrewd_tpu.ops.trial import TrialKernel
+    from shrewd_tpu.utils import prng
+
+    n_uops = args.uops or 4096
+    reps = max(args.reps, 5)
+    trace = native.generate_trace(seed=1, n=n_uops, nphys=256,
+                                  mem_words=4096, working_set_words=1024)
+    kernel = TrialKernel(trace, O3Config())
+    keys = prng.trial_keys(prng.campaign_key(0), 2048)
+    m, _, _ = _measure_serial_baseline(kernel, trace, keys, 2048, reps,
+                                       native)
+    out = {"metric": "serial_golden_trials_per_sec",
+           "unit": "trials/sec", "n_uops": n_uops, **
+           {k: (round(v, 1) if isinstance(v, float) else v)
+            for k, v in m.items()}}
+    with open(BASELINE_PIN, "w") as f:
+        json.dump(out, f, indent=1)
+        f.write("\n")
+    log(f"pinned serial baseline → {BASELINE_PIN}")
+    print(json.dumps(out))
+
+
+def _load_pinned_baseline(n_uops: int) -> float | None:
+    try:
+        with open(BASELINE_PIN) as f:
+            pin = json.load(f)
+        if pin.get("n_uops") == n_uops:
+            return float(pin["median"])
+        log(f"pinned baseline is for n_uops={pin.get('n_uops')}, "
+            f"run has {n_uops} — ignoring pin")
+    except Exception as e:  # noqa: BLE001 — a malformed pin must never
+        # discard a completed accelerator measurement at the last step
+        log(f"pinned baseline unreadable ({type(e).__name__}) — ignoring")
+    return None
 
 
 # --------------------------------------------------------------------------
@@ -236,15 +417,15 @@ def run_worker(args) -> None:
     log(f"device: median {device_rate:,.0f} trials/s over {args.reps} reps "
         f"(min {min(rates):,.0f}, max {max(rates):,.0f})")
 
-    # serial C++ baseline on the same trace (sample of trials, extrapolated)
+    # serial C++ baseline on the same trace — median over ≥5 reps (a
+    # single 2,048-trial timing moved 2× between r3 runs, VERDICT weak #2)
     n_base = min(batch, 512 if args.quick else 2048)
-    faults = kernel.sampler("regfile").sample_batch(keys[:n_base])
-    fk, fc, fe, fb, fs = (np.asarray(x) for x in faults)
-    cov = np.asarray(kernel.shadow_cov)    # per-µop, availability folded in
-    t0 = time.monotonic()
-    base_out = native.golden_trials(trace, fk, fc, fe, fb, fs, cov)
-    base_rate = n_base / (time.monotonic() - t0)
-    log(f"serial C++ baseline: {base_rate:,.0f} trials/s")
+    base_reps = max(args.reps, 2 if args.quick else 5)
+    bm, faults, base_out = _measure_serial_baseline(
+        kernel, trace, keys, n_base, base_reps, native)
+    base_rate = bm["median"]
+    log(f"serial C++ baseline: median {base_rate:,.0f} trials/s over "
+        f"{base_reps} reps (min {bm['min']:,.0f}, max {bm['max']:,.0f})")
 
     # cross-check: device and serial outcomes agree on the sampled subset
     dev_out = np.asarray(kernel.run_batch(faults))
@@ -252,8 +433,17 @@ def run_worker(args) -> None:
     if mismatches:
         log(f"WARNING: {mismatches}/{n_base} outcome mismatches vs oracle")
 
-    # refined line no. 2: device rate + baseline ratio
-    extra = {"vs_baseline": round(device_rate / base_rate, 3)}
+    # refined line no. 2: device rate + baseline ratios.  The headline
+    # vs_baseline divides by the *pinned* committed median when one
+    # matches this window; the fresh rate is always reported alongside.
+    pinned = _load_pinned_baseline(n_uops)
+    extra = {"vs_baseline_fresh": round(device_rate / base_rate, 3),
+             "baseline_serial_fresh": round(base_rate, 1)}
+    if pinned:
+        extra["baseline_serial_pinned"] = round(pinned, 1)
+        extra["vs_baseline"] = round(device_rate / pinned, 3)
+    else:
+        extra["vs_baseline"] = extra["vs_baseline_fresh"]
     emit(device_rate, extra)
 
     # Pallas on/off delta (the fast pass is auto-enabled on TPU backends;
@@ -305,10 +495,22 @@ def main() -> None:
     ap.add_argument("--uops", type=int, default=None, help="window length")
     ap.add_argument("--reps", type=int, default=3, help="timed repetitions")
     ap.add_argument("--worker", action="store_true", help=argparse.SUPPRESS)
+    ap.add_argument("--probe", action="store_true", help=argparse.SUPPRESS)
+    ap.add_argument("--no-probe", action="store_true",
+                    help="skip the tunnel health probe (trusted-healthy)")
+    ap.add_argument("--pin-baseline", action="store_true",
+                    help="measure the serial baseline (≥5 reps/median) and "
+                         "write BASELINE_MEASURED.json")
     ap.add_argument("--platform", type=str, default=None,
                     help="jax platform to pin (worker mode)")
     args = ap.parse_args()
 
+    if args.probe:
+        run_probe(args)
+        return
+    if args.pin_baseline:
+        run_pin_baseline(args)
+        return
     if args.worker:
         run_worker(args)
         return
