@@ -257,8 +257,38 @@ def run_host(paths: BuildPaths, coords: np.ndarray,
             p.unlink(missing_ok=True)
 
 
+def memmap_from_meta(meta: dict, cut: int | None = None):
+    """ops.replay.MemMap device arrays from lift metadata, or None when
+    the lift predates the VA crash model (no mem_cluster/map_regions)."""
+    import jax.numpy as jnp
+
+    from shrewd_tpu.ops.replay import MemMap
+
+    mc = np.asarray(meta.get("mem_cluster", []), dtype=np.int32)
+    regions = meta.get("map_regions") or []
+    clusters = meta.get("clusters") or []
+    if mc.size == 0 or not regions or not clusters:
+        return None
+    if cut is not None:
+        mc = mc[:cut]
+    cl = np.asarray(clusters, dtype=np.int64)          # (k, 3) lo, hi, off
+    ld = [(lo, span) for lo, span, _w in regions]
+    st = [(lo, span) for lo, span, w in regions if w] or [(0, 0)]
+    ld_lo, ld_span = (np.asarray(x, dtype=np.uint32) for x in zip(*ld))
+    st_lo, st_span = (np.asarray(x, dtype=np.uint32) for x in zip(*st))
+    return MemMap(
+        uop_cluster=jnp.asarray(mc),
+        cl_lo=jnp.asarray(cl[:, 0].astype(np.uint32)),
+        cl_span=jnp.asarray((cl[:, 1] - cl[:, 0]).astype(np.uint32)),
+        cl_word_off=jnp.asarray(cl[:, 2].astype(np.int32)),
+        ld_lo=jnp.asarray(ld_lo), ld_span=jnp.asarray(ld_span),
+        st_lo=jnp.asarray(st_lo), st_span=jnp.asarray(st_span))
+
+
 def run_device(trace, meta: dict, coords: np.ndarray,
-               liveness=None) -> np.ndarray:
+               liveness=None, paths: BuildPaths | None = None,
+               resolve_diverged: bool = True,
+               report: dict | None = None) -> np.ndarray:
     """The same trials on the replay kernel → outcome classes int32[n].
 
     Dense kernel, no shadow detection (the host has no shadow FUs).  With a
@@ -276,7 +306,8 @@ def run_device(trace, meta: dict, coords: np.ndarray,
     from shrewd_tpu.ops import classify as C
     from shrewd_tpu.ops.trial import TrialKernel
 
-    k = TrialKernel(trace, O3Config(enable_shrewd=False))
+    k = TrialKernel(trace, O3Config(enable_shrewd=False),
+                    memmap=memmap_from_meta(meta))
     uop_start = np.asarray(meta["uop_start"], dtype=np.int64)
     step, reg, bit = coords.T
     faults = Fault(
@@ -309,7 +340,8 @@ def run_device(trace, meta: dict, coords: np.ndarray,
                 src1=trace.src1[:cut], src2=trace.src2[:cut],
                 imm=trace.imm[:cut], taken=trace.taken[:cut],
                 init_reg=trace.init_reg, init_mem=trace.init_mem)
-            k_cut = TrialKernel(tr_cut, O3Config(enable_shrewd=False))
+            k_cut = TrialKernel(tr_cut, O3Config(enable_shrewd=False),
+                                memmap=memmap_from_meta(meta, cut=cut))
             rcut = jax.jit(jax.vmap(k_cut._replay_one))(faults)
             gold_w = np.asarray(k_cut.golden.mem)[words]
             bmask = np.asarray(ev["byte_masks"], dtype=np.uint32)
@@ -324,6 +356,27 @@ def run_device(trace, meta: dict, coords: np.ndarray,
         out[sdc] = C.OUTCOME_SDC
         out[trapped] = C.OUTCOME_DUE
         out[detected] = C.OUTCOME_DETECTED
+        # Diverged-trial escalation: a wrong branch direction leaves the
+        # captured window's dataflow entirely — the replay cannot follow
+        # the wrong path, and calling every divergence SDC mislabeled
+        # 1,100/1,785 host-DUEs in r3 (on silicon the wrong path usually
+        # dies on a bad pointer).  Hand exactly those trials to the
+        # whole-program emulator oracle, which executes the actual wrong
+        # path to its real outcome (segfault → DUE / output diff → SDC /
+        # re-convergence → masked).  masked/sdc/due class codes coincide
+        # between HOST_OUTCOME and ops.classify.
+        div = np.asarray(rfull.diverged) & ~trapped & ~detected
+        if report is not None:
+            report["device_diverged"] = int(div.sum())
+            report["device_memmap"] = k.memmap is not None
+        if resolve_diverged and paths is not None and div.any():
+            oracle = Emu64Oracle(paths)
+            resolved = oracle.classify(coords[div])
+            out[div] = resolved
+            if report is not None:
+                report["diverged_resolved"] = {
+                    name: int((resolved == code).sum())
+                    for name, code in HOST_OUTCOME.items()}
         return out
 
     mask = np.zeros(trace.nphys, dtype=bool)
@@ -345,56 +398,74 @@ def run_device(trace, meta: dict, coords: np.ndarray,
     return np.asarray(outcomes(faults))
 
 
+class Emu64Oracle:
+    """Perturbed whole-program re-execution on the snapshot-seeded 64-bit
+    emulator (ingest/emu.py run_program), classified by the host oracle's
+    own criteria (stdout + exit status).  Covers the upper register halves
+    and real wrong-path execution — the two things the 32-bit window
+    replay cannot track.  Built once (snapshot capture + golden run), then
+    ``classify`` maps any coordinate subset — the escalation tier the
+    replay kernel hands its *diverged* trials to (run_device)."""
+
+    def __init__(self, paths: BuildPaths, max_steps: int = 4_000_000):
+        import subprocess
+
+        from shrewd_tpu.ingest.emu import elf_regions, run_program
+        from shrewd_tpu.ingest.lift import read_nativetrace, static_decode
+
+        self._run_program = run_program
+        self.max_steps = max_steps
+        bd = paths.workload.parent
+        trace_bin = bd / f"{paths.workload.name}_emu64.{os.getpid()}.bin"
+        try:
+            proc = subprocess.run(
+                [str(paths.tracer), str(trace_bin), f"{paths.begin:x}", "0",
+                 "1", str(paths.workload)],     # 1 step: snapshot only
+                capture_output=True, text=True)
+            if proc.returncode not in (0, 1) or not trace_bin.exists():
+                raise RuntimeError(f"snapshot capture failed: {proc.stderr}")
+            nt = read_nativetrace(trace_bin)
+        finally:
+            trace_bin.unlink(missing_ok=True)
+        self.insts = static_decode(str(paths.workload))
+        self.regs0 = nt.steps[0][:16]
+        # snapshot regions first (writable, current values — they win on
+        # overlap), then ALL of the binary's segments as fallback:
+        # text/rodata plus the RELRO slice the writable-only snapshot
+        # cannot see
+        self.regions = [(v, d) for v, d in nt.regions]
+        self.regions += elf_regions(str(paths.workload))
+        self.pc0 = int(nt.steps[0][16])
+        self.fs_base = nt.fs_base
+
+        self.golden = run_program(self.insts, self.regs0, self.regions,
+                                  self.pc0, max_steps, fs_base=self.fs_base)
+        if self.golden.kind != "exit" or self.golden.exit_code != 0:
+            raise RuntimeError(f"golden emu run failed: {self.golden.kind}")
+
+    def classify_one(self, step: int, reg: int, bit: int) -> int:
+        r = self._run_program(self.insts, self.regs0, self.regions,
+                              self.pc0, self.max_steps,
+                              fault=(int(step), int(reg), int(bit)),
+                              fs_base=self.fs_base)
+        if r.kind != "exit" or r.exit_code != 0:
+            return HOST_OUTCOME["due"]
+        if r.stdout != self.golden.stdout:
+            return HOST_OUTCOME["sdc"]
+        return HOST_OUTCOME["masked"]
+
+    def classify(self, coords: np.ndarray) -> np.ndarray:
+        out = np.zeros(len(coords), dtype=np.int32)
+        for i, (step, reg, bit) in enumerate(coords):
+            out[i] = self.classify_one(step, reg, bit)
+        return out
+
+
 def run_device_emu64(paths: BuildPaths, coords: np.ndarray,
                      max_steps: int = 4_000_000) -> np.ndarray:
-    """The 64-bit classification path: perturbed whole-program re-execution
-    on the snapshot-seeded emulator (ingest/emu.py run_program), classified
-    by the host oracle's own criteria (stdout + exit status).  Covers the
-    upper register halves and real wrong-path execution — the two things
-    the 32-bit window replay cannot track."""
-    import subprocess
-
-    from shrewd_tpu.ingest.emu import elf_regions, run_program
-    from shrewd_tpu.ingest.lift import read_nativetrace, static_decode
-
-    bd = paths.workload.parent
-    trace_bin = bd / f"{paths.workload.name}_emu64.{os.getpid()}.bin"
-    try:
-        proc = subprocess.run(
-            [str(paths.tracer), str(trace_bin), f"{paths.begin:x}", "0",
-             "1", str(paths.workload)],     # 1 step: snapshot only
-            capture_output=True, text=True)
-        if proc.returncode not in (0, 1) or not trace_bin.exists():
-            raise RuntimeError(f"snapshot capture failed: {proc.stderr}")
-        nt = read_nativetrace(trace_bin)
-    finally:
-        trace_bin.unlink(missing_ok=True)
-    insts = static_decode(str(paths.workload))
-    regs0 = nt.steps[0][:16]
-    # snapshot regions first (writable, current values — they win on
-    # overlap), then ALL of the binary's segments as fallback: text/rodata
-    # plus the RELRO slice the writable-only snapshot cannot see
-    regions = [(v, d) for v, d in nt.regions]
-    regions += elf_regions(str(paths.workload))
-    pc0 = int(nt.steps[0][16])
-
-    golden = run_program(insts, regs0, regions, pc0, max_steps,
-                         fs_base=nt.fs_base)
-    if golden.kind != "exit" or golden.exit_code != 0:
-        raise RuntimeError(f"golden emu run failed: {golden.kind}")
-
-    out = np.zeros(len(coords), dtype=np.int32)
-    for i, (step, reg, bit) in enumerate(coords):
-        r = run_program(insts, regs0, regions, pc0, max_steps,
-                        fault=(int(step), int(reg), int(bit)),
-                        fs_base=nt.fs_base)
-        if r.kind != "exit" or r.exit_code != 0:
-            out[i] = HOST_OUTCOME["due"]
-        elif r.stdout != golden.stdout:
-            out[i] = HOST_OUTCOME["sdc"]
-        else:
-            out[i] = HOST_OUTCOME["masked"]
-    return out
+    """The 64-bit classification path over a coordinate list — see
+    Emu64Oracle."""
+    return Emu64Oracle(paths, max_steps).classify(coords)
 
 
 def wilson(successes: int, n: int, confidence: float = 0.95):
@@ -473,8 +544,12 @@ def run_diff(n_trials: int = 500, seed: int = 0,
                 lv = post_window_liveness(paths, meta["clusters"])
         coords = sample_coords(n_trials, window, seed)
         host = run_host(paths, coords)
-        dev = run_device(trace, meta, coords, liveness=lv)
+        dev_report: dict = {}
+        dev = run_device(trace, meta, coords, liveness=lv, paths=paths,
+                         report=dev_report)
     rep = compare(host, dev)
+    if mode not in ("emu64",):
+        rep.update(dev_report)
     rep["workload"] = workload_c
     rep["seed"] = seed
     rep["mode"] = mode

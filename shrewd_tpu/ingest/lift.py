@@ -403,10 +403,11 @@ class Lifter:
     """One nativetrace capture + static decode → Trace + metadata."""
 
     def __init__(self, nt: NativeTrace, insts: dict[int, Inst],
-                 max_uops: int | None = None):
+                 max_uops: int | None = None, elf_regs: list | None = None):
         self.nt = nt
         self.insts = insts
         self.max_uops = max_uops
+        self.elf_regs = elf_regs or []      # (vaddr, bytes, ro) PT_LOADs
         self.stats = LiftStats()
         # emitted µop columns
         self.opcode: list[int] = []
@@ -415,6 +416,7 @@ class Lifter:
         self.src2: list[int] = []
         self.imm: list[int] = []
         self.taken: list[int] = []
+        self.mem_cluster: list[int] = []    # per-µop cluster idx (-1: none)
         self.uop_start: list[int] = []      # macro step -> first µop index
         # golden simulation state (the self-check oracle)
         self.reg = np.zeros(NPHYS, dtype=np.uint64)   # low-32 values (u64 buf)
@@ -547,6 +549,28 @@ class Lifter:
             self.pc_cluster[pc] = (cls.pop() if len(cls) == 1
                                    and None not in cls else None)
 
+    STACK_GROW = 4 << 20
+
+    def map_regions(self) -> list:
+        """Silicon-mapped address windows for the replay kernel's VA crash
+        model: (lo32, span_bytes, writable).  Snapshot regions are the live
+        writable map; ELF PT_LOAD segments add text/rodata (a store into a
+        read-only one is a SIGSEGV on silicon).  The region holding the
+        initial stack pointer extends downward by STACK_GROW — Linux grows
+        the main-thread stack on demand, so an address landing shortly
+        below the mapped stack does NOT fault on real hardware."""
+        rsp0 = int(self.nt.steps[0][4])
+        out = []
+        for vaddr, data in self.nt.regions:
+            lo, span = int(vaddr), len(data)
+            if lo <= rsp0 < lo + span:
+                lo -= self.STACK_GROW
+                span += self.STACK_GROW
+            out.append((lo & M32, int(span), True))
+        for vaddr, data, ro in self.elf_regs:
+            out.append((int(vaddr) & M32, len(data), not ro))
+        return out
+
     def _cluster_of(self, ea32: int) -> Cluster | None:
         for cl in self.clusters:
             if cl.lo <= ea32 < cl.hi:
@@ -568,7 +592,23 @@ class Lifter:
         self.src2.append(src2)
         self.imm.append(imm & M32)
         self.taken.append(taken)
+        # per-µop cluster for the replay kernel's VA-space crash model:
+        # derived from the *golden* replay address (cluster-stable by the
+        # folded-affine invariant), so every emission site gets it free
+        if op in (U.LOAD, U.STORE):
+            addr = (int(self.reg[src1]) + (imm & M32)) & M32
+            self.mem_cluster.append(self._replay_cluster_idx(addr))
+        else:
+            self.mem_cluster.append(-1)
         self._sim_apply(op, dst, src1, src2, imm & M32)
+
+    def _replay_cluster_idx(self, replay_addr: int) -> int:
+        """Cluster index owning a flat replay byte-address, or -1."""
+        w = replay_addr >> 2
+        for i, cl in enumerate(self.clusters):
+            if cl.word_off <= w < cl.word_off + (cl.hi - cl.lo) // 4:
+                return i
+        return -1
 
     def _sim_apply(self, op, dst, src1, src2, imm) -> None:
         r = self.reg
@@ -1469,6 +1509,7 @@ class Lifter:
         del self.src2[mark:]
         del self.imm[mark:]
         del self.taken[mark:]
+        del self.mem_cluster[mark:]
 
     # -- main loop ----------------------------------------------------------
 
@@ -1543,6 +1584,8 @@ class Lifter:
                                  (steps[n_macro][:N_GPR]
                                   & np.uint64(M32))],
             "clusters": [tuple(int(v) for v in c) for c in self.clusters],
+            "mem_cluster": [int(x) for x in self.mem_cluster],
+            "map_regions": self.map_regions(),
             "stats": self.stats.to_dict(),
             "nphys": NPHYS,
             "arch_regs": GPR_NAMES_64,
@@ -1561,4 +1604,9 @@ def lift(trace_path: str, binary: str, max_uops: int | None = None,
         nt = read_nativetrace(trace_path)
     if insts is None:
         insts = static_decode(binary)
-    return Lifter(nt, insts, max_uops=max_uops).run()
+    try:
+        from shrewd_tpu.ingest.emu import elf_regions
+        elf_regs = elf_regions(binary)
+    except Exception:  # noqa: BLE001 — crash model degrades, lift survives
+        elf_regs = []
+    return Lifter(nt, insts, max_uops=max_uops, elf_regs=elf_regs).run()

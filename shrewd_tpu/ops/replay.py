@@ -66,6 +66,36 @@ class ReplayResult(NamedTuple):
     diverged: jax.Array   # bool — branch outcome differed from golden
 
 
+class MemMap(NamedTuple):
+    """VA-space crash model for lifted traces (the silicon DUE channel).
+
+    The folded-affine remap (ingest/lift.py) compacts the touched clusters
+    into a dense replay array, so "address in [0, mem_words)" is a far
+    *denser* validity set than the host's sparse page map — a faulted
+    pointer that segfaults on silicon often lands in another cluster's
+    replay words and mis-classifies SDC (VERDICT r3: 1,100/1,785 host-DUEs
+    read as device-SDC).  With a MemMap the kernel un-folds each memory
+    access back to its virtual address (replay_addr − cluster delta) and
+    traps exactly when silicon would: the VA lies outside every mapped
+    region (loads) or every writable mapped region (stores — a hit in a
+    read-only ELF segment is a SIGSEGV, reference analog
+    ``tests/gem5/verifier.py:158`` program-outcome classes).  Valid
+    cross-cluster hits are routed to the *correct* cluster's replay words,
+    so in-image corruption stays bit-faithful too.
+
+    All address arrays are low-32 projections (the replay address space).
+    """
+
+    uop_cluster: jax.Array   # int32[n]   cluster index per µop (-1: legacy)
+    cl_lo: jax.Array         # uint32[k]  cluster VA lo
+    cl_span: jax.Array       # uint32[k]  hi − lo, bytes
+    cl_word_off: jax.Array   # int32[k]   word offset in replay memory
+    ld_lo: jax.Array         # uint32[r]  mapped-region lo (load validity)
+    ld_span: jax.Array       # uint32[r]
+    st_lo: jax.Array         # uint32[w]  writable-region lo (store validity)
+    st_span: jax.Array       # uint32[w]
+
+
 def _sra(a: jax.Array, sh: jax.Array) -> jax.Array:
     ai = jax.lax.bitcast_convert_type(a, i32)
     return jax.lax.bitcast_convert_type(ai >> sh.astype(i32), u32)
@@ -152,11 +182,14 @@ def _alu(op: jax.Array, a: jax.Array, b: jax.Array, imm: jax.Array) -> jax.Array
 
 
 def replay(tr: TraceArrays, init_reg: jax.Array, init_mem: jax.Array,
-           fault: Fault, shadow_cov: jax.Array) -> ReplayResult:
+           fault: Fault, shadow_cov: jax.Array,
+           memmap: MemMap | None = None) -> ReplayResult:
     """Propagate one trial. All inputs are device arrays; jit/vmap-safe.
 
     ``shadow_cov`` is the per-µop shadow detection probability, float32[n]
-    (``models.o3.compute_shadow_cov``) — availability already folded in."""
+    (``models.o3.compute_shadow_cov``) — availability already folded in.
+    ``memmap`` (lifted traces only) switches the memory trap test from the
+    dense replay range to the silicon VA map — see MemMap."""
     nphys = init_reg.shape[0]
     mem_words = init_mem.shape[0]
     idx_mask = i32(nphys - 1)
@@ -166,7 +199,11 @@ def replay(tr: TraceArrays, init_reg: jax.Array, init_mem: jax.Array,
 
     def step(carry, xs):
         reg, mem, live, detected, trapped, diverged = carry
-        i, op, dstr, s1, s2, imm, tk, sc = xs
+        if memmap is None:
+            i, op, dstr, s1, s2, imm, tk, sc = xs
+            clu = None
+        else:
+            i, op, dstr, s1, s2, imm, tk, sc, clu = xs
 
         # 1. storage-fault landing (entry masked to the register space so a
         # hand-constructed out-of-range entry behaves identically in the
@@ -207,14 +244,49 @@ def replay(tr: TraceArrays, init_reg: jax.Array, init_mem: jax.Array,
         # 4. memory access with LSQ faults
         addr = eff ^ jnp.where((fault.kind == KIND_LSQ_ADDR) & at_uop,
                                bitmask, u32(0))
-        valid = ((addr & u32(3)) == 0) & ((addr >> u32(2)) < u32(mem_words))
+        if memmap is None:
+            valid = ((addr & u32(3)) == 0) \
+                & ((addr >> u32(2)) < u32(mem_words))
+            slot = (addr >> u32(2)).astype(i32) & i32(mem_words - 1)
+        else:
+            # un-fold to the virtual address and apply the silicon map:
+            # loads trap outside every mapped region, stores also trap in
+            # read-only ones; valid cross-cluster hits route to the right
+            # replay words (see MemMap docstring)
+            nk = memmap.cl_lo.shape[0]
+            jv = jnp.clip(clu, 0, nk - 1)
+            delta = (u32(4) * memmap.cl_word_off[jv].astype(u32)
+                     - memmap.cl_lo[jv])
+            va = addr - delta
+            offs = va - memmap.cl_lo                       # u32[k]
+            in_cl = offs < memmap.cl_span
+            any_cl = jnp.any(in_cl)
+            slot_cl = jnp.sum(jnp.where(
+                in_cl, (offs >> u32(2)).astype(i32) + memmap.cl_word_off,
+                i32(0)))
+            ld_ok = jnp.any((va - memmap.ld_lo) < memmap.ld_span) | any_cl
+            st_ok = jnp.any((va - memmap.st_lo) < memmap.st_span)
+            valid_mm = jnp.where(op == U.STORE, st_ok, ld_ok)
+            # mapped-but-untracked VA: silicon touches bytes the compared
+            # image never reads — absorb at the own cluster's tail-pad
+            # word (the layout reserves 16 pad words per cluster that no
+            # golden access or comparison mask ever touches)
+            pad_word = memmap.cl_word_off[jv] \
+                + (memmap.cl_span[jv] >> u32(2)).astype(i32) - 1
+            slot_mm = jnp.where(any_cl, slot_cl, pad_word)
+            mapped = clu >= 0
+            legacy_valid = ((addr & u32(3)) == 0) \
+                & ((addr >> u32(2)) < u32(mem_words))
+            valid = jnp.where(mapped, valid_mm, legacy_valid)
+            slot = jnp.where(mapped, slot_mm,
+                             (addr >> u32(2)).astype(i32)) \
+                & i32(mem_words - 1)
         # x86 #DE: div-by-zero / INT_MIN÷-1 ends the program (SIGFPE on the
         # host oracle) — a corrupted divisor must classify DUE, not SDC
         _, _, _, _, bad_s, bad_u = _div4(a, b)
         div_trap = ((((op == U.DIV) | (op == U.REM)) & bad_s)
                     | (((op == U.DIVU) | (op == U.REMU)) & bad_u)) & live
         trapped_now = (is_mem_op & ~valid & live) | illegal_now | div_trap
-        slot = (addr >> u32(2)).astype(i32) & i32(mem_words - 1)
         ldval = mem[slot]
         st_data = b ^ jnp.where((fault.kind == KIND_LSQ_DATA) & at_uop,
                                 bitmask, u32(0))
@@ -246,6 +318,8 @@ def replay(tr: TraceArrays, init_reg: jax.Array, init_mem: jax.Array,
 
     xs = (jnp.arange(n, dtype=i32), tr.opcode, tr.dst, tr.src1, tr.src2,
           tr.imm, tr.taken, shadow_cov.astype(jnp.float32))
+    if memmap is not None:
+        xs = xs + (memmap.uop_cluster,)
     # Derive the initial carry from the fault so its "varying" type under
     # shard_map matches the step outputs (the carry depends on the per-trial
     # fault after one step; an unvarying init would fail scan's type check).

@@ -37,9 +37,14 @@ N_STRATA = 8
 
 
 class TrialKernel:
-    def __init__(self, trace, cfg: O3Config | None = None, minor_cfg=None):
+    def __init__(self, trace, cfg: O3Config | None = None, minor_cfg=None,
+                 memmap=None):
         self.cfg = cfg if cfg is not None else O3Config()
         self.minor_cfg = minor_cfg    # models.minor.MinorConfig | None
+        # ops.replay.MemMap | None — lifted traces only: silicon VA-space
+        # trap model (dense kernel; the taint fast path escapes mem-faulted
+        # lanes to dense anyway, so the hybrid stays bit-identical)
+        self.memmap = memmap
         self.trace = trace
         self.tr = TraceArrays.from_trace(trace)
         self.init_reg = jnp.asarray(trace.init_reg, dtype=jnp.uint32)
@@ -77,11 +82,12 @@ class TrialKernel:
             cfg.enable_shrewd = enable
         if priority_to_shadow is not None:
             cfg.priority_to_shadow = priority_to_shadow
-        return TrialKernel(self.trace, cfg, self.minor_cfg)
+        return TrialKernel(self.trace, cfg, self.minor_cfg,
+                           memmap=self.memmap)
 
     def _replay_one(self, fault: Fault) -> ReplayResult:
         return replay(self.tr, self.init_reg, self.init_mem, fault,
-                      self.shadow_cov)
+                      self.shadow_cov, memmap=self.memmap)
 
     def _outcomes(self, faults: Fault) -> jax.Array:
         results = jax.vmap(self._replay_one)(faults)
@@ -219,6 +225,10 @@ class TrialKernel:
         ``may_latch=False`` tells the Pallas fast pass no LATCH_OP faults
         are present, enabling the scalar-opcode ALU (one lax.switch branch
         per step instead of 23 candidates)."""
+        if self.memmap is not None:
+            # the VA-space trap model lives in the dense kernel only — the
+            # taint kernels' validity test would disagree on mem faults
+            return np.asarray(self.run_batch(faults))
         res = self.taint_fast(faults, may_latch=may_latch)
         return self.resolve_escapes(faults, np.asarray(res.outcome).copy(),
                                     np.asarray(res.escaped),
@@ -269,7 +279,8 @@ class TrialKernel:
         campaign stays one SPMD program per batch, and every process
         resolves only its own shard."""
         faults = self.sampler(structure).sample_batch(keys)
-        if self.cfg.replay_kernel == "dense":
+        if self.cfg.replay_kernel == "dense" or self.memmap is not None:
+            # memmap (VA-trap) semantics exist only in the dense kernel
             return self._outcomes(faults), faults, jnp.int32(0)
         _ = self.golden_rec
         res = self.taint_fast(faults, may_latch=structure == "latch")

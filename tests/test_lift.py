@@ -156,3 +156,45 @@ def test_rotate_xchg_subword_test_lift_clean():
     st = meta["stats"]
     assert st["lift_rate"] == 1.0, st["opaque_mnemonics"]
     assert st["branches_dropped"] == 0
+
+
+def test_mem_cluster_metadata_consistent(lifted):
+    """Every LOAD/STORE µop carries its cluster index (the VA crash
+    model's un-fold key); non-memory µops carry -1; the mapped-region
+    table covers every cluster so no golden access could ever trap."""
+    from shrewd_tpu.isa import uops as U
+
+    trace, meta = lifted
+    mc = np.asarray(meta["mem_cluster"])
+    assert mc.shape[0] == trace.n
+    is_mem = np.isin(trace.opcode, (U.LOAD, U.STORE))
+    assert (mc[~is_mem] == -1).all()
+    k = len(meta["clusters"])
+    assert ((mc[is_mem] >= 0) & (mc[is_mem] < k)).all()
+    regions = meta["map_regions"]
+    assert regions and any(w for _, _, w in regions)
+    for lo, hi, _off in meta["clusters"]:
+        assert any(rlo <= lo and hi <= rlo + span
+                   for rlo, span, _w in regions), hex(lo)
+
+
+def test_memmap_golden_replay_identical(lifted):
+    """Attaching the VA crash model must not change the golden replay —
+    every golden access stays in its own cluster by the folded-affine
+    invariant, so slots and values are bit-identical."""
+    import jax
+
+    from shrewd_tpu.ingest.hostdiff import memmap_from_meta
+    from shrewd_tpu.models.o3 import O3Config, null_fault
+    from shrewd_tpu.ops.trial import TrialKernel
+
+    trace, meta = lifted
+    k_plain = TrialKernel(trace, O3Config(enable_shrewd=False))
+    k_mm = TrialKernel(trace, O3Config(enable_shrewd=False),
+                       memmap=memmap_from_meta(meta))
+    assert k_mm.memmap is not None
+    np.testing.assert_array_equal(np.asarray(k_plain.golden.reg),
+                                  np.asarray(k_mm.golden.reg))
+    np.testing.assert_array_equal(np.asarray(k_plain.golden.mem),
+                                  np.asarray(k_mm.golden.mem))
+    assert not bool(k_mm.golden.trapped)

@@ -203,3 +203,105 @@ def test_all_structures_produce_valid_outcomes(structure):
     tally = np.asarray(k.run_keys(keys, structure))
     assert tally.sum() == 32
     assert (tally >= 0).all()
+
+
+# --- VA-space crash model (MemMap) ---
+
+class TestMemMap:
+    """The silicon DUE channel: un-fold replay addresses to virtual
+    addresses and trap exactly when the host would segfault (reference
+    analog: program-outcome classes, ``tests/gem5/verifier.py:158``).
+    Layout under test — two clusters in a sparse VA map:
+
+      cluster 0: VA [0x10000, 0x10100), words 0..63, inside writable
+                 region A = [0x10000, 0x10200)
+      cluster 1: VA [0x50000, 0x50100), words 64..127, inside READ-ONLY
+                 region R = [0x50000, 0x50200)
+    """
+
+    LO0, LO1 = 0x10000, 0x50000
+
+    def _memmap(self, uop_cluster):
+        from shrewd_tpu.ops.replay import MemMap
+
+        u32a = lambda xs: jnp.asarray(np.asarray(xs, np.uint32))  # noqa: E731
+        return MemMap(
+            uop_cluster=jnp.asarray(np.asarray(uop_cluster, np.int32)),
+            cl_lo=u32a([self.LO0, self.LO1]),
+            cl_span=u32a([0x100, 0x100]),
+            cl_word_off=jnp.asarray(np.asarray([0, 64], np.int32)),
+            ld_lo=u32a([self.LO0, self.LO1]),
+            ld_span=u32a([0x200, 0x200]),
+            st_lo=u32a([self.LO0]),
+            st_span=u32a([0x200]))
+
+    def _trace(self, store=False):
+        delta0 = (0 * 4 - self.LO0) & 0xFFFFFFFF
+        rows = [
+            (U.LUI, 2, 0, 0, (self.LO0 + 0x10 + delta0) & 0xFFFFFFFF, 0),
+            (U.LOAD, 3, 2, 0, 0, 0),         # replay addr 0x10 → word 4
+        ]
+        if store:
+            rows.append((U.STORE, 0, 2, 3, 0, 0))
+        t = mini_trace(rows, nphys=16, mem_words=128)
+        return t, self._memmap([-1, 0] + ([0] if store else []))
+
+    def _run(self, t, mm, f):
+        tr = TraceArrays.from_trace(t)
+        cov = jnp.zeros(t.n, dtype=jnp.float32)
+        return replay(tr, jnp.asarray(t.init_reg), jnp.asarray(t.init_mem),
+                      f, cov, memmap=mm)
+
+    def test_golden_unchanged(self):
+        t, mm = self._trace()
+        res = self._run(t, mm, null_fault())
+        assert not bool(res.trapped) and not bool(res.diverged)
+        assert int(np.asarray(res.reg)[3]) == int(t.init_mem[4])
+
+    def test_unmapped_va_traps(self):
+        # flip bit 23 of the folded address: VA 0x810010 — outside every
+        # mapped region → the silicon outcome is SIGSEGV → DUE
+        t, mm = self._trace()
+        res = self._run(t, mm, fault(kind=KIND_LSQ_ADDR, entry=1, bit=23))
+        assert bool(res.trapped)
+
+    def test_cross_cluster_load_routes_not_traps(self):
+        # flip bit 18: VA 0x50010 — a *mapped* read-only page; silicon
+        # reads it fine, and the replay must serve cluster 1's word 68
+        t, mm = self._trace()
+        res = self._run(t, mm, fault(kind=KIND_LSQ_ADDR, entry=1, bit=18))
+        assert not bool(res.trapped)
+        assert int(np.asarray(res.reg)[3]) == int(t.init_mem[64 + 4])
+
+    def test_store_to_readonly_region_traps(self):
+        t, mm = self._trace(store=True)
+        res = self._run(t, mm, fault(kind=KIND_LSQ_ADDR, entry=2, bit=18))
+        assert bool(res.trapped)
+
+    def test_store_in_cluster_corrupts_right_word(self):
+        t, mm = self._trace(store=True)
+        res = self._run(t, mm, fault(kind=KIND_LSQ_ADDR, entry=2, bit=2))
+        assert not bool(res.trapped)
+        m = np.asarray(res.mem)
+        assert m[5] == int(t.init_mem[4])      # VA 0x10014 → word 5
+        assert m[4] == int(t.init_mem[4])      # original word untouched
+
+    def test_mapped_untracked_absorbs_to_pad_word(self):
+        # flip bit 8: VA 0x10110 — inside region A but past cluster 0's
+        # span; silicon touches bytes the image never compares → no trap,
+        # the write absorbs at the cluster's tail-pad word (63)
+        t, mm = self._trace(store=True)
+        res = self._run(t, mm, fault(kind=KIND_LSQ_ADDR, entry=2, bit=8))
+        assert not bool(res.trapped)
+        m = np.asarray(res.mem)
+        assert m[63] == int(t.init_mem[4])
+        assert m[4] == int(t.init_mem[4])
+
+    def test_legacy_uop_keeps_dense_semantics(self):
+        # uop_cluster = -1 rows fall back to the dense-range validity
+        t, _ = self._trace()
+        mm = self._memmap([-1, -1])
+        # folded replay addr 0x10 is in [0, mem_words*4) → valid
+        res = self._run(t, mm, null_fault())
+        assert not bool(res.trapped)
+        assert int(np.asarray(res.reg)[3]) == int(t.init_mem[4])
