@@ -225,7 +225,10 @@ class FaultSampler:
             start, end = sb.occupancy(structure,
                                       mem_mask if structure == "lsq"
                                       else None)
-            self._res = ResidencySampler(start, end)
+            # wrong-path entries (bpred model) add squash-masked strike
+            # cross-section to ROB/IQ — drawn as the sentinel entry
+            self._res = ResidencySampler(
+                start, end, squashed_mass=sb.wrongpath_mass(structure))
             self._store_mask = jnp.asarray(U.is_store(trace.opcode))
 
     def sample(self, key: jax.Array) -> Fault:

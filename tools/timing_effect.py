@@ -1,0 +1,81 @@
+"""Timing-model AVF sensitivity artifact (TIMING_EFFECT_r{N}).
+
+Per structure, the same trial budget under three fault-landing models:
+
+- proxy:       1-IPC occupancy window (r2 baseline)
+- scoreboard:  dependence-driven residency mass (r3)
+- squash:      scoreboard + bimodal-mispredict wrong-path mass — faults
+               landing in would-be-squashed entries are masked by the
+               squash walk (VERDICT r3 #7; reference rob.hh:207)
+
+Usage: python tools/timing_effect.py [--trials 8192] [--out FILE]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--trials", type=int, default=8192)
+    ap.add_argument("--workload", default="workloads/sort.c")
+    ap.add_argument("--out", default=str(REPO / "TIMING_EFFECT.json"))
+    a = ap.parse_args()
+
+    import numpy as np
+
+    from shrewd_tpu.ingest import hostdiff as hd
+    from shrewd_tpu.models.o3 import O3Config
+    from shrewd_tpu.models.timing import TimingConfig
+    from shrewd_tpu.ops.trial import TrialKernel
+    from shrewd_tpu.utils import prng
+
+    paths = hd.build_tools(a.workload)
+    trace, meta = hd.capture_and_lift(paths)
+    keys = prng.trial_keys(prng.campaign_key(17), a.trials)
+
+    models = {
+        "proxy": O3Config(timing="proxy"),
+        "scoreboard": O3Config(timing="scoreboard"),
+        "squash": O3Config(timing="scoreboard",
+                           timing_cfg=TimingConfig(bpred="bimodal")),
+    }
+    out = {"workload": a.workload, "trials": a.trials,
+           "window_uops": trace.n, "structures": {}}
+    for structure in ("rob", "iq", "lsq", "fu"):
+        row = {}
+        for name, cfg in models.items():
+            k = TrialKernel(trace, cfg)
+            tally = np.asarray(k.run_keys(keys, structure))
+            avf = float((tally[1] + tally[2]) / max(tally.sum(), 1))
+            row[name] = {"avf": round(avf, 4),
+                         "tally": [int(x) for x in tally]}
+            if name == "squash":
+                sb = k._scoreboard
+                row[name]["mispredicts"] = int(sb.mispredict.sum())
+                row[name]["wp_mass"] = sb.wrongpath_mass(structure)
+        row["avf_delta_scoreboard"] = round(
+            row["scoreboard"]["avf"] - row["proxy"]["avf"], 4)
+        row["avf_delta_squash"] = round(
+            row["squash"]["avf"] - row["scoreboard"]["avf"], 4)
+        out["structures"][structure] = row
+        print(f"{structure}: proxy {row['proxy']['avf']:.4f} "
+              f"scoreboard {row['scoreboard']['avf']:.4f} "
+              f"squash {row['squash']['avf']:.4f}", file=sys.stderr)
+    with open(a.out, "w") as f:
+        json.dump(out, f, indent=1)
+        f.write("\n")
+    print(json.dumps({s: {m: out["structures"][s][m]["avf"]
+                          for m in models}
+                      for s in out["structures"]}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
