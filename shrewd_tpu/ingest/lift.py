@@ -520,7 +520,10 @@ class Lifter:
             hi = ((c[-1] + 8 + 0x3F) & ~0x3F) + 64
             self.clusters.append(Cluster(lo & M32, hi & M32, word_off))
             word_off += (hi - lo) // 4
-        self.mem_words = 1 << int(np.ceil(np.log2(max(word_off, 64))))
+        # +1: the replay kernel's VA crash model absorbs mapped-but-
+        # untracked accesses at mem_words-1, which must lie outside every
+        # cluster (and so outside every liveness comparison mask)
+        self.mem_words = 1 << int(np.ceil(np.log2(max(word_off + 1, 64))))
         self.mem = np.zeros(self.mem_words, dtype=np.uint32)
         # Fill from the snapshot regions.  Reverse order so that on
         # overlap the EARLIEST region wins (its write lands last) — the
@@ -1048,10 +1051,21 @@ class Lifter:
 
         # --- byte/halfword compare & test: sign-extended operands preserve
         # both the signed and the unsigned ordering of the sub-word domain
+        sub_cmp_w = None
         if m in ("cmpb", "cmpw"):
+            sub_cmp_w = 1 if m == "cmpb" else 2
+        elif m == "cmp" and len(ops) == 2:
+            # AT&T spells byte compares "cmp %cl,(%rax)" when a register
+            # operand implies the size — the hot byte-match loops of
+            # compression workloads are exactly this form
+            ws = {abs(o.width) for o in ops
+                  if o.kind == "reg" and o.reg >= 0 and o.width}
+            if ws and max(ws) <= 16:
+                sub_cmp_w = 1 if max(ws) == 8 else 2
+        if sub_cmp_w is not None:
             if len(ops) != 2:
                 return False
-            width = 1 if m == "cmpb" else 2
+            width = sub_cmp_w
             msk = 0xFF if width == 1 else 0xFFFF
             sbit = msk ^ (msk >> 1)
             src, dst = ops                        # flags of dst - src

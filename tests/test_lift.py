@@ -198,3 +198,18 @@ def test_memmap_golden_replay_identical(lifted):
     np.testing.assert_array_equal(np.asarray(k_plain.golden.mem),
                                   np.asarray(k_mm.golden.mem))
     assert not bool(k_mm.golden.trapped)
+
+
+def test_byte_cmp_mem_form_lifts_clean():
+    """`cmp %cl,(%rax)` — a byte compare whose size comes from the
+    register operand, the hot form of compression match loops — must lift
+    via the sub-word compare path, not demote (it was 112k of the lzss
+    window's 113k demotions)."""
+    from shrewd_tpu.ingest import hostdiff as hd
+
+    paths = hd.build_tools("workloads/lzss_small.c")
+    _trace, meta = hd.capture_and_lift(paths)
+    st = meta["stats"]
+    assert st["lift_rate"] > 0.999, st["opaque_mnemonics"]
+    assert "cmp" not in st["opaque_mnemonics"]
+    assert st["branches_dropped"] == 0

@@ -1,0 +1,145 @@
+"""Large-window pipeline driver (VERDICT r3 #4: scale windows 100×).
+
+Captures the lzss compression workload once, lifts windows of several
+lengths, caches them as .npz traces, and measures replay throughput per
+window length on the current JAX platform.  The reference analog is the
+SPEC-SimPoint flow (30B-instruction measured regions,
+``x86_spec/x86-spec-cpu2017.py:404``); here the capture is a ptrace
+single-step of the marked kernel and the window is the lifted µop stream.
+
+Usage:
+    python tools/bigwindow.py --build            # capture + lift + cache
+    python tools/bigwindow.py --rate             # trials/s per length
+    python tools/bigwindow.py --build --rate --out WINDOW_SCALE.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+CACHE = REPO / "tests" / "_build"
+LENGTHS = (4096, 65536, 524288)
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def cache_path(n: int) -> Path:
+    return CACHE / f"lzss_w{n}.npz"
+
+
+def build(lengths=LENGTHS, workload="workloads/lzss.c") -> dict:
+    from shrewd_tpu.ingest import hostdiff as hd
+    from shrewd_tpu.ingest.lift import lift, read_nativetrace, static_decode
+    from shrewd_tpu.trace import format as tfmt
+
+    paths = hd.build_tools(workload)
+    trace_bin = CACHE / f"lzss_capture.{os.getpid()}.bin"
+    info = {}
+    try:
+        t0 = time.time()
+        subprocess.run([str(paths.tracer), str(trace_bin),
+                        f"{paths.begin:x}", f"{paths.end:x}", "4000000",
+                        str(paths.workload)],
+                       check=True, capture_output=True, text=True)
+        nt = read_nativetrace(trace_bin)
+        insts = static_decode(str(paths.workload))
+        info["capture_steps"] = len(nt.steps) - 1
+        info["capture_seconds"] = round(time.time() - t0, 1)
+        log(f"capture: {info['capture_steps']} macro-steps "
+            f"in {info['capture_seconds']}s")
+        for n in lengths:
+            t0 = time.time()
+            tr, meta = lift(str(trace_bin), str(paths.workload),
+                            max_uops=n, nt=nt, insts=insts)
+            tfmt.save(cache_path(n), tr, meta)
+            info[f"lift_{n}"] = {
+                "uops": tr.n,
+                "lift_rate": round(meta["stats"]["lift_rate"], 4),
+                "seconds": round(time.time() - t0, 1),
+            }
+            log(f"lift {n}: rate {info[f'lift_{n}']['lift_rate']} "
+                f"in {info[f'lift_{n}']['seconds']}s → {cache_path(n)}")
+    finally:
+        trace_bin.unlink(missing_ok=True)
+    return info
+
+
+def rate(lengths=LENGTHS, batch=None, reps: int = 3) -> dict:
+    import jax
+    import numpy as np
+
+    from shrewd_tpu.models.o3 import O3Config
+    from shrewd_tpu.ops.trial import TrialKernel
+    from shrewd_tpu.trace import format as tfmt
+    from shrewd_tpu.utils import prng
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform in ("tpu", "axon")
+    out = {"platform": dev.platform, "rates": {}}
+    for n in lengths:
+        p = cache_path(n)
+        if not p.exists():
+            log(f"skip {n}: {p} missing (run --build)")
+            continue
+        tr, meta = tfmt.load(p)
+        # batch scaled so each length measures in seconds, not minutes:
+        # per-trial work grows linearly with window length
+        b = batch or max(256, min(131072 if on_tpu else 8192,
+                                  (1 << 29) // max(tr.n, 1)))
+        k = TrialKernel(tr, O3Config())
+        keys = prng.trial_keys(prng.campaign_key(0), b)
+        t0 = time.time()
+        np.asarray(k.run_keys(keys, "regfile"))
+        compile_s = time.time() - t0
+        rates = []
+        for _ in range(reps):
+            t0 = time.time()
+            np.asarray(k.run_keys(keys, "regfile"))
+            rates.append(b / (time.time() - t0))
+        rates.sort()
+        out["rates"][str(tr.n)] = {
+            "trials_per_sec": round(rates[len(rates) // 2], 2),
+            "batch": b,
+            "compile_seconds": round(compile_s, 1),
+            "lift_rate": round(meta["stats"]["lift_rate"], 4)
+            if "stats" in meta else None,
+        }
+        log(f"window {tr.n}: {out['rates'][str(tr.n)]['trials_per_sec']:,} "
+            f"trials/s (batch {b})")
+    return out
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--build", action="store_true")
+    ap.add_argument("--rate", action="store_true")
+    ap.add_argument("--lengths", type=int, nargs="*", default=list(LENGTHS))
+    ap.add_argument("--batch", type=int, default=None)
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--workload", default="workloads/lzss.c")
+    ap.add_argument("--out", default=None)
+    a = ap.parse_args()
+    result = {}
+    if a.build:
+        result["build"] = build(a.lengths, a.workload)
+    if a.rate:
+        result["rate"] = rate(a.lengths, a.batch, a.reps)
+    if a.out:
+        with open(a.out, "w") as f:
+            json.dump(result, f, indent=1)
+            f.write("\n")
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,133 @@
+/* LZSS-style compression loop — the large-window workload (VERDICT r3 #4:
+ * the SPEC-scale analog; reference north star measures 30B-instruction
+ * SimPoint regions, x86_spec/x86-spec-cpu2017.py:404).
+ *
+ * A hash-chain match searcher compresses a deterministic, partly
+ * repetitive buffer: pointer-and-byte-heavy code (greedy match loops,
+ * hash table probes, window copies) whose measured window runs to
+ * hundreds of thousands of macro-ops — two orders of magnitude past the
+ * toy kernels — while keeping the lifter's constraints (int32 data,
+ * no libc inside the markers, one write(2) checksum at the end).
+ *
+ * Same marker/build conventions as sort.c.
+ */
+
+#include <unistd.h>
+
+#ifndef IN_N
+#define IN_N   20480          /* input bytes (override: -DIN_N=...) */
+#endif
+#define OUT_N  (IN_N + IN_N / 8 + 64)
+#define HASH_BITS 12
+#define HASH_N (1 << HASH_BITS)
+#define MAX_MATCH 34
+#define MIN_MATCH 3
+#define WINDOW 4096
+
+static unsigned char in_buf[IN_N];
+static unsigned char out_buf[OUT_N];
+static int head[HASH_N];
+static int prev[IN_N];
+static volatile int sink;
+
+static unsigned int rng_state = 0x9E3779B9u;
+static unsigned int xorshift(void) {
+    unsigned int x = rng_state;
+    x ^= x << 13;
+    x ^= x >> 17;
+    x ^= x << 5;
+    rng_state = x;
+    return x;
+}
+
+__attribute__((noinline)) void kernel_begin(void) { __asm__ volatile(""); }
+__attribute__((noinline)) void kernel_end(void)   { __asm__ volatile(""); }
+
+static unsigned int hash3(const unsigned char *p) {
+    unsigned int h = (unsigned int)p[0] | ((unsigned int)p[1] << 8)
+                   | ((unsigned int)p[2] << 16);
+    h *= 0x9E3779B1u;
+    return h >> (32 - HASH_BITS);
+}
+
+__attribute__((noinline)) static int compress(void) {
+    int op = 0;                 /* output cursor */
+    int ip = 0;
+    int i;
+    for (i = 0; i < HASH_N; i++) head[i] = -1;
+    while (ip + MIN_MATCH < IN_N && op + 5 < OUT_N) {
+        unsigned int h = hash3(&in_buf[ip]);
+        int cand = head[h];
+        int best_len = 0, best_dist = 0, chain = 8;
+        while (cand >= 0 && chain-- > 0 && ip - cand <= WINDOW) {
+            int len = 0;
+            int lim = IN_N - ip;
+            if (lim > MAX_MATCH) lim = MAX_MATCH;
+            while (len < lim && in_buf[cand + len] == in_buf[ip + len])
+                len++;
+            if (len > best_len) { best_len = len; best_dist = ip - cand; }
+            cand = prev[cand];
+        }
+        head[h] = ip;
+        prev[ip] = (head[h] >= 0) ? head[h] : -1;
+        /* maintain the chain properly: prev points at the previous
+         * occupant of this bucket (recorded before overwrite above) */
+        if (best_len >= MIN_MATCH) {
+            out_buf[op++] = (unsigned char)(0x80 | (best_len - MIN_MATCH));
+            out_buf[op++] = (unsigned char)(best_dist & 0xFF);
+            out_buf[op++] = (unsigned char)(best_dist >> 8);
+            /* index the skipped positions so later matches can find them */
+            {
+                int stop = ip + best_len;
+                ip++;
+                while (ip < stop && ip + MIN_MATCH < IN_N) {
+                    unsigned int h2 = hash3(&in_buf[ip]);
+                    prev[ip] = head[h2];
+                    head[h2] = ip;
+                    ip++;
+                }
+                ip = stop;
+            }
+        } else {
+            out_buf[op++] = in_buf[ip] & 0x7F;
+            ip++;
+        }
+    }
+    while (ip < IN_N && op < OUT_N) out_buf[op++] = in_buf[ip++] & 0x7F;
+    return op;
+}
+
+static char out_line[64];
+
+static int fmt(unsigned int v, char *p) {
+    char tmp[16];
+    int n = 0, i;
+    if (!v) tmp[n++] = '0';
+    while (v) { tmp[n++] = (char)('0' + v % 10u); v /= 10u; }
+    for (i = 0; i < n; i++) p[i] = tmp[n - 1 - i];
+    return n;
+}
+
+int main(void) {
+    int i, olen, pos = 0;
+    unsigned int csum = 2166136261u;
+    /* fill: repetitive runs interleaved with noise so matches exist */
+    for (i = 0; i < IN_N; i++) {
+        if ((i >> 6) & 1)
+            in_buf[i] = (unsigned char)(i & 31);          /* repetitive */
+        else
+            in_buf[i] = (unsigned char)(xorshift() & 63); /* semi-noise */
+    }
+    kernel_begin();
+    olen = compress();
+    for (i = 0; i < olen; i++)
+        csum = (csum ^ out_buf[i]) * 16777619u;
+    kernel_end();
+    sink = (int)csum;
+    pos += fmt(csum, out_line + pos);
+    out_line[pos++] = ' ';
+    pos += fmt((unsigned int)olen, out_line + pos);
+    out_line[pos++] = '\n';
+    if (write(1, out_line, (unsigned long)pos) != pos) return 2;
+    return 0;
+}

@@ -1,0 +1,5 @@
+/* lzss.c at a reduced input size: a ~500k-µop whole-program window that
+ * keeps host-diff (ptrace campaign + emulator escalation) affordable
+ * while still two orders of magnitude past the toy kernels. */
+#define IN_N 2048
+#include "lzss.c"
