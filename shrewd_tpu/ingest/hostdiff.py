@@ -370,13 +370,21 @@ def run_device(trace, meta: dict, coords: np.ndarray,
             report["device_diverged"] = int(div.sum())
             report["device_memmap"] = k.memmap is not None
         if resolve_diverged and paths is not None and div.any():
-            oracle = Emu64Oracle(paths)
-            resolved = oracle.classify(coords[div])
-            out[div] = resolved
-            if report is not None:
-                report["diverged_resolved"] = {
-                    name: int((resolved == code).sum())
-                    for name, code in HOST_OUTCOME.items()}
+            try:
+                oracle = Emu64Oracle(paths)
+                resolved = oracle.classify(coords[div])
+            except Exception as e:  # noqa: BLE001 — a workload the
+                # emulator cannot run whole-program must degrade to the
+                # conservative diverged→SDC labeling, not lose the report
+                if report is not None:
+                    report["diverged_resolution_failed"] = \
+                        f"{type(e).__name__}: {e}"[:200]
+            else:
+                out[div] = resolved
+                if report is not None:
+                    report["diverged_resolved"] = {
+                        name: int((resolved == code).sum())
+                        for name, code in HOST_OUTCOME.items()}
         return out
 
     mask = np.zeros(trace.nphys, dtype=bool)

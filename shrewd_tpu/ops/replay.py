@@ -268,12 +268,10 @@ def replay(tr: TraceArrays, init_reg: jax.Array, init_mem: jax.Array,
             st_ok = jnp.any((va - memmap.st_lo) < memmap.st_span)
             valid_mm = jnp.where(op == U.STORE, st_ok, ld_ok)
             # mapped-but-untracked VA: silicon touches bytes the compared
-            # image never reads — absorb at the own cluster's tail-pad
-            # word (the layout reserves 16 pad words per cluster that no
-            # golden access or comparison mask ever touches)
-            pad_word = memmap.cl_word_off[jv] \
-                + (memmap.cl_span[jv] >> u32(2)).astype(i32) - 1
-            slot_mm = jnp.where(any_cl, slot_cl, pad_word)
+            # image never reads — absorb at the scratch word past every
+            # cluster (the layout always leaves ≥1 word of power-of-two
+            # padding above the last cluster, outside every liveness mask)
+            slot_mm = jnp.where(any_cl, slot_cl, i32(mem_words - 1))
             mapped = clu >= 0
             legacy_valid = ((addr & u32(3)) == 0) \
                 & ((addr >> u32(2)) < u32(mem_words))

@@ -42,8 +42,9 @@ class TrialKernel:
         self.cfg = cfg if cfg is not None else O3Config()
         self.minor_cfg = minor_cfg    # models.minor.MinorConfig | None
         # ops.replay.MemMap | None — lifted traces only: silicon VA-space
-        # trap model (dense kernel; the taint fast path escapes mem-faulted
-        # lanes to dense anyway, so the hybrid stays bit-identical)
+        # trap model.  Implemented in the dense kernel ONLY; run paths
+        # guard on it and force dense, because the taint kernels' validity
+        # test would disagree on mem faults.
         self.memmap = memmap
         self.trace = trace
         self.tr = TraceArrays.from_trace(trace)

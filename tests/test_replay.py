@@ -286,15 +286,16 @@ class TestMemMap:
         assert m[5] == int(t.init_mem[4])      # VA 0x10014 → word 5
         assert m[4] == int(t.init_mem[4])      # original word untouched
 
-    def test_mapped_untracked_absorbs_to_pad_word(self):
+    def test_mapped_untracked_absorbs_to_scratch_word(self):
         # flip bit 8: VA 0x10110 — inside region A but past cluster 0's
         # span; silicon touches bytes the image never compares → no trap,
-        # the write absorbs at the cluster's tail-pad word (63)
+        # the write absorbs at the scratch word past every cluster
+        # (mem_words-1, outside all liveness masks)
         t, mm = self._trace(store=True)
         res = self._run(t, mm, fault(kind=KIND_LSQ_ADDR, entry=2, bit=8))
         assert not bool(res.trapped)
         m = np.asarray(res.mem)
-        assert m[63] == int(t.init_mem[4])
+        assert m[127] == int(t.init_mem[4])
         assert m[4] == int(t.init_mem[4])
 
     def test_legacy_uop_keeps_dense_semantics(self):
