@@ -128,7 +128,8 @@ def capture_window_macro_ops(paths: BuildPaths,
 
 def capture_and_lift_to_output(paths: BuildPaths,
                                build_dir: Path | None = None,
-                               max_steps: int = 2_000_000):
+                               max_steps: int = 2_000_000,
+                               lifter=None):
     """Capture and lift the *extended* window: kernel_begin → process exit.
 
     The replay then runs through the workload's own output stage (checksum
@@ -157,8 +158,8 @@ def capture_and_lift_to_output(paths: BuildPaths,
             raise RuntimeError(f"full capture failed: {proc.stderr}")
         nt = read_nativetrace(trace_bin)
         insts = static_decode(str(paths.workload))
-        trace, meta = lift(str(trace_bin), str(paths.workload), nt=nt,
-                           insts=insts)
+        trace, meta = (lifter or lift)(str(trace_bin), str(paths.workload),
+                                       nt=nt, insts=insts)
     finally:
         trace_bin.unlink(missing_ok=True)
     # executed steps only — the trailing record is state-at-end, not a step
@@ -310,6 +311,11 @@ def run_device(trace, meta: dict, coords: np.ndarray,
                     memmap=memmap_from_meta(meta))
     uop_start = np.asarray(meta["uop_start"], dtype=np.int64)
     step, reg, bit = coords.T
+    if int(meta.get("width", 32)) == 64:
+        # pair-lane datapath (ingest/lift64.py): arch reg r bit b ↦ phys
+        # (r + 32·(b≥32), b mod 32) — the full 64-bit PhysRegFile bank
+        reg = reg + 32 * (bit >= 32)
+        bit = bit % 32
     faults = Fault(
         kind=jnp.full(len(coords), KIND_REGFILE, dtype=jnp.int32),
         cycle=jnp.asarray(uop_start[step], dtype=jnp.int32),
@@ -526,7 +532,11 @@ def run_diff(n_trials: int = 500, seed: int = 0,
         kept for comparison — known to over-report);
       - "emu64": perturbed whole-program re-execution on the 64-bit
         emulator, sampling the FULL bit range [0,64) — upper register
-        halves and wrong paths included.
+        halves and wrong paths included;
+      - "device64": the pair-lane 64-bit lift (ingest/lift64.py) on the
+        replay KERNEL, sampling bits [0,64) — the device column is
+        computed on-device, with the emulator serving only as the
+        diverged-trial escalation tier.
     """
     from shrewd_tpu.ingest.lift import GPR_NAMES_64
 
@@ -541,7 +551,13 @@ def run_diff(n_trials: int = 500, seed: int = 0,
         host = run_host(paths, coords)
         dev = run_device_emu64(paths, coords)
     else:
-        if mode == "output":
+        bit_range = 32
+        if mode == "device64":
+            from shrewd_tpu.ingest.lift64 import lift64
+            trace, meta = capture_and_lift_to_output(paths, lifter=lift64)
+            window = meta["window_macro_ops"]
+            bit_range = 64
+        elif mode == "output":
             trace, meta = capture_and_lift_to_output(paths)
             window = meta["window_macro_ops"]
         else:
@@ -550,7 +566,8 @@ def run_diff(n_trials: int = 500, seed: int = 0,
             if mode == "liveness":
                 from shrewd_tpu.ingest.liveness import post_window_liveness
                 lv = post_window_liveness(paths, meta["clusters"])
-        coords = sample_coords(n_trials, window, seed)
+        coords = sample_coords(n_trials, window, seed,
+                               bit_range=bit_range)
         host = run_host(paths, coords)
         dev_report: dict = {}
         dev = run_device(trace, meta, coords, liveness=lv, paths=paths,
@@ -590,7 +607,7 @@ if __name__ == "__main__":
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--workload", default="workloads/sort.c")
     ap.add_argument("--mode", default="output",
-                    choices=("output", "liveness", "abi", "emu64"))
+                    choices=("output", "liveness", "abi", "emu64", "device64"))
     ap.add_argument("--out", default=str(REPO / "DIFF_AVF.json"))
     a = ap.parse_args()
     rep = run_diff(a.trials, a.seed, a.workload, mode=a.mode)

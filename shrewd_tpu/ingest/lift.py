@@ -1525,15 +1525,37 @@ class Lifter:
         del self.taken[mark:]
         del self.mem_cluster[mark:]
 
+    # -- datapath-width hooks (ingest/lift64.py overrides all four) --------
+
+    def _seed_regs(self, step0: np.ndarray) -> None:
+        self.reg[:] = 0
+        self.reg[:N_GPR] = step0[:N_GPR] & np.uint64(M32)
+
+    def _regs_match(self, next_full: np.ndarray) -> bool:
+        """Post-macro-op self-check against the captured register file —
+        the lift's correctness authority (full 64-bit in lift64)."""
+        return bool(
+            (self.reg[:N_GPR] == (next_full & np.uint64(M32))).all())
+
+    def _resync_regs(self, next_full: np.ndarray) -> None:
+        """Opaque demotion: overwrite every mismatched register with its
+        captured value."""
+        want = next_full & np.uint64(M32)
+        changed = np.nonzero(self.reg[:N_GPR] != want)[0]
+        for r in changed:
+            self._emit(U.LUI, int(r), ZERO, ZERO, int(want[r]))
+
+    def _final_reg_expect(self, vals: np.ndarray) -> list:
+        return [int(x) for x in (vals & np.uint64(M32))]
+
     # -- main loop ----------------------------------------------------------
 
     def run(self) -> tuple[Trace, dict]:
         self.build_memory_map()
         steps = self.nt.steps
         n_macro = len(steps) - 1
-        # initial register file: captured GPRs (low 32), specials zeroed
-        self.reg[:] = 0
-        self.reg[:N_GPR] = steps[0][:N_GPR] & np.uint64(M32)
+        # initial register file: captured GPRs (width per mode), specials 0
+        self._seed_regs(steps[0])
         init_reg = self.reg.astype(np.uint32).copy()
         init_mem = self.mem.copy()
 
@@ -1543,7 +1565,8 @@ class Lifter:
                 break
             pc = int(steps[i][16])
             next_pc = int(steps[i + 1][16])
-            next_regs = steps[i + 1][:N_GPR] & np.uint64(M32)
+            next_full = steps[i + 1][:N_GPR]
+            next_regs = next_full & np.uint64(M32)
             inst = self.insts.get(pc)
             self.uop_start.append(len(self.opcode))
             self.stats.macro_ops += 1
@@ -1556,7 +1579,7 @@ class Lifter:
                 mem_before = self.mem.copy()
                 ok = self._lift_one(i, inst, steps[i], next_regs, next_pc)
                 if ok:
-                    ok = bool((self.reg[:N_GPR] == next_regs).all())
+                    ok = self._regs_match(next_full)
             if ok:
                 self.stats.lifted += 1
             else:
@@ -1566,9 +1589,7 @@ class Lifter:
                 if mem_before is not None:
                     self.mem = mem_before
                 self.flags_src = flags_before
-                changed = np.nonzero(self.reg[:N_GPR] != next_regs)[0]
-                for r in changed:
-                    self._emit(U.LUI, int(r), ZERO, ZERO, int(next_regs[r]))
+                self._resync_regs(next_full)
                 self.stats.opaque += 1
                 mn = inst.mnemonic if inst else f"@{pc:x}"
                 self.stats.opaque_mnemonics[mn] = \
@@ -1594,14 +1615,13 @@ class Lifter:
             "end": self.nt.end,
             "macro_ops": n_macro,
             "uop_start": [int(x) for x in self.uop_start],
-            "final_reg_expect": [int(x) for x in
-                                 (steps[n_macro][:N_GPR]
-                                  & np.uint64(M32))],
+            "final_reg_expect": self._final_reg_expect(
+                steps[n_macro][:N_GPR]),
             "clusters": [tuple(int(v) for v in c) for c in self.clusters],
             "mem_cluster": [int(x) for x in self.mem_cluster],
             "map_regions": self.map_regions(),
             "stats": self.stats.to_dict(),
-            "nphys": NPHYS,
+            "nphys": int(self.reg.shape[0]),
             "arch_regs": GPR_NAMES_64,
         }
         return trace, meta

@@ -1,0 +1,85 @@
+"""Round-artifact driver for the host-silicon differential campaigns.
+
+Runs ``ingest.hostdiff.run_diff`` over a workload list in one process and
+writes the aggregate artifact the judge reads (DIFF_AVF_WORKLOADS_r{N},
+DIFF_AVF_64BIT_r{N}, big-window DIFFs).  Every per-workload failure is
+recorded instead of aborting the sweep.
+
+Usage:
+    python tools/diff_artifacts.py --mode device64 --trials 200 \
+        --out DIFF_AVF_64BIT_r04.json
+    python tools/diff_artifacts.py --mode output --trials 500 \
+        --out DIFF_AVF_WORKLOADS_r04.json
+    python tools/diff_artifacts.py --mode output --trials 300 \
+        --workloads workloads/lzss_small.c --out DIFF_AVF_BIGWIN_r04.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+DEFAULT_WORKLOADS = [
+    "workloads/sort.c", "workloads/intmm.c", "workloads/divmix.c",
+    "workloads/bytehash.c", "workloads/memops.c", "workloads/ptrchase.c",
+    "workloads/rotmix.c", "workloads/strmix.c",
+]
+
+KEEP = ("trials", "host_avf", "device_avf", "avf_abs_err",
+        "agreement_exact", "agreement_vulnerable", "cis_overlap",
+        "device_diverged", "diverged_resolved",
+        "diverged_resolution_failed", "window_macro_ops_sampled")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--mode", default="output")
+    ap.add_argument("--trials", type=int, default=200)
+    ap.add_argument("--seed", type=int, default=9)
+    ap.add_argument("--workloads", nargs="*", default=DEFAULT_WORKLOADS)
+    ap.add_argument("--out", required=True)
+    a = ap.parse_args()
+
+    from shrewd_tpu.ingest.hostdiff import run_diff
+
+    out = {"mode": a.mode, "trials_per_workload": a.trials, "seed": a.seed,
+           "bit_range": 64 if a.mode in ("emu64", "device64") else 32,
+           "workloads": {}}
+    for wl in a.workloads:
+        t0 = time.time()
+        try:
+            import jax
+            jax.clear_caches()     # bound XLA-CPU compile-state growth
+            rep = run_diff(a.trials, a.seed, wl, mode=a.mode)
+            row = {k: rep[k] for k in KEEP if k in rep}
+            if "lift_stats" in rep:
+                row["lift_rate"] = round(rep["lift_stats"]["lift_rate"], 4)
+                row["uops"] = rep["lift_stats"]["uops"]
+        except Exception as e:  # noqa: BLE001 — record, keep sweeping
+            row = {"error": f"{type(e).__name__}: {e}"[:300]}
+        row["seconds"] = round(time.time() - t0, 1)
+        out["workloads"][wl] = row
+        print(f"{wl}: {json.dumps(row)[:200]}", file=sys.stderr, flush=True)
+    ok = [w for w in out["workloads"].values() if "agreement_exact" in w]
+    if ok:
+        out["summary"] = {
+            "workloads_ok": len(ok),
+            "min_agreement_exact": min(w["agreement_exact"] for w in ok),
+            "min_agreement_vulnerable": min(w["agreement_vulnerable"]
+                                            for w in ok),
+            "max_avf_abs_err": max(w["avf_abs_err"] for w in ok),
+        }
+    with open(a.out, "w") as f:
+        json.dump(out, f, indent=1)
+        f.write("\n")
+    print(json.dumps(out.get("summary", {})))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
