@@ -1,30 +1,32 @@
-"""Two-core MESI coherence with protocol-STATE fault injection.
+"""N-core directory MESI with protocol-state, directory, and TBE faults.
 
-The reference's cache-tier SFI target is protocol state proper: the per-line
-MESI state field of the SLICC-generated L1 controllers
-(``/root/reference/src/mem/ruby/protocol/MESI_Two_Level-L1cache.sm``) held
-in ``CacheMemory`` entry arrays (``mem/ruby/structures/CacheMemory.hh:70``)
-over ``DataBlock`` lines (``mem/ruby/common/DataBlock.hh:61``).  A flipped
-state bit does not just lose a line — it mis-steers the protocol (a dirty M
-silently demoted to S skips its writeback; an I flipped valid serves stale
-hits; a flipped tag aliases another address), and the outcome depends on
-the subsequent coherence traffic.
+The reference's cache-tier SFI targets are protocol state proper: the
+per-line MESI state of the SLICC-generated L1 controllers
+(``/root/reference/src/mem/ruby/protocol/MESI_Two_Level-L1cache.sm``) in
+``CacheMemory`` entry arrays (``mem/ruby/structures/CacheMemory.hh:70``),
+the **directory** that routes coherence
+(``mem/ruby/structures/DirectoryMemory.hh:60``), and the **TBE table**
+holding each in-flight transaction's transient record
+(``mem/ruby/structures/TBETable.hh``).  Round 3 modeled only a 2-core
+snooping walk; this round the protocol is directory-routed over N cores,
+so a corrupted directory genuinely mis-steers it: a dropped sharer bit
+skips an invalidation and that L1 later serves stale hits; a flipped
+owner bit asks the wrong core for a dirty line (a lookup miss there is
+the protocol-NACK analog) and the true dirty copy is silently lost; a
+flipped TBE address/requester bit mis-routes the in-flight fill.
 
-TPU-first design (the ops/replay.py stance applied to coherence): the MESI
-state machine itself is the dense kernel — one ``lax.scan`` over the
-interleaved two-core access stream carrying (state, tag, data, LRU) arrays
-for both L1s plus the shared L2 image, with the fault landing as a bit
-flip in the state/tag array at its cycle.  Faulty and golden runs execute
-the SAME machine, so outcomes are protocol-accurate by construction;
-divergent protocol walks are just different data flow (no control-flow
-divergence problem — the machine is total over corrupted states).
-``scalar_mesi`` is the independent host oracle (CheckerCPU pattern) the
-kernel is differentially tested against (tests/test_mesi.py).
+TPU-first design (the ops/replay.py stance): the protocol machine IS the
+dense kernel — one ``lax.scan`` over the interleaved access stream
+carrying (L1 state/tag/data/LRU, directory state/owner/sharers, L2 image)
+with the fault landing as a bit flip at its cycle.  Faulty and golden
+runs execute the same total machine, so outcomes are protocol-accurate by
+construction.  ``scalar_mesi`` is the independent host oracle
+(CheckerCPU pattern) the kernel is differentially tested against
+(tests/test_mesi.py).
 
-Classification is program-visible, matching the framework's output-boundary
-stance: SDC ⇔ any LOADED value differs from golden, or the final flushed
-memory image differs.  Parity/ECC on the state/tag arrays (CacheConfig-
-style protection) maps to DETECTED/MASKED exactly as in models/ruby.py.
+Classification is program-visible: SDC ⇔ any LOADED value differs from
+golden or the final flushed memory image differs.  Parity/ECC on the
+protocol arrays maps to DETECTED/MASKED as in models/ruby.py.
 """
 
 from __future__ import annotations
@@ -42,52 +44,75 @@ from shrewd_tpu.utils.config import ConfigObject, Param
 u32 = jnp.uint32
 i32 = jnp.int32
 
-# MESI encoding: the 2-bit state field under fault.  Bit 0 distinguishes
-# within {clean, dirty} pairs; the encoding is part of the fault model the
-# same way the .sm enum ordering is part of the reference's.
+# L1 MESI encoding: the 2-bit state field under fault.
 ST_I, ST_S, ST_E, ST_M = 0, 1, 2, 3
 
+# directory states (2-bit, fault-targetable): not-present / shared /
+# exclusive-granted (owner holds E or M)
+DIR_NP, DIR_S, DIR_EM = 0, 1, 2
+
 # fault targets
-TGT_STATE = 0
-TGT_TAG = 1
+TGT_STATE = 0      # L1 state array
+TGT_TAG = 1        # L1 tag array
+TGT_DIR = 2        # directory entry (state | sharers | owner, see bit map)
+TGT_TBE = 3        # in-flight transaction record (addr | requester bits)
 
 
 class MesiConfig(ConfigObject):
-    """Two-core private-L1 / shared-L2 geometry + protection."""
+    """N-core private-L1 / shared-L2 directory geometry + protection."""
 
-    n_cores = Param(int, 2, "cores (private L1 each)")
+    n_cores = Param(int, 2, "cores (private L1 each, 2..16)")
     n_sets = Param(int, 4, "L1 sets (power of two)")
     n_ways = Param(int, 2, "L1 associativity")
     words_per_line = Param(int, 2, "32-bit words per line (power of two)")
     tag_bits = Param(int, 16, "tag field width (fault-targetable)")
     state_protection = Param(str, PROT_NONE,
-                             "none | parity | ecc on the state/tag arrays")
+                             "none | parity | ecc on the protocol arrays")
 
     def validate(self) -> None:
         for f in ("n_sets", "words_per_line"):
             v = getattr(self, f)
             if v & (v - 1):
                 raise ValueError(f"{f}={v} must be a power of two")
-        if self.n_cores != 2:
-            raise ValueError("the protocol walk is specialized to 2 cores")
+        if not 2 <= self.n_cores <= 16:
+            # 16 keeps every sharer-mask constant and shift inside int32
+            # (the device arrays' dtype) with sign-bit headroom
+            raise ValueError("n_cores must be in [2, 16] (sharers bitmask)")
         if self.state_protection not in (PROT_NONE, PROT_PARITY, PROT_ECC):
             raise ValueError(
                 f"unknown state_protection {self.state_protection!r}")
 
+    @property
+    def owner_bits(self) -> int:
+        return max(int(np.ceil(np.log2(self.n_cores))), 1)
+
+    def dir_bits(self) -> int:
+        """Directory-entry fault-bit space: 2 state bits, then one sharer
+        bit per core, then the owner-id bits."""
+        return 2 + self.n_cores + self.owner_bits
+
+    def tbe_bits(self) -> int:
+        """TBE fault-bit space: line-address bits then requester-id bits."""
+        return 16 + self.owner_bits
+
 
 class MesiFault(NamedTuple):
-    """One trial's coordinates (vmapped leaves)."""
+    """One trial's coordinates (vmapped leaves).
 
-    target: jax.Array    # TGT_STATE | TGT_TAG
+    ``mset`` doubles as the directory line index for TGT_DIR faults; the
+    ``bit`` index selects within the target's composite bit map
+    (MesiConfig.dir_bits / tbe_bits)."""
+
+    target: jax.Array    # TGT_*
     core: jax.Array
-    mset: jax.Array
+    mset: jax.Array      # L1 set, or directory line for TGT_DIR
     way: jax.Array
-    bit: jax.Array       # state: [0,2); tag: [0,tag_bits)
+    bit: jax.Array
     cycle: jax.Array     # access index at which the flip lands
 
 
 class AccessTrace(NamedTuple):
-    """Interleaved two-core access stream (device arrays)."""
+    """Interleaved N-core access stream (device arrays)."""
 
     core: jax.Array      # i32[A]
     word: jax.Array      # i32[A] global word address
@@ -97,7 +122,7 @@ class AccessTrace(NamedTuple):
 
 def torture_stream(cfg: MesiConfig, n_accesses: int, mem_words: int,
                    seed: int = 0, sharing: float = 0.5) -> AccessTrace:
-    """RubyTester-style random coherence torture: two cores hammering a
+    """RubyTester-style random coherence torture: N cores hammering a
     small shared footprint (``sharing`` controls contention)."""
     rng = np.random.default_rng(seed)
     core = rng.integers(0, cfg.n_cores, n_accesses)
@@ -115,32 +140,35 @@ def torture_stream(cfg: MesiConfig, n_accesses: int, mem_words: int,
 
 
 # --------------------------------------------------------------------------
-# scalar oracle — an independent MESI implementation (CheckerCPU pattern)
+# scalar oracle — an independent implementation (CheckerCPU pattern)
 # --------------------------------------------------------------------------
 
 def scalar_mesi(trace: AccessTrace, cfg: MesiConfig, init_mem: np.ndarray,
-                fault: "tuple | None" = None):
+                fault: "tuple | None" = None, return_state: bool = False):
     """Python reference walk.  ``fault`` = (target, core, mset, way, bit,
-    cycle) or None.  Returns (loads, final_mem) — every loaded value plus
-    the final flushed memory image (the program-visible surface)."""
+    cycle) or None.  Returns (loads, final_mem)."""
+    nc = cfg.n_cores
     wpl = cfg.words_per_line
     n_lines = len(init_mem) // wpl
-    state = np.zeros((2, cfg.n_sets, cfg.n_ways), dtype=np.int64)
-    tag = np.zeros((2, cfg.n_sets, cfg.n_ways), dtype=np.int64)
-    data = np.zeros((2, cfg.n_sets, cfg.n_ways, wpl), dtype=np.uint32)
-    age = np.zeros((2, cfg.n_sets, cfg.n_ways), dtype=np.int64)
+    state = np.zeros((nc, cfg.n_sets, cfg.n_ways), dtype=np.int64)
+    tag = np.zeros((nc, cfg.n_sets, cfg.n_ways), dtype=np.int64)
+    data = np.zeros((nc, cfg.n_sets, cfg.n_ways, wpl), dtype=np.uint32)
+    age = np.zeros((nc, cfg.n_sets, cfg.n_ways), dtype=np.int64)
+    dstate = np.zeros(n_lines, dtype=np.int64)       # DIR_*
+    downer = np.zeros(n_lines, dtype=np.int64)
+    dsharers = np.zeros(n_lines, dtype=np.int64)     # bitmask over cores
     mem = init_mem.copy()
     loads = []
     core_np = np.asarray(trace.core)
     word_np = np.asarray(trace.word)
     st_np = np.asarray(trace.is_store)
     val_np = np.asarray(trace.value)
+    ob = cfg.owner_bits
 
     def wb(c, s, w):
-        """Write line back to L2 iff it claims dirty."""
         if state[c, s, w] == ST_M:
             base = (tag[c, s, w] * cfg.n_sets + s) * wpl
-            if 0 <= base < len(mem) - wpl + 1:
+            if 0 <= base <= len(mem) - wpl:
                 mem[base:base + wpl] = data[c, s, w]
 
     def find(c, s, t):
@@ -149,66 +177,145 @@ def scalar_mesi(trace: AccessTrace, cfg: MesiConfig, init_mem: np.ndarray,
                 return w
         return -1
 
+    def dir_evict(c, s, w):
+        """PutS/PutM: eviction notifies the directory."""
+        ln = tag[c, s, w] * cfg.n_sets + s
+        if not (0 <= ln < n_lines) or state[c, s, w] == ST_I:
+            return
+        dsharers[ln] &= ~(1 << c)
+        # NOTE: the owner field is deliberately left stale (don't-care
+        # outside DIR_EM) — the kernel does the same, and the two must
+        # agree bit-for-bit because a later dir-state FAULT can flip the
+        # entry back to EM and make the stale owner live again
+        if downer[ln] == c and dstate[ln] == DIR_EM:
+            dstate[ln] = DIR_S if dsharers[ln] else DIR_NP
+        elif dsharers[ln] == 0 and dstate[ln] == DIR_S:
+            dstate[ln] = DIR_NP
+
     for i in range(len(core_np)):
         if fault is not None and fault[5] == i:
             tgt, fc, fs, fw, fb, _ = fault
             if tgt == TGT_STATE:
                 state[fc, fs, fw] ^= (1 << fb)
-            else:
+            elif tgt == TGT_TAG:
                 tag[fc, fs, fw] ^= (1 << fb)
+            elif tgt == TGT_DIR and 0 <= fs < n_lines:
+                if fb < 2:
+                    dstate[fs] ^= (1 << fb)
+                elif fb < 2 + nc:
+                    dsharers[fs] ^= (1 << (fb - 2))
+                else:
+                    downer[fs] ^= (1 << (fb - 2 - nc))
+                    downer[fs] &= (1 << ob) - 1
         c = int(core_np[i])
-        o = 1 - c
         wd = int(word_np[i])
         line = wd // wpl
         s = line % cfg.n_sets
         t = line // cfg.n_sets
         off = wd % wpl
+        # TBE fault: corrupt the in-flight miss record being processed at
+        # this access — the fill mis-routes (wrong line fetched / wrong
+        # requester receives it)
+        tbe_line, tbe_c = line, c
+        if fault is not None and fault[5] == i and fault[0] == TGT_TBE:
+            fb = fault[4]
+            if fb < 16:
+                tbe_line = (line ^ (1 << fb)) % max(n_lines, 1)
+            else:
+                tbe_c = (c ^ (1 << (fb - 16))) % nc
         w = find(c, s, t)
-        ow = find(o, s, t)
         if not st_np[i]:                      # -------- load --------
+            filled = None
             if w < 0:
-                # other core holds it dirty → writeback + downgrade
-                if ow >= 0 and state[o, s, ow] == ST_M:
-                    wb(o, s, ow)
-                    state[o, s, ow] = ST_S
-                # victim (LRU way)
-                w = int(np.argmin(age[c, s]))
-                wb(c, s, w)
+                # directory-routed miss service
+                if dstate[line] == DIR_EM:
+                    o = int(downer[line]) % nc
+                    ow = find(o, s, t)
+                    if ow >= 0:               # NACK analog on lookup miss
+                        wb(o, s, ow)
+                        state[o, s, ow] = ST_S
+                    dsharers[line] = ((dsharers[line] | (1 << o))
+                                      & ((1 << nc) - 1))
+                    dstate[line] = DIR_S
+                # fill via the (possibly corrupted) TBE record
+                fs_ = tbe_line % cfg.n_sets
+                ft_ = tbe_line // cfg.n_sets
+                fc_ = tbe_c
+                fw = int(np.argmin(age[fc_, fs_]))
+                dir_evict(fc_, fs_, fw)
+                wb(fc_, fs_, fw)
+                base = tbe_line * wpl
+                data[fc_, fs_, fw] = (mem[base:base + wpl]
+                                      if 0 <= base <= len(mem) - wpl else 0)
+                tag[fc_, fs_, fw] = ft_
+                excl = dstate[line] == DIR_NP
+                state[fc_, fs_, fw] = ST_E if excl else ST_S
+                dsharers[line] |= (1 << c)
+                if excl:
+                    dstate[line] = DIR_EM
+                    downer[line] = c
+                else:
+                    dstate[line] = DIR_S
+                filled = (fc_, fs_, fw)
+                w = find(c, s, t)             # may miss if fill mis-routed
+            if w >= 0:
+                loads.append(int(data[c, s, w][off]))
+                age[c, s] -= 1
+                age[c, s, w] = 0
+            else:
+                # mis-routed fill: requester retries straight from L2
                 base = line * wpl
-                data[c, s, w] = (mem[base:base + wpl]
-                                 if base + wpl <= len(mem) else 0)
-                tag[c, s, w] = t
-                state[c, s, w] = ST_S if ow >= 0 else ST_E
-                if ow >= 0 and state[o, s, ow] == ST_E:
-                    state[o, s, ow] = ST_S
-            loads.append(int(data[c, s, w][off]))
+                v = int(mem[base + off]) if 0 <= base <= len(mem) - wpl \
+                    else 0
+                loads.append(v)
+                fc_, fs_, fw = filled
+                age[fc_, fs_] -= 1
+                age[fc_, fs_, fw] = 0
         else:                                 # -------- store -------
             if w >= 0 and state[c, s, w] != ST_S:
                 state[c, s, w] = ST_M
+                dstate[line] = DIR_EM
+                downer[line] = c
+                dsharers[line] = 1 << c
             else:
-                if ow >= 0:
-                    wb(o, s, ow)              # M writes back on invalidate
-                    state[o, s, ow] = ST_I
+                # invalidate per directory
+                if dstate[line] == DIR_EM:
+                    o = int(downer[line]) % nc
+                    if o != c:
+                        ow = find(o, s, t)
+                        if ow >= 0:
+                            wb(o, s, ow)
+                            state[o, s, ow] = ST_I
+                sh = int(dsharers[line])
+                for o in range(nc):
+                    if o != c and (sh >> o) & 1:
+                        ow = find(o, s, t)
+                        if ow >= 0:
+                            state[o, s, ow] = ST_I
                 if w < 0:
                     w = int(np.argmin(age[c, s]))
+                    dir_evict(c, s, w)
                     wb(c, s, w)
                     base = line * wpl
                     data[c, s, w] = (mem[base:base + wpl]
-                                     if base + wpl <= len(mem) else 0)
+                                     if 0 <= base <= len(mem) - wpl else 0)
                     tag[c, s, w] = t
                 state[c, s, w] = ST_M
+                dstate[line] = DIR_EM
+                downer[line] = c
+                dsharers[line] = 1 << c
             data[c, s, w][off] = np.uint32(val_np[i])
-        age[c, s] -= 1
-        age[c, s, w] = 0
+            age[c, s] -= 1
+            age[c, s, w] = 0
 
-    # final flush: every line claiming M writes back (program-visible end
-    # state; a falsely-clean dirty line is lost here — the M→S/E SDC)
-    for c in range(2):
+    for c in range(nc):
         for s in range(cfg.n_sets):
             for w in range(cfg.n_ways):
                 wb(c, s, w)
-    _ = n_lines
-    return np.asarray(loads, dtype=np.uint32), mem
+    out_loads = np.asarray(loads, dtype=np.uint32)
+    if return_state:
+        return out_loads, mem, (state, tag, dstate, downer, dsharers)
+    return out_loads, mem
 
 
 # --------------------------------------------------------------------------
@@ -216,49 +323,74 @@ def scalar_mesi(trace: AccessTrace, cfg: MesiConfig, init_mem: np.ndarray,
 # --------------------------------------------------------------------------
 
 def mesi_replay(trace: AccessTrace, cfg: MesiConfig, init_mem: jax.Array,
-                fault: MesiFault):
+                fault: MesiFault, return_state: bool = False):
     """One trial's protocol walk → (loads u32[A], final mem u32[n]).
 
-    jit/vmap-safe; a ``fault`` with cycle < 0 is the golden run."""
+    jit/vmap-safe; a ``fault`` with cycle < 0 is the golden run.
+    ``return_state`` appends the final protocol arrays
+    (state, tag, dir_state, dir_owner, dir_sharers) for differential
+    tests that compare more than the program-visible surface."""
+    nc = cfg.n_cores
     wpl = cfg.words_per_line
     n_sets, n_ways = cfg.n_sets, cfg.n_ways
     mem_words = init_mem.shape[0]
+    n_lines = mem_words // wpl
+    ob = cfg.owner_bits
 
     def step(carry, xs):
-        state, tagv, data, age, mem = carry
+        state, tagv, data, age, dstate, downer, dsharers, mem = carry
         i, c, wd, is_st, val = xs
-        o = 1 - c
 
-        # fault landing: flip a bit of the state or tag array entry
+        # ---- fault landing ----
         land = i == fault.cycle
-        st_flip = jnp.zeros((2, n_sets, n_ways), i32)
+        st_flip = jnp.zeros((nc, n_sets, n_ways), i32)
         st_flip = st_flip.at[fault.core, fault.mset, fault.way].set(
             jnp.where(land & (fault.target == TGT_STATE),
                       i32(1) << fault.bit, 0))
         state = state ^ st_flip
-        tg_flip = jnp.zeros((2, n_sets, n_ways), i32)
+        tg_flip = jnp.zeros((nc, n_sets, n_ways), i32)
         tg_flip = tg_flip.at[fault.core, fault.mset, fault.way].set(
             jnp.where(land & (fault.target == TGT_TAG),
                       i32(1) << fault.bit, 0))
         tagv = tagv ^ tg_flip
+        dl = jnp.clip(fault.mset, 0, max(n_lines - 1, 0))
+        dir_land = land & (fault.target == TGT_DIR)
+        fb = fault.bit
+        dstate = dstate.at[dl].set(jnp.where(
+            dir_land & (fb < 2), dstate[dl] ^ (i32(1) << fb), dstate[dl]))
+        dsharers = dsharers.at[dl].set(jnp.where(
+            dir_land & (fb >= 2) & (fb < 2 + nc),
+            dsharers[dl] ^ (i32(1) << jnp.maximum(fb - 2, 0)),
+            dsharers[dl]))
+        downer = downer.at[dl].set(jnp.where(
+            dir_land & (fb >= 2 + nc),
+            (downer[dl] ^ (i32(1) << jnp.maximum(fb - 2 - nc, 0)))
+            & ((1 << ob) - 1),
+            downer[dl]))
 
         line = wd // wpl
         s = line % n_sets
         t = line // n_sets
         off = wd % wpl
 
-        def find(core_idx):
+        # TBE corruption of the in-flight miss record at this access
+        tbe_land = land & (fault.target == TGT_TBE)
+        tbe_line = jnp.where(tbe_land & (fault.bit < 16),
+                             (line ^ (i32(1) << fault.bit))
+                             % jnp.maximum(n_lines, 1), line)
+        tbe_c = jnp.where(tbe_land & (fault.bit >= 16),
+                          (c ^ (i32(1) << jnp.maximum(fault.bit - 16, 0)))
+                          % nc, c)
+
+        def find_w(core_idx):
             hits = (state[core_idx, s] != ST_I) & (tagv[core_idx, s] == t)
             return jnp.where(hits.any(),
                              jnp.argmax(hits).astype(i32), i32(-1))
 
-        w = find(c)
-        ow = find(o)
+        w = find_w(c)
         have = w >= 0
-        ohave = ow >= 0
 
-        def wb_line(mem, core_idx, way):
-            """Write (core, s, way) back iff it claims M."""
+        def wb_into(mem, core_idx, way):
             dirty = state[core_idx, s, way] == ST_M
             base = (tagv[core_idx, s, way] * n_sets + s) * wpl
             okrange = (base >= 0) & (base + wpl <= mem_words)
@@ -267,81 +399,169 @@ def mesi_replay(trace: AccessTrace, cfg: MesiConfig, init_mem: jax.Array,
                             mem[idx])
             return mem.at[idx].set(new)
 
-        victim = jnp.argmin(age[c, s]).astype(i32)
-        w_eff = jnp.where(have, w, victim)
+        dln = jnp.clip(line, 0, max(n_lines - 1, 0))
+        d_st = dstate[dln]
+        d_ow = downer[dln] % nc        # same reduction as the oracle
+        d_sh = dsharers[dln]
+        ow = find_w(d_ow)                     # owner lookup (NACK if -1)
+        owner_hit = (d_st == DIR_EM) & (ow >= 0)
+        ow_c = jnp.maximum(ow, 0)
 
-        # ---- load path ----
-        other_m = ohave & (state[o, s, jnp.maximum(ow, 0)] == ST_M)
-        mem_l = jnp.where(other_m & ~have & ~is_st,
-                          wb_line(mem, o, jnp.maximum(ow, 0)), mem)
-        # miss: victim writeback then fill from L2
-        mem_l = jnp.where(~have & ~is_st, wb_line(mem_l, c, victim), mem_l)
-        base = line * wpl
-        fill_ok = base + wpl <= mem_words
-        fill = jnp.where(fill_ok,
-                         mem_l[jnp.clip(base + jnp.arange(wpl), 0,
-                                        mem_words - 1)],
-                         jnp.zeros(wpl, u32))
-        data_l = data.at[c, s, w_eff].set(
-            jnp.where(~have, fill, data[c, s, w_eff]))
-        tag_l = tagv.at[c, s, w_eff].set(
-            jnp.where(~have, t, tagv[c, s, w_eff]))
-        st_l = state.at[c, s, w_eff].set(
-            jnp.where(have, state[c, s, w_eff],
-                      jnp.where(ohave, ST_S, ST_E)))
-        # my load miss downgrades the other core's copy (M and E → S; an
-        # S copy just stays S)
-        st_l = st_l.at[o, s, jnp.maximum(ow, 0)].set(
-            jnp.where(ohave & ~have, ST_S,
-                      st_l[o, s, jnp.maximum(ow, 0)]))
-        ld_val = data_l[c, s, w_eff, off]
+        # ======== LOAD ========
+        need_l = ~is_st & ~have
+        # owner writeback + downgrade to S (directory-routed)
+        mem_l = jnp.where(need_l & owner_hit, wb_into(mem, d_ow, ow_c), mem)
+        st_l = state.at[d_ow, s, ow_c].set(
+            jnp.where(need_l & owner_hit, ST_S, state[d_ow, s, ow_c]))
+        # fill via the (possibly corrupted) TBE record
+        fs_ = tbe_line % n_sets
+        ft_ = tbe_line // n_sets
+        victim = jnp.argmin(age[tbe_c, fs_]).astype(i32)
+        # eviction notice for the victim line (PutS/PutM analog)
+        ev_raw = tagv[tbe_c, fs_, victim] * n_sets + fs_
+        ev_ln = jnp.clip(ev_raw, 0, max(n_lines - 1, 0))
+        # out-of-range lines (corrupted-tag victims) get NO eviction
+        # notice — the oracle's dir_evict skips them the same way
+        ev_valid = (state[tbe_c, fs_, victim] != ST_I) \
+            & (ev_raw >= 0) & (ev_raw < n_lines)
+        mem_l = jnp.where(need_l, wb_into(mem_l, tbe_c, victim), mem_l)
+        sh_ev = dsharers[ev_ln] & ~(i32(1) << tbe_c)
+        dsharers_l = dsharers.at[ev_ln].set(
+            jnp.where(need_l & ev_valid, sh_ev, dsharers[ev_ln]))
+        dstate_l = dstate.at[ev_ln].set(jnp.where(
+            need_l & ev_valid
+            & (((downer[ev_ln] == tbe_c) & (dstate[ev_ln] == DIR_EM))
+               | ((sh_ev == 0) & (dstate[ev_ln] == DIR_S))),
+            jnp.where(sh_ev != 0, DIR_S, DIR_NP), dstate[ev_ln]))
+        base = tbe_line * wpl
+        fill_ok = (base >= 0) & (base + wpl <= mem_words)
+        fidx = jnp.clip(base + jnp.arange(wpl), 0, mem_words - 1)
+        fill = jnp.where(fill_ok, mem_l[fidx], jnp.zeros(wpl, u32))
+        excl = d_st == DIR_NP
+        data_l = data.at[tbe_c, fs_, victim].set(
+            jnp.where(need_l, fill, data[tbe_c, fs_, victim]))
+        tag_l = tagv.at[tbe_c, fs_, victim].set(
+            jnp.where(need_l, ft_, tagv[tbe_c, fs_, victim]))
+        st_l = st_l.at[tbe_c, fs_, victim].set(
+            jnp.where(need_l, jnp.where(excl, ST_E, ST_S),
+                      st_l[tbe_c, fs_, victim]))
+        # directory update for the REQUESTED line
+        dsharers_l = dsharers_l.at[dln].set(jnp.where(
+            need_l,
+            (dsharers_l[dln] | (i32(1) << c)
+             | jnp.where(d_st == DIR_EM, i32(1) << d_ow, 0))
+            & ((1 << nc) - 1),
+            dsharers_l[dln]))
+        dstate_l = dstate_l.at[dln].set(jnp.where(
+            need_l, jnp.where(excl, DIR_EM, DIR_S), dstate_l[dln]))
+        downer_l = downer.at[dln].set(jnp.where(
+            need_l & excl, c, downer[dln]))
+        # serve the load: re-find after the fill (a mis-routed fill means
+        # the requester still misses → retry straight from L2)
+        hits2 = (st_l[c, s] != ST_I) & (tag_l[c, s] == t)
+        w2 = jnp.where(hits2.any(), jnp.argmax(hits2).astype(i32), i32(-1))
+        lbase = line * wpl
+        l_ok = (lbase >= 0) & (lbase + off < mem_words)
+        ld_val = jnp.where(
+            w2 >= 0, data_l[c, s, jnp.maximum(w2, 0), off],
+            jnp.where(l_ok, mem_l[jnp.clip(lbase + off, 0, mem_words - 1)],
+                      u32(0)))
 
-        # ---- store path ----
+        # ======== STORE ========
         silent = have & (state[c, s, jnp.maximum(w, 0)] != ST_S)
-        # upgrade/fetch-exclusive: other core writes back if M, then I
-        mem_s = jnp.where(is_st & ~silent & ohave,
-                          wb_line(mem, o, jnp.maximum(ow, 0)), mem)
-        mem_s = jnp.where(is_st & ~silent & ~have,
-                          wb_line(mem_s, c, victim), mem_s)
-        fill_s = jnp.where(fill_ok,
-                           mem_s[jnp.clip(base + jnp.arange(wpl), 0,
-                                          mem_words - 1)],
-                           jnp.zeros(wpl, u32))
+        need_s = is_st & ~silent
+        # directory-routed invalidations: owner writes back, sharers drop
+        mem_s = jnp.where(need_s & owner_hit & (d_ow != c),
+                          wb_into(mem, d_ow, ow_c), mem)
+        st_s = state.at[d_ow, s, ow_c].set(
+            jnp.where(need_s & owner_hit & (d_ow != c), ST_I,
+                      state[d_ow, s, ow_c]))
+        # invalidate every directory-listed sharer's matching entry.
+        # FIRST matching way only — the same lookup semantics as find_w
+        # and the scalar oracle, which matters when a tag fault has
+        # created a duplicate match in another way
+        core_ids = jnp.arange(nc, dtype=i32)
+        sh_mask = ((d_sh >> core_ids) & 1).astype(bool) & (core_ids != c)
+        tag_match = (st_s[:, s] != ST_I) & (tagv[:, s] == t)   # (nc, ways)
+        first_w = jnp.argmax(tag_match, axis=1)
+        inv_core = sh_mask & tag_match.any(axis=1) & need_s
+        st_s = st_s.at[core_ids, s, first_w].set(
+            jnp.where(inv_core, ST_I, st_s[core_ids, s, first_w]))
+        # miss: victim fill (store allocations are not TBE-corrupted in
+        # this model — loads carry the fill TBE; stores' transient record
+        # is the invalidation fan-out above)
+        victim_s = jnp.argmin(age[c, s]).astype(i32)
+        w_eff = jnp.where(have, jnp.maximum(w, 0), victim_s)
+        ev_raw_s = tagv[c, s, victim_s] * n_sets + s
+        ev_ln_s = jnp.clip(ev_raw_s, 0, max(n_lines - 1, 0))
+        ev_valid_s = (state[c, s, victim_s] != ST_I) & ~have \
+            & (ev_raw_s >= 0) & (ev_raw_s < n_lines)
+        mem_s = jnp.where(need_s & ~have, wb_into(mem_s, c, victim_s),
+                          mem_s)
+        sh_ev_s = dsharers[ev_ln_s] & ~(i32(1) << c)
+        base_s = line * wpl
+        fill_ok_s = (base_s >= 0) & (base_s + wpl <= mem_words)
+        fidx_s = jnp.clip(base_s + jnp.arange(wpl), 0, mem_words - 1)
+        fill_s = jnp.where(fill_ok_s, mem_s[fidx_s], jnp.zeros(wpl, u32))
         data_s = data.at[c, s, w_eff].set(
-            jnp.where(have, data[c, s, w_eff], fill_s))
-        data_s = data_s.at[c, s, w_eff, off].set(val)
+            jnp.where(is_st & ~have, fill_s, data[c, s, w_eff]))
+        data_s = data_s.at[c, s, w_eff, off].set(
+            jnp.where(is_st, val, data_s[c, s, w_eff, off]))
         tag_s = tagv.at[c, s, w_eff].set(
-            jnp.where(have, tagv[c, s, w_eff], t))
-        st_s = state.at[c, s, w_eff].set(ST_M)
-        st_s = st_s.at[o, s, jnp.maximum(ow, 0)].set(
-            jnp.where(ohave & ~silent, ST_I,
-                      st_s[o, s, jnp.maximum(ow, 0)]))
+            jnp.where(is_st & ~have, t, tagv[c, s, w_eff]))
+        st_s = st_s.at[c, s, w_eff].set(
+            jnp.where(is_st, ST_M, st_s[c, s, w_eff]))
+        dsharers_s = dsharers.at[ev_ln_s].set(
+            jnp.where(need_s & ev_valid_s, sh_ev_s, dsharers[ev_ln_s]))
+        dstate_s = dstate.at[ev_ln_s].set(jnp.where(
+            need_s & ev_valid_s
+            & (((downer[ev_ln_s] == c) & (dstate[ev_ln_s] == DIR_EM))
+               | ((sh_ev_s == 0) & (dstate[ev_ln_s] == DIR_S))),
+            jnp.where(sh_ev_s != 0, DIR_S, DIR_NP), dstate[ev_ln_s]))
+        dstate_s = dstate_s.at[dln].set(
+            jnp.where(is_st, DIR_EM, dstate_s[dln]))
+        downer_s = downer.at[dln].set(jnp.where(is_st, c, downer[dln]))
+        dsharers_s = dsharers_s.at[dln].set(
+            jnp.where(is_st, i32(1) << c, dsharers_s[dln]))
 
+        # ---- select load/store outcome ----
         state = jnp.where(is_st, st_s, st_l)
         tagv = jnp.where(is_st, tag_s, tag_l)
         data = jnp.where(is_st, data_s, data_l)
         mem = jnp.where(is_st, mem_s, mem_l)
+        dstate = jnp.where(is_st, dstate_s, dstate_l)
+        downer = jnp.where(is_st, downer_s, downer_l)
+        dsharers = jnp.where(is_st, dsharers_s, dsharers_l)
         ld_out = jnp.where(is_st, u32(0), ld_val)
 
-        age = age.at[c, s].add(-1)
-        age = age.at[c, s, w_eff].set(0)
-        return (state, tagv, data, age, mem), ld_out
+        # LRU touch, once per access: the slot that served the request
+        # (for a mis-routed load fill, the slot the fill landed in)
+        touched_c = jnp.where(is_st, c, jnp.where(w2 >= 0, c, tbe_c))
+        touched_s = jnp.where(is_st, s, jnp.where(w2 >= 0, s, fs_))
+        touched_w = jnp.where(is_st, w_eff,
+                              jnp.where(w2 >= 0, jnp.maximum(w2, 0),
+                                        victim))
+        age = age.at[touched_c, touched_s].add(-1)
+        age = age.at[touched_c, touched_s, touched_w].set(0)
+        return (state, tagv, data, age, dstate, downer, dsharers,
+                mem), ld_out
 
     A = trace.core.shape[0]
-    # derive the init carry from the fault so its "varying" type under
-    # shard_map matches the step outputs (ops/replay.py does the same)
     vz = fault.cycle * 0
     vzu = vz.astype(u32)
-    init = (jnp.zeros((2, n_sets, n_ways), i32) + vz,
-            jnp.zeros((2, n_sets, n_ways), i32) + vz,
-            jnp.zeros((2, n_sets, n_ways, wpl), u32) + vzu,
-            jnp.zeros((2, n_sets, n_ways), i32) + vz,
+    init = (jnp.zeros((nc, n_sets, n_ways), i32) + vz,
+            jnp.zeros((nc, n_sets, n_ways), i32) + vz,
+            jnp.zeros((nc, n_sets, n_ways, wpl), u32) + vzu,
+            jnp.zeros((nc, n_sets, n_ways), i32) + vz,
+            jnp.zeros(max(n_lines, 1), i32) + vz,
+            jnp.zeros(max(n_lines, 1), i32) + vz,
+            jnp.zeros(max(n_lines, 1), i32) + vz,
             init_mem.astype(u32) + vzu)
     xs = (jnp.arange(A, dtype=i32), trace.core, trace.word,
           trace.is_store, trace.value)
-    (state, tagv, data, age, mem), loads = jax.lax.scan(step, init, xs)
+    (state, tagv, data, age, dstate, downer, dsharers, mem), loads = \
+        jax.lax.scan(step, init, xs)
 
-    # final flush of every line claiming M
     def flush(mem, cw):
         c, s, w = cw
         dirty = state[c, s, w] == ST_M
@@ -349,20 +569,20 @@ def mesi_replay(trace: AccessTrace, cfg: MesiConfig, init_mem: jax.Array,
         okrange = (base >= 0) & (base + wpl <= mem_words)
         idx = jnp.clip(base + jnp.arange(wpl), 0, mem_words - 1)
         return mem.at[idx].set(
-            jnp.where(dirty & okrange, data[c, s, w], mem[idx])), None
+            jnp.where(dirty & okrange, data[c, s, w], mem[idx]))
 
-    coords = [(c, s, w) for c in range(2) for s in range(n_sets)
-              for w in range(n_ways)]
-    for cw in coords:
-        mem, _ = flush(mem, cw)
+    for cw in [(c, s, w) for c in range(nc) for s in range(n_sets)
+               for w in range(n_ways)]:
+        mem = flush(mem, cw)
+    if return_state:
+        return loads, mem, (state, tagv, dstate, downer, dsharers)
     return loads, mem
 
 
 class MesiKernel:
-    """Campaign-facing kernel: the same protocol as TrialKernel exposes for
-    O3 structures (``outcomes_from_keys``/``run_keys``), so the sharded
-    campaign layer and orchestrator drive MESI state faults unchanged.
-    Structures: ``"state"``, ``"tag"``."""
+    """Campaign-facing kernel (TrialKernel protocol: outcomes_from_keys /
+    run_keys / run_keys_stratified).  Structures: ``"state"``, ``"tag"``,
+    ``"dir"``, ``"tbe"``."""
 
     def __init__(self, trace: AccessTrace, cfg: MesiConfig,
                  init_mem: np.ndarray):
@@ -376,16 +596,20 @@ class MesiKernel:
 
     def sample_batch(self, keys: jax.Array, structure: str) -> MesiFault:
         cfg = self.cfg
-        n_bits = 2 if structure == "state" else cfg.tag_bits
-        tgt = TGT_STATE if structure == "state" else TGT_TAG
+        n_lines = max(int(self.init_mem.shape[0]) // cfg.words_per_line, 1)
+        tgt = {"state": TGT_STATE, "tag": TGT_TAG,
+               "dir": TGT_DIR, "tbe": TGT_TBE}[structure]
+        n_bits = {"state": 2, "tag": cfg.tag_bits,
+                  "dir": cfg.dir_bits(), "tbe": cfg.tbe_bits()}[structure]
         A = self.trace.core.shape[0]
 
         def one(key):
             ks = jax.random.split(key, 5)
+            mset_hi = n_lines if structure == "dir" else cfg.n_sets
             return MesiFault(
                 target=i32(tgt),
                 core=jax.random.randint(ks[0], (), 0, cfg.n_cores, i32),
-                mset=jax.random.randint(ks[1], (), 0, cfg.n_sets, i32),
+                mset=jax.random.randint(ks[1], (), 0, mset_hi, i32),
                 way=jax.random.randint(ks[2], (), 0, cfg.n_ways, i32),
                 bit=jax.random.randint(ks[3], (), 0, n_bits, i32),
                 cycle=jax.random.randint(ks[4], (), 0, A, i32))
