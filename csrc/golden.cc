@@ -114,7 +114,7 @@ TrialResult replay(const TraceView& tr, uint32_t* reg, uint32_t* mem,
 
     // 6. writeback with ROB dest-index fault
     const bool writes = (op >= OP_ADD && op <= OP_REMU) || is_ld ||
-                        (op >= OP_FADD && op <= OP_FDIV);
+                        (op >= OP_FADD && op <= OP_FDIV) || op == OP_MULHU;
     if (writes) {
       int32_t d = tr.dst[i];
       if (kind == KIND_ROB_DST && at_uop) d = (d ^ index_mask) & idx_mask;

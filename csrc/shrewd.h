@@ -22,6 +22,7 @@ enum Opcode : int32_t {
   OP_DIV, OP_REM, OP_DIVU, OP_REMU,
   OP_LOAD, OP_STORE, OP_BEQ, OP_BNE, OP_BLT, OP_BGE,
   OP_FADD, OP_FSUB, OP_FMUL, OP_FDIV,
+  OP_MULHU,   // high32(a*b) unsigned (divide-by-constant idiom)
   N_OPCODES
 };
 
@@ -120,6 +121,9 @@ inline uint32_t shrewd_alu(int32_t op, uint32_t a, uint32_t b, uint32_t imm) {
     case OP_XORI: return a ^ imm;
     case OP_LUI:  return imm;
     case OP_MUL:  return a * b;
+    case OP_MULHU:
+      return static_cast<uint32_t>(
+          (static_cast<uint64_t>(a) * static_cast<uint64_t>(b)) >> 32);
     case OP_SLT:  return static_cast<int32_t>(a) < static_cast<int32_t>(b);
     case OP_SLTU: return a < b;
     // x86 #DE cases (b==0, INT_MIN/-1) return 0 here; the replay's trap
@@ -166,7 +170,8 @@ inline uint32_t shrewd_alu(int32_t op, uint32_t a, uint32_t b, uint32_t imm) {
 inline int32_t shrewd_opclass(int32_t op) {
   switch (op) {
     case OP_NOP:   return OC_NONE;
-    case OP_MUL: case OP_DIV: case OP_REM: case OP_DIVU: case OP_REMU:
+    case OP_MUL: case OP_MULHU:
+    case OP_DIV: case OP_REM: case OP_DIVU: case OP_REMU:
       return OC_INT_MULT;  // the reference's IntMultDiv unit
     case OP_FADD: case OP_FSUB: return OC_FP_ALU;
     case OP_FMUL: case OP_FDIV: return OC_FP_MULT;

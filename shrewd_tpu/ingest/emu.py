@@ -297,6 +297,8 @@ class Emulator:
     def _fl(self) -> tuple[bool, bool, bool, bool]:
         """(ZF, SF, CF, OF) from the lazy flags record."""
         kind = self.flags[0]
+        if kind == "fl":                   # directly materialized flags
+            return self.flags[1], self.flags[2], self.flags[3], self.flags[4]
         if kind == "res":
             _, v, w, _ = self.flags
             mask = (1 << w) - 1
@@ -480,6 +482,59 @@ class Emulator:
                     mask |= 1 << i
             self.kreg[dst.reg] = mask
             return
+        if base in ("addss", "subss", "mulss", "divss", "minss",
+                    "maxss") and len(ops) == 2:
+            src, dst = ops
+            if dst.kind != "xmm":
+                raise StopEmu(f"{base} dst {dst.kind}")
+            a = self._simd_read(src, 32) if src.kind != "mem" else None
+            if src.kind == "mem":
+                a = self.load(self.ea(src), 4)
+            b = self.xmm[dst.reg] & 0xFFFFFFFF
+            with np.errstate(all="ignore"):
+                fa = np.uint32(a).view(np.float32)
+                fb = np.uint32(b).view(np.float32)
+                # min/max pick the SOURCE on NaN or tie (Intel MINSS/MAXSS)
+                r = {"addss": fb + fa, "subss": fb - fa, "mulss": fb * fa,
+                     "divss": fb / fa,
+                     "minss": fb if fb < fa else fa,
+                     "maxss": fb if fb > fa else fa}[base]
+            bits = int(np.float32(r).view(np.uint32))
+            self.xmm[dst.reg] = (self.xmm[dst.reg]
+                                 & ~0xFFFFFFFF) | bits
+            return
+        if base in ("comiss", "ucomiss") and len(ops) == 2:
+            src, dst = ops
+            a = (self.load(self.ea(src), 4) if src.kind == "mem"
+                 else self._simd_read(src, 32))
+            b = self._simd_read(dst, 32)
+            fa = np.uint32(a & 0xFFFFFFFF).view(np.float32)
+            fb = np.uint32(b & 0xFFFFFFFF).view(np.float32)
+            # hardware semantics exactly: unordered → ZF=CF=1 (PF too,
+            # unmodeled); equal (incl. +0/-0) → ZF=1; dst<src → CF=1
+            if np.isnan(fa) or np.isnan(fb):
+                self.flags = ("fl", True, False, True, False)
+            elif fb == fa:
+                self.flags = ("fl", True, False, False, False)
+            elif fb < fa:
+                self.flags = ("fl", False, False, True, False)
+            else:
+                self.flags = ("fl", False, False, False, False)
+            return
+        if base == "movss" and len(ops) == 2:
+            src, dst = ops
+            if dst.kind == "xmm" and src.kind == "mem":
+                v = self.load(self.ea(src), 4)
+                self.xmm[dst.reg] = v               # load zero-extends
+                return
+            if dst.kind == "mem" and src.kind == "xmm":
+                self.store(self.ea(dst), 4, self.xmm[src.reg] & 0xFFFFFFFF)
+                return
+            if dst.kind == "xmm" and src.kind == "xmm":
+                self.xmm[dst.reg] = ((self.xmm[dst.reg] & ~0xFFFFFFFF)
+                                     | (self.xmm[src.reg] & 0xFFFFFFFF))
+                return
+            raise StopEmu("movss operands")
         if base in ("pxor", "por", "pand", "pandn", "pcmpeqb", "pminub",
                     "psubb", "paddb"):
             if vex and len(ops) == 3:
@@ -866,8 +921,13 @@ def run_program(insts: dict[int, Inst], regs: np.ndarray,
     try:
         for i in range(max_steps):
             if fault is not None and i == fault[0]:
-                emu.reg[fault[1]] ^= (1 << fault[2])
-                emu.reg[fault[1]] &= M64
+                if fault[1] >= 16:
+                    # xmm[reg-16] low lane, the FP-bank coordinate space
+                    # (hostsfi's PTRACE_SETFPREGS flip)
+                    emu.xmm[fault[1] - 16] ^= (1 << fault[2])
+                else:
+                    emu.reg[fault[1]] ^= (1 << fault[2])
+                    emu.reg[fault[1]] &= M64
             emu.step()
             steps += 1
         return ProgramResult("hang", bytes(emu.stdout), None, steps)

@@ -217,14 +217,14 @@ def capture_and_lift_to_output(paths: BuildPaths,
 
 
 def sample_coords(n_trials: int, window: int, seed: int = 0,
-                  bit_range: int = 32) -> np.ndarray:
+                  bit_range: int = 32, n_regs: int = 16) -> np.ndarray:
     """(step, reg, bit) samples.  ``bit_range=32`` restricts to the low
     half (the TPU replay's 32-bit projection); ``bit_range=64`` samples
     the full register, for the emu64 whole-program re-execution path."""
     rng = np.random.default_rng(seed)
     return np.stack([
         rng.integers(0, window, n_trials),
-        rng.integers(0, 16, n_trials),
+        rng.integers(0, n_regs, n_trials),
         rng.integers(0, bit_range, n_trials),
     ], axis=1).astype(np.int64)
 
@@ -316,6 +316,10 @@ def run_device(trace, meta: dict, coords: np.ndarray,
         # (r + 32·(b≥32), b mod 32) — the full 64-bit PhysRegFile bank
         reg = reg + 32 * (bit >= 32)
         bit = bit % 32
+    elif meta.get("fp_bank") is not None:
+        # coords reg 16..31 are xmm0..15 low lanes → the FP bank
+        fb = int(meta["fp_bank"])
+        reg = np.where(reg >= 16, fb + (reg - 16), reg)
     faults = Fault(
         kind=jnp.full(len(coords), KIND_REGFILE, dtype=jnp.int32),
         cycle=jnp.asarray(uop_start[step], dtype=jnp.int32),
@@ -552,7 +556,14 @@ def run_diff(n_trials: int = 500, seed: int = 0,
         dev = run_device_emu64(paths, coords)
     else:
         bit_range = 32
-        if mode == "device64":
+        n_regs = 16
+        if mode == "fp":
+            # GPR + xmm fault space: regs 0..15 GPRs, 16..31 xmm low
+            # lanes (hostsfi flips the latter via PTRACE_SETFPREGS)
+            trace, meta = capture_and_lift_to_output(paths)
+            window = meta["window_macro_ops"]
+            n_regs = 32
+        elif mode == "device64":
             from shrewd_tpu.ingest.lift64 import lift64
             trace, meta = capture_and_lift_to_output(paths, lifter=lift64)
             window = meta["window_macro_ops"]
@@ -567,7 +578,7 @@ def run_diff(n_trials: int = 500, seed: int = 0,
                 from shrewd_tpu.ingest.liveness import post_window_liveness
                 lv = post_window_liveness(paths, meta["clusters"])
         coords = sample_coords(n_trials, window, seed,
-                               bit_range=bit_range)
+                               bit_range=bit_range, n_regs=n_regs)
         host = run_host(paths, coords)
         dev_report: dict = {}
         dev = run_device(trace, meta, coords, liveness=lv, paths=paths,
@@ -607,7 +618,7 @@ if __name__ == "__main__":
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--workload", default="workloads/sort.c")
     ap.add_argument("--mode", default="output",
-                    choices=("output", "liveness", "abi", "emu64", "device64"))
+                    choices=("output", "liveness", "abi", "emu64", "device64", "fp"))
     ap.add_argument("--out", default=str(REPO / "DIFF_AVF.json"))
     a = ap.parse_args()
     rep = run_diff(a.trials, a.seed, a.workload, mode=a.mode)

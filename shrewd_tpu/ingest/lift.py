@@ -89,11 +89,14 @@ TCMP = 17          # cmp-immediate staging (live cmp → jcc only)
 T0, T1, T2, T3 = 18, 19, 20, 21
 T4, T5 = 22, 23    # sub-word expansion / cmov scratch
 T6, T7 = 24, 25    # flags-preserving-instruction scratch
+FX0 = 32           # xmm bank: phys FX0+k holds xmm{k}'s low 32 bits (f32)
+FT0, FT1 = 48, 49  # FP-lift scratch (loaded operands, compare keys)
+HSH = 50           # hi-half shadow of the last 64-bit imul (peephole)
 # Register discipline: flags_src may reference T1/T2/TCMP between the
 # flag-setting instruction and its consumer (jcc/cmov), and x86 mov/cmov/
 # string/push do NOT write EFLAGS — so every lift of a flags-PRESERVING
 # instruction must keep its scratch to T0/T3..T7 and never write T1/T2/TCMP.
-NPHYS = 32
+NPHYS = 64
 
 M32 = 0xFFFFFFFF
 
@@ -111,20 +114,23 @@ class NativeTrace(NamedTuple):
 def read_nativetrace(path) -> NativeTrace:
     with open(path, "rb") as f:
         magic = f.read(8)
-        if magic not in (b"SHTRACE1", b"SHTRACE2"):
+        if magic not in (b"SHTRACE1", b"SHTRACE2", b"SHTRACE3"):
             raise ValueError(f"bad magic {magic!r}")
         begin, end, n_steps, n_regions = struct.unpack("<4Q", f.read(32))
         fs_base = (struct.unpack("<Q", f.read(8))[0]
-                   if magic == b"SHTRACE2" else 0)
+                   if magic != b"SHTRACE1" else 0)
         regions = []
         for _ in range(n_regions):
             vaddr, size = struct.unpack("<2Q", f.read(16))
             regions.append((vaddr, f.read(size)))
         data = f.read()
-    rec = 18 * 8
+    # SHTRACE3 appends 8 u64 per step: the 16 xmm low lanes (f32 bit
+    # patterns) packed two per word — columns 18..25
+    cols = 26 if magic == b"SHTRACE3" else 18
+    rec = cols * 8
     n_rec = len(data) // rec
     steps = np.frombuffer(data[:n_rec * rec], dtype=np.uint64).reshape(
-        n_rec, 18)
+        n_rec, cols)
     if n_rec not in (n_steps, n_steps + 1):
         raise ValueError(f"step records {n_rec} != n_steps {n_steps}(+1)")
     return NativeTrace(begin, end, steps, regions, fs_base)
@@ -402,6 +408,10 @@ class Cluster(NamedTuple):
 class Lifter:
     """One nativetrace capture + static decode → Trace + metadata."""
 
+    # phys index of xmm0's low lane; None disables the FP lift (lift64
+    # reuses 32..57 as GPR hi lanes)
+    FP_BASE: "int | None" = FX0
+
     def __init__(self, nt: NativeTrace, insts: dict[int, Inst],
                  max_uops: int | None = None, elf_regs: list | None = None):
         self.nt = nt
@@ -424,6 +434,11 @@ class Lifter:
         self.clusters: list[Cluster] = []
         self.mem_words = 0
         self.flags_src: tuple | None = None  # ('cmp'|'test'|'res', a, b)
+        # (reg, macro_idx) after `imul r64, r64` whose true operands fit
+        # u32: HSH holds high32 of the product, consumed by an adjacent
+        # `shr $c, reg` with c >= 32 — the unsigned divide-by-constant
+        # idiom (magic multiply + wide shift) every compiler emits
+        self._hi_shadow: "tuple | None" = None
 
     # -- memory clustering (pre-pass) --------------------------------------
 
@@ -651,7 +666,8 @@ class Lifter:
             res = int(self._s32(a) < self._s32(b))
         elif op == U.SLTU:
             res = int(a < b)
-        elif op in (U.DIV, U.REM, U.DIVU, U.REMU):
+        elif op in (U.DIV, U.REM, U.DIVU, U.REMU, U.MULHU,
+                    U.FADD, U.FSUB, U.FMUL, U.FDIV):
             res = semantics.alu(op, a, b, imm)
         elif op == U.LOAD:
             addr = (a + imm) & M32
@@ -791,6 +807,13 @@ class Lifter:
         if self.flags_src is None:
             return None
         k = self.flags_src[0]
+        if k == "fcmp":
+            # float compare keys: only unordered-style conditions map to
+            # SLTU/equality on the keys (as in _lift_jcc); everything
+            # else demotes fail-closed
+            if cond not in ("eq", "ne", "ub", "uae", "ua", "ube"):
+                return None
+            k = "cmp"
         if k in ("cmp", "cmpb"):
             a, b = self.flags_src[1], self.flags_src[2]
         else:
@@ -870,6 +893,14 @@ class Lifter:
         m = inst.mnemonic
         ops = inst.operands
         pc = inst.pc
+
+        # --- scalar-SSE float (xmm low lanes → FADD..FDIV µops) ---
+        if any(o.kind == "xmm" for o in ops):
+            if self.FP_BASE is None or not getattr(self, "_has_xmm", False):
+                # no captured xmm lanes (SHTRACE1/2) → the FP bank would
+                # be unverifiable; demote rather than fail open
+                return False
+            return self._lift_fp(m, ops, pc, regs)
 
         # --- moves ---
         if m in ("mov", "movq", "movl", "movb", "movw", "movabs", "movslq",
@@ -1183,6 +1214,18 @@ class Lifter:
                         c = self._const(src.imm, T1)
                         self._emit(opcode, dst.reg, dst.reg, c)
                 elif src.kind == "reg" and src.reg >= 0:
+                    if (opcode == U.MUL
+                            and self.FP_BASE is not None
+                            and any(abs(o.width) == 64 for o in ops
+                                    if o.kind == "reg")
+                            and int(regs[dst.reg]) <= M32
+                            and int(regs[src.reg]) <= M32):
+                        # 64-bit imul whose true operands fit u32: also
+                        # stash the high product half — the adjacent
+                        # `shr $c, reg` (c >= 32) of the divide-by-
+                        # constant idiom consumes it (peephole below)
+                        self._emit(U.MULHU, HSH, dst.reg, src.reg)
+                        self._hi_shadow = (dst.reg, i)
                     self._emit(opcode, dst.reg, dst.reg, src.reg)
                 elif src.kind == "mem":
                     if self._mem_width(inst, src) < 4:
@@ -1225,6 +1268,15 @@ class Lifter:
             src, dst = ops
             if dst.kind != "reg" or dst.reg < 0:
                 return False
+            if src.kind == "imm" and src.imm >= 32 and opcode == U.SRL \
+                    and self._hi_shadow == (dst.reg, i - 1):
+                # wide shift of the imul-peephole product: the result is
+                # the HIGH half shifted by c-32 (true when the quotient
+                # fits u32 — the self-check verifies exactly that)
+                c = self._const((src.imm - 32) & 31, T1)
+                self._emit(U.SRL, dst.reg, HSH, c)
+                self.flags_src = ("res", dst.reg)
+                return True
             if src.kind == "imm":
                 c = self._const(src.imm & 31, T1)
                 self._emit(opcode, dst.reg, dst.reg, c)
@@ -1448,6 +1500,133 @@ class Lifter:
 
         return False
 
+    # -- scalar-SSE float lift (VERDICT r3 #6) ---------------------------
+    #
+    # The FP bank is phys FX0+k = xmm{k}'s low 32 bits; arithmetic maps
+    # 1:1 onto the FADD/FSUB/FMUL/FDIV µops (f32, FTZ, canonical NaN —
+    # isa/uops.py), so an FP-bank REGFILE fault propagates through real
+    # float dataflow on the device.  comiss/min/max use the monotone
+    # integer-key trick: key = bits ^ (sra(bits,31) | 0x80000000) maps
+    # IEEE-754 order onto unsigned integer order, so the existing SLTU
+    # branch machinery consumes float compares unchanged (±0 and NaN
+    # edge cases self-check at lift time and demote).
+
+    def _fx(self, o: Operand) -> "int | None":
+        if o.kind == "xmm" and 0 <= o.reg < 16 and abs(o.width) <= 128:
+            return self.FP_BASE + o.reg
+        return None
+
+    def _fp_key(self, src_reg: int, dst_reg: int, tmp: int) -> int:
+        """Monotone integer key of an f32 bit pattern → dst_reg."""
+        self._emit(U.ADDI, tmp, ZERO, ZERO, 31)
+        self._emit(U.SRA, dst_reg, src_reg, tmp)
+        self._emit(U.ORI, dst_reg, dst_reg, ZERO, 0x80000000)
+        self._emit(U.XOR, dst_reg, src_reg, dst_reg)
+        return dst_reg
+
+    def _fp_operand(self, o: Operand, pc: int, tmp: int) -> "int | None":
+        """Register holding the f32 operand's bits (xmm lane or a loaded
+        memory word)."""
+        fx = self._fx(o)
+        if fx is not None:
+            return fx
+        if o.kind == "mem":
+            a = self._addr_uops(o, pc, T0)
+            if a is None:
+                return None
+            self._emit(U.LOAD, tmp, a[0], ZERO, a[1])
+            return tmp
+        return None
+
+    def _lift_fp(self, m: str, ops: list, pc: int,
+                 regs: np.ndarray) -> bool:
+        alu = {"addss": U.FADD, "subss": U.FSUB,
+               "mulss": U.FMUL, "divss": U.FDIV}
+        if m in alu and len(ops) == 2:
+            src, dst = ops
+            d = self._fx(dst)
+            if d is None:
+                return False
+            a = self._fp_operand(src, pc, FT0)
+            if a is None:
+                return False
+            self._emit(alu[m], d, d, a)
+            return True
+        if m in ("movss", "movaps", "movapd", "movups", "movdqa",
+                 "movdqu", "movd") and len(ops) == 2:
+            src, dst = ops
+            sfx, dfx = self._fx(src), self._fx(dst)
+            if sfx is not None and dfx is not None:
+                self._emit(U.ADD, dfx, sfx, ZERO)        # bit copy (lane 0)
+                return True
+            if dfx is not None and src.kind == "mem":
+                a = self._addr_uops(src, pc, T0)
+                if a is None:
+                    return False
+                self._emit(U.LOAD, dfx, a[0], ZERO, a[1])
+                return True
+            if sfx is not None and dst.kind == "mem" and m == "movss":
+                a = self._addr_uops(dst, pc, T0)
+                if a is None:
+                    return False
+                self._emit(U.STORE, 0, a[0], sfx, a[1])
+                return True
+            # movd xmm↔GPR: the int/float boundary (bit-pattern move) —
+            # severing it would erase FP-bank corruption exactly at the
+            # program-output conversion
+            if m == "movd":
+                if sfx is not None and dst.kind == "reg" and dst.reg >= 0 \
+                        and abs(dst.width) == 32:
+                    self._emit(U.ADD, dst.reg, sfx, ZERO)
+                    return True
+                if dfx is not None and src.kind == "reg" and src.reg >= 0 \
+                        and abs(src.width) == 32:
+                    self._emit(U.ADD, dfx, src.reg, ZERO)
+                    return True
+            return False
+        if m in ("pxor", "xorps", "xorpd") and len(ops) == 2:
+            sfx, dfx = self._fx(ops[0]), self._fx(ops[1])
+            if sfx is None or dfx is None:
+                return False
+            if sfx == dfx:
+                self._emit(U.LUI, dfx, ZERO, ZERO, 0)    # zeroing idiom
+            else:
+                self._emit(U.XOR, dfx, dfx, sfx)
+            return True
+        if m in ("maxss", "minss") and len(ops) == 2:
+            src, dst = ops
+            d = self._fx(dst)
+            if d is None:
+                return False
+            a = self._fp_operand(src, pc, FT0)
+            if a is None:
+                return False
+            ka = self._fp_key(a, FT1, T6)
+            kd = self._fp_key(d, T7, T6)
+            # cond = (key_src > key_dst) for maxss, (key_src < key_dst)
+            # for minss; x86 picks the SOURCE when the condition holds
+            if m == "maxss":
+                self._emit(U.SLTU, T6, kd, ka)
+            else:
+                self._emit(U.SLTU, T6, ka, kd)
+            # branchless select: d ^= (d ^ a) & (-cond)
+            self._emit(U.XOR, T7, d, a)
+            self._emit(U.SUB, T6, ZERO, T6)
+            self._emit(U.AND, T7, T7, T6)
+            self._emit(U.XOR, d, d, T7)
+            return True
+        if m in ("comiss", "ucomiss") and len(ops) == 2:
+            src, dst = ops                        # flags of dst ? src
+            a = self._fp_operand(dst, pc, FT0)
+            b = self._fp_operand(src, pc, FT1)
+            if a is None or b is None or a == b:
+                return False
+            ka = self._fp_key(a, T1, T6)
+            kb = self._fp_key(b, TCMP, T6)
+            self.flags_src = ("fcmp", ka, kb)
+            return True
+        return False
+
     def _branch_cond(self, kind: str, a: int, b: int) -> tuple | None:
         """(opcode, src1, src2, extra_uops_emitted) for a signed cond."""
         table = {"eq": (U.BEQ, a, b), "ne": (U.BNE, a, b),
@@ -1459,6 +1638,12 @@ class Lifter:
         if self.flags_src is None:
             return False
         kind = self.flags_src[0]
+        if kind == "fcmp":
+            # float keys order like unsigned ints: only the unordered-
+            # style consumers compilers emit after comiss are valid
+            if m in _JCC_SIGNED and _JCC_SIGNED[m][0] not in ("eq", "ne"):
+                return False
+            kind = "cmp"
         if kind in ("cmp", "cmpb"):
             _, a, b = self.flags_src
         else:                                     # result vs zero
@@ -1527,26 +1712,54 @@ class Lifter:
 
     # -- datapath-width hooks (ingest/lift64.py overrides all four) --------
 
+    @staticmethod
+    def _xmm_lanes(row: np.ndarray) -> np.ndarray | None:
+        """16 captured xmm low lanes from a full SHTRACE3 step row."""
+        if row.shape[0] < 26:
+            return None
+        packed = row[18:26]
+        out = np.empty(16, np.uint64)
+        out[0::2] = packed & np.uint64(M32)
+        out[1::2] = packed >> np.uint64(32)
+        return out
+
     def _seed_regs(self, step0: np.ndarray) -> None:
         self.reg[:] = 0
         self.reg[:N_GPR] = step0[:N_GPR] & np.uint64(M32)
+        lanes = self._xmm_lanes(step0)
+        self._has_xmm = lanes is not None
+        if self.FP_BASE is not None and lanes is not None:
+            self.reg[self.FP_BASE:self.FP_BASE + 16] = lanes
 
     def _regs_match(self, next_full: np.ndarray) -> bool:
         """Post-macro-op self-check against the captured register file —
-        the lift's correctness authority (full 64-bit in lift64)."""
-        return bool(
-            (self.reg[:N_GPR] == (next_full & np.uint64(M32))).all())
+        the lift's correctness authority (full 64-bit in lift64).  With an
+        SHTRACE3 capture the FP bank is held to the same standard: every
+        xmm low lane must match, every macro-op."""
+        if not (self.reg[:N_GPR] == (next_full[:N_GPR]
+                                     & np.uint64(M32))).all():
+            return False
+        lanes = self._xmm_lanes(next_full)
+        if self.FP_BASE is not None and lanes is not None:
+            return bool(
+                (self.reg[self.FP_BASE:self.FP_BASE + 16] == lanes).all())
+        return True
 
     def _resync_regs(self, next_full: np.ndarray) -> None:
         """Opaque demotion: overwrite every mismatched register with its
         captured value."""
-        want = next_full & np.uint64(M32)
+        want = next_full[:N_GPR] & np.uint64(M32)
         changed = np.nonzero(self.reg[:N_GPR] != want)[0]
         for r in changed:
             self._emit(U.LUI, int(r), ZERO, ZERO, int(want[r]))
+        lanes = self._xmm_lanes(next_full)
+        if self.FP_BASE is not None and lanes is not None:
+            fb = self.FP_BASE
+            for k in np.nonzero(self.reg[fb:fb + 16] != lanes)[0]:
+                self._emit(U.LUI, fb + int(k), ZERO, ZERO, int(lanes[k]))
 
     def _final_reg_expect(self, vals: np.ndarray) -> list:
-        return [int(x) for x in (vals & np.uint64(M32))]
+        return [int(x) for x in (vals[:N_GPR] & np.uint64(M32))]
 
     # -- main loop ----------------------------------------------------------
 
@@ -1565,8 +1778,8 @@ class Lifter:
                 break
             pc = int(steps[i][16])
             next_pc = int(steps[i + 1][16])
-            next_full = steps[i + 1][:N_GPR]
-            next_regs = next_full & np.uint64(M32)
+            next_full = steps[i + 1]
+            next_regs = next_full[:N_GPR] & np.uint64(M32)
             inst = self.insts.get(pc)
             self.uop_start.append(len(self.opcode))
             self.stats.macro_ops += 1
@@ -1615,13 +1828,13 @@ class Lifter:
             "end": self.nt.end,
             "macro_ops": n_macro,
             "uop_start": [int(x) for x in self.uop_start],
-            "final_reg_expect": self._final_reg_expect(
-                steps[n_macro][:N_GPR]),
+            "final_reg_expect": self._final_reg_expect(steps[n_macro]),
             "clusters": [tuple(int(v) for v in c) for c in self.clusters],
             "mem_cluster": [int(x) for x in self.mem_cluster],
             "map_regions": self.map_regions(),
             "stats": self.stats.to_dict(),
             "nphys": int(self.reg.shape[0]),
+            "fp_bank": self.FP_BASE,
             "arch_regs": GPR_NAMES_64,
         }
         return trace, meta

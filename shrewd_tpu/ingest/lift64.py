@@ -65,6 +65,11 @@ class Lifter64(Lifter):
     register writes (x86 zeroes bits 63:32 on every 32-bit write,
     data-independently), all under full-width verification."""
 
+    # phys 32..57 are the GPR/temp hi lanes here, so the base lifter's
+    # FP bank (FX0=32..47) cannot coexist — xmm instructions demote in
+    # 64-bit mode (use the 32-bit lift for FP campaigns)
+    FP_BASE = None
+
     # mnemonics whose last operand is NOT a written register destination
     _NO_DEST = ("cmp", "test", "push", "bt", "j", "call", "ret", "nop")
 
@@ -85,18 +90,18 @@ class Lifter64(Lifter):
 
     def _regs_match(self, next_full: np.ndarray) -> bool:
         got = self.reg[:N_GPR] | (self.reg[HI:HI + N_GPR] << np.uint64(32))
-        return bool((got == next_full).all())
+        return bool((got == next_full[:N_GPR]).all())
 
     def _resync_regs(self, next_full: np.ndarray) -> None:
-        lo_want = next_full & np.uint64(M32)
-        hi_want = next_full >> np.uint64(32)
+        lo_want = next_full[:N_GPR] & np.uint64(M32)
+        hi_want = next_full[:N_GPR] >> np.uint64(32)
         for r in np.nonzero(self.reg[:N_GPR] != lo_want)[0]:
             self._emit(U.LUI, int(r), ZERO, ZERO, int(lo_want[r]))
         for r in np.nonzero(self.reg[HI:HI + N_GPR] != hi_want)[0]:
             self._emit(U.LUI, hi(int(r)), ZERO, ZERO, int(hi_want[r]))
 
     def _final_reg_expect(self, vals: np.ndarray) -> list:
-        return [int(x) for x in vals]
+        return [int(x) for x in vals[:N_GPR]]
 
     # -- pair emission helpers --------------------------------------------
 

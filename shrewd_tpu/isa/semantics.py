@@ -94,6 +94,8 @@ def alu(op: int, a: int, b: int, imm: int) -> int:
         return imm
     if op == U.MUL:
         return (a * b) & M32
+    if op == U.MULHU:
+        return ((a * b) >> 32) & M32
     if op == U.SLT:
         return 1 if _s32(a) < _s32(b) else 0
     if op == U.SLTU:

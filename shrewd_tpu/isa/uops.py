@@ -66,15 +66,18 @@ FADD = 27
 FSUB = 28
 FMUL = 29
 FDIV = 30    # IEEE: x/0 = ±inf, 0/0 = NaN — no trap (unlike integer DIV)
+MULHU = 31   # rd = high32(rs1 * rs2), unsigned — the wide half of x86's
+             # 64-bit multiply, which compilers emit for every unsigned
+             # divide-by-constant (magic-number multiply + shr >= 32)
 
-N_OPCODES = 31
+N_OPCODES = 32
 
 OPCODE_NAMES = [
     "nop", "add", "sub", "and", "or", "xor", "sll", "srl", "sra",
     "addi", "andi", "ori", "xori", "lui", "mul", "slt", "sltu",
     "div", "rem", "divu", "remu",
     "load", "store", "beq", "bne", "blt", "bge",
-    "fadd", "fsub", "fmul", "fdiv",
+    "fadd", "fsub", "fmul", "fdiv", "mulhu",
 ]
 
 # --- op classes (shadow-FU capability granularity) -------------------------
@@ -103,6 +106,7 @@ _OPCLASS_TABLE = np.array([
     OC_MEM_READ, OC_MEM_WRITE,                    # LOAD/STORE
     OC_INT_ALU, OC_INT_ALU, OC_INT_ALU, OC_INT_ALU,  # branches
     OC_FP_ALU, OC_FP_ALU, OC_FP_MULT, OC_FP_MULT,    # FADD..FDIV
+    OC_INT_MULT,                                     # MULHU
 ], dtype=np.int32)
 
 
@@ -115,7 +119,8 @@ def opclass_of(opcodes: np.ndarray) -> np.ndarray:
 
 def writes_dest(op: np.ndarray) -> np.ndarray:
     op = np.asarray(op)
-    return ((op >= ADD) & (op <= REMU)) | (op == LOAD) | is_fp(op)
+    return (((op >= ADD) & (op <= REMU)) | (op == LOAD) | is_fp(op)
+            | (op == MULHU))
 
 
 def is_div(op):
@@ -153,6 +158,6 @@ def uses_src1(op):
 
 def uses_src2(op):
     op = np.asarray(op)
-    return (((op >= ADD) & (op <= SRA)) | (op == MUL) | (op == SLT)
-            | (op == SLTU) | is_div(op) | is_fp(op) | (op == STORE)
-            | is_branch(op))
+    return (((op >= ADD) & (op <= SRA)) | (op == MUL) | (op == MULHU)
+            | (op == SLT) | (op == SLTU) | is_div(op) | is_fp(op)
+            | (op == STORE) | is_branch(op))

@@ -191,7 +191,7 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--workload", default="workloads/sort.c")
     p.add_argument("--mode", default="output",
-                   choices=("output", "liveness", "abi", "emu64", "device64"))
+                   choices=("output", "liveness", "abi", "emu64", "device64", "fp"))
     p.add_argument("--out", default="")
     p.set_defaults(fn=cmd_hostdiff)
 

@@ -39,6 +39,7 @@ from shrewd_tpu.models.o3 import (Fault, KIND_FU, KIND_IQ_SRC1, KIND_IQ_SRC2,
                                   KIND_LSQ_ADDR, KIND_LSQ_DATA, KIND_REGFILE,
                                   KIND_ROB_DST)
 from shrewd_tpu.ops import classify as C
+from shrewd_tpu.ops.replay import _mulhi
 from shrewd_tpu.ops.taint import EMPTY, GoldenRecord, TaintResult
 
 i32 = jnp.int32
@@ -145,7 +146,9 @@ def _alu_switch(op, a, b, imm):
         lambda _: jnp.where(a >= b, one, zero),
         lambda _: _fp4_i(a, b)[0], lambda _: _fp4_i(a, b)[1],
         lambda _: _fp4_i(a, b)[2], lambda _: _fp4_i(a, b)[3],
+        lambda _: _s(_mulhi(_u(a), _u(b))),               # MULHU
     ]
+    assert len(branches) == U.N_OPCODES
     return jax.lax.switch(op, branches, None)
 
 
@@ -188,7 +191,9 @@ def _alu_vec(op, a, b, imm):
         jnp.where(a < b, one, zero),
         jnp.where(a >= b, one, zero),
         *_fp4_i(a, b),
+        _s(_mulhi(_u(a), _u(b))),
     ]
+    assert len(cands) == U.N_OPCODES
     out = zero
     for c, cand in enumerate(cands):
         out = jnp.where(op == i32(c), cand, out)
@@ -332,7 +337,7 @@ def _make_kernel(n: int, k: int, nphys: int, mem_words: int, may_latch: bool):
             is_st = opv == U.STORE
             is_br = (opv >= U.BEQ) & (opv <= U.BGE)
             writes_op = (((opv >= U.ADD) & (opv <= U.REMU))
-                             | ((opv >= U.FADD) & (opv <= U.FDIV)))
+                             | ((opv >= U.FADD) & (opv <= U.MULHU)))
             is_div_s = (opv == U.DIV) | (opv == U.REM)
             is_div_u = (opv == U.DIVU) | (opv == U.REMU)
         else:
@@ -341,7 +346,7 @@ def _make_kernel(n: int, k: int, nphys: int, mem_words: int, may_latch: bool):
             is_st = jnp.full((1, B), op0 == U.STORE)
             is_br = jnp.full((1, B), (op0 >= U.BEQ) & (op0 <= U.BGE))
             writes_op = jnp.full((1, B), ((op0 >= U.ADD) & (op0 <= U.REMU))
-                                 | ((op0 >= U.FADD) & (op0 <= U.FDIV)))
+                                 | ((op0 >= U.FADD) & (op0 <= U.MULHU)))
             is_div_s = jnp.full((1, B), (op0 == U.DIV) | (op0 == U.REM))
             is_div_u = jnp.full((1, B), (op0 == U.DIVU)
                                 | (op0 == U.REMU))

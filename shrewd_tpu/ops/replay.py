@@ -126,6 +126,18 @@ def _div4(a: jax.Array, b: jax.Array):
     return div, rem, divu, remu, bad_s, bad_u
 
 
+def _mulhi(a: jax.Array, b: jax.Array) -> jax.Array:
+    """high32(a*b) unsigned via 16-bit partial products — no 64-bit ints
+    (TPU int64 support is not assumed; every term stays exact in u32)."""
+    al, ah = a & u32(0xFFFF), a >> u32(16)
+    bl, bh = b & u32(0xFFFF), b >> u32(16)
+    ll = al * bl
+    lh = al * bh
+    hl = ah * bl
+    mid = (ll >> u32(16)) + (lh & u32(0xFFFF)) + (hl & u32(0xFFFF))
+    return ah * bh + (lh >> u32(16)) + (hl >> u32(16)) + (mid >> u32(16))
+
+
 _QNAN = 0x7FC00000
 
 
@@ -177,6 +189,7 @@ def _alu(op: jax.Array, a: jax.Array, b: jax.Array, imm: jax.Array) -> jax.Array
         jnp.where(_signed_lt(a, b), one, zero),
         jnp.where(~_signed_lt(a, b), one, zero),
         fadd, fsub, fmul, fdiv,
+        _mulhi(a, b),
     ])
     return cand[op]
 
@@ -304,7 +317,7 @@ def replay(tr: TraceArrays, init_reg: jax.Array, init_mem: jax.Array,
                        dstr ^ fault.bit_as_index_mask(), dstr) & idx_mask
         result = jnp.where(is_ld, ldval, eff)
         writes = (((op >= U.ADD) & (op <= U.REMU)) | is_ld
-                  | ((op >= U.FADD) & (op <= U.FDIV))) & live_next
+                  | ((op >= U.FADD) & (op <= U.MULHU))) & live_next
         reg = reg.at[de].set(jnp.where(writes, result, reg[de]))
         do_store = is_st & valid & live_next
         mem = mem.at[slot].set(jnp.where(do_store, st_data, mem[slot]))

@@ -94,7 +94,7 @@ def record_golden(tr: TraceArrays, init_reg: jax.Array, init_mem: jax.Array,
         res = jnp.where(is_ld, ldval, eff)
         dst_old = reg[dstr]
         writes = (((op >= U.ADD) & (op <= U.REMU)) | is_ld
-                  | ((op >= U.FADD) & (op <= U.FDIV)))
+                  | ((op >= U.FADD) & (op <= U.MULHU)))
         ys = (a, b, eff, res, st_old, dst_old) \
             + ((reg,) if reg_timeline else ()) \
             + ((mem,) if mem_timeline else ())
@@ -210,7 +210,7 @@ def setup_scan(tr: TraceArrays, init_reg: jax.Array, init_mem: jax.Array,
         slot = (eff >> u32(2)).astype(i32) & i32(mem_words - 1)
         res = jnp.where(is_ld, mem[slot], eff)
         writes = (((op >= U.ADD) & (op <= U.REMU)) | is_ld
-                  | ((op >= U.FADD) & (op <= U.FDIV)))
+                  | ((op >= U.FADD) & (op <= U.MULHU)))
         reg = reg.at[dstr].set(jnp.where(writes, res, reg[dstr]))
         mem = mem.at[slot].set(jnp.where(is_st, b, mem[slot]))
         return (reg, mem, gaf, alt1, alt2), None
@@ -353,7 +353,7 @@ def taint_replay(gold: GoldenRecord, tr: TraceArrays, fault: Fault,
         # 6. writeback (ROB dest-index fault redirects the write)
         rob_here = (fault.kind == KIND_ROB_DST) & at_uop
         writes_t = (((op >= U.ADD) & (op <= U.REMU)) | is_ld
-                  | ((op >= U.FADD) & (op <= U.FDIV))) & live_next
+                  | ((op >= U.FADD) & (op <= U.MULHU))) & live_next
         result = jnp.where(is_ld, ldval, eff)
         wtag = jnp.where(rob_here, (dstr ^ index_mask) & idx_mask, dstr)
         same_dst = wtag == dstr

@@ -17,13 +17,17 @@ from tests.test_replay import fault, mini_trace, run
 
 
 def test_opcode_flip_to_illegal_is_due():
-    # SLT (15) with bit 4 flipped → 31 ≥ N_OPCODES → illegal µop → DUE
+    # With 32 opcodes the 5-bit field saturates: every single-bit flip
+    # lands on a defined opcode (MULHU filled slot 31), so the illegal
+    # path needs a wider (multi-latch) flip — bit 5 → 15^32 = 47 ≥ 32 →
+    # illegal µop → DUE.  The kernel semantics (out-of-range opcode
+    # traps) is what this pins, not the sampler's reachable bit range.
     t = mini_trace([
         (U.SLT, 1, 2, 3, 0, 0),
         (U.ADD, 4, 1, 2, 0, 0),
     ])
-    assert U.SLT ^ (1 << 4) >= U.N_OPCODES
-    r = run(t, fault(kind=KIND_LATCH_OP, cycle=0, entry=0, bit=4))
+    assert U.SLT ^ (1 << 5) >= U.N_OPCODES
+    r = run(t, fault(kind=KIND_LATCH_OP, cycle=0, entry=0, bit=5))
     assert bool(r.trapped)
     golden = run(t, fault())
     assert C.classify(r, golden) == C.OUTCOME_DUE

@@ -125,7 +125,14 @@ int main(int argc, char **argv) {
     if (!cf) { perror(coords_path); return 2; }
     Trial t;
     while (fscanf(cf, "%ld %d %d", &t.step, &t.reg, &t.bit) == 3) {
-      if (t.reg < 0 || t.reg >= kNumGPR || t.bit < 0 || t.bit >= 64) {
+      // reg 0..15: GPR (bit < 64); reg 16..31: xmm[reg-16] low lane
+      // (bit < 32) via PTRACE_GETFPREGS/SETFPREGS — the FP bank target
+      // (reference: fpu/simd PhysRegFile banks, cpu/o3/regfile.hh:75-99)
+      bool xmm_ok = t.reg >= kNumGPR && t.reg < kNumGPR + 16 &&
+                    t.bit >= 0 && t.bit < 32;
+      bool gpr_ok = t.reg >= 0 && t.reg < kNumGPR && t.bit >= 0 &&
+                    t.bit < 64;
+      if (!gpr_ok && !xmm_ok) {
         fprintf(stderr, "bad coord: %ld %d %d\n", t.step, t.reg, t.bit);
         return 2;
       }
@@ -170,10 +177,17 @@ int main(int argc, char **argv) {
       close(pfd[0]);
     } else {
       struct user_regs_struct regs;
-      ptrace(PTRACE_GETREGS, pid, nullptr, &regs);
-      uint64_t v = canonical_get(regs, t.reg);
-      canonical_set(regs, t.reg, v ^ (1ULL << t.bit));
-      ptrace(PTRACE_SETREGS, pid, nullptr, &regs);
+      if (t.reg >= kNumGPR) {
+        struct user_fpregs_struct fpr;
+        ptrace(PTRACE_GETFPREGS, pid, nullptr, &fpr);
+        fpr.xmm_space[4 * (t.reg - kNumGPR)] ^= (1U << t.bit);
+        ptrace(PTRACE_SETFPREGS, pid, nullptr, &fpr);
+      } else {
+        ptrace(PTRACE_GETREGS, pid, nullptr, &regs);
+        uint64_t v = canonical_get(regs, t.reg);
+        canonical_set(regs, t.reg, v ^ (1ULL << t.bit));
+        ptrace(PTRACE_SETREGS, pid, nullptr, &regs);
+      }
       RunResult rr = run_to_exit(pid, pfd[0], 5);
       close(pfd[0]);
       if (rr.hang || rr.fatal_signal || !WIFEXITED(rr.status) ||

@@ -113,7 +113,7 @@ int main(int argc, char **argv) {
 
   FILE *f = fopen(outpath, "wb");
   if (!f) { perror(outpath); return 2; }
-  fwrite("SHTRACE2", 8, 1, f);
+  fwrite("SHTRACE3", 8, 1, f);
   put_u64(f, begin);
   put_u64(f, end);
   long n_steps_off = ftell(f);
@@ -127,13 +127,16 @@ int main(int argc, char **argv) {
   }
 
   uint64_t steps = 0;
-  uint64_t c[kRegsPerStep];
+  uint64_t c[kRegsPerStep + kXmmWords];
+  struct user_fpregs_struct fpregs;
   bool clean_exit = false;
   while (steps < max_steps) {
     ptrace(PTRACE_GETREGS, pid, nullptr, &regs);
     if (regs.rip == end) { clean_exit = true; break; }
     regs_to_canonical(regs, c);
-    fwrite(c, 8, kRegsPerStep, f);
+    ptrace(PTRACE_GETFPREGS, pid, nullptr, &fpregs);
+    xmm_lo_to_canonical(fpregs, c + kRegsPerStep);
+    fwrite(c, 8, kRegsPerStep + kXmmWords, f);
     steps++;
     if (!single_step(pid)) {
       fprintf(stderr, "child exited mid-window after %lu steps\n",
@@ -145,7 +148,9 @@ int main(int argc, char **argv) {
   // last macro-op's results too
   if (clean_exit) {
     regs_to_canonical(regs, c);
-    fwrite(c, 8, kRegsPerStep, f);
+    ptrace(PTRACE_GETFPREGS, pid, nullptr, &fpregs);
+    xmm_lo_to_canonical(fpregs, c + kRegsPerStep);
+    fwrite(c, 8, kRegsPerStep + kXmmWords, f);
   }
 
   fseek(f, n_steps_off, SEEK_SET);

@@ -31,6 +31,10 @@
 // by this table; keep all three in sync.
 static const int kNumGPR = 16;
 static const int kRegsPerStep = 18;  // 16 GPRs + rip + eflags
+// SHTRACE3: each step additionally records the 16 xmm registers' low 32
+// bits (the scalar-SSE f32 lanes the FP lift verifies against), packed
+// two lanes per u64 — xmm[2k] in the low half, xmm[2k+1] in the high.
+static const int kXmmWords = 8;
 
 static inline void regs_to_canonical(const struct user_regs_struct &r,
                                      uint64_t out[kRegsPerStep]) {
@@ -40,6 +44,15 @@ static inline void regs_to_canonical(const struct user_regs_struct &r,
   out[12] = r.r12; out[13] = r.r13; out[14] = r.r14; out[15] = r.r15;
   out[16] = r.rip;
   out[17] = r.eflags;
+}
+
+static inline void xmm_lo_to_canonical(const struct user_fpregs_struct &fp,
+                                       uint64_t out[kXmmWords]) {
+  for (int k = 0; k < 8; k++) {
+    uint64_t lo = fp.xmm_space[4 * (2 * k)];
+    uint64_t hi = fp.xmm_space[4 * (2 * k + 1)];
+    out[k] = lo | (hi << 32);
+  }
 }
 
 static inline void canonical_set(struct user_regs_struct &r, int idx,

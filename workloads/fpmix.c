@@ -1,0 +1,98 @@
+/* Scalar-SSE floating-point workload (VERDICT r3 #6: FP/vector state as
+ * a lifted injection target; reference FP OpClasses,
+ * src/cpu/FuncUnitConfig.py, FP shadow FUs being the fork's raison
+ * d'etre).
+ *
+ * Single-precision only — the replay ISA's FADD/FSUB/FMUL/FDIV lanes are
+ * f32 with FTZ + canonical-NaN semantics (isa/uops.py FP contract), and
+ * scalar SSE keeps every value in an xmm low lane the tracer can capture.
+ * A polynomial-evaluation / dot-product / iterative-refinement mix keeps
+ * add/sub/mul/div and float compares all hot.  Output: the float
+ * accumulator's BIT PATTERN as an integer checksum (bit-exact, no printf
+ * rounding), same marker/build conventions as sort.c.
+ */
+
+#include <unistd.h>
+
+#define N 96
+
+static float xs[N], ys[N];
+static volatile int sink;
+
+static unsigned int rng_state = 0x1234567u;
+static unsigned int xorshift(void) {
+    unsigned int x = rng_state;
+    x ^= x << 13;
+    x ^= x >> 17;
+    x ^= x << 5;
+    rng_state = x;
+    return x;
+}
+
+__attribute__((noinline)) void kernel_begin(void) { __asm__ volatile(""); }
+__attribute__((noinline)) void kernel_end(void)   { __asm__ volatile(""); }
+
+__attribute__((noinline)) static float fp_kernel(void) {
+    float acc = 0.0f;
+    float p, d;
+    int i, j;
+    /* dot product with a polynomial twist */
+    for (i = 0; i < N; i++) {
+        p = xs[i] * ys[i];
+        acc = acc + p;
+        /* Horner polynomial on xs[i] */
+        p = 1.5f;
+        for (j = 0; j < 4; j++)
+            p = p * xs[i] + 0.25f;
+        acc = acc + p;
+    }
+    /* iterative refinement of a reciprocal (division + compare loop) */
+    d = acc;
+    if (d < 1.0f)
+        d = d + 2.0f;
+    for (i = 0; i < 24; i++) {
+        float q = 100.0f / d;
+        if (q > d)
+            acc = acc + 0.125f;
+        else
+            acc = acc - 0.0625f;
+        d = d + q;
+    }
+    /* running min/max via compares */
+    p = xs[0];
+    for (i = 1; i < N; i++) {
+        if (xs[i] > p)
+            p = xs[i];
+        if (ys[i] < acc && ys[i] > 0.0f)
+            acc = acc + ys[i];
+    }
+    return acc + p;
+}
+
+static char out_line[32];
+
+static int fmt(unsigned int v, char *p) {
+    char tmp[16];
+    int n = 0, i;
+    if (!v) tmp[n++] = '0';
+    while (v) { tmp[n++] = (char)('0' + v % 10u); v /= 10u; }
+    for (i = 0; i < n; i++) p[i] = tmp[n - 1 - i];
+    return n;
+}
+
+int main(void) {
+    int i, pos = 0;
+    union { float f; unsigned int u; } r;
+    for (i = 0; i < N; i++) {
+        xs[i] = (float)(int)(xorshift() & 255u) / 64.0f - 1.0f;
+        ys[i] = (float)(int)(xorshift() & 511u) / 128.0f - 2.0f;
+    }
+    kernel_begin();
+    r.f = fp_kernel();
+    kernel_end();
+    sink = (int)r.u;
+    pos += fmt(r.u, out_line + pos);
+    out_line[pos++] = '\n';
+    if (write(1, out_line, (unsigned long)pos) != pos) return 2;
+    return 0;
+}
