@@ -1,0 +1,109 @@
+"""Post-stratification effectiveness artifact (STRAT_EFFECT_r{N}).
+
+For each fault tier, compare the plain Wilson estimator's variance
+against the post-stratified estimator's on the same trial budget — the
+variance-reduction factor is (trials to reach a CI target, plain) /
+(trials, stratified), approximated here by the ratio of estimator
+variances over repeated batches (VERDICT r3 weak #7: the r3 strata
+carried almost no signal for mesi/noc; the NoC pipeline made outcomes
+type-determined, and MESI gained structure-specific tiers).
+
+Usage: python tools/strat_effect.py [--batches 24] [--batch 512]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _variance_ratio(kernel, structure: str, batches: int, batch: int,
+                    seed0: int):
+    """Trials-to-CI-target reduction factor: mean of
+    (plain Wilson halfwidth / post-stratified halfwidth)² over repeated
+    batches.  (The point estimates coincide by construction — observed-
+    allocation weights telescope to the pooled proportion — so the win is
+    entirely in the interval width, i.e. how soon run_until_ci stops.)"""
+    import numpy as np
+
+    from shrewd_tpu.ops import classify as C
+    from shrewd_tpu.parallel.stopping import post_stratified, wilson
+    from shrewd_tpu.utils import prng
+
+    avfs, factors = [], []
+    for b in range(batches):
+        keys = prng.trial_keys(prng.campaign_key(seed0 + b), batch)
+        st_tally, _ = kernel.run_keys_stratified(keys, structure)
+        st_tally = np.asarray(st_tally)
+        tally = st_tally.sum(axis=0)
+        avfs.append(float(C.avf(tally)))
+        vuln = int(tally[C.OUTCOME_SDC] + tally[C.OUTCOME_DUE])
+        hw_p = wilson(vuln, int(tally.sum())).halfwidth
+        pairs = [(int(row[C.OUTCOME_SDC] + row[C.OUTCOME_DUE]),
+                  int(row.sum())) for row in st_tally]
+        hw_s = post_stratified(pairs).halfwidth
+        if hw_s > 0:
+            factors.append((hw_p / hw_s) ** 2)
+    return {
+        "avf_mean": round(float(np.mean(avfs)), 4),
+        "trials_reduction_factor": round(float(np.mean(factors)), 3)
+        if factors else None,
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batches", type=int, default=24)
+    ap.add_argument("--batch", type=int, default=512)
+    ap.add_argument("--out", default=str(REPO / "STRAT_EFFECT.json"))
+    a = ap.parse_args()
+
+    import numpy as np
+
+    from shrewd_tpu.models.mesi import MesiConfig, MesiKernel, torture_stream
+    from shrewd_tpu.models.noc import NocConfig, NocKernel, build_message_trace
+    from shrewd_tpu.models.o3 import O3Config
+    from shrewd_tpu.ops.trial import TrialKernel
+    from shrewd_tpu import native
+
+    out = {"batches": a.batches, "batch": a.batch, "tiers": {}}
+
+    trace = native.generate_trace(seed=1, n=2048, nphys=256, mem_words=2048,
+                                  working_set_words=512)
+    o3 = TrialKernel(trace, O3Config())
+    for structure in ("regfile", "fu"):
+        out["tiers"][f"o3:{structure}"] = _variance_ratio(
+            o3, structure, a.batches, a.batch, 100)
+        print(structure, out["tiers"][f"o3:{structure}"], file=sys.stderr)
+
+    mcfg = MesiConfig(n_cores=4)
+    mcfg.validate()
+    stream = torture_stream(mcfg, 96, 64, seed=3, sharing=0.6)
+    init = np.arange(64, dtype=np.uint32)
+    mk = MesiKernel(stream, mcfg, init)
+    for structure in ("state", "dir", "tbe"):
+        out["tiers"][f"mesi:{structure}"] = _variance_ratio(
+            mk, structure, a.batches, min(a.batch, 256), 200)
+        print(structure, out["tiers"][f"mesi:{structure}"], file=sys.stderr)
+
+    ncfg = NocConfig()
+    ncfg.validate()
+    nk = NocKernel(build_message_trace(stream, mcfg, ncfg), ncfg)
+    out["tiers"]["noc:router"] = _variance_ratio(
+        nk, "router", a.batches, min(a.batch, 256), 300)
+    print("noc", out["tiers"]["noc:router"], file=sys.stderr)
+
+    with open(a.out, "w") as f:
+        json.dump(out, f, indent=1)
+        f.write("\n")
+    print(json.dumps({k: v["trials_reduction_factor"]
+                      for k, v in out["tiers"].items()}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
