@@ -31,7 +31,8 @@ def _variance_ratio(kernel, structure: str, batches: int, batch: int,
     import numpy as np
 
     from shrewd_tpu.ops import classify as C
-    from shrewd_tpu.parallel.stopping import post_stratified, wilson
+    from shrewd_tpu.parallel.stopping import (pairs_from_strata,
+                                              post_stratified, wilson)
     from shrewd_tpu.utils import prng
 
     avfs, factors = [], []
@@ -43,13 +44,14 @@ def _variance_ratio(kernel, structure: str, batches: int, batch: int,
         avfs.append(float(C.avf(tally)))
         vuln = int(tally[C.OUTCOME_SDC] + tally[C.OUTCOME_DUE])
         hw_p = wilson(vuln, int(tally.sum())).halfwidth
-        pairs = [(int(row[C.OUTCOME_SDC] + row[C.OUTCOME_DUE]),
-                  int(row.sum())) for row in st_tally]
+        # the campaign stopping rule's own vulnerability definition —
+        # never re-derive it here
+        pairs = pairs_from_strata(st_tally)
         hw_s = post_stratified(pairs).halfwidth
-        if hw_s > 0:
-            factors.append((hw_p / hw_s) ** 2)
+        factors.append((hw_p / hw_s) ** 2)
     return {
         "avf_mean": round(float(np.mean(avfs)), 4),
+        "batch": batch,
         "trials_reduction_factor": round(float(np.mean(factors)), 3)
         if factors else None,
     }
@@ -70,7 +72,8 @@ def main() -> int:
     from shrewd_tpu.ops.trial import TrialKernel
     from shrewd_tpu import native
 
-    out = {"batches": a.batches, "batch": a.batch, "tiers": {}}
+    out = {"batches": a.batches,
+           "batch": "per-tier (see tiers[*].batch)", "tiers": {}}
 
     trace = native.generate_trace(seed=1, n=2048, nphys=256, mem_words=2048,
                                   working_set_words=512)
