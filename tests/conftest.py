@@ -64,9 +64,11 @@ QUICK_TESTS = {
     "test_unmapped_va_traps",              # VA crash model (MemMap)
     "test_fp_fault_propagates_to_sdc",     # FP µop lanes
     "test_lift_rate_is_high",              # capture → x86 lift
+    "test_mulhu_bit_exact_across_backends",  # MULHU parity
 }
 QUICK_CLASSES = {
     "TestSuffixStems", "TestSimdSubset",   # emulator units, no capture
+    "TestPairAlgebra",                     # 64-bit carry/borrow µop algebra
 }
 SLOW_TESTS = {
     "test_strmix_emu64_runs_to_exit",      # whole-program emu, ~30 s
