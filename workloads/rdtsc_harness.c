@@ -1,0 +1,66 @@
+/* Cycle-count harness: run a marker workload's kernel under rdtsc and
+ * print the median cycle count — the EXTERNAL timing truth the scoreboard
+ * model is sanity-anchored against (tools/timing_validate.py).  The host
+ * x86 core is itself a wide out-of-order machine, i.e. exactly the class
+ * of pipeline the reference's O3 model and our scoreboard approximate.
+ *
+ * Build: gcc -O1 -static -fno-pie -no-pie -DWORKLOAD='"sort.c"' \
+ *            rdtsc_harness.c -o harness
+ * The workload's main() is renamed away; we call its kernel directly.
+ */
+
+#include <stdint.h>
+#include <unistd.h>
+
+#define main workload_main          /* keep the workload's main out */
+#include WORKLOAD
+#undef main
+
+static inline uint64_t rdtsc_begin(void) {
+    uint32_t lo, hi;
+    __asm__ volatile("cpuid\n\trdtsc" : "=a"(lo), "=d"(hi)
+                     :: "rbx", "rcx");
+    return ((uint64_t)hi << 32) | lo;
+}
+
+static inline uint64_t rdtsc_end(void) {
+    uint32_t lo, hi;
+    __asm__ volatile("rdtscp" : "=a"(lo), "=d"(hi) :: "rcx");
+    return ((uint64_t)hi << 32) | lo;
+}
+
+static int out(char *p, uint64_t v) {
+    char tmp[24];
+    int n = 0, i;
+    if (!v) tmp[n++] = '0';
+    while (v) { tmp[n++] = (char)('0' + v % 10u); v /= 10u; }
+    for (i = 0; i < n; i++) p[i] = tmp[n - 1 - i];
+    p[n] = '\n';
+    return n + 1;
+}
+
+int main(void) {
+    enum { REPS = 21 };
+    uint64_t cyc[REPS];
+    char line[32];
+    int i, j;
+    /* one warm run populates caches/predictors the way the traced run
+     * (which the scoreboard models) executed */
+    workload_init();
+    kernel_payload();
+    for (i = 0; i < REPS; i++) {
+        workload_init();
+        uint64_t a = rdtsc_begin();
+        kernel_payload();
+        cyc[i] = rdtsc_end() - a;
+    }
+    /* insertion-sort, print median */
+    for (i = 1; i < REPS; i++)
+        for (j = i; j > 0 && cyc[j] < cyc[j - 1]; j--) {
+            uint64_t t = cyc[j]; cyc[j] = cyc[j - 1]; cyc[j - 1] = t;
+        }
+    if (write(1, line, (unsigned long)out(line, cyc[REPS / 2]))
+            < 0)
+        return 2;
+    return 0;
+}
