@@ -55,7 +55,7 @@ QUICK_MODULES = {
     # sub-second unit modules: host utilities, stats engine, m5.cpt
     # ingest, trace format, the dedicated smoke module
     "test_utils", "test_stats", "test_ingest", "test_trace",
-    "test_quick_smoke",
+    "test_quick_smoke", "test_bench",
 }
 QUICK_TESTS = {
     # one representative per subsystem (≈4-10 s each, compile-dominated)

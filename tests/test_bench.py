@@ -1,0 +1,70 @@
+"""bench.py supervisor logic: JSON-line selection, pinned-baseline
+loading, and the tunnel-probe contract (VERDICT r3 weak #1/#2 — the
+official metric pipeline must not lie downward silently)."""
+
+import importlib.util
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+spec = importlib.util.spec_from_file_location("bench", REPO / "bench.py")
+bench = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(bench)
+
+
+def test_last_json_line_picks_refined_result():
+    out = "\n".join([
+        "noise",
+        json.dumps({"metric": "m", "value": 1, "provisional": True}),
+        "more noise",
+        json.dumps({"metric": "m", "value": 2}),
+        "{broken",
+    ])
+    line = bench._last_json_line(out)
+    assert json.loads(line)["value"] == 2
+
+
+def test_last_json_line_none_when_absent():
+    assert bench._last_json_line("no json here\nat all") is None
+
+
+def test_pinned_baseline_roundtrip(tmp_path, monkeypatch):
+    pin = tmp_path / "BASELINE_MEASURED.json"
+    monkeypatch.setattr(bench, "BASELINE_PIN", str(pin))
+    assert bench._load_pinned_baseline(4096) is None      # missing file
+    pin.write_text(json.dumps(
+        {"metric": "serial_golden_trials_per_sec", "n_uops": 4096,
+         "median": 14772.6}))
+    assert bench._load_pinned_baseline(4096) == 14772.6
+    assert bench._load_pinned_baseline(256) is None       # window mismatch
+    pin.write_text("null")                                # malformed pin
+    assert bench._load_pinned_baseline(4096) is None      # never raises
+
+
+def test_strip_axon_site_removes_tunnel_path():
+    env = bench._strip_axon_site(
+        {"PYTHONPATH": "/root/.axon_site:/root/repo", "OTHER": "x"})
+    assert "axon_site" not in env["PYTHONPATH"]
+    assert "/root/repo" in env["PYTHONPATH"]
+
+
+def test_probe_self_exits_never_hangs():
+    """The probe process must terminate on its own well inside the
+    supervisor's grace window even when the backend blocks — the watchdog
+    self-exit is what keeps killed-mid-dial wedges impossible."""
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "bench.py"), "--probe",
+         "--platform", "cpu"],
+        capture_output=True, text=True, timeout=bench.PROBE_WAIT_S,
+        env={"PATH": "/usr/bin:/bin", "JAX_PLATFORMS": "cpu",
+             "HOME": "/root"})
+    assert proc.returncode == 0 and "PROBE_OK" in proc.stdout
+
+
+def test_committed_pin_matches_schema():
+    pin = json.loads((REPO / "BASELINE_MEASURED.json").read_text())
+    assert pin["n_uops"] == 4096 and pin["median"] > 0
+    assert pin["reps"] >= 5
