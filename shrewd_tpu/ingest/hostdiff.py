@@ -286,6 +286,55 @@ def memmap_from_meta(meta: dict, cut: int | None = None):
         st_lo=jnp.asarray(st_lo), st_span=jnp.asarray(st_span))
 
 
+def _coords_to_phys(meta: dict, reg: np.ndarray,
+                    bit: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """The ONE coords→phys-register mapping (shared by fault construction
+    and the severed test): 64-bit hi lanes at +32, xmm lanes at fp_bank."""
+    if int(meta.get("width", 32)) == 64:
+        return reg + 32 * (bit >= 32), bit % 32
+    if meta.get("fp_bank") is not None:
+        fb = int(meta["fp_bank"])
+        return np.where(reg >= 16, fb + (reg - 16), reg), bit
+    return reg, bit
+
+
+def _resync_severed(trace, meta: dict, coords: np.ndarray) -> np.ndarray:
+    """bool[n_coords]: faults whose struck phys register's first touch at
+    or after the landing cycle is a demotion-resync LUI — severed in the
+    replay (the constant overwrites the flip) but alive on silicon."""
+    from shrewd_tpu.isa import uops as U
+
+    resync = meta.get("resync_uops") or []
+    if not resync:
+        return np.zeros(len(coords), dtype=bool)
+    n = trace.n
+    opcode = np.asarray(trace.opcode)
+    src1 = np.asarray(trace.src1)
+    src2 = np.asarray(trace.src2)
+    dst = np.asarray(trace.dst)
+    u1 = np.asarray(U.uses_src1(opcode))
+    u2 = np.asarray(U.uses_src2(opcode))
+    wd = np.asarray(U.writes_dest(opcode))
+    is_resync = np.zeros(n, dtype=bool)
+    is_resync[np.asarray(resync, dtype=np.int64)] = True
+
+    uop_start = np.asarray(meta["uop_start"], dtype=np.int64)
+    step, reg, bit = coords.T
+    reg, _ = _coords_to_phys(meta, reg, bit)
+    out = np.zeros(len(coords), dtype=bool)
+    for r in np.unique(reg):
+        touch = np.nonzero(((src1 == r) & u1) | ((src2 == r) & u2)
+                           | ((dst == r) & wd))[0]
+        if touch.size == 0:
+            continue
+        sel = np.nonzero(reg == r)[0]
+        pos = np.searchsorted(touch, uop_start[step[sel]], side="left")
+        has = pos < touch.size
+        first = touch[np.minimum(pos, touch.size - 1)]
+        out[sel] = has & is_resync[first]
+    return out
+
+
 def run_device(trace, meta: dict, coords: np.ndarray,
                liveness=None, paths: BuildPaths | None = None,
                resolve_diverged: bool = True,
@@ -311,15 +360,8 @@ def run_device(trace, meta: dict, coords: np.ndarray,
                     memmap=memmap_from_meta(meta))
     uop_start = np.asarray(meta["uop_start"], dtype=np.int64)
     step, reg, bit = coords.T
-    if int(meta.get("width", 32)) == 64:
-        # pair-lane datapath (ingest/lift64.py): arch reg r bit b ↦ phys
-        # (r + 32·(b≥32), b mod 32) — the full 64-bit PhysRegFile bank
-        reg = reg + 32 * (bit >= 32)
-        bit = bit % 32
-    elif meta.get("fp_bank") is not None:
-        # coords reg 16..31 are xmm0..15 low lanes → the FP bank
-        fb = int(meta["fp_bank"])
-        reg = np.where(reg >= 16, fb + (reg - 16), reg)
+    # pair-lane hi lanes / FP bank — the same mapping _resync_severed uses
+    reg, bit = _coords_to_phys(meta, reg, bit)
     faults = Fault(
         kind=jnp.full(len(coords), KIND_REGFILE, dtype=jnp.int32),
         cycle=jnp.asarray(uop_start[step], dtype=jnp.int32),
@@ -375,9 +417,21 @@ def run_device(trace, meta: dict, coords: np.ndarray,
         # path to its real outcome (segfault → DUE / output diff → SDC /
         # re-convergence → masked).  masked/sdc/due class codes coincide
         # between HOST_OUTCOME and ops.classify.
-        div = np.asarray(rfull.diverged) & ~trapped & ~detected
+        # Resync-severed coordinates: the struck register's first touch
+        # after the landing cycle is a demotion-resync LUI, so the replay
+        # provably drops a corruption silicon keeps — escalate those to
+        # the oracle along with the diverged trials (the low-lift-rate
+        # workloads' dominant disagreement channel).
+        sev = _resync_severed(trace, meta, coords)
+        div_only = np.asarray(rfull.diverged) & ~trapped & ~detected
+        div = (div_only | sev) & ~trapped & ~detected
         if report is not None:
-            report["device_diverged"] = int(div.sum())
+            # device_diverged keeps its r04-artifact meaning (the
+            # diverged escalation set); resync_severed counts the trials
+            # the severed test ADDS to it
+            report["device_diverged"] = int(div_only.sum())
+            report["resync_severed"] = int((sev & ~div_only & ~trapped
+                                            & ~detected).sum())
             report["device_memmap"] = k.memmap is not None
         if resolve_diverged and paths is not None and div.any():
             try:

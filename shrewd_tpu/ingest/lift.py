@@ -427,6 +427,7 @@ class Lifter:
         self.imm: list[int] = []
         self.taken: list[int] = []
         self.mem_cluster: list[int] = []    # per-µop cluster idx (-1: none)
+        self.resync_uops: list[int] = []    # LUIs emitted by demotions
         self.uop_start: list[int] = []      # macro step -> first µop index
         # golden simulation state (the self-check oracle)
         self.reg = np.zeros(NPHYS, dtype=np.uint64)   # low-32 values (u64 buf)
@@ -1747,16 +1748,27 @@ class Lifter:
 
     def _resync_regs(self, next_full: np.ndarray) -> None:
         """Opaque demotion: overwrite every mismatched register with its
-        captured value."""
+        captured value.  Each emitted LUI's µop index is recorded — a
+        fault whose struck register meets a resync before its next read
+        is provably severed in replay while silicon keeps it, and the
+        host-diff harness escalates exactly those coordinates to the
+        whole-program emulator oracle (ingest/hostdiff.py)."""
         want = next_full[:N_GPR] & np.uint64(M32)
         changed = np.nonzero(self.reg[:N_GPR] != want)[0]
         for r in changed:
-            self._emit(U.LUI, int(r), ZERO, ZERO, int(want[r]))
+            self._emit_resync(int(r), int(want[r]))
         lanes = self._xmm_lanes(next_full)
         if self.FP_BASE is not None and lanes is not None:
             fb = self.FP_BASE
             for k in np.nonzero(self.reg[fb:fb + 16] != lanes)[0]:
-                self._emit(U.LUI, fb + int(k), ZERO, ZERO, int(lanes[k]))
+                self._emit_resync(fb + int(k), int(lanes[k]))
+
+    def _emit_resync(self, phys: int, value: int) -> None:
+        """A demotion-resync LUI, recorded for the severed-fault test —
+        every resync emission MUST go through here (ingest/hostdiff.py
+        _resync_severed depends on the record being complete)."""
+        self.resync_uops.append(len(self.opcode))
+        self._emit(U.LUI, phys, ZERO, ZERO, value & M32)
 
     def _final_reg_expect(self, vals: np.ndarray) -> list:
         return [int(x) for x in (vals[:N_GPR] & np.uint64(M32))]
@@ -1831,6 +1843,7 @@ class Lifter:
             "final_reg_expect": self._final_reg_expect(steps[n_macro]),
             "clusters": [tuple(int(v) for v in c) for c in self.clusters],
             "mem_cluster": [int(x) for x in self.mem_cluster],
+            "resync_uops": [int(x) for x in self.resync_uops],
             "map_regions": self.map_regions(),
             "stats": self.stats.to_dict(),
             "nphys": int(self.reg.shape[0]),

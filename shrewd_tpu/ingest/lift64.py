@@ -96,9 +96,9 @@ class Lifter64(Lifter):
         lo_want = next_full[:N_GPR] & np.uint64(M32)
         hi_want = next_full[:N_GPR] >> np.uint64(32)
         for r in np.nonzero(self.reg[:N_GPR] != lo_want)[0]:
-            self._emit(U.LUI, int(r), ZERO, ZERO, int(lo_want[r]))
+            self._emit_resync(int(r), int(lo_want[r]))
         for r in np.nonzero(self.reg[HI:HI + N_GPR] != hi_want)[0]:
-            self._emit(U.LUI, hi(int(r)), ZERO, ZERO, int(hi_want[r]))
+            self._emit_resync(hi(int(r)), int(hi_want[r]))
 
     def _final_reg_expect(self, vals: np.ndarray) -> list:
         return [int(x) for x in vals[:N_GPR]]
