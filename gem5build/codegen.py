@@ -1,0 +1,350 @@
+"""Run gem5's code generation steps from the collected manifest, scons-free.
+
+Reproduces, in dependency order, what the reference's scons build does via
+gem5py/gem5py_m5 commands (reference src/SConscript:83-238, 485-652):
+
+  1. config/<var>.hh per CONF symbol + config/the_gpu_isa.hh
+  2. debug/<flag>.{hh,cc}              (build_tools/debugflag{hh,cc}.py)
+  3. python/m5/defines.py + info.py    (makeDefinesPyFile / infopy.py)
+  4. marshalled embedded python .py.cc (build_tools/marshal.py)
+  5. params/<Obj>.hh, python/_m5/param_<Obj>.cc, enums/<E>.{hh,cc}
+     (build_tools/sim_object_param_struct_*.py, enum_*.py) — driven with a
+     manifest-backed module importer instead of the gem5py_m5 embedded one
+  6. the m5ImporterCode blob           (gem5_scons/builders/blob.py analog)
+  7. the X86 ISA description           (src/arch/isa_parser)
+  8. sim/tags.cc                       (util/cpt_upgrader.py --get-cc-file)
+  9. ext/libelf generated .c + native-elf-format.h (mini-m4; m4 is not in
+     this image)
+
+Steps 4/5 run in-process: one interpreter, one `import m5`, hundreds of
+generation units — a large win on this 1-core host vs per-file gem5py
+subprocesses, with identical outputs (same interpreter version, so the
+marshal format matches the embedded libpython).
+"""
+
+import importlib
+import importlib.abc
+import importlib.util
+import json
+import marshal as _marshal
+import os
+import runpy
+import subprocess
+import sys
+import time
+import zlib
+
+REF = "/root/reference"
+SRC = os.path.join(REF, "src")
+HERE = os.path.dirname(os.path.abspath(__file__))
+BUILD = os.path.join(HERE, "build")
+
+sys.path.insert(0, os.path.join(REF, "build_tools"))
+sys.path.insert(0, os.path.join(REF, "ext/ply"))
+
+
+def log(msg):
+    print(f"[codegen +{time.monotonic() - T0:6.1f}s] {msg}", flush=True)
+
+
+def run_tool(script, argv):
+    """Execute a build_tools script in-process with a patched argv."""
+    saved = sys.argv
+    sys.argv = [script] + [str(a) for a in argv]
+    try:
+        runpy.run_path(script, run_name="__main__")
+    finally:
+        sys.argv = saved
+
+
+# ----------------------------------------------------------------------
+# manifest-backed module importer (stands in for gem5py_m5's embedded one)
+
+class ManifestImporter(importlib.abc.MetaPathFinder, importlib.abc.Loader):
+    def __init__(self, modmap):
+        self.modmap = modmap  # modpath -> source file
+
+    def find_spec(self, fullname, path, target=None):
+        if fullname not in self.modmap:
+            return None
+        abspath = self.modmap[fullname]
+        is_package = os.path.basename(abspath) == "__init__.py"
+        spec = importlib.util.spec_from_loader(
+            name=fullname, loader=self, is_package=is_package)
+        spec.loader_state = self.modmap.keys()
+        spec.origin = abspath
+        return spec
+
+    def exec_module(self, module):
+        abspath = self.modmap[module.__name__]
+        with open(abspath) as f:
+            src = f.read()
+        code = compile(src, abspath, "exec")
+        exec(code, module.__dict__)
+
+
+def install_importer(man):
+    modmap = {p["modpath"]: p["path"] for p in man["pysources"]}
+    imp = ManifestImporter(modmap)
+    sys.meta_path.insert(0, imp)
+    # the codegen scripts do `import importer; importer.install()`
+    fake = type(sys)("importer")
+    fake.install = lambda: None
+    fake.add_module = lambda *a: None
+    sys.modules["importer"] = fake
+    return imp
+
+
+# ----------------------------------------------------------------------
+
+def gen_config_headers(conf):
+    d = os.path.join(BUILD, "config")
+    os.makedirs(d, exist_ok=True)
+    for var, val in conf.items():
+        if isinstance(val, bool):
+            sval = str(int(val))
+        elif isinstance(val, str):
+            sval = '"' + val + '"'
+        else:
+            sval = str(val)
+        _write_if_changed(os.path.join(d, var.lower() + ".hh"),
+                          f"#define {var} {sval}\n")
+    _write_if_changed(os.path.join(d, "the_gpu_isa.hh"),
+                      "#ifndef TheGpuISA\n#define TheGpuISA None\n"
+                      "#endif // TheGpuISA\n")
+    log(f"config headers: {len(conf) + 1}")
+
+
+def gen_debugflags(man):
+    d = os.path.join(BUILD, "debug")
+    os.makedirs(d, exist_ok=True)
+    for fl in man["debugflags"]:
+        name = fl["name"]
+        desc = fl["desc"] or name
+        run_tool(os.path.join(REF, "build_tools/debugflaghh.py"),
+                 [os.path.join(d, name + ".hh"), name, desc,
+                  "True" if fl["fmt"] else "False",
+                  ":".join(fl["components"])])
+        run_tool(os.path.join(REF, "build_tools/debugflagcc.py"),
+                 [os.path.join(d, name + ".cc"), name])
+    log(f"debug flags: {len(man['debugflags'])}")
+
+
+def gen_defines_info(conf):
+    d = os.path.join(BUILD, "python/m5")
+    os.makedirs(d, exist_ok=True)
+    with open(os.path.join(d, "defines.py"), "w") as f:
+        f.write(f"buildEnv = {dict(conf)!r}\n")
+    run_tool(os.path.join(REF, "build_tools/infopy.py"),
+             [os.path.join(d, "info.py"),
+              os.path.join(REF, "COPYING"), os.path.join(REF, "LICENSE"),
+              os.path.join(REF, "README.md")])
+    log("defines.py + info.py")
+
+
+def gen_marshal(man):
+    sys.path.insert(0, os.path.join(SRC, "python"))
+    from blob import bytesToCppArray
+    from code_formatter import code_formatter
+
+    n = 0
+    for p in man["pysources"]:
+        cc, py, modpath = p["cc"], p["path"], p["modpath"]
+        if _newer(cc, py):
+            continue
+        os.makedirs(os.path.dirname(cc), exist_ok=True)
+        with open(py) as f:
+            src = f.read()
+        compiled = compile(src, py, "exec")
+        marshalled = _marshal.dumps(compiled)
+        compressed = zlib.compress(marshalled)
+        code = code_formatter()
+        code("namespace gem5\n{\nnamespace\n{")
+        bytesToCppArray(code, "embedded_module_data", compressed)
+        abspath = py
+        code('\nEmbeddedPython embedded_module_info(\n'
+             f'    "{abspath}",\n'
+             f'    "{modpath}",\n'
+             '    embedded_module_data,\n'
+             f'    {len(compressed)},\n'
+             f'    {len(marshalled)});\n'
+             '} // anonymous namespace\n} // namespace gem5')
+        text = '#include "python/embedded.hh"\n\n' + str(code) + "\n"
+        _write_if_changed(cc, text)
+        n += 1
+    log(f"marshalled python: {n} regenerated "
+        f"of {len(man['pysources'])}")
+
+
+def gen_params(man):
+    os.makedirs(os.path.join(BUILD, "params"), exist_ok=True)
+    os.makedirs(os.path.join(BUILD, "python/_m5"), exist_ok=True)
+    os.makedirs(os.path.join(BUILD, "enums"), exist_ok=True)
+    bt = os.path.join(REF, "build_tools")
+    n = 0
+    for so in man["simobjects"]:
+        module = so["module"]
+        for obj in so["sim_objects"]:
+            run_tool(os.path.join(bt, "sim_object_param_struct_hh.py"),
+                     [module, os.path.join(BUILD, f"params/{obj}.hh")])
+            run_tool(os.path.join(bt, "sim_object_param_struct_cc.py"),
+                     [module,
+                      os.path.join(BUILD, f"python/_m5/param_{obj}.cc"),
+                      "True"])
+            n += 1
+        for en in so["enums"]:
+            run_tool(os.path.join(bt, "enum_hh.py"),
+                     [module, os.path.join(BUILD, f"enums/{en}.hh")])
+            run_tool(os.path.join(bt, "enum_cc.py"),
+                     [module, os.path.join(BUILD, f"enums/{en}.cc"),
+                      "True"])
+            n += 1
+    log(f"param/enum units: {n}")
+
+
+def gen_blobs(man):
+    from blob import bytesToCppArray
+    from code_formatter import code_formatter
+
+    for b in man["blobs"]:
+        with open(b["path"], "rb") as f:
+            data = f.read()
+        symbol = b["symbol"]
+        hh_code = code_formatter()
+        hh_code("#include <cstddef>\n#include <cstdint>\n\n"
+                "namespace gem5\n{\nnamespace Blobs\n{\n\n"
+                f"extern const std::size_t {symbol}_len;\n"
+                f"extern const std::uint8_t {symbol}[];\n\n"
+                "} // namespace Blobs\n} // namespace gem5")
+        os.makedirs(os.path.dirname(b["hh"]), exist_ok=True)
+        hh_code.write(b["hh"])
+        include_path = os.path.relpath(b["hh"], BUILD)
+        cc_code = code_formatter()
+        cc_code(f'#include "{include_path}"\n\n'
+                "namespace gem5\n{\nnamespace Blobs\n{\n\n"
+                f"const std::size_t {symbol}_len = {len(data)};")
+        bytesToCppArray(cc_code, symbol, data)
+        cc_code("\n} // namespace Blobs\n} // namespace gem5")
+        cc_code.write(b["cc"])
+    log(f"blobs: {len(man['blobs'])}")
+
+
+def gen_isa(man):
+    sys.path.insert(0, os.path.join(SRC, "arch"))
+    for d in man["isadescs"]:
+        gendir = d["gendir"]
+        os.makedirs(gendir, exist_ok=True)
+        stamp = os.path.join(gendir, ".stamp")
+        if _newer(stamp, d["desc"]):
+            log(f"isa: {d['desc']} up to date")
+            continue
+        import isa_parser
+
+        # the x86 microasm.isa splices "src/arch/x86/isa/" into sys.path
+        # relative to the gem5 root — run the parser from there
+        cwd = os.getcwd()
+        os.chdir(REF)
+        try:
+            parser = isa_parser.ISAParser(gendir)
+            parser.parse_isa_desc(d["desc"])
+        finally:
+            os.chdir(cwd)
+        with open(stamp, "w") as f:
+            f.write("ok\n")
+        log(f"isa: {d['desc']} -> {gendir}")
+
+
+def gen_tags_cc():
+    out = os.path.join(BUILD, "sim/tags.cc")
+    os.makedirs(os.path.dirname(out), exist_ok=True)
+    r = subprocess.run(
+        [sys.executable, os.path.join(REF, "util/cpt_upgrader.py"),
+         "--get-cc-file"], capture_output=True, text=True, cwd=REF)
+    if r.returncode != 0:
+        raise RuntimeError(f"cpt_upgrader failed: {r.stderr[-400:]}")
+    _write_if_changed(out, r.stdout)
+    log("sim/tags.cc")
+
+
+def gen_libelf():
+    from mini_m4 import m4_expand
+
+    src = os.path.join(REF, "ext/libelf")
+    out = os.path.join(BUILD, "ext/libelf")
+    os.makedirs(out, exist_ok=True)
+    for m4f in ("libelf_convert", "libelf_fsize", "libelf_msize"):
+        target = os.path.join(out, m4f + ".c")
+        source = os.path.join(src, m4f + ".m4")
+        if _newer(target, source):
+            continue
+        text = m4_expand(source, defines={"SRCDIR": src})
+        _write_if_changed(target, text)
+    # native-elf-format.h: the reference generates this by compiling an
+    # empty object and running readelf (ext/libelf/native-elf-format);
+    # the result on this x86_64/linux host is static
+    nef = subprocess.run(
+        ["sh", os.path.join(src, "native-elf-format")],
+        capture_output=True, text=True, cwd=out)
+    if nef.returncode == 0 and "ELFTC_CLASS" in nef.stdout:
+        _write_if_changed(os.path.join(out, "native-elf-format.h"),
+                          nef.stdout)
+    else:
+        _write_if_changed(
+            os.path.join(out, "native-elf-format.h"),
+            "#define ELFTC_CLASS ELFCLASS64\n"
+            "#define ELFTC_ARCH EM_X86_64\n"
+            "#define ELFTC_BYTEORDER ELFDATA2LSB\n")
+    log("libelf generated sources")
+
+
+def _newer(target, source):
+    return (os.path.exists(target)
+            and os.path.getmtime(target) >= os.path.getmtime(source))
+
+
+def _write_if_changed(path, text):
+    if os.path.exists(path):
+        with open(path) as f:
+            if f.read() == text:
+                return
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        f.write(text)
+
+
+T0 = time.monotonic()
+
+
+def main():
+    with open(os.path.join(BUILD, "manifest.json")) as f:
+        man = json.load(f)
+    conf = man["conf"]
+    gen_config_headers(conf)
+    gen_defines_info(conf)
+    # register the generated python files as embedded modules the way
+    # src/SConscript:621-633 does
+    for modpath, rel in (("m5.defines", "python/m5/defines.py"),
+                         ("m5.info", "python/m5/info.py")):
+        path = os.path.join(BUILD, rel)
+        man["pysources"].append({
+            "package": "m5", "modpath": modpath, "path": path,
+            "cc": path + ".cc"})
+        man["sources"].append({"path": path + ".cc",
+                               "tags": ["gem5 lib", "python", "m5_module"],
+                               "append": None, "generated": True})
+    with open(os.path.join(BUILD, "manifest+gen.json"), "w") as f:
+        json.dump(man, f, indent=1)
+    gen_debugflags(man)
+    install_importer(man)
+    sys.path.insert(0, os.path.join(SRC, "python"))
+    gen_params(man)
+    gen_marshal(man)
+    gen_blobs(man)
+    gen_tags_cc()
+    gen_libelf()
+    gen_isa(man)
+    log("codegen complete")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,92 @@
+"""Evaluate the reference gem5's Kconfig without scons.
+
+Replicates SConstruct's kconfig flow (reference SConstruct:896-976,
+site_scons/gem5_scons/kconfig.py:defconfig/update_env) using the vendored
+ext/Kconfiglib: build a base Kconfig that sources src/Kconfig, seed it from
+a defconfig fragment, and read every defined symbol back into a CONF dict.
+
+The HAVE_* feature probes normally come from SConsopts scripts; here they
+are pinned for this container (no systemc/hdf5/png/kvm/protobuf/capstone,
+working fenv + posix clocks).
+"""
+
+import os
+import sys
+
+REF = "/root/reference"
+HERE = os.path.dirname(os.path.abspath(__file__))
+BUILD = os.path.join(HERE, "build")
+
+sys.path.insert(0, os.path.join(REF, "ext/Kconfiglib/import"))
+
+# Feature-probe results the Kconfig reads via $(VAR) preprocessor macros.
+FEATURES = {
+    "HAVE_SYSTEMC": "n",
+    "HAVE_HDF5": "n",
+    "HAVE_PNG": "n",
+    "HAVE_KVM": "n",
+    "HAVE_PERF_ATTR_EXCLUDE_HOST": "n",
+    "HAVE_PROTOBUF": "n",
+    "HAVE_CAPSTONE": "n",
+    "HAVE_TUNTAP": "n",
+    "HAVE_VALGRIND": "n",
+    "HAVE_FENV": "y",
+    "HAVE_POSIX_CLOCK": "y",
+    "HAVE_DEPRECATED_NAMESPACE": "y",
+    "KVM_ISA": "",  # only set by SConsopts when <linux/kvm.h> probes OK
+    "CONFIG_": "",
+    "MAIN_MENU_TEXT": "gem5",
+}
+
+# X86 SE-mode preset (reference build_opts/X86) minus Ruby: the golden
+# campaign runs classic memory, and RUBY=n skips SLICC + ~40% of the
+# compile on this 1-core host.
+DEFCONFIG = """\
+BUILD_ISA=y
+USE_X86_ISA=y
+# RUBY is not set
+"""
+
+
+def make_conf(verbose=False):
+    import kconfiglib
+
+    os.makedirs(BUILD, exist_ok=True)
+    base = os.path.join(BUILD, "Kconfig.base")
+    with open(base, "w") as f:
+        f.write(f'source "{REF}/src/Kconfig"\n')
+    config_in = os.path.join(BUILD, "defconfig.in")
+    with open(config_in, "w") as f:
+        f.write(DEFCONFIG)
+
+    saved = dict(os.environ)
+    os.environ.update(FEATURES)
+    try:
+        kconf = kconfiglib.Kconfig(filename=base, warn_to_stderr=verbose)
+        kconf.load_config(config_in, replace=True)
+        kconf.write_config(os.path.join(BUILD, "config.out"))
+    finally:
+        os.environ.clear()
+        os.environ.update(saved)
+
+    # SConsopts-derived CONF entries that do not come from Kconfig
+    # (reference src/mem/ruby/protocol/chi/tlm/SConsopts:47)
+    conf = {"BUILD_TLM": False, "TLM_PATH": "."}
+    for sym in kconf.unique_defined_syms:
+        val = sym.str_value
+        if sym.type in (kconfiglib.BOOL, kconfiglib.TRISTATE):
+            conf[sym.name] = val == "y"
+        elif sym.type == kconfiglib.INT:
+            conf[sym.name] = int(val or "0", 0)
+        elif sym.type == kconfiglib.HEX:
+            conf[sym.name] = int(val or "0", 16)
+        else:
+            conf[sym.name] = val
+    return conf
+
+
+if __name__ == "__main__":
+    conf = make_conf(verbose=True)
+    import json
+
+    print(json.dumps(conf, indent=1, sort_keys=True))

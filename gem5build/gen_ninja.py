@@ -1,0 +1,178 @@
+"""Emit build.ninja for the scons-less gem5 build.
+
+Translates the collected manifest into compile + link edges: the gem5
+binary takes every collected source (reference src/SConscript:728
+``Gem5('gem5', with_any_tags('gem5 lib', 'main'))`` — all Source()
+declarations carry 'gem5 lib' by default) plus the ext libraries the
+reference links statically (libelf/fputils/iostream3/softfloat/libfdt/
+drampower/nomali, reference ext/*/SConscript).
+
+Build style follows the reference's gem5.opt: -O2 single-job here instead
+of -O3 (1-core host; the golden campaign is about fidelity, not speed),
+same TRACING_ON=1 semantics, same C++17, embedded CPython from
+python3-config --embed.
+"""
+
+import glob
+import json
+import os
+import subprocess
+
+REF = "/root/reference"
+HERE = os.path.dirname(os.path.abspath(__file__))
+BUILD = os.path.join(HERE, "build")
+OBJ = os.path.join(BUILD, "obj")
+
+
+def py_flags():
+    inc = subprocess.run(["python3-config", "--includes"],
+                         capture_output=True, text=True).stdout.split()
+    ld = subprocess.run(["python3-config", "--ldflags", "--embed"],
+                        capture_output=True, text=True).stdout.split()
+    return inc, ld
+
+
+EXT_LIBS = {
+    # name -> (source glob roots, include dirs, language)
+    "elf": {
+        "srcs": [os.path.join(REF, "ext/libelf/*.c"),
+                 os.path.join(BUILD, "ext/libelf/*.c")],
+        "inc": [os.path.join(BUILD, "ext/libelf"),
+                os.path.join(REF, "ext/libelf")],
+        "exclude": {"native-elf-format"},
+    },
+    "fputils": {
+        "srcs": [os.path.join(REF, "ext/fputils/*.c")],
+        "inc": [os.path.join(REF, "ext/fputils/include")],
+    },
+    "iostream3": {
+        "srcs": [os.path.join(REF, "ext/iostream3/zfstream.cc")],
+        "inc": [os.path.join(REF, "ext/iostream3")],
+    },
+    "softfloat": {
+        "srcs": [os.path.join(REF, "ext/softfloat/*.c")],
+        "inc": [os.path.join(REF, "ext/softfloat")],
+    },
+    "fdt": {
+        "srcs": [os.path.join(REF, "ext/libfdt/*.c")],
+        "inc": [os.path.join(REF, "ext/libfdt")],
+    },
+    "drampower": {
+        "srcs": [os.path.join(REF, "ext/drampower/src/*.cc"),
+                 os.path.join(REF, "ext/drampower/src/common/*.cc")],
+        "inc": [os.path.join(REF, "ext/drampower/src")],
+    },
+    "nomali": {
+        "srcs": [os.path.join(REF, "ext/nomali/lib/*.cc")],
+        "inc": [os.path.join(REF, "ext/nomali/include"),
+                os.path.join(REF, "ext/nomali")],
+    },
+}
+
+
+def obj_path(src):
+    rel = os.path.relpath(src, "/")
+    return os.path.join(OBJ, rel) + ".o"
+
+
+def esc(p):
+    return p.replace(" ", "$ ").replace(":", "$:")
+
+
+def main():
+    with open(os.path.join(BUILD, "manifest+gen.json")) as f:
+        man = json.load(f)
+
+    py_inc, py_ld = py_flags()
+
+    inc_dirs = [BUILD, os.path.join(REF, "src"), os.path.join(REF, "include"),
+                os.path.join(REF, "ext"),
+                os.path.join(REF, "ext/pybind11/include")]
+    for lib in EXT_LIBS.values():
+        inc_dirs += lib["inc"]
+    incs = " ".join(f"-I{d}" for d in dict.fromkeys(inc_dirs)) + " " + \
+        " ".join(py_inc)
+
+    common = "-O2 -pipe -fno-strict-aliasing -w -DTRACING_ON=1"
+    cxxflags = f"{common} -std=c++17"
+    cflags = common
+
+    lines = [
+        "ninja_required_version = 1.3",
+        f"builddir = {BUILD}",
+        f"cxxflags = {cxxflags}",
+        f"cflags = {cflags}",
+        f"incs = {incs}",
+        "",
+        "rule cxx",
+        "  command = g++ $cxxflags $extra $incs -MMD -MF $out.d -c $in -o $out",
+        "  depfile = $out.d",
+        "  deps = gcc",
+        "  description = CXX $out",
+        "",
+        "rule cc",
+        "  command = gcc $cflags $extra $incs -MMD -MF $out.d -c $in -o $out",
+        "  depfile = $out.d",
+        "  deps = gcc",
+        "  description = CC $out",
+        "",
+        "rule link",
+        "  command = g++ -o $out @$out.rsp $ldflags",
+        "  rspfile = $out.rsp",
+        "  rspfile_content = $in",
+        "  description = LINK $out",
+        "",
+    ]
+
+    objs = []
+    seen = set()
+
+    def add_cc(src, lang="cxx", extra=""):
+        o = obj_path(src)
+        if o in seen:
+            return
+        seen.add(o)
+        objs.append(o)
+        lines.append(f"build {esc(o)}: {lang} {esc(src)}")
+        if extra:
+            lines.append(f"  extra = {extra}")
+
+    for s in man["sources"]:
+        path = s["path"]
+        extra = ""
+        if s.get("append"):
+            ccf = s["append"].get("CCFLAGS") or s["append"].get("CXXFLAGS")
+            if ccf:
+                extra = " ".join(ccf) if isinstance(ccf, list) else str(ccf)
+        lang = "cc" if path.endswith(".c") else "cxx"
+        add_cc(path, lang, extra)
+
+    # the date stamp object the reference rebuilds per link
+    add_cc(os.path.join(REF, "src/base/date.cc"))
+
+    for name, lib in EXT_LIBS.items():
+        excl = lib.get("exclude", set())
+        for pat in lib["srcs"]:
+            for src in sorted(glob.glob(pat)):
+                stem = os.path.splitext(os.path.basename(src))[0]
+                if stem in excl:
+                    continue
+                add_cc(src, "cc" if src.endswith(".c") else "cxx")
+
+    ldflags = " ".join(py_ld + ["-lz", "-lm", "-lpthread", "-ldl",
+                                "-rdynamic"])
+    gem5 = os.path.join(BUILD, "gem5.opt")
+    lines.append(f"build {esc(gem5)}: link " +
+                 " ".join(esc(o) for o in objs))
+    lines.append(f"  ldflags = {ldflags}")
+    lines.append("")
+    lines.append(f"default {esc(gem5)}")
+    lines.append("")
+
+    with open(os.path.join(BUILD, "build.ninja"), "w") as f:
+        f.write("\n".join(lines))
+    print(f"build.ninja: {len(objs)} objects")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,328 @@
+"""A small m4 subset, sufficient for the reference's ext/libelf .m4 sources.
+
+m4 is not installed in this image, and the reference's libelf needs three
+generated .c files (reference ext/libelf/SConscript m4env.M4 calls).  This
+implements the classic m4 evaluation model for the macro set those files
+use: define/pushdef/popdef/ifdef/ifelse/shift/include/divert/dnl/eval,
+`' quoting, # comments, $1..$n/$#/$*/$@, and — crucially — expansion
+*during* argument collection, so commas produced by a nested expansion
+split the outer macro's arguments (the list-iteration idiom
+``MSIZES(ELF_TYPE_LIST)`` depends on this).
+"""
+
+import re
+
+WORD = re.compile(r"[A-Za-z_][A-Za-z0-9_]*")
+
+
+class M4Error(Exception):
+    pass
+
+
+class _Frame:
+    """An in-progress macro call: name + the argument being collected."""
+
+    __slots__ = ("name", "args", "cur", "depth")
+
+    def __init__(self, name):
+        self.name = name
+        self.args = []
+        self.cur = []
+        self.depth = 1  # parens
+
+    def finish_arg(self):
+        self.args.append("".join(self.cur))
+        self.cur = []
+
+
+class M4:
+    def __init__(self, defines=None):
+        self.macros = {}  # name -> list of bodies (pushdef stack)
+        for k, v in (defines or {}).items():
+            self.macros[k] = [str(v)]
+        self.diversions = {0: []}
+        self.div = 0
+        self.frames = []          # active macro-call frames
+        self.input = []           # stack of (text, pos) segments
+        self.skip_ws = False      # eat whitespace (after '(' or ',')
+
+    # -- input stream --------------------------------------------------
+    def push_input(self, text):
+        if text:
+            self.input.append([text, 0])
+
+    def _getc(self):
+        while self.input:
+            seg = self.input[-1]
+            if seg[1] < len(seg[0]):
+                c = seg[0][seg[1]]
+                seg[1] += 1
+                return c
+            self.input.pop()
+        return None
+
+    def _peek(self):
+        while self.input:
+            seg = self.input[-1]
+            if seg[1] < len(seg[0]):
+                return seg[0][seg[1]]
+            self.input.pop()
+        return None
+
+    def _read_word(self, first):
+        out = [first]
+        while True:
+            c = self._peek()
+            if c is not None and (c.isalnum() or c == "_"):
+                out.append(self._getc())
+            else:
+                return "".join(out)
+
+    def _skip_line(self):
+        while True:
+            c = self._getc()
+            if c is None or c == "\n":
+                return
+
+    # -- output sink ---------------------------------------------------
+    def emit(self, text):
+        if not text:
+            return
+        if self.frames:
+            self.frames[-1].cur.append(text)
+        elif self.div >= 0:
+            self.diversions.setdefault(self.div, []).append(text)
+
+    def result(self):
+        if self.frames:
+            raise M4Error(f"unterminated call of {self.frames[-1].name}")
+        out = []
+        for n in sorted(self.diversions):
+            if n >= 0:
+                out.append("".join(self.diversions[n]))
+        return "".join(out)
+
+    # -- main loop -----------------------------------------------------
+    def process(self, text):
+        self.push_input(text)
+        while True:
+            c = self._getc()
+            if c is None:
+                return
+            if self.skip_ws:
+                if c in " \t\n":
+                    continue
+                self.skip_ws = False
+            if c == "`":
+                self._scan_quote()
+                continue
+            if c == "#":
+                self._scan_comment()
+                continue
+            if c.isalpha() or c == "_":
+                name = self._read_word(c)
+                self._dispatch(name)
+                continue
+            if self.frames:
+                f = self.frames[-1]
+                if c == "(":
+                    f.depth += 1
+                    f.cur.append(c)
+                    continue
+                if c == ")":
+                    f.depth -= 1
+                    if f.depth == 0:
+                        f.finish_arg()
+                        self.frames.pop()
+                        self._apply(f.name, f.args)
+                        continue
+                    f.cur.append(c)
+                    continue
+                if c == "," and f.depth == 1:
+                    f.finish_arg()
+                    self.skip_ws = True
+                    continue
+            self.emit(c)
+
+    def _scan_quote(self):
+        depth = 1
+        out = []
+        while True:
+            c = self._getc()
+            if c is None:
+                raise M4Error("unterminated quote")
+            if c == "`":
+                depth += 1
+                out.append(c)
+            elif c == "'":
+                depth -= 1
+                if depth == 0:
+                    break
+                out.append(c)
+            else:
+                out.append(c)
+        self.emit("".join(out))
+
+    def _scan_comment(self):
+        out = ["#"]
+        while True:
+            c = self._getc()
+            if c is None:
+                break
+            out.append(c)
+            if c == "\n":
+                break
+        self.emit("".join(out))
+
+    def _dispatch(self, name):
+        defined = name in self.macros
+        if name == "dnl" and not defined:
+            self._skip_line()
+            return
+        if not defined and name not in BUILTINS:
+            self.emit(name)
+            return
+        if self._peek() == "(":
+            self._getc()
+            self.frames.append(_Frame(name))
+            self.skip_ws = True
+            return
+        if not defined and name in NEED_PARENS:
+            self.emit(name)
+            return
+        self._apply(name, [])
+
+    # -- application ---------------------------------------------------
+    def _apply(self, name, args):
+        if name in self.macros:
+            body = self.macros[name][-1]
+            self.push_input(self._substitute(body, args))
+            return
+        expansion = BUILTINS[name](self, args)
+        if expansion:
+            self.push_input(expansion)
+
+    def _substitute(self, body, args):
+        out = []
+        i, n = 0, len(body)
+        while i < n:
+            c = body[i]
+            if c == "$" and i + 1 < n:
+                nxt = body[i + 1]
+                if nxt.isdigit():
+                    j = i + 1
+                    while j < n and body[j].isdigit():
+                        j += 1
+                    k = int(body[i + 1:j])
+                    out.append(args[k - 1] if 1 <= k <= len(args) else "")
+                    i = j
+                    continue
+                if nxt == "#":
+                    out.append(str(len(args)))
+                    i += 2
+                    continue
+                if nxt == "*":
+                    out.append(",".join(args))
+                    i += 2
+                    continue
+                if nxt == "@":
+                    out.append(",".join(f"`{a}'" for a in args))
+                    i += 2
+                    continue
+            out.append(c)
+            i += 1
+        return "".join(out)
+
+
+# -- builtins (return text to push back onto the input, or None) -------
+
+def _bi_define(m4, args):
+    if args:
+        m4.macros[args[0]] = [args[1] if len(args) > 1 else ""]
+
+
+def _bi_pushdef(m4, args):
+    if args:
+        m4.macros.setdefault(args[0], []).append(
+            args[1] if len(args) > 1 else "")
+
+
+def _bi_popdef(m4, args):
+    for name in args:
+        stack = m4.macros.get(name)
+        if stack:
+            stack.pop()
+            if not stack:
+                del m4.macros[name]
+
+
+def _bi_ifdef(m4, args):
+    if args and args[0] in m4.macros:
+        return args[1] if len(args) > 1 else None
+    return args[2] if len(args) > 2 else None
+
+
+def _bi_ifelse(m4, args):
+    a = args
+    while True:
+        if len(a) < 3:
+            return None
+        if a[0] == a[1]:
+            return a[2]
+        if len(a) == 3:
+            return None
+        if len(a) == 4:
+            return a[3]
+        a = a[3:]
+
+
+def _bi_shift(m4, args):
+    return ",".join(f"`{a}'" for a in args[1:]) or None
+
+
+def _bi_divert(m4, args):
+    m4.div = int(args[0]) if args and args[0].strip() else 0
+
+
+def _bi_include(m4, args):
+    with open(args[0]) as f:
+        return f.read()
+
+
+def _bi_eval(m4, args):
+    expr = args[0]
+    if not re.fullmatch(r"[0-9+\-*/%()<>&|^~! \t]*", expr):
+        raise M4Error(f"eval: unsupported expression {expr!r}")
+    return str(int(eval(expr)))  # noqa: S307 — charset-restricted
+
+
+NEED_PARENS = {"define", "pushdef", "popdef", "ifdef", "ifelse", "shift",
+               "include", "eval"}
+
+BUILTINS = {
+    "define": _bi_define,
+    "pushdef": _bi_pushdef,
+    "popdef": _bi_popdef,
+    "ifdef": _bi_ifdef,
+    "ifelse": _bi_ifelse,
+    "shift": _bi_shift,
+    "dnl": lambda m4, args: None,
+    "divert": _bi_divert,
+    "include": _bi_include,
+    "eval": _bi_eval,
+}
+
+
+def m4_expand(path, defines=None):
+    m4 = M4(defines=defines)
+    with open(path) as f:
+        text = f.read()
+    m4.process(text)
+    return m4.result()
+
+
+if __name__ == "__main__":
+    import sys
+
+    print(m4_expand(sys.argv[1],
+                    defines=dict(kv.split("=", 1) for kv in sys.argv[2:])))

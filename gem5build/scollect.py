@@ -1,0 +1,561 @@
+"""Walk the reference gem5's SConscripts without scons and collect the
+build manifest.
+
+scons in the reference build is two things: a declarative layer
+(``Source``/``SimObject``/``PySource``/``DebugFlag``/``ISADesc``/... calls
+spread over ~126 SConscripts, reference src/SConscript:75-528) and an
+execution engine.  This module re-implements only the declarative layer:
+each SConscript is exec'd with stub implementations that *record* what
+would be built.  Config gating works unchanged because the scripts
+themselves test ``env['CONF'][...]`` (e.g. reference
+src/arch/x86/SConscript:43 returns early unless USE_X86_ISA).
+
+The output manifest lists: C++ sources with tags, embedded-python modules,
+SimObject param/enum codegen units, debug flags, ISA descriptions, and
+binary blobs — everything codegen.py and gen_ninja.py need.
+"""
+
+import json
+import os
+import shutil
+import sys
+import types
+
+REF = "/root/reference"
+SRC = os.path.join(REF, "src")
+HERE = os.path.dirname(os.path.abspath(__file__))
+BUILD = os.path.join(HERE, "build")
+
+from conf import make_conf
+
+
+class _ReturnScript(Exception):
+    pass
+
+
+class AutoStub:
+    """Callable/attribute-chaining stub for scons APIs whose results the
+    SConscripts never actually consume (scanners, actions, transforms)."""
+
+    def __init__(self, name="stub"):
+        self._name = name
+
+    def __call__(self, *a, **k):
+        return AutoStub(self._name + "()")
+
+    def __getattr__(self, k):
+        if k.startswith("__") and k.endswith("__"):
+            raise AttributeError(k)
+        return AutoStub(f"{self._name}.{k}")
+
+    def __iter__(self):
+        return iter(())
+
+    def __bool__(self):
+        return False
+
+    def __str__(self):
+        return self._name
+
+
+class Node:
+    """File/Dir node with scons' variant-dir duality: ``abspath`` is the
+    build-tree path, ``srcnode()`` the source-tree path."""
+
+    def __init__(self, build_path, src_path=None):
+        self.build_path = os.path.normpath(build_path)
+        self.src_path = os.path.normpath(src_path) if src_path else None
+
+    # -- scons API
+    @property
+    def abspath(self):
+        return self.build_path
+
+    def get_abspath(self):
+        return self.build_path
+
+    def srcnode(self):
+        return Node(self.src_path or self.build_path, self.src_path)
+
+    @property
+    def path(self):
+        return os.path.relpath(self.build_path, os.getcwd())
+
+    def File(self, name):
+        return Node(os.path.join(self.build_path, name),
+                    os.path.join(self.src_path, name) if self.src_path
+                    else None)
+
+    def Dir(self, name):
+        return Node(os.path.join(self.build_path, name),
+                    os.path.join(self.src_path, name) if self.src_path
+                    else None)
+
+    def up(self):
+        return Node(os.path.dirname(self.build_path),
+                    os.path.dirname(self.src_path) if self.src_path
+                    else None)
+
+    def target_from_source(self, prefix, suffix, splitext=True):
+        base = os.path.basename(self.build_path)
+        if splitext:
+            base = os.path.splitext(base)[0]
+        return Node(os.path.join(os.path.dirname(self.build_path),
+                                 prefix + base + suffix))
+
+    def __str__(self):
+        return self.build_path
+
+    def __fspath__(self):
+        return self.build_path
+
+
+class Collector:
+    def __init__(self, conf):
+        self.conf = conf
+        self.sources = []        # {path, tags, append, generated}
+        self.pysources = []      # {package, modpath, path}
+        self.simobjects = []     # {module, path, sim_objects, enums}
+        self.debugflags = []     # {name, desc, fmt, components}
+        self.isadescs = []       # {desc, splits...}
+        self.blobs = []          # {symbol, path, out_cc, out_hh}
+        self.tag_implies = {}
+        self.errors = []
+        self._flagnames = set()
+
+    # ------------------------------------------------------------------
+    def add_source(self, ctx, s, tags=None, add_tags=None, append=None,
+                   tag_gem5_lib=True):
+        t = _tagset(tags)
+        if tag_gem5_lib:
+            t |= {"gem5 lib"}
+        t |= _tagset(add_tags)
+        if isinstance(s, Node):
+            path = s.build_path
+            gen = not (s.src_path and os.path.exists(s.src_path))
+            if not gen:
+                path = s.src_path
+        else:
+            srcp = os.path.join(ctx["srcdir"], str(s))
+            if os.path.exists(srcp):
+                path, gen = srcp, False
+            else:
+                path, gen = os.path.join(ctx["builddir"], str(s)), True
+        self.sources.append({"path": path, "tags": sorted(t),
+                             "append": append, "generated": gen})
+
+    def add_pysource(self, ctx, package, source, tags=None, add_tags=None):
+        node = source if isinstance(source, Node) else \
+            Node(os.path.join(ctx["builddir"], str(source)),
+                 os.path.join(ctx["srcdir"], str(source)))
+        basename = os.path.basename(node.build_path)
+        modname, ext = os.path.splitext(basename)
+        assert ext == ".py", source
+        modpath = package.split(".") if package else []
+        if modname != "__init__":
+            modpath += [modname]
+        modpath = ".".join(modpath)
+        abspath = node.src_path if (node.src_path and
+                                    os.path.exists(node.src_path)) \
+            else node.build_path
+        cc = node.target_from_source("", ".py.cc").build_path
+        self.pysources.append({"package": package, "modpath": modpath,
+                               "path": abspath, "cc": cc})
+        self.add_source(ctx, Node(cc), tags=_tagset(tags),
+                        add_tags=_tagset(add_tags) | {"python", "m5_module"})
+        return modpath
+
+    def add_simobject(self, ctx, source, sim_objects, enums, tags=None,
+                      add_tags=None):
+        modpath = self.add_pysource(ctx, "m5.objects", source, tags,
+                                    add_tags)
+        self.simobjects.append({
+            "module": modpath,
+            "sim_objects": list(sim_objects),
+            "enums": list(enums),
+        })
+        for so in sim_objects:
+            cc = os.path.join(BUILD, f"python/_m5/param_{so}.cc")
+            self.add_source(ctx, Node(cc), tags=_tagset(tags),
+                            add_tags=_tagset(add_tags) | {"python"})
+        for en in enums:
+            cc = os.path.join(BUILD, f"enums/{en}.cc")
+            self.add_source(ctx, Node(cc), tags=_tagset(tags),
+                            add_tags=_tagset(add_tags) | {"python"})
+
+    def add_debugflag(self, ctx, name, components, desc, fmt, tags):
+        if name in self._flagnames:
+            raise AttributeError(f"debug flag {name} duplicated")
+        self._flagnames.add(name)
+        self.debugflags.append({"name": name, "desc": desc, "fmt": bool(fmt),
+                                "components": list(components)})
+        t = _tagset(tags) | {"gem5 trace"}
+        cc = os.path.join(BUILD, f"debug/{name}.cc")
+        self.add_source(ctx, Node(cc), tags=t)
+
+    # ------------------------------------------------------------------
+    def run(self):
+        scripts = []
+        for root, dirs, files in os.walk(SRC, topdown=True):
+            if root == SRC:
+                continue
+            if "SConscript" in files:
+                scripts.append(root)
+        scripts.sort()
+        # arch/SConscript exports ISADesc consumed by per-ISA scripts;
+        # src-level walk order (os.walk topdown) gives parents first, which
+        # matches scons' recursive SConscript() calls closely enough
+        for root in scripts:
+            self.run_script(os.path.join(root, "SConscript"))
+        return self
+
+    def run_script(self, path):
+        srcdir = os.path.dirname(path)
+        rel = os.path.relpath(srcdir, SRC)
+        ctx = {"srcdir": srcdir,
+               "builddir": os.path.join(BUILD, rel),
+               "rel": rel}
+        g = self.make_globals(ctx)
+        with open(path) as f:
+            code = f.read()
+        cwd = os.getcwd()
+        try:
+            os.makedirs(ctx["builddir"], exist_ok=True)
+            os.chdir(ctx["builddir"])
+            exec(compile(code, path, "exec"), g)
+        except _ReturnScript:
+            pass
+        except Exception as e:  # noqa: BLE001 — survey everything first
+            self.errors.append(f"{path}: {type(e).__name__}: {e}")
+        finally:
+            os.chdir(cwd)
+
+    # ------------------------------------------------------------------
+    def make_globals(self, ctx):
+        col = self
+        conf = self.conf
+
+        class Env:
+            def __init__(self, d=None):
+                self._d = dict(d or {})
+
+            def __getitem__(self, k):
+                if k == "CONF":
+                    return conf
+                if k == "BUILDDIR":
+                    return BUILD
+                if k == "GCC":
+                    return True
+                if k in ("CLANG",):
+                    return False
+                if k == "USE_PYTHON":
+                    return True
+                if k == "BIN_TARGET_ARCH":
+                    return "x86_64"
+                if k == "BACKTRACE_IMPL":
+                    return "glibc"
+                return self._d.get(k, AutoStub(f"env[{k!r}]"))
+
+            def __setitem__(self, k, v):
+                self._d[k] = v
+
+            def __contains__(self, k):
+                return k in ("CONF", "BUILDDIR", "GCC", "CLANG",
+                             "USE_PYTHON") or k in self._d
+
+            def get(self, k, default=None):
+                return self._d.get(k, default)
+
+            def __delitem__(self, k):
+                self._d.pop(k, None)
+
+            def Clone(self, **kw):
+                e = Env(self._d)
+                e._d.update(kw)
+                return e
+
+            def TagImplies(self, tag, tag_list):
+                if isinstance(tag_list, str):
+                    tag_list = [tag_list]
+                col.tag_implies.setdefault(tag, set()).update(tag_list)
+
+            def Append(self, **kw):
+                for k, v in kw.items():
+                    cur = self._d.setdefault(k, [])
+                    if isinstance(cur, list):
+                        cur.extend(v if isinstance(v, (list, tuple)) else [v])
+
+            Prepend = Append
+
+            def SetDefault(self, **kw):
+                for k, v in kw.items():
+                    self._d.setdefault(k, v)
+
+            def Detect(self, prog):
+                if isinstance(prog, (list, tuple)):
+                    for p in prog:
+                        if shutil.which(p):
+                            return p
+                    return None
+                return prog if shutil.which(prog) else None
+
+            def subst(self, s):
+                if "TARGET_GPU_ISA" in s:
+                    return conf.get("TARGET_GPU_ISA", "")
+                return s
+
+            def Blob(self, symbol, src):
+                src_path = os.path.join(ctx["srcdir"], str(src))
+                cc = os.path.join(ctx["builddir"], symbol + ".cc")
+                hh = os.path.join(ctx["builddir"], symbol + ".hh")
+                col.blobs.append({"symbol": symbol, "path": src_path,
+                                  "cc": cc, "hh": hh})
+                return Node(cc), Node(hh)
+
+            def File(self, name, *a):
+                if isinstance(name, Node):
+                    return name
+                return Node(os.path.join(ctx["builddir"], str(name)),
+                            os.path.join(ctx["srcdir"], str(name)))
+
+            def Dir(self, name):
+                if isinstance(name, Node):
+                    return name
+                return _Dir(str(name))
+
+            # inert pieces of the scons API
+            def Command(self, *a, **k):
+                return AutoStub("env.Command")
+
+            def Depends(self, *a, **k):
+                pass
+
+            def SideEffect(self, *a, **k):
+                pass
+
+            def AlwaysBuild(self, *a, **k):
+                pass
+
+            def Execute(self, *a, **k):
+                return 0
+
+            def ConfigFile(self, *a, **k):
+                pass
+
+            def SwitchingHeaders(self, *a, **k):
+                pass
+
+            def AddLocalRPATH(self, *a, **k):
+                pass
+
+            def AddMethod(self, fn, name):
+                setattr(self, name, types.MethodType(
+                    lambda _self, *a, **k: fn(_self, *a, **k), self))
+
+            def UseSystemcCheck(self, *a, **k):
+                return False
+
+            def __getattr__(self, k):
+                return AutoStub(f"env.{k}")
+
+        def _Dir(name):
+            if os.path.isabs(name):
+                return Node(name)
+            if name.startswith("#"):
+                sub = name[1:].lstrip("/")
+                return Node(os.path.join(REF, sub),
+                            os.path.join(REF, sub))
+            return Node(os.path.join(ctx["builddir"], name),
+                        os.path.join(ctx["srcdir"], name))
+
+        def File(name):
+            if isinstance(name, Node):
+                return name
+            if str(name).startswith("#"):
+                sub = str(name)[1:].lstrip("/")
+                return Node(os.path.join(REF, sub), os.path.join(REF, sub))
+            return Node(os.path.join(ctx["builddir"], str(name)),
+                        os.path.join(ctx["srcdir"], str(name)))
+
+        env = Env()
+
+        def Source(s, tags=None, add_tags=None, append=None,
+                   tag_gem5_lib=True):
+            col.add_source(ctx, s, tags, add_tags, append, tag_gem5_lib)
+            return s
+
+        def PySource(package, source, tags=None, add_tags=None):
+            col.add_pysource(ctx, package, source, tags, add_tags)
+
+        def SimObject(source, *, sim_objects=None, enums=None, tags=None,
+                      add_tags=None):
+            if sim_objects is None:
+                if enums is None:
+                    raise ValueError(f"SimObject({source}) lists nothing")
+                sim_objects = []
+            col.add_simobject(ctx, source, sim_objects, enums or [], tags,
+                              add_tags)
+
+        def DebugFlag(name, desc=None, fmt=False, tags=None):
+            col.add_debugflag(ctx, name, (), desc, fmt, tags)
+
+        def CompoundFlag(name, flags, desc=None, tags=None):
+            col.add_debugflag(ctx, name, flags, desc, False, tags)
+
+        def DebugFormatFlag(name, desc=None, tags=None):
+            col.add_debugflag(ctx, name, (), desc, True, tags)
+
+        def GdbXml(xml_id, symbol, tags=None):
+            cc, hh = env.Blob(symbol, xml_id)
+            Source(cc, tags=tags)
+
+        def ISADesc(desc, decoder_splits=1, exec_splits=1, tags=None):
+            desc_node = File(desc)
+            gendir = os.path.join(os.path.dirname(
+                os.path.dirname(desc_node.build_path)), "generated")
+            col.isadescs.append({
+                "desc": desc_node.src_path,
+                "gendir": gendir,
+                "decoder_splits": decoder_splits,
+                "exec_splits": exec_splits,
+            })
+            out = []
+
+            def source_gen(name):
+                p = os.path.join(gendir, name)
+                col.add_source(ctx, Node(p), tags=tags)
+                out.append(Node(p))
+
+            source_gen("decoder.cc")
+            if decoder_splits == 1:
+                source_gen("inst-constrs.cc")
+            else:
+                for i in range(1, decoder_splits + 1):
+                    source_gen(f"inst-constrs-{i}.cc")
+            if exec_splits == 1:
+                source_gen("generic_cpu_exec.cc")
+            else:
+                for i in range(1, exec_splits + 1):
+                    source_gen(f"generic_cpu_exec_{i}.cc")
+            return out
+
+        def Import(*a):
+            pass
+
+        def Export(*a, **k):
+            pass
+
+        def Return(*a):
+            raise _ReturnScript()
+
+        def GetOption(name):
+            return {"duplicate_sources": False, "with_cxx_config": False,
+                    "without_python": False, "verbose": False,
+                    "silent": True, "num_jobs": 1}.get(name, False)
+
+        def Split(s):
+            return s.split() if isinstance(s, str) else list(s)
+
+        g = {
+            "env": env,
+            "gem5py_env": env,
+            "Source": Source,
+            "PySource": PySource,
+            "SimObject": SimObject,
+            "DebugFlag": DebugFlag,
+            "CompoundFlag": CompoundFlag,
+            "DebugFormatFlag": DebugFormatFlag,
+            "GdbXml": GdbXml,
+            "ISADesc": ISADesc,
+            "SourceLib": lambda *a, **k: None,
+            "GTest": lambda *a, **k: AutoStub("GTest"),
+            "Executable": lambda *a, **k: AutoStub("Executable"),
+            "ProtoBuf": lambda *a, **k: col.errors.append(
+                f"{ctx['rel']}: ProtoBuf called with protobuf disabled"),
+            "GrpcProtoBuf": lambda *a, **k: None,
+            "Import": Import,
+            "Export": Export,
+            "Return": Return,
+            "GetOption": GetOption,
+            "Split": Split,
+            "File": File,
+            "Dir": _Dir,
+            "Value": lambda x: x,
+            "MakeAction": lambda *a, **k: AutoStub("MakeAction"),
+            "Builder": lambda *a, **k: AutoStub("Builder"),
+            "Action": lambda *a, **k: AutoStub("Action"),
+            "AlwaysBuild": lambda *a, **k: None,
+            "SConscript": lambda *a, **k: None,
+            "Depends": lambda *a, **k: None,
+            "with_tag": lambda *a: AutoStub("with_tag"),
+            "with_any_tags": lambda *a: AutoStub("with_any_tags"),
+            "with_all_tags": lambda *a: AutoStub("with_all_tags"),
+            "without_tag": lambda *a: AutoStub("without_tag"),
+            "without_tags": lambda *a: AutoStub("without_tags"),
+        }
+        return g
+
+    # ------------------------------------------------------------------
+    def manifest(self):
+        return {
+            "conf": self.conf,
+            "sources": self.sources,
+            "pysources": self.pysources,
+            "simobjects": self.simobjects,
+            "debugflags": self.debugflags,
+            "isadescs": self.isadescs,
+            "blobs": self.blobs,
+            "tag_implies": {k: sorted(v)
+                            for k, v in self.tag_implies.items()},
+            "errors": self.errors,
+        }
+
+
+def _tagset(tags):
+    if tags is None:
+        return set()
+    if isinstance(tags, str):
+        return {tags}
+    if isinstance(tags, AutoStub):
+        return set()
+    return set(tags)
+
+
+def _install_fake_modules():
+    """SConscripts import scons/gem5 build helpers at module scope; none of
+    their results drive what we collect, so satisfy the imports with
+    stubs.  ply is real (vendored in the reference's ext/)."""
+    for name in ("SCons", "SCons.Scanner", "SCons.Tool", "SCons.Node",
+                 "SCons.Node.Python", "SCons.Script", "SCons.Defaults",
+                 "gem5_scons", "gem5_scons.builders", "gem5_scons.sources",
+                 "gem5_scons.util", "m5.util.terminal"):
+        mod = types.ModuleType(name)
+        mod.__getattr__ = lambda k, _n=name: AutoStub(f"{_n}.{k}")
+        sys.modules.setdefault(name, mod)
+    sys.path.insert(0, os.path.join(REF, "ext/ply"))
+    sys.path.insert(0, os.path.join(REF, "build_tools"))
+
+
+def main():
+    _install_fake_modules()
+    conf = make_conf()
+    col = Collector(conf).run()
+    man = col.manifest()
+    os.makedirs(BUILD, exist_ok=True)
+    with open(os.path.join(BUILD, "manifest.json"), "w") as f:
+        json.dump(man, f, indent=1)
+    print(f"sources:    {len(man['sources'])}")
+    print(f"pysources:  {len(man['pysources'])}")
+    print(f"simobjects: {sum(len(s['sim_objects']) for s in man['simobjects'])}"
+          f" in {len(man['simobjects'])} modules")
+    print(f"enums:      {sum(len(s['enums']) for s in man['simobjects'])}")
+    print(f"debugflags: {len(man['debugflags'])}")
+    print(f"isadescs:   {len(man['isadescs'])}")
+    print(f"blobs:      {len(man['blobs'])}")
+    print(f"errors:     {len(man['errors'])}")
+    for e in man["errors"]:
+        print("  ERROR", e)
+
+
+if __name__ == "__main__":
+    main()
