@@ -235,7 +235,20 @@ def gen_isa(man):
         gendir = d["gendir"]
         os.makedirs(gendir, exist_ok=True)
         stamp = os.path.join(gendir, ".stamp")
-        if _newer(stamp, d["desc"]):
+        # the description is a ##include tree (plus python insts modules
+        # and the parser itself) — staleness must consider all of it
+        newest = 0.0
+        for root in (os.path.dirname(d["desc"]),
+                     os.path.join(SRC, "arch/isa_parser"),
+                     os.path.join(SRC, "arch/micro_asm.py")):
+            if os.path.isfile(root):
+                newest = max(newest, os.path.getmtime(root))
+                continue
+            for dirpath, _dirs, files in os.walk(root):
+                for fn in files:
+                    newest = max(newest,
+                                 os.path.getmtime(os.path.join(dirpath, fn)))
+        if os.path.exists(stamp) and os.path.getmtime(stamp) >= newest:
             log(f"isa: {d['desc']} up to date")
             continue
         import isa_parser

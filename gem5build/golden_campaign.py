@@ -1,0 +1,253 @@
+"""Reference-binary golden campaign: paired gem5 vs host-silicon SFI.
+
+The experiment (VERDICT r3 #3): flip one bit of one architected GPR at the
+workload's kernel_begin marker and run to completion, classifying by
+program outcome — masked / sdc / due.  Three executors answer the same
+(reg, bit) coordinate list:
+
+  gem5   — the reference binary built by gem5build/: checkpoint at the
+           marker PC (se.py), flip the bit in the serialized thread
+           context (the m5.cpt text format, reference
+           src/sim/serialize.hh:311), restore, run to completion.  This
+           is the reference's own restore+perturb golden loop
+           (ThreadContext::setReg analog via checkpoint state,
+           src/cpu/thread_context.hh:190-207) with zero reference-code
+           modification.
+  host   — tools/hostsfi.cc ptrace flips on real silicon (step 0 ==
+           the same marker), via shrewd_tpu.ingest.hostdiff.run_host.
+           Skippable with --skip-host (e.g. ptrace unavailable).
+
+Register index space is the canonical x86 encoding order shared by
+tools/ptrace_common.h and gem5's X86 int register file — index i means
+the same register everywhere.
+
+Output: GEM5_GOLDEN_r04.json with the three-way tallies and agreement.
+"""
+
+import argparse
+import json
+import os
+import re
+import shutil
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+BUILD = os.path.join(HERE, "build")
+GEM5 = os.path.join(BUILD, "gem5.opt")
+SE = os.path.join(HERE, "se.py")
+RUNDIR = os.path.join(BUILD, "golden")
+
+N_GPRS = 16
+N_BITS = 64
+
+
+def sh(cmd, timeout=None, cwd=None):
+    return subprocess.run(cmd, capture_output=True, text=True,
+                          timeout=timeout, cwd=cwd)
+
+
+def build_workload():
+    """The exact binary the framework's host-diff path uses — one recipe,
+    one artifact, so the gem5 and silicon legs cannot drift apart."""
+    sys.path.insert(0, REPO)
+    from shrewd_tpu.ingest.hostdiff import build_tools
+
+    paths = build_tools(workload_c="workloads/sort.c")
+    return str(paths.workload)
+
+
+def marker_pc(binary, symbol="kernel_begin"):
+    r = sh(["nm", binary])
+    for line in r.stdout.splitlines():
+        parts = line.split()
+        if len(parts) == 3 and parts[2] == symbol:
+            return int(parts[0], 16)
+    raise RuntimeError(f"{symbol} not found in {binary}")
+
+
+def run_gem5(mode, binary, ckpt, extra=(), timeout=600):
+    outdir = os.path.join(RUNDIR, f"m5out-{mode}-{os.getpid()}")
+    cmd = [GEM5, "-r", "--stdout-file=simout", f"--outdir={outdir}",
+           SE, mode, binary, f"--ckpt-dir={ckpt}"] + list(extra)
+    t0 = time.monotonic()
+    try:
+        r = sh(cmd, timeout=timeout)
+        rc = r.returncode
+    except subprocess.TimeoutExpired:
+        rc = -1
+    wall = time.monotonic() - t0
+    simout = ""
+    p = os.path.join(outdir, "simout")
+    if os.path.exists(p):
+        with open(p, errors="replace") as f:
+            simout = f.read()
+    return rc, simout, wall, outdir
+
+
+GUEST_LINE = re.compile(r"^sorted checksum [0-9a-fx]+$", re.M)
+
+
+def guest_output(simout):
+    """The workload prints one checksum line; gem5's own chatter (build
+    info, sim notices) surrounds it in the redirected stdout."""
+    m = GUEST_LINE.findall(simout)
+    return "\n".join(m)
+
+
+# ----------------------------------------------------------------------
+# m5.cpt register patching
+
+
+def load_cpt(ckpt_dir):
+    with open(os.path.join(ckpt_dir, "m5.cpt")) as f:
+        return f.read()
+
+
+def find_intregs(cpt_text):
+    """Locate thread 0's integer register vector: the section span, the
+    key line span (absolute offsets), and the values.  Format: the m5.cpt
+    ini dialect (reference src/sim/serialize.hh:311); the exact key name
+    is confirmed against the generated checkpoint at campaign start."""
+    sec = re.search(r"\[system\.cpu\.xc\.0\](.*?)(?=\n\[|\Z)", cpt_text,
+                    re.S)
+    if not sec:
+        raise RuntimeError("thread-context section not found in m5.cpt")
+    m = re.search(r"^regs\.intRegs=(.*)$", sec.group(1), re.M)
+    if not m:
+        raise RuntimeError(
+            "regs.intRegs not found; section keys: "
+            + ", ".join(re.findall(r"^([\w.]+)=", sec.group(1), re.M)[:40]))
+    line_start = sec.start(1) + m.start()
+    line_end = sec.start(1) + m.end()
+    return (line_start, line_end), m.group(1).split()
+
+
+def patch_cpt(src_dir, dst_dir, reg, bit):
+    """Copy the checkpoint with one bit of one GPR flipped."""
+    text = load_cpt(src_dir)
+    (start, end), vals = find_intregs(text)
+    vals = list(vals)
+    vals[reg] = str(int(vals[reg]) ^ (1 << bit))
+    text = text[:start] + "regs.intRegs=" + " ".join(vals) + text[end:]
+    if os.path.exists(dst_dir):
+        shutil.rmtree(dst_dir)
+    shutil.copytree(src_dir, dst_dir)
+    with open(os.path.join(dst_dir, "m5.cpt"), "w") as f:
+        f.write(text)
+
+
+def classify(rc, out, golden_out):
+    if rc == 0:
+        return "masked" if out == golden_out else "sdc"
+    return "due"
+
+
+# ----------------------------------------------------------------------
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--trials", type=int, default=256,
+                    help="sampled (reg,bit) coords (<=1024 distinct)")
+    ap.add_argument("--all", action="store_true",
+                    help="run the full 16x64 cross product")
+    ap.add_argument("--seed", type=int, default=4)
+    ap.add_argument("--timeout", type=float, default=300.0)
+    ap.add_argument("--skip-host", action="store_true")
+    ap.add_argument("--out", default=os.path.join(REPO,
+                                                  "GEM5_GOLDEN_r04.json"))
+    args = ap.parse_args()
+
+    assert os.path.exists(GEM5), f"{GEM5} not built yet"
+    binary = build_workload()
+    pc = marker_pc(binary)
+    print(f"workload {binary} kernel_begin=0x{pc:x}")
+
+    ckpt = os.path.join(RUNDIR, "ckpt-golden")
+    if not os.path.exists(os.path.join(ckpt, "m5.cpt")):
+        rc, out, wall, _ = run_gem5("checkpoint", binary, ckpt,
+                                    [f"--marker-pc=0x{pc:x}"],
+                                    timeout=args.timeout)
+        assert rc == 0, f"checkpoint run failed rc={rc}\n{out[-2000:]}"
+        print(f"checkpoint at marker in {wall:.1f}s")
+
+    rc, out, wall, _ = run_gem5("restore", binary, ckpt,
+                                timeout=args.timeout)
+    golden_out = guest_output(out)
+    assert rc == 0 and golden_out, \
+        f"golden restore failed rc={rc}\n{out[-2000:]}"
+    print(f"golden restore: rc=0, output {golden_out!r} in {wall:.1f}s")
+
+    # coordinate list (shared with hostsfi)
+    import random
+
+    rng = random.Random(args.seed)
+    coords = [(r, b) for r in range(N_GPRS) for b in range(N_BITS)]
+    if not args.all:
+        coords = rng.sample(coords, min(args.trials, len(coords)))
+
+    tally = {"masked": 0, "sdc": 0, "due": 0}
+    results = []
+    t0 = time.monotonic()
+    patched = os.path.join(RUNDIR, "ckpt-patched")
+    for i, (reg, bit) in enumerate(coords):
+        patch_cpt(ckpt, patched, reg, bit)
+        rc, out, wall, outdir = run_gem5("restore", binary, patched,
+                                         timeout=args.timeout)
+        cls = classify(rc, guest_output(out), golden_out)
+        tally[cls] += 1
+        results.append({"reg": reg, "bit": bit, "gem5": cls})
+        shutil.rmtree(outdir, ignore_errors=True)
+        if (i + 1) % 16 == 0:
+            el = time.monotonic() - t0
+            print(f"  {i+1}/{len(coords)} gem5 trials "
+                  f"({el/(i+1):.1f}s/trial) tally={tally}", flush=True)
+    sec_per_trial = (time.monotonic() - t0) / len(coords)
+
+    out_doc = {
+        "experiment": "architected-GPR bit flip at kernel_begin, run to "
+                      "completion",
+        "workload": "sort.c (gcc -O1 -static -fno-pie -no-pie)",
+        "binary_sha": sh(["sha256sum", binary]).stdout.split()[0],
+        "marker_pc": hex(pc),
+        "coords": len(coords),
+        "gem5": dict(tally),
+        "gem5_avf": (tally["sdc"] + tally["due"]) / len(coords),
+        "sec_per_trial": sec_per_trial,
+    }
+
+    if not args.skip_host:
+        import numpy as np
+
+        from shrewd_tpu.ingest.hostdiff import (HOST_OUTCOME, build_tools,
+                                                run_host)
+        names = {v: k for k, v in HOST_OUTCOME.items()}
+        paths = build_tools(workload_c="workloads/sort.c")
+        hc = np.array([[0, r, b] for r, b in coords], dtype=np.int64)
+        host_out = run_host(paths, hc)
+        htally = {"masked": 0, "sdc": 0, "due": 0}
+        agree = agree_vuln = 0
+        for rec, h in zip(results, host_out):
+            hcls = names[int(h)]
+            rec["host"] = hcls
+            htally[hcls] += 1
+            agree += rec["gem5"] == hcls
+            agree_vuln += (rec["gem5"] != "masked") == (hcls != "masked")
+        out_doc["host"] = htally
+        out_doc["host_avf"] = (htally["sdc"] + htally["due"]) / len(coords)
+        out_doc["agreement_exact"] = agree / len(coords)
+        out_doc["agreement_vulnerable"] = agree_vuln / len(coords)
+        out_doc["avf_abs_err"] = abs(out_doc["gem5_avf"]
+                                     - out_doc["host_avf"])
+
+    out_doc["trials"] = results
+    with open(args.out, "w") as f:
+        json.dump(out_doc, f, indent=1)
+    print(json.dumps({k: v for k, v in out_doc.items()
+                      if k != "trials"}, indent=1))
+
+
+if __name__ == "__main__":
+    main()

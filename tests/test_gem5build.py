@@ -1,0 +1,121 @@
+"""Tests for the scons-less reference-build harness (gem5build/).
+
+The mini-m4 is the riskiest piece (hand-written macro processor feeding
+libelf's generated C), so its classic-m4 semantics are pinned here:
+expansion during argument collection, recursion via shift($@), quoting,
+dnl, and the define-inside-define idiom libelf uses.
+"""
+
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "gem5build"))
+
+from mini_m4 import M4, m4_expand  # noqa: E402
+
+REF = "/root/reference"
+
+pytestmark = pytest.mark.quick
+
+
+def expand(text, defines=None):
+    m4 = M4(defines=defines)
+    m4.process(text)
+    return m4.result()
+
+
+class TestMiniM4:
+    def test_define_and_expand(self):
+        assert expand("define(`A', `hello')A world") == "hello world"
+
+    def test_quoting_suppresses_expansion(self):
+        assert expand("define(`A', `x')`A' A") == "A x"
+
+    def test_nested_quotes_strip_one_level(self):
+        assert expand("``A''") == "`A'"
+
+    def test_args_substitute(self):
+        assert expand("define(`F', `[$1|$2]')F(a, b)") == "[a|b]"
+
+    def test_arg_count_and_at(self):
+        assert expand("define(`F', `$#')F(a,b,c)") == "3"
+        assert expand("define(`F', `$@')F(a,b)") == "a,b"
+
+    def test_dnl_eats_line(self):
+        assert expand("a dnl comment here\nb") == "a b"
+
+    def test_comment_passthrough_no_expansion(self):
+        assert expand("define(`A', `x')# A stays\nA") == "# A stays\nx"
+
+    def test_expansion_during_arg_collection(self):
+        # the libelf list idiom: a macro expanding to `a',`b' must split
+        # the outer call's arguments
+        text = ("define(`LIST', ``a', `b', `c'')"
+                "define(`COUNT', `$#')"
+                "COUNT(LIST)")
+        assert expand(text) == "3"
+
+    def test_shift_recursion(self):
+        text = ("define(`JOIN', `ifelse($#, 1, `$1', `$1-JOIN(shift($@))')')"
+                "JOIN(x, y, z)")
+        assert expand(text) == "x-y-z"
+
+    def test_define_inside_define(self):
+        # NOCVT(TYPE) -> define(NOCVT_TYPE, 1) (libelf_convert.m4)
+        text = ("define(`MARK', `define(`SAW_'$1, 1)')"
+                "MARK(`FOO')"
+                "ifdef(`SAW_FOO', `yes', `no')")
+        assert expand(text) == "yes"
+
+    def test_pushdef_popdef(self):
+        text = ("define(`V', `one')pushdef(`V', `two')V popdef(`V')V")
+        assert expand(text) == "two one"
+
+    def test_divert_discards(self):
+        assert expand("keep divert(-1)gone divert(0)back") == "keep back"
+
+    def test_ifelse_chain(self):
+        t = "define(`F', `ifelse($1, a, `A', $1, b, `B', `other')')"
+        assert expand(t + "F(a)") == "A"
+        assert expand(t + "F(b)") == "B"
+        assert expand(t + "F(z)") == "other"
+
+    def test_builtin_bare_word_passthrough(self):
+        # words like "include" in C prose must not fire the builtin
+        assert expand("do not include this") == "do not include this"
+
+    @pytest.mark.skipif(not os.path.isdir(REF), reason="no reference tree")
+    def test_libelf_msize_generates_full_table(self):
+        out = m4_expand(os.path.join(REF, "ext/libelf/libelf_msize.m4"),
+                        defines={"SRCDIR": os.path.join(REF, "ext/libelf")})
+        # every fixed-size ELF type must land one initializer row
+        for t in ("ADDR", "EHDR", "SYM", "RELA", "PHDR", "SHDR"):
+            assert f"[ELF_T_{t}]" in out
+        assert "ELF_TYPE_LIST" not in out
+
+    @pytest.mark.skipif(not os.path.isdir(REF), reason="no reference tree")
+    def test_libelf_convert_generates_functions(self):
+        out = m4_expand(os.path.join(REF, "ext/libelf/libelf_convert.m4"),
+                        defines={"SRCDIR": os.path.join(REF, "ext/libelf")})
+        assert out.count("_libelf_cvt_") > 100  # defs + table refs
+        for fn in ("_libelf_cvt_EHDR64_tom", "_libelf_cvt_SYM32_tof"):
+            assert fn in out
+
+
+@pytest.mark.skipif(not os.path.isdir(REF), reason="no reference tree")
+class TestConf:
+    def test_x86_se_config(self):
+        from conf import make_conf
+
+        conf = make_conf()
+        assert conf["USE_X86_ISA"] is True
+        assert conf["RUBY"] is False
+        assert conf["USE_ARM_ISA"] is False
+        assert conf["USE_KVM"] is False
+        # every symbol the SConscripts consult must exist
+        for key in ("USE_SYSTEMC", "BUILD_GPU", "HAVE_PROTOBUF",
+                    "BUILD_TLM", "KVM_ISA", "USE_EFENCE"):
+            assert key in conf
