@@ -93,7 +93,11 @@ def main():
     incs = " ".join(f"-I{d}" for d in dict.fromkeys(inc_dirs)) + " " + \
         " ".join(py_inc)
 
-    common = "-O2 -pipe -fno-strict-aliasing -w -DTRACING_ON=1"
+    # -O1 over the reference's -O3: this is a 1-core host and the golden
+    # campaign needs fidelity, not simulation speed.  The pybind param
+    # bindings and marshalled-python arrays only run at init — -O0 there
+    # roughly halves their (template-heavy) compile cost.
+    common = "-O1 -pipe -fno-strict-aliasing -w -DTRACING_ON=1"
     cxxflags = f"{common} -std=c++17"
     cflags = common
 
@@ -144,6 +148,8 @@ def main():
             ccf = s["append"].get("CCFLAGS") or s["append"].get("CXXFLAGS")
             if ccf:
                 extra = " ".join(ccf) if isinstance(ccf, list) else str(ccf)
+        if "/python/_m5/" in path or path.endswith(".py.cc"):
+            extra = ("-O0 " + extra).strip()
         lang = "cc" if path.endswith(".c") else "cxx"
         add_cc(path, lang, extra)
 

@@ -50,22 +50,13 @@ def sh(cmd, timeout=None, cwd=None):
 
 
 def build_workload():
-    """The exact binary the framework's host-diff path uses — one recipe,
-    one artifact, so the gem5 and silicon legs cannot drift apart."""
+    """The exact binary + marker the framework's host-diff path uses — one
+    recipe, one artifact, one nm parse (BuildPaths.begin is kernel_begin),
+    so the gem5 and silicon legs cannot drift apart."""
     sys.path.insert(0, REPO)
     from shrewd_tpu.ingest.hostdiff import build_tools
 
-    paths = build_tools(workload_c="workloads/sort.c")
-    return str(paths.workload)
-
-
-def marker_pc(binary, symbol="kernel_begin"):
-    r = sh(["nm", binary])
-    for line in r.stdout.splitlines():
-        parts = line.split()
-        if len(parts) == 3 and parts[2] == symbol:
-            return int(parts[0], 16)
-    raise RuntimeError(f"{symbol} not found in {binary}")
+    return build_tools(workload_c="workloads/sort.c")
 
 
 def run_gem5(mode, binary, ckpt, extra=(), timeout=600):
@@ -87,14 +78,14 @@ def run_gem5(mode, binary, ckpt, extra=(), timeout=600):
     return rc, simout, wall, outdir
 
 
-GUEST_LINE = re.compile(r"^sorted checksum [0-9a-fx]+$", re.M)
+# sort.c emits exactly one line: 8 lowercase hex digits (emit_checksum,
+# workloads/sort.c:54-67).  gem5's own chatter (build info, sim notices)
+# surrounds it in the redirected stdout, so extract by shape.
+GUEST_LINE = re.compile(r"^[0-9a-f]{8}$", re.M)
 
 
 def guest_output(simout):
-    """The workload prints one checksum line; gem5's own chatter (build
-    info, sim notices) surrounds it in the redirected stdout."""
-    m = GUEST_LINE.findall(simout)
-    return "\n".join(m)
+    return "\n".join(GUEST_LINE.findall(simout))
 
 
 # ----------------------------------------------------------------------
@@ -125,16 +116,21 @@ def find_intregs(cpt_text):
     return (line_start, line_end), m.group(1).split()
 
 
-def patch_cpt(src_dir, dst_dir, reg, bit):
-    """Copy the checkpoint with one bit of one GPR flipped."""
-    text = load_cpt(src_dir)
-    (start, end), vals = find_intregs(text)
-    vals = list(vals)
-    vals[reg] = str(int(vals[reg]) ^ (1 << bit))
-    text = text[:start] + "regs.intRegs=" + " ".join(vals) + text[end:]
+def prepare_patch_dir(src_dir, dst_dir):
+    """One-time copy of the checkpoint tree (the serialized memory image
+    dominates it); per-trial patching rewrites only m5.cpt."""
     if os.path.exists(dst_dir):
         shutil.rmtree(dst_dir)
     shutil.copytree(src_dir, dst_dir)
+
+
+def patch_cpt(golden_text, dst_dir, reg, bit):
+    """Rewrite dst_dir/m5.cpt as the golden text with one GPR bit flipped."""
+    (start, end), vals = find_intregs(golden_text)
+    vals = list(vals)
+    vals[reg] = str(int(vals[reg]) ^ (1 << bit))
+    text = (golden_text[:start] + "regs.intRegs=" + " ".join(vals)
+            + golden_text[end:])
     with open(os.path.join(dst_dir, "m5.cpt"), "w") as f:
         f.write(text)
 
@@ -161,16 +157,27 @@ def main():
     args = ap.parse_args()
 
     assert os.path.exists(GEM5), f"{GEM5} not built yet"
-    binary = build_workload()
-    pc = marker_pc(binary)
+    paths = build_workload()
+    binary, pc = str(paths.workload), paths.begin
+    binary_sha = sh(["sha256sum", binary]).stdout.split()[0]
     print(f"workload {binary} kernel_begin=0x{pc:x}")
 
     ckpt = os.path.join(RUNDIR, "ckpt-golden")
-    if not os.path.exists(os.path.join(ckpt, "m5.cpt")):
+    stamp_path = os.path.join(RUNDIR, "ckpt-golden.stamp")
+    stamp = f"{binary_sha} 0x{pc:x}"
+    stale = True
+    if os.path.exists(os.path.join(ckpt, "m5.cpt")) \
+            and os.path.exists(stamp_path):
+        with open(stamp_path) as f:
+            stale = f.read().strip() != stamp
+    if stale:
+        shutil.rmtree(ckpt, ignore_errors=True)
         rc, out, wall, _ = run_gem5("checkpoint", binary, ckpt,
                                     [f"--marker-pc=0x{pc:x}"],
                                     timeout=args.timeout)
         assert rc == 0, f"checkpoint run failed rc={rc}\n{out[-2000:]}"
+        with open(stamp_path, "w") as f:
+            f.write(stamp + "\n")
         print(f"checkpoint at marker in {wall:.1f}s")
 
     rc, out, wall, _ = run_gem5("restore", binary, ckpt,
@@ -192,8 +199,10 @@ def main():
     results = []
     t0 = time.monotonic()
     patched = os.path.join(RUNDIR, "ckpt-patched")
+    prepare_patch_dir(ckpt, patched)
+    golden_text = load_cpt(ckpt)
     for i, (reg, bit) in enumerate(coords):
-        patch_cpt(ckpt, patched, reg, bit)
+        patch_cpt(golden_text, patched, reg, bit)
         rc, out, wall, outdir = run_gem5("restore", binary, patched,
                                          timeout=args.timeout)
         cls = classify(rc, guest_output(out), golden_out)
@@ -210,7 +219,7 @@ def main():
         "experiment": "architected-GPR bit flip at kernel_begin, run to "
                       "completion",
         "workload": "sort.c (gcc -O1 -static -fno-pie -no-pie)",
-        "binary_sha": sh(["sha256sum", binary]).stdout.split()[0],
+        "binary_sha": binary_sha,
         "marker_pc": hex(pc),
         "coords": len(coords),
         "gem5": dict(tally),
@@ -221,10 +230,8 @@ def main():
     if not args.skip_host:
         import numpy as np
 
-        from shrewd_tpu.ingest.hostdiff import (HOST_OUTCOME, build_tools,
-                                                run_host)
+        from shrewd_tpu.ingest.hostdiff import HOST_OUTCOME, run_host
         names = {v: k for k, v in HOST_OUTCOME.items()}
-        paths = build_tools(workload_c="workloads/sort.c")
         hc = np.array([[0, r, b] for r, b in coords], dtype=np.int64)
         host_out = run_host(paths, hc)
         htally = {"masked": 0, "sdc": 0, "due": 0}

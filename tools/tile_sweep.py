@@ -1,0 +1,114 @@
+"""On-chip Pallas tile sweep (VERDICT r3 #10).
+
+Measures the taint fast pass at b_tile ∈ {256, 512, 1024, 2048} on the
+real device, using the same flagship shape as bench.py (4096-µop window,
+131072-trial batch, regfile tier), and reports trials/s per configuration
+plus the XLA-kernel reference point.  One process, strictly sequential
+device sessions, and an internal watchdog that *self-exits* rather than
+being killed mid-compile (the axon relay wedge mechanism — see
+.claude/skills/verify/SKILL.md).
+
+Usage:  PYTHONPATH=/root/repo:$PYTHONPATH python tools/tile_sweep.py \
+            [--batch N] [--uops N] [--reps N] [--out TILE_SWEEP.json]
+
+Prints one JSON document at the end; writes it to --out too.
+"""
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import threading
+import time
+
+WATCHDOG_S = 1500.0
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=131072)
+    ap.add_argument("--uops", type=int, default=4096)
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--tiles", type=str, default="256,512,1024,2048")
+    ap.add_argument("--out", type=str, default="TILE_SWEEP.json")
+    args = ap.parse_args()
+
+    # self-exit watchdog: never leave this process to be SIGKILLed
+    # mid-compile by an impatient caller
+    def _watchdog():
+        time.sleep(WATCHDOG_S)
+        sys.stderr.write("tile_sweep: watchdog fired — self-exiting\n")
+        os._exit(9)
+
+    threading.Thread(target=_watchdog, daemon=True).start()
+
+    import jax
+    import numpy as np
+
+    from shrewd_tpu import native
+    from shrewd_tpu.models.o3 import O3Config
+    from shrewd_tpu.ops.trial import TrialKernel
+    from shrewd_tpu.utils import prng
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform in ("tpu", "axon")
+    print(f"device: {dev} ({dev.platform})", file=sys.stderr, flush=True)
+
+    trace = native.generate_trace(seed=1, n=args.uops, nphys=256,
+                                  mem_words=4096,
+                                  working_set_words=1024)
+    keys = prng.trial_keys(prng.campaign_key(0), args.batch)
+
+    doc = {"device": str(dev), "platform": dev.platform,
+           "batch": args.batch, "uops": args.uops, "reps": args.reps,
+           "configs": []}
+    ref_tally = None
+    # tile 0 = the XLA taint kernel (pallas off) — runs FIRST so it is the
+    # tally reference every Pallas tile is checked against
+    for tile in [0] + [int(t) for t in args.tiles.split(",")]:
+        label = "xla" if tile == 0 else f"b_tile={tile}"
+        try:
+            cfg = O3Config(pallas="off") if tile == 0 else \
+                O3Config(pallas="auto" if on_tpu else "on",
+                         pallas_b_tile=tile)
+            kern = TrialKernel(trace, cfg)
+            t0 = time.monotonic()
+            tally = np.asarray(kern.run_keys(keys, "regfile"))
+            compile_s = time.monotonic() - t0
+            rates = []
+            for _ in range(args.reps):
+                t0 = time.monotonic()
+                np.asarray(kern.run_keys(keys, "regfile"))
+                rates.append(args.batch / (time.monotonic() - t0))
+            entry = {"config": label,
+                     "trials_per_sec": round(statistics.median(rates), 1),
+                     "rate_min": round(min(rates), 1),
+                     "rate_max": round(max(rates), 1),
+                     "compile_plus_first_s": round(compile_s, 1),
+                     "tally": tally.tolist()}
+            if tile == 0:
+                ref_tally = tally.tolist()
+            entry["tally_matches_xla"] = (ref_tally is not None
+                                          and tally.tolist() == ref_tally)
+            doc["configs"].append(entry)
+            print(json.dumps(entry), file=sys.stderr, flush=True)
+        except Exception as e:  # noqa: BLE001 — record and continue sweep
+            doc["configs"].append({"config": label,
+                                   "error": f"{type(e).__name__}: "
+                                            f"{str(e)[:300]}"})
+            print(f"{label} FAILED: {e}", file=sys.stderr, flush=True)
+
+    ok = [c for c in doc["configs"]
+          if "trials_per_sec" in c and c["config"] != "xla"]
+    if ok:
+        best = max(ok, key=lambda c: c["trials_per_sec"])
+        doc["best"] = best["config"]
+        doc["best_trials_per_sec"] = best["trials_per_sec"]
+    with open(args.out, "w") as f:
+        json.dump(doc, f, indent=1)
+    print(json.dumps(doc))
+
+
+if __name__ == "__main__":
+    main()
