@@ -142,6 +142,10 @@ def main():
             lines.append(f"  extra = {extra}")
 
     for s in man["sources"]:
+        # the gem5 binary takes with_any_tags('gem5 lib', 'main') —
+        # gtest-only support sources (skip_lib=True) stay out
+        if not {"gem5 lib", "main"} & set(s["tags"]):
+            continue
         path = s["path"]
         extra = ""
         if s.get("append"):

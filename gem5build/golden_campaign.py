@@ -98,18 +98,23 @@ def load_cpt(ckpt_dir):
 
 
 def find_intregs(cpt_text):
-    """Locate thread 0's integer register vector: the section span, the
-    key line span (absolute offsets), and the values.  Format: the m5.cpt
-    ini dialect (reference src/sim/serialize.hh:311); the exact key name
-    is confirmed against the generated checkpoint at campaign start."""
-    sec = re.search(r"\[system\.cpu\.xc\.0\](.*?)(?=\n\[|\Z)", cpt_text,
-                    re.S)
+    """Locate thread 0's integer register byte array.
+
+    Format (reference src/cpu/thread_context.cc:194-216): each non-misc
+    register class serializes as ``regs.<class-name>`` — for the int class
+    ``regs.integer`` (src/cpu/reg_class.hh:75) — a flattened little-endian
+    byte array, one unsigned int per byte, 8 bytes per x86 GPR, in the
+    x86 encoding order (src/arch/x86/regs/int.hh:69-86) that the
+    framework's canonical GPR index shares.
+
+    Returns ((abs_start, abs_end) of the key line, byte-value list)."""
+    sec = re.search(r"\[[\w.]*\.xc\.0\](.*?)(?=\n\[|\Z)", cpt_text, re.S)
     if not sec:
         raise RuntimeError("thread-context section not found in m5.cpt")
-    m = re.search(r"^regs\.intRegs=(.*)$", sec.group(1), re.M)
+    m = re.search(r"^regs\.integer=(.*)$", sec.group(1), re.M)
     if not m:
         raise RuntimeError(
-            "regs.intRegs not found; section keys: "
+            "regs.integer not found; section keys: "
             + ", ".join(re.findall(r"^([\w.]+)=", sec.group(1), re.M)[:40]))
     line_start = sec.start(1) + m.start()
     line_end = sec.start(1) + m.end()
@@ -125,11 +130,13 @@ def prepare_patch_dir(src_dir, dst_dir):
 
 
 def patch_cpt(golden_text, dst_dir, reg, bit):
-    """Rewrite dst_dir/m5.cpt as the golden text with one GPR bit flipped."""
+    """Rewrite dst_dir/m5.cpt as the golden text with one GPR bit flipped
+    (byte ``reg*8 + bit//8``, bit ``bit%8`` — little-endian RegVal)."""
     (start, end), vals = find_intregs(golden_text)
     vals = list(vals)
-    vals[reg] = str(int(vals[reg]) ^ (1 << bit))
-    text = (golden_text[:start] + "regs.intRegs=" + " ".join(vals)
+    idx = reg * 8 + bit // 8
+    vals[idx] = str(int(vals[idx]) ^ (1 << (bit % 8)))
+    text = (golden_text[:start] + "regs.integer=" + " ".join(vals)
             + golden_text[end:])
     with open(os.path.join(dst_dir, "m5.cpt"), "w") as f:
         f.write(text)
@@ -187,6 +194,24 @@ def main():
         f"golden restore failed rc={rc}\n{out[-2000:]}"
     print(f"golden restore: rc=0, output {golden_out!r} in {wall:.1f}s")
 
+    # cross-check: the framework's checkpoint reader parses this genuine
+    # gem5-produced file and agrees with this script's own byte extraction
+    # (retires VERDICT r3 weak #5 — ingest had only ever seen hand-written
+    # fixtures in the reference's shape)
+    from shrewd_tpu.ingest import cpt as cptmod
+
+    cp = cptmod.CheckpointIn(ckpt)
+    xc = [s for s in cp.sections() if s.endswith(".xc.0")]
+    _, my_vals = find_intregs(load_cpt(ckpt))
+    if len(xc) == 1:
+        ingest_bytes = cp.get_bytes(xc[0], "regs.integer")
+        ingest_ok = [str(int(b)) for b in ingest_bytes] == my_vals
+    else:
+        ingest_bytes = []
+        ingest_ok = False
+    print(f"ingest cross-check on real m5.cpt: sections={len(cp.sections())}"
+          f" intregs_bytes={len(ingest_bytes)} match={ingest_ok}")
+
     # coordinate list (shared with hostsfi)
     import random
 
@@ -225,6 +250,9 @@ def main():
         "gem5": dict(tally),
         "gem5_avf": (tally["sdc"] + tally["due"]) / len(coords),
         "sec_per_trial": sec_per_trial,
+        "real_cpt_ingest": {"sections": len(cp.sections()),
+                            "intregs_bytes": int(len(ingest_bytes)),
+                            "matches_campaign_parse": bool(ingest_ok)},
     }
 
     if not args.skip_host:

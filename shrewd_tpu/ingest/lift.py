@@ -180,6 +180,10 @@ def _parse_operand(tok: str, comment_addr: int | None) -> Operand | None:
             return Operand("reg", reg=idx, width=width)
         if name == "rip":
             return None
+        if name.startswith(("ds:", "es:", "ss:", "cs:")):
+            # zero-base segments in 64-bit mode (string-op operands print
+            # as "%ds:(%rsi)" / "%es:(%rdi)"): parse the inner form plain
+            return _parse_operand(name[3:], comment_addr)
         if name.startswith(("fs:", "gs:")):
             # Segment-relative absolute ("%fs:0x30"): base=-4 marks an
             # fs-relative address — unmappable for the lifter (demote) but
@@ -405,6 +409,37 @@ class Cluster(NamedTuple):
     word_off: int               # word offset in the flat replay memory
 
 
+# --- x86 string ops (the erms memcpy/memset loops glibc leans on) ----------
+# Single-stepping a rep-prefixed instruction traps once per ITERATION with
+# rip unchanged, so each captured step is exactly one element move — the
+# lifter emits that one element's dataflow and the register self-check
+# validates it (direction-flag-reversed or otherwise odd iterations demote).
+
+_STR_W = {"b": 1, "w": 2, "l": 4, "d": 4, "q": 8}
+
+
+def _is_movs(inst: Inst) -> bool:
+    m = inst.mnemonic.split()[-1]
+    return (m[:-1] == "movs" and m[-1] in _STR_W
+            and len(inst.operands) == 2
+            and all(o.kind == "mem" for o in inst.operands))
+
+
+def _is_stos(inst: Inst) -> bool:
+    m = inst.mnemonic.split()[-1]
+    return ((m == "stos" or (m[:-1] == "stos" and m[-1] in _STR_W))
+            and len(inst.operands) == 2
+            and inst.operands[0].kind == "reg"
+            and inst.operands[1].kind == "mem")
+
+
+def _str_width(inst: Inst) -> int:
+    for o in inst.operands:
+        if o.kind == "reg" and o.reg >= 0 and o.width:
+            return abs(o.width) // 8
+    return _STR_W.get(inst.mnemonic.split()[-1][-1], 8)
+
+
 class Lifter:
     """One nativetrace capture + static decode → Trace + metadata."""
 
@@ -485,6 +520,15 @@ class Lifter:
                 touched.setdefault(pc, set()).add(ea & 0xFFFFFFFFFFFFFFFF)
             if inst.mnemonic in ("pop", "popq"):
                 touched.setdefault(pc, set()).add(int(steps[i][4]))
+            if _is_movs(inst):
+                # two independent memory streams at one static pc: keyed
+                # (pc, "s")/(pc, "d") so each gets its own cluster binding
+                # (the plain pc key would demand a single shared cluster)
+                for op, tag in zip(inst.operands, ("s", "d")):
+                    ea = self._ea_of(op, steps[i])
+                    if ea is not None:
+                        touched.setdefault((pc, tag), set()).add(ea)
+                continue
             for op in inst.operands:
                 if op.kind != "mem" or op.base in (-3, -4, -5) or op.seg:
                     continue
@@ -723,6 +767,222 @@ class Lifter:
             disp = op.disp
         return base_reg, (disp + delta) & M32
 
+    # -- EVEX strlen chain -------------------------------------------------
+    # glibc's __strlen_evex head is vpxorq zmmZ (zero) → vpcmpeqb
+    # (mem),ymmZ,k → kmovd k,r32 → tzcnt: everything between memory bytes
+    # and the GPR mask is vector state the 32-bit datapath cannot hold.
+    # Tracked symbolically instead: a known-zero vector register set and a
+    # per-k-register "byte==0 mask of W bytes at [base+disp]" record; at
+    # kmovd the mask is MATERIALIZED as byte-compare µops against replay
+    # memory, restoring fault propagation from string bytes to the length
+    # (the r3/r4 strmix disagreement channel).  The register self-check
+    # validates every materialized mask against the captured GPR, and any
+    # unrecognized vector/k write invalidates the touched state
+    # (fail-closed: unknown k at kmovd demotes exactly as before).
+
+    class _KMask(NamedTuple):
+        pc: int            # the vpcmpeqb pc (cluster binding key)
+        base: int          # address base register (canonical index)
+        base_val: int      # captured base value at compare time (low 32)
+        disp: int
+        width: int         # compared bytes (ymm: 32)
+
+    def _vec_state(self):
+        if not hasattr(self, "_vzero"):
+            self._vzero: set[int] = set()
+            self._kmask: dict[int, Lifter._KMask | None] = {}
+        return self._vzero, self._kmask
+
+    def _vec_reset(self) -> None:
+        if hasattr(self, "_vzero"):
+            self._vzero.clear()
+            self._kmask.clear()
+
+    def _lift_vec_chain(self, m: str, ops: list, pc: int,
+                        regs: np.ndarray):
+        """True/False when this instruction was consumed (lifted/demoted);
+        None to fall through to the ordinary handlers."""
+        touches_vec = any(o.kind in ("xmm", "kreg") for o in ops)
+        if not touches_vec and m not in ("tzcnt",):
+            return None
+        vzero, kmask = self._vec_state()
+        # conservative pre-invalidation of the destination (AT&T: last op)
+        if touches_vec and ops:
+            d = ops[-1]
+            if d.kind == "xmm":
+                vzero.discard(d.reg)
+            elif d.kind == "kreg":
+                kmask[d.reg] = None
+
+        if m in ("vpxor", "vpxord", "vpxorq", "xorps", "xorpd", "pxor") \
+                and len(ops) in (2, 3) \
+                and all(o.kind == "xmm" and o.reg == ops[0].reg
+                        for o in ops):
+            vzero.add(ops[0].reg)
+            # FP-modeled low xmm regs: the scalar-SSE lift must still zero
+            # the modeled lane (consuming here left it stale and demoted
+            # every gcc pxor-zeroing idiom) — record and fall through
+            if (self.FP_BASE is not None
+                    and getattr(self, "_has_xmm", False)
+                    and 0 <= ops[0].reg < 16 and abs(ops[0].width) <= 128):
+                return None
+            return True                      # architecturally GPR-silent
+
+        if m in ("vpcmpeqb",) and len(ops) == 3 \
+                and ops[0].kind == "mem" and ops[1].kind == "xmm" \
+                and ops[2].kind == "kreg":
+            mem, z, k = ops
+            if (z.reg in vzero and mem.base >= 0 and mem.index < 0
+                    and not mem.rip_rel and not mem.seg):
+                kmask[k.reg] = self._KMask(
+                    pc, mem.base, int(regs[mem.base]) & M32, mem.disp,
+                    abs(z.width) // 8)
+                return True
+            return False                     # unknown compare → opaque
+
+        if m in ("kmovd",) and len(ops) == 2 and ops[0].kind == "kreg" \
+                and ops[1].kind == "reg" and ops[1].reg >= 0:
+            st = kmask.get(ops[0].reg)
+            dst = ops[1].reg
+            if st is None or dst == st.base \
+                    or (int(regs[st.base]) & M32) != st.base_val \
+                    or st.width > 32:
+                return False
+            return self._materialize_kmask(st, dst)
+
+        if m == "tzcnt" and len(ops) == 2 \
+                and all(o.kind == "reg" and o.reg >= 0
+                        and abs(o.width) == 32 for o in ops):
+            self._emit_ctz32(ops[0].reg, ops[1].reg)
+            self.flags_src = ("res", ops[1].reg)
+            return True
+
+        # fall through: the scalar-SSE FP lift (and the generic demotion
+        # path) still see the instruction; state was already invalidated
+        return None
+
+    def _materialize_kmask(self, st: "_KMask", dst: int) -> bool:
+        """dst = bitmask over st.width bytes at [base+disp]: bit b set iff
+        byte b == 0 — the vpcmpeqb-vs-zero result, recomputed from replay
+        memory so corrupted string bytes reach the mask."""
+        cl = self.pc_cluster.get(st.pc)
+        self.stats.mem_accesses += 1
+        if cl is None:
+            self.stats.mem_dropped += 1
+            return False
+        # cost note: ~11 µops/byte (354 per 32-byte kmovd).  Bounded in
+        # practice — strmix's 59 materializations ≈ 21k µops, under 5% of
+        # the largest lifted windows — and every µop is validated by the
+        # register self-check, so the simple per-byte form is kept over a
+        # load-each-word-once variant (~22% fewer µops, more edge cases).
+        delta = (st.disp + self._remap_const(cl)) & M32
+        self._emit(U.LUI, dst, ZERO, ZERO, 0)
+        self._emit(U.ADDI, T3, ZERO, ZERO, 3)         # byte→bit shift ×8
+        for i in range(st.width):
+            # string pointers are NOT word-aligned: per-byte address with
+            # an aligned word load + dynamic in-word shift
+            self._emit(U.ADDI, T2, st.base, ZERO, (delta + i) & M32)
+            self._emit(U.ANDI, T6, T2, ZERO, (~3) & M32)
+            self._emit(U.LOAD, T6, T6, ZERO, 0)
+            self._emit(U.ANDI, T4, T2, ZERO, 3)
+            self._emit(U.SLL, T4, T4, T3)
+            self._emit(U.SRL, T5, T6, T4)
+            self._emit(U.ANDI, T5, T5, ZERO, 0xFF)
+            self._emit(U.SLTU, T5, ZERO, T5)
+            self._emit(U.XORI, T5, T5, ZERO, 1)
+            self._emit(U.ADDI, T4, ZERO, ZERO, i)
+            self._emit(U.SLL, T5, T5, T4)
+            self._emit(U.OR, dst, dst, T5)
+        return True
+
+    def _emit_ctz32(self, src: int, dst: int) -> None:
+        """Branchless count-trailing-zeros (tzcnt semantics: 32 for 0)."""
+        self._emit(U.ADD, T5, src, ZERO)
+        self._emit(U.LUI, T6, ZERO, ZERO, 0)
+        for msk, log in ((0xFFFF, 4), (0xFF, 3), (0xF, 2), (0x3, 1),
+                         (0x1, 0)):
+            self._emit(U.ANDI, T4, T5, ZERO, msk)
+            self._emit(U.SLTU, T4, ZERO, T4)
+            self._emit(U.XORI, T4, T4, ZERO, 1)       # low part all-zero?
+            self._emit(U.ADDI, T3, ZERO, ZERO, log)
+            self._emit(U.SLL, T4, T4, T3)             # 0 or 2^log
+            self._emit(U.ADD, T6, T6, T4)
+            self._emit(U.SRL, T5, T5, T4)
+        self._emit(U.ANDI, T4, T5, ZERO, 1)
+        self._emit(U.XORI, T4, T4, ZERO, 1)
+        self._emit(U.ADD, T6, T6, T4)                 # src==0 → 32
+        self._emit(U.ADD, dst, T6, ZERO)
+
+    # -- x86 string ops ----------------------------------------------------
+    # Canonical indices of the implicit string registers.
+    _RSI, _RDI, _RCX = 6, 7, 1
+
+    def _lift_movs(self, inst: Inst, pc: int, regs: np.ndarray) -> bool:
+        """One movs iteration: [rdi] <- [rsi], rsi/rdi advance, rep
+        decrements rcx.  DF=1 (backward) iterations fail the register
+        self-check and demote — fail-closed."""
+        w = _str_width(inst)
+        scl = self.pc_cluster.get((pc, "s"))
+        dcl = self.pc_cluster.get((pc, "d"))
+        self.stats.mem_accesses += 2
+        if scl is None or dcl is None or w < 4:
+            self.stats.mem_dropped += 2
+            return False
+        self._str_copy_word(self._remap_const(scl), self._remap_const(dcl),
+                            w)
+        self._inc_strreg(self._RSI, w)
+        self._inc_strreg(self._RDI, w)
+        if inst.mnemonic.startswith("rep"):
+            self._inc_strreg(self._RCX, -1)
+        return True
+
+    def _stos_hi_imm(self, src_reg: int, regs: np.ndarray) -> int:
+        """High word a qword stos writes: the 32-bit projection tracks
+        only the low lane, so the high half is golden-frozen from the
+        captured register (lift64 overrides with the live hi lane)."""
+        return (int(regs[src_reg]) >> 32) & M32
+
+    def _lift_stos(self, inst: Inst, pc: int, regs: np.ndarray) -> bool:
+        """One stos iteration: [rdi] <- rax/eax/al, rdi advances, rep
+        decrements rcx (the erms memset loop)."""
+        w = _str_width(inst)
+        src, dst = inst.operands
+        if w >= 4:
+            cl = self.pc_cluster.get(pc)
+            self.stats.mem_accesses += 1
+            if cl is None:
+                self.stats.mem_dropped += 1
+                return False
+            self._str_store_reg(src.reg, self._remap_const(cl), w,
+                                self._stos_hi_imm(src.reg, regs))
+        elif not self._subword_store(dst, pc, regs, w, src_reg=src.reg):
+            return False
+        self._inc_strreg(self._RDI, w)
+        if inst.mnemonic.startswith("rep"):
+            self._inc_strreg(self._RCX, -1)
+        return True
+
+    # overridable string-op primitives (Lifter64 widens them to pair lanes)
+    def _str_copy_word(self, sdelta: int, ddelta: int, w: int) -> None:
+        self._emit(U.LOAD, T6, self._RSI, ZERO, sdelta)
+        self._emit(U.STORE, 0, self._RDI, T6, ddelta)
+        if w == 8:
+            # both halves move memory→memory: exact even in the 32-bit
+            # projection, and replay memory stays byte-faithful for later
+            # byte readers (the EVEX mask materialization reads it)
+            self._emit(U.LOAD, T7, self._RSI, ZERO, (sdelta + 4) & M32)
+            self._emit(U.STORE, 0, self._RDI, T7, (ddelta + 4) & M32)
+
+    def _str_store_reg(self, reg: int, ddelta: int, w: int,
+                       hi_imm: int = 0) -> None:
+        self._emit(U.STORE, 0, self._RDI, reg, ddelta)
+        if w == 8:
+            self._emit(U.LUI, T7, ZERO, ZERO, hi_imm)
+            self._emit(U.STORE, 0, self._RDI, T7, (ddelta + 4) & M32)
+
+    def _inc_strreg(self, r: int, v: int) -> None:
+        self._emit(U.ADDI, r, r, ZERO, v & M32)
+
     # -- sub-word (byte/halfword) memory access expansion ------------------
     #
     # The replay µop ISA is word-only (LOAD/STORE trap on addr&3 != 0, the
@@ -895,6 +1155,11 @@ class Lifter:
         ops = inst.operands
         pc = inst.pc
 
+        # --- EVEX strlen chain (vpxorq / vpcmpeqb→k / kmovd / tzcnt) ---
+        handled = self._lift_vec_chain(m, ops, pc, regs)
+        if handled is not None:
+            return handled
+
         # --- scalar-SSE float (xmm low lanes → FADD..FDIV µops) ---
         if any(o.kind == "xmm" for o in ops):
             if self.FP_BASE is None or not getattr(self, "_has_xmm", False):
@@ -902,6 +1167,12 @@ class Lifter:
                 # be unverifiable; demote rather than fail open
                 return False
             return self._lift_fp(m, ops, pc, regs)
+
+        # --- x86 string ops (one captured iteration per step) ---
+        if _is_movs(inst):
+            return self._lift_movs(inst, pc, regs)
+        if _is_stos(inst):
+            return self._lift_stos(inst, pc, regs)
 
         # --- moves ---
         if m in ("mov", "movq", "movl", "movb", "movw", "movabs", "movslq",
@@ -1816,6 +2087,9 @@ class Lifter:
                 self.flags_src = flags_before
                 self._resync_regs(next_full)
                 self.stats.opaque += 1
+                if inst is None or inst.mnemonic == "syscall":
+                    # unknown effects may include vector/k state
+                    self._vec_reset()
                 mn = inst.mnemonic if inst else f"@{pc:x}"
                 self.stats.opaque_mnemonics[mn] = \
                     self.stats.opaque_mnemonics.get(mn, 0) + 1

@@ -34,8 +34,8 @@ from __future__ import annotations
 
 import numpy as np
 
-from shrewd_tpu.ingest.lift import (M32, N_GPR, T0, T1, T2, T3, T4, T5, T7,
-                                    TCMP, ZERO, Inst, Lifter, NativeTrace,
+from shrewd_tpu.ingest.lift import (M32, N_GPR, T0, T1, T2, T3, T4, T5, T6,
+                                    T7, TCMP, ZERO, Inst, Lifter, NativeTrace,
                                     Operand, _JCC_SIGNED, _JCC_UNSIGNED,
                                     read_nativetrace, static_decode)
 from shrewd_tpu.isa import uops as U
@@ -244,6 +244,28 @@ class Lifter64(Lifter):
         return self._emit_guard(4, [4])
 
     # -- the 64-bit handler layer ------------------------------------------
+
+    # -- string-op primitives: pair-lane widening + hi-guards --------------
+    def _inc_strreg(self, r: int, v: int) -> None:
+        self._addi64(r, r, v)
+
+    def _str_copy_word(self, sdelta: int, ddelta: int, w: int) -> None:
+        s = self._emit_guard(self._RSI, [self._RSI])
+        self._emit(U.LOAD, T6, s, ZERO, sdelta)
+        if w == 8:
+            self._emit(U.LOAD, T7, s, ZERO, (sdelta + 4) & M32)
+        d = self._emit_guard(self._RDI, [self._RDI])
+        self._emit(U.STORE, 0, d, T6, ddelta)
+        if w == 8:
+            self._emit(U.STORE, 0, d, T7, (ddelta + 4) & M32)
+
+    def _str_store_reg(self, reg: int, ddelta: int, w: int,
+                       hi_imm: int = 0) -> None:
+        # hi_imm unused: the pair-lane datapath has the live hi lane
+        d = self._emit_guard(self._RDI, [self._RDI])
+        self._emit(U.STORE, 0, d, reg, ddelta)
+        if w == 8:
+            self._emit(U.STORE, 0, d, hi(reg), (ddelta + 4) & M32)
 
     def _lift_one(self, i: int, inst: Inst, regs: np.ndarray,
                   next_regs: np.ndarray, next_pc: int) -> bool:

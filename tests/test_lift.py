@@ -213,3 +213,45 @@ def test_byte_cmp_mem_form_lifts_clean():
     assert st["lift_rate"] > 0.999, st["opaque_mnemonics"]
     assert "cmp" not in st["opaque_mnemonics"]
     assert st["branches_dropped"] == 0
+
+
+def test_string_ops_lift_clean():
+    """rep movsq/movsl and rep stosq/stosl/stosb — the erms memcpy/memset
+    loops glibc leans on (43% of strmix's opaque tail before the string-op
+    handlers) — lift exactly on both datapaths: the 32-bit projection and
+    the pair-lane 64-bit lift with hi-guarded addresses."""
+    from shrewd_tpu.ingest import hostdiff as hd
+    from shrewd_tpu.ingest.lift64 import lift64
+
+    paths = hd.build_tools("workloads/strops.c")
+
+    _trace, meta = hd.capture_and_lift(paths)
+    st = meta["stats"]
+    # residual demotions are 64-bit right shifts (documented projection
+    # limit) — never the string ops themselves
+    assert st["lift_rate"] > 0.95, st["opaque_mnemonics"]
+    assert not any("movs" in m or "stos" in m
+                   for m in st["opaque_mnemonics"]), st["opaque_mnemonics"]
+
+    _trace64, meta64 = hd.capture_and_lift_to_output(paths, lifter=lift64)
+    st64 = meta64["stats"]
+    assert st64["lift_rate"] > 0.98, st64["opaque_mnemonics"]
+    assert not any("movs" in m or "stos" in m
+                   for m in st64["opaque_mnemonics"]), st64["opaque_mnemonics"]
+
+
+def test_evex_strlen_chain_lifts():
+    """The glibc __strlen_evex head (vpxorq zero → mem-form vpcmpeqb→k →
+    kmovd → tzcnt) lifts via symbolic vector tracking with the byte-mask
+    materialized from replay memory — strmix's lift rate rises from 0.70
+    (r4 session 1) to ≥0.93, and the k-mask chain no longer dominates the
+    opaque tail."""
+    from shrewd_tpu.ingest import hostdiff as hd
+
+    paths = hd.build_tools("workloads/strmix.c")
+    _trace, meta = hd.capture_and_lift(paths)
+    st = meta["stats"]
+    assert st["lift_rate"] > 0.93, st["opaque_mnemonics"]
+    assert st["opaque_mnemonics"].get("kmovd", 0) <= 10
+    assert "vpxorq" not in st["opaque_mnemonics"]
+    assert st["opaque_mnemonics"].get("tzcnt", 0) <= 4  # 64-bit forms only

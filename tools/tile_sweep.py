@@ -98,6 +98,10 @@ def main() -> None:
                                    "error": f"{type(e).__name__}: "
                                             f"{str(e)[:300]}"})
             print(f"{label} FAILED: {e}", file=sys.stderr, flush=True)
+        # incremental write: a watchdog self-exit mid-sweep must not
+        # discard the configs already measured
+        with open(args.out, "w") as f:
+            json.dump(doc, f, indent=1)
 
     ok = [c for c in doc["configs"]
           if "trials_per_sec" in c and c["config"] != "xla"]

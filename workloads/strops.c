@@ -1,0 +1,91 @@
+/* x86 string-op workload (lifter-hardening tier).
+ *
+ * Explicit rep movsq/movsl/stosq/stosl/stosb via inline asm — the erms
+ * memcpy/memset loops glibc emits, pinned here so the lifter's string-op
+ * handlers (ingest/lift.py _lift_movs/_lift_stos, pair-lane variants in
+ * ingest/lift64.py) are exercised deterministically regardless of which
+ * path the host libc picks.  Contract as sort.c: kernel_begin/kernel_end
+ * markers, one write(2) checksum at the end.
+ */
+
+#include <unistd.h>
+
+#define N 64
+
+static unsigned long src64[N];
+static unsigned long dst64[N];
+static unsigned int src32[N];
+static unsigned int dst32[N];
+static unsigned char bytes[96];
+
+static unsigned int rng_state = 0x5EEDF00Du;
+static unsigned int xorshift(void) {
+    unsigned int x = rng_state;
+    x ^= x << 13;
+    x ^= x >> 17;
+    x ^= x << 5;
+    rng_state = x;
+    return x;
+}
+
+__attribute__((noinline)) void kernel_begin(void) { __asm__ volatile(""); }
+__attribute__((noinline)) void kernel_end(void)   { __asm__ volatile(""); }
+
+static void rep_movsq(void *dst, const void *srcp, unsigned long n) {
+    __asm__ volatile("rep movsq"
+                     : "+D"(dst), "+S"(srcp), "+c"(n) :: "memory");
+}
+
+static void rep_movsl(void *dst, const void *srcp, unsigned long n) {
+    __asm__ volatile("rep movsl"
+                     : "+D"(dst), "+S"(srcp), "+c"(n) :: "memory");
+}
+
+static void rep_stosq(void *dst, unsigned long v, unsigned long n) {
+    __asm__ volatile("rep stosq" : "+D"(dst), "+c"(n) : "a"(v) : "memory");
+}
+
+static void rep_stosl(void *dst, unsigned int v, unsigned long n) {
+    __asm__ volatile("rep stosl" : "+D"(dst), "+c"(n) : "a"(v) : "memory");
+}
+
+static void rep_stosb(void *dst, unsigned char v, unsigned long n) {
+    __asm__ volatile("rep stosb" : "+D"(dst), "+c"(n) : "a"(v) : "memory");
+}
+
+static void emit_checksum(unsigned int sum) {
+    char buf[16];
+    int i;
+    for (i = 0; i < 8; i++) {
+        unsigned int nib = (sum >> (28 - 4 * i)) & 0xF;
+        buf[i] = (char)(nib < 10 ? '0' + nib : 'a' + nib - 10);
+    }
+    buf[8] = '\n';
+    write(1, buf, 9);
+}
+
+int main(void) {
+    unsigned int i, sum = 0;
+    for (i = 0; i < N; i++) {
+        src64[i] = ((unsigned long)xorshift() << 32) | xorshift();
+        src32[i] = xorshift();
+    }
+
+    kernel_begin();
+    rep_movsq(dst64, src64, N);                /* qword copy */
+    rep_movsl(dst32, src32, N);                /* dword copy */
+    rep_stosq(src64, 0x0123456789abcdefUL, N / 2);  /* qword fill */
+    rep_stosl(src32, 0xCAFEBABEu, N / 2);      /* dword fill */
+    rep_stosb(bytes, 0x5A, sizeof(bytes));     /* byte fill (erms) */
+    for (i = 0; i < N; i++) {
+        sum = sum * 31u + (unsigned int)dst64[i]
+            + (unsigned int)(dst64[i] >> 32) + dst32[i]
+            + (unsigned int)src64[i] + src32[i];
+    }
+    for (i = 0; i < sizeof(bytes); i++)
+        sum = sum * 31u + bytes[i];
+    kernel_end();
+
+    emit_checksum(sum);
+    return 0;
+}
