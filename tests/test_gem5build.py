@@ -119,3 +119,66 @@ class TestConf:
         for key in ("USE_SYSTEMC", "BUILD_GPU", "HAVE_PROTOBUF",
                     "BUILD_TLM", "KVM_ISA", "USE_EFENCE"):
             assert key in conf
+
+
+class TestGoldenCampaignPatch:
+    """The m5.cpt GPR patcher, against a synthetic checkpoint in the
+    reference's serialization shape (regs.<class> flattened byte arrays,
+    src/cpu/thread_context.cc:194-216)."""
+
+    CPT = (
+        "[Globals]\n"
+        "curTick=1000\n"
+        "\n"
+        "[system.cpu.xc.0]\n"
+        "regs.integer=" + " ".join(
+            str((r * 17 + b) % 256) for r in range(18) for b in range(8))
+        + "\n"
+        "regs.floating_point=0 0 0 0\n"
+        "_pc=4198400\n"
+        "\n"
+        "[system.mem_ctrl]\n"
+        "range_size=536870912\n"
+    )
+
+    def _mod(self):
+        import golden_campaign as gc
+        return gc
+
+    def test_find_intregs(self):
+        gc = self._mod()
+        (start, end), vals = gc.find_intregs(self.CPT)
+        assert len(vals) == 18 * 8
+        assert self.CPT[start:end].startswith("regs.integer=")
+        assert vals[0] == "0" and vals[8] == "17"  # (r*17+b) % 256 fill
+
+    def test_patch_flips_exactly_one_bit(self, tmp_path):
+        gc = self._mod()
+        src = tmp_path / "ckpt"
+        src.mkdir()
+        (src / "m5.cpt").write_text(self.CPT)
+        dst = tmp_path / "patched"
+        gc.prepare_patch_dir(str(src), str(dst))
+        for reg, bit in ((0, 0), (7, 33), (15, 63)):
+            gc.patch_cpt(self.CPT, str(dst), reg, bit)
+            text = (dst / "m5.cpt").read_text()
+            (_, vals0) = gc.find_intregs(self.CPT)[0], \
+                gc.find_intregs(self.CPT)[1]
+            (_, vals1) = gc.find_intregs(text)[0], gc.find_intregs(text)[1]
+            diffs = [i for i, (a, b) in enumerate(zip(vals0, vals1))
+                     if a != b]
+            assert diffs == [reg * 8 + bit // 8]
+            delta = int(vals0[diffs[0]]) ^ int(vals1[diffs[0]])
+            assert delta == 1 << (bit % 8)
+            # everything outside the key line is untouched
+            assert text.split("regs.integer=")[0] == \
+                self.CPT.split("regs.integer=")[0]
+            assert text.split("\nregs.floating_point=")[1] == \
+                self.CPT.split("\nregs.floating_point=")[1]
+
+    def test_last_section_checkpoint(self):
+        gc = self._mod()
+        cpt = ("[system.cpu.xc.0]\n"
+               "regs.integer=" + " ".join(["5"] * 128) + "\n")
+        (_s, _e), vals = gc.find_intregs(cpt)
+        assert len(vals) == 128
