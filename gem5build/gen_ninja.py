@@ -49,10 +49,8 @@ EXT_LIBS = {
         "srcs": [os.path.join(REF, "ext/iostream3/zfstream.cc")],
         "inc": [os.path.join(REF, "ext/iostream3")],
     },
-    "softfloat": {
-        "srcs": [os.path.join(REF, "ext/softfloat/*.c")],
-        "inc": [os.path.join(REF, "ext/softfloat")],
-    },
+    # softfloat deliberately absent: only the RISC-V ISA consumes it, and
+    # its build needs the SConscript's specialization defines
     "fdt": {
         "srcs": [os.path.join(REF, "ext/libfdt/*.c")],
         "inc": [os.path.join(REF, "ext/libfdt")],

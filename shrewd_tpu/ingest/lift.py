@@ -780,23 +780,42 @@ class Lifter:
     # unrecognized vector/k write invalidates the touched state
     # (fail-closed: unknown k at kmovd demotes exactly as before).
 
-    class _KMask(NamedTuple):
-        pc: int            # the vpcmpeqb pc (cluster binding key)
+    class _VRegion(NamedTuple):
+        pc: int            # the referencing instruction (cluster key)
         base: int          # address base register (canonical index)
-        base_val: int      # captured base value at compare time (low 32)
+        base_val: int      # captured base value at reference time (low 32)
         disp: int
+
+    class _KMask(NamedTuple):
+        regions: tuple     # _VRegion tuple; mask bit b = OR over regions
         width: int         # compared bytes (ymm: 32)
+
+    class _KConcat(NamedTuple):
+        lo: "Lifter._KMask"    # bits [0,32)  (kunpckdq src2)
+        hi: "Lifter._KMask"    # bits [32,64) (kunpckdq src1)
 
     def _vec_state(self):
         if not hasattr(self, "_vzero"):
             self._vzero: set[int] = set()
             self._kmask: dict[int, Lifter._KMask | None] = {}
+            # vector regs holding loaded/min-combined byte blocks: reg ->
+            # (regions tuple, width); min(a,b)==0 iff a==0 or b==0, so a
+            # vpminub chain is exactly a region-set union for the later
+            # ==0 compare
+            self._vreg: dict[int, tuple] = {}
         return self._vzero, self._kmask
 
     def _vec_reset(self) -> None:
         if hasattr(self, "_vzero"):
             self._vzero.clear()
             self._kmask.clear()
+            self._vreg.clear()
+
+    def _vregion_of(self, mem: "Operand", pc: int, regs: np.ndarray):
+        if mem.base < 0 or mem.index >= 0 or mem.rip_rel or mem.seg:
+            return None
+        return self._VRegion(pc, mem.base, int(regs[mem.base]) & M32,
+                             mem.disp)
 
     def _lift_vec_chain(self, m: str, ops: list, pc: int,
                         regs: np.ndarray):
@@ -806,11 +825,16 @@ class Lifter:
         if not touches_vec and m not in ("tzcnt",):
             return None
         vzero, kmask = self._vec_state()
+        vreg = self._vreg
         # conservative pre-invalidation of the destination (AT&T: last op)
-        if touches_vec and ops:
+        # — except flags-only instructions (last operand is a source) and
+        # kunpck, whose dst may alias a source (its handler re-writes it)
+        if touches_vec and ops and not m.startswith(("kortest", "ktest",
+                                                     "vptest", "kunpck")):
             d = ops[-1]
             if d.kind == "xmm":
                 vzero.discard(d.reg)
+                vreg.pop(d.reg, None)
             elif d.kind == "kreg":
                 kmask[d.reg] = None
 
@@ -828,15 +852,49 @@ class Lifter:
                 return None
             return True                      # architecturally GPR-silent
 
+        if m in ("vmovdqa64", "vmovdqu64", "vmovdqa", "vmovdqu") \
+                and len(ops) == 2 and ops[0].kind == "mem" \
+                and ops[1].kind == "xmm":
+            r = self._vregion_of(ops[0], pc, regs)
+            if r is not None:
+                vreg[ops[1].reg] = ((r,), abs(ops[1].width) // 8)
+                return True                  # GPR-silent block load
+            return False
+
+        if m in ("vpminub",) and len(ops) == 3 and ops[2].kind == "xmm":
+            # unsigned byte min: min(a,b)==0 iff a==0 or b==0 — the ==0
+            # compare downstream sees the union of the source regions
+            a, b, d = ops
+            regions = []
+            for o in (a, b):
+                if o.kind == "mem":
+                    r = self._vregion_of(o, pc, regs)
+                    if r is None:
+                        return False
+                    regions.append(r)
+                elif o.kind == "xmm" and o.reg in vreg:
+                    regions.extend(vreg[o.reg][0])
+                else:
+                    return False
+            if len(regions) > 4:
+                return False
+            vreg[d.reg] = (tuple(regions), abs(d.width) // 8)
+            return True
+
         if m in ("vpcmpeqb",) and len(ops) == 3 \
-                and ops[0].kind == "mem" and ops[1].kind == "xmm" \
-                and ops[2].kind == "kreg":
-            mem, z, k = ops
-            if (z.reg in vzero and mem.base >= 0 and mem.index < 0
-                    and not mem.rip_rel and not mem.seg):
-                kmask[k.reg] = self._KMask(
-                    pc, mem.base, int(regs[mem.base]) & M32, mem.disp,
-                    abs(z.width) // 8)
+                and ops[1].kind == "xmm" and ops[2].kind == "kreg":
+            src, z, k = ops
+            if z.reg not in vzero:
+                return False
+            w = abs(z.width) // 8
+            if src.kind == "mem":
+                r = self._vregion_of(src, pc, regs)
+                if r is not None:
+                    kmask[k.reg] = self._KMask((r,), w)
+                    return True
+                return False
+            if src.kind == "xmm" and src.reg in vreg:
+                kmask[k.reg] = self._KMask(vreg[src.reg][0], w)
                 return True
             return False                     # unknown compare → opaque
 
@@ -844,11 +902,54 @@ class Lifter:
                 and ops[1].kind == "reg" and ops[1].reg >= 0:
             st = kmask.get(ops[0].reg)
             dst = ops[1].reg
-            if st is None or dst == st.base \
-                    or (int(regs[st.base]) & M32) != st.base_val \
-                    or st.width > 32:
+            if not isinstance(st, self._KMask) or st.width > 32 \
+                    or not self._kmask_live(st, dst, regs):
                 return False
-            return self._materialize_kmask(st, dst)
+            return self._materialize_kmask(st, dst, regs)
+
+        if m in ("kunpckdq",) and len(ops) == 3 \
+                and all(o.kind == "kreg" for o in ops):
+            # AT&T (src2, src1, dst): dst[31:0]=src2, dst[63:32]=src1
+            lo_st, hi_st = kmask.get(ops[0].reg), kmask.get(ops[1].reg)
+            if not isinstance(lo_st, self._KMask) \
+                    or not isinstance(hi_st, self._KMask):
+                kmask[ops[2].reg] = None
+                return False
+            kmask[ops[2].reg] = self._KConcat(lo_st, hi_st)
+            return True                      # GPR-silent
+
+        if m in ("kmovq",) and len(ops) == 2 and ops[0].kind == "kreg" \
+                and ops[1].kind == "reg" and ops[1].reg >= 0:
+            st = kmask.get(ops[0].reg)
+            dst = ops[1].reg
+            if isinstance(st, self._KConcat):
+                # 32-bit projection: only the low half is tracked (the
+                # pair-lane lifter overrides with the hi lane too)
+                if not self._kmask_live(st.lo, dst, regs):
+                    return False
+                return self._materialize_kmask(st.lo, dst, regs)
+            if st is not None and st.width <= 32 \
+                    and self._kmask_live(st, dst, regs):
+                return self._materialize_kmask(st, dst, regs)
+            return False
+
+        if m in ("kortestd",) and len(ops) == 2 \
+                and all(o.kind == "kreg" for o in ops):
+            # flags = (k0 | k1) == 0; OR of masks = union of regions.
+            # No GPR is written, so the register self-check cannot vet
+            # this — the BRANCH self-check (captured direction vs lifted
+            # condition) is the net instead.
+            sts = [kmask.get(o.reg) for o in ops]
+            if any(not isinstance(s, self._KMask) or s.width > 32
+                   or not self._kmask_live(s, TCMP, regs) for s in sts):
+                return False
+            merged = self._KMask(sts[0].regions + sts[1].regions,
+                                 max(s.width for s in sts))
+            if len(merged.regions) > 8 \
+                    or not self._materialize_kmask(merged, TCMP, regs):
+                return False
+            self.flags_src = ("res", TCMP)
+            return True
 
         if m == "tzcnt" and len(ops) == 2 \
                 and all(o.kind == "reg" and o.reg >= 0
@@ -861,37 +962,61 @@ class Lifter:
         # path) still see the instruction; state was already invalidated
         return None
 
-    def _materialize_kmask(self, st: "_KMask", dst: int) -> bool:
-        """dst = bitmask over st.width bytes at [base+disp]: bit b set iff
-        byte b == 0 — the vpcmpeqb-vs-zero result, recomputed from replay
-        memory so corrupted string bytes reach the mask."""
-        cl = self.pc_cluster.get(st.pc)
-        self.stats.mem_accesses += 1
-        if cl is None:
-            self.stats.mem_dropped += 1
-            return False
-        # cost note: ~11 µops/byte (354 per 32-byte kmovd).  Bounded in
-        # practice — strmix's 59 materializations ≈ 21k µops, under 5% of
-        # the largest lifted windows — and every µop is validated by the
-        # register self-check, so the simple per-byte form is kept over a
-        # load-each-word-once variant (~22% fewer µops, more edge cases).
-        delta = (st.disp + self._remap_const(cl)) & M32
+    def _kmask_live(self, st: "_KMask", dst: int, regs: np.ndarray) -> bool:
+        """The materialization addresses through the live base registers,
+        so none may be the destination.  A base that moved since the
+        compare (the strlen 4× loop bumps rdi before kortest) is fine —
+        the golden drift folds into the displacement."""
+        return all(r.base != dst for r in st.regions)
+
+    def _materialize_kmask(self, st: "_KMask", dst: int,
+                           regs: np.ndarray) -> bool:
+        """dst = bitmask over st.width bytes: bit b set iff byte b == 0 in
+        ANY region (single region: the vpcmpeqb-vs-zero result; several:
+        the vpminub-combined compare) — recomputed from replay memory so
+        corrupted string bytes reach the mask."""
+        deltas = []
+        for r in st.regions:
+            cl = self.pc_cluster.get(r.pc)
+            self.stats.mem_accesses += 1
+            if cl is None:
+                self.stats.mem_dropped += 1
+                return False
+            # golden drift of the base register since the compare: on the
+            # golden path base_now + (disp − drift) == base_then + disp;
+            # off-path a corrupted base shifts the window, as on hardware
+            drift = (int(regs[r.base]) - r.base_val) & M32
+            deltas.append((r, (r.disp + self._remap_const(cl) - drift)
+                           & M32))
+        # cost note: ~11 µops/byte/region (354 per 32-byte single-region
+        # kmovd).  Bounded in practice — strmix's materializations total
+        # ≈ 25k µops, a few % of the largest lifted windows — and every
+        # µop is validated by the register self-check, so the simple
+        # per-byte form is kept over a load-each-word-once variant (~22%
+        # fewer µops, more edge cases).
         self._emit(U.LUI, dst, ZERO, ZERO, 0)
         self._emit(U.ADDI, T3, ZERO, ZERO, 3)         # byte→bit shift ×8
         for i in range(st.width):
-            # string pointers are NOT word-aligned: per-byte address with
-            # an aligned word load + dynamic in-word shift
-            self._emit(U.ADDI, T2, st.base, ZERO, (delta + i) & M32)
-            self._emit(U.ANDI, T6, T2, ZERO, (~3) & M32)
-            self._emit(U.LOAD, T6, T6, ZERO, 0)
-            self._emit(U.ANDI, T4, T2, ZERO, 3)
-            self._emit(U.SLL, T4, T4, T3)
-            self._emit(U.SRL, T5, T6, T4)
-            self._emit(U.ANDI, T5, T5, ZERO, 0xFF)
-            self._emit(U.SLTU, T5, ZERO, T5)
-            self._emit(U.XORI, T5, T5, ZERO, 1)
+            first = True
+            for r, delta in deltas:
+                # pointers are NOT word-aligned: per-byte address with an
+                # aligned word load + dynamic in-word shift
+                self._emit(U.ADDI, T2, r.base, ZERO, (delta + i) & M32)
+                self._emit(U.ANDI, T6, T2, ZERO, (~3) & M32)
+                self._emit(U.LOAD, T6, T6, ZERO, 0)
+                self._emit(U.ANDI, T4, T2, ZERO, 3)
+                self._emit(U.SLL, T4, T4, T3)
+                self._emit(U.SRL, T5, T6, T4)
+                self._emit(U.ANDI, T5, T5, ZERO, 0xFF)
+                self._emit(U.SLTU, T5, ZERO, T5)
+                self._emit(U.XORI, T5, T5, ZERO, 1)
+                if first:
+                    self._emit(U.ADD, T7, T5, ZERO)
+                    first = False
+                else:
+                    self._emit(U.OR, T7, T7, T5)
             self._emit(U.ADDI, T4, ZERO, ZERO, i)
-            self._emit(U.SLL, T5, T5, T4)
+            self._emit(U.SLL, T5, T7, T4)
             self._emit(U.OR, dst, dst, T5)
         return True
 

@@ -245,6 +245,45 @@ class Lifter64(Lifter):
 
     # -- the 64-bit handler layer ------------------------------------------
 
+    # -- EVEX chain: pair-lane kmovq + 64-bit tzcnt ------------------------
+    def _lift_vec_chain(self, m, ops, pc, regs):
+        if m == "kmovq" and len(ops) == 2 and ops[0].kind == "kreg" \
+                and ops[1].kind == "reg" and ops[1].reg >= 0:
+            _, kmask = self._vec_state()
+            st = kmask.get(ops[0].reg)
+            dst = ops[1].reg
+            if isinstance(st, self._KConcat):
+                if not (self._kmask_live(st.lo, dst, regs)
+                        and self._kmask_live(st.hi, dst, regs)):
+                    return False
+                return (self._materialize_kmask(st.lo, dst, regs)
+                        and self._materialize_kmask(st.hi, hi(dst), regs))
+            if isinstance(st, self._KMask) and st.width <= 32 \
+                    and self._kmask_live(st, dst, regs):
+                if not self._materialize_kmask(st, dst, regs):
+                    return False
+                self._emit(U.LUI, hi(dst), ZERO, ZERO, 0)
+                return True
+            return False
+        if m == "tzcnt" and len(ops) == 2 \
+                and all(o.kind == "reg" and o.reg >= 0
+                        and abs(o.width) == 64 for o in ops):
+            src, dst = ops[0].reg, ops[1].reg
+            # ctz64 = ctz32(lo) + (lo==0 ? ctz32(hi) : 0) — ctz32 already
+            # returns 32 for a zero input, so the sum is 64 for src==0
+            self._emit_ctz32(src, T0)
+            self._emit_ctz32(hi(src), T1)
+            self._emit(U.ADDI, T2, ZERO, ZERO, 5)
+            self._emit(U.SRL, T2, T0, T2)            # 1 iff ctz_lo == 32
+            self._emit(U.ANDI, T2, T2, ZERO, 1)
+            self._emit(U.SUB, T3, ZERO, T2)          # 0 or all-ones
+            self._emit(U.AND, T3, T1, T3)
+            self._emit(U.ADD, dst, T0, T3)
+            self._emit(U.LUI, hi(dst), ZERO, ZERO, 0)
+            self.flags_src = ("res64", dst)
+            return True
+        return super()._lift_vec_chain(m, ops, pc, regs)
+
     # -- string-op primitives: pair-lane widening + hi-guards --------------
     def _inc_strreg(self, r: int, v: int) -> None:
         self._addi64(r, r, v)
