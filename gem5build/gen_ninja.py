@@ -5,7 +5,8 @@ binary takes every collected source (reference src/SConscript:728
 ``Gem5('gem5', with_any_tags('gem5 lib', 'main'))`` — all Source()
 declarations carry 'gem5 lib' by default) plus the ext libraries the
 reference links statically (libelf/fputils/iostream3/softfloat/libfdt/
-drampower/nomali, reference ext/*/SConscript).
+drampower/nomali, reference ext/*/SConscript; softfloat is deliberately excluded — see
+EXT_LIBS).
 
 Build style follows the reference's gem5.opt: -O2 single-job here instead
 of -O3 (1-core host; the golden campaign is about fidelity, not speed),

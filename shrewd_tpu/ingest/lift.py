@@ -830,7 +830,7 @@ class Lifter:
         # — except flags-only instructions (last operand is a source) and
         # kunpck, whose dst may alias a source (its handler re-writes it)
         if touches_vec and ops and not m.startswith(("kortest", "ktest",
-                                                     "vptest", "kunpck")):
+                                                     "vptest", "kunpckdq")):
             d = ops[-1]
             if d.kind == "xmm":
                 vzero.discard(d.reg)
@@ -943,8 +943,13 @@ class Lifter:
             if any(not isinstance(s, self._KMask) or s.width > 32
                    or not self._kmask_live(s, TCMP, regs) for s in sts):
                 return False
+            if sts[0].width != sts[1].width:
+                # differing compare widths: the narrower mask's high bits
+                # are architecturally zero, but a region-union would
+                # materialize phantom byte-compares there — demote
+                return False
             merged = self._KMask(sts[0].regions + sts[1].regions,
-                                 max(s.width for s in sts))
+                                 sts[0].width)
             if len(merged.regions) > 8 \
                     or not self._materialize_kmask(merged, TCMP, regs):
                 return False

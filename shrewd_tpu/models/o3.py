@@ -134,10 +134,11 @@ class O3Config(ConfigObject):
     # only; "on" forces it (interpret mode off-TPU, for tests); "off" keeps
     # the XLA taint kernel.
     pallas = Param(str, "auto", check=lambda s: s in ("auto", "on", "off"))
-    # trials per Pallas grid block (lane-tile width).  512 is the r3
-    # measured best; the on-chip sweep tool (tools/tile_sweep.py) measures
+    # trials per Pallas grid block (lane-tile width).  1024 is the round-4
+    # on-chip sweep winner (TILE_SWEEP_r04.json: 58.1k trials/s vs 53.1k at
+    # 512, tallies bit-identical); tools/tile_sweep.py re-measures
     # alternatives and this param applies the winner without code changes.
-    pallas_b_tile = Param(int, 512,
+    pallas_b_tile = Param(int, 1024,
                           check=lambda v: v >= 128 and v % 128 == 0)
     # SHREWD controls (reference enableShrewd/priorityToShadow params,
     # src/cpu/o3/BaseO3CPU.py:226-227; runtime pybind setters cpu.hh:298-302
