@@ -58,7 +58,7 @@ EXT_LIBS = {
     },
     "drampower": {
         "srcs": [os.path.join(REF, "ext/drampower/src/*.cc"),
-                 os.path.join(REF, "ext/drampower/src/common/*.cc")],
+                 os.path.join(REF, "ext/drampower/src/libdrampower/*.cc")],
         "inc": [os.path.join(REF, "ext/drampower/src")],
     },
     "nomali": {

@@ -60,6 +60,11 @@ KIND_LATCH_IMM = 9
 KIND_NAMES = ["none", "regfile", "fu", "rob_dst", "iq_src1", "iq_src2",
               "lsq_addr", "lsq_data", "latch_op", "latch_imm"]
 
+# Pallas golden-stream SMEM block width (single source of truth: the
+# kernel's S_CHUNK and the pallas_u_steps config check both read this —
+# o3.py cannot import ops.pallas_taint, which imports this module).
+PALLAS_S_CHUNK = 128
+
 # structure name → kinds drawn for it
 STRUCTURES = {
     "regfile": (KIND_REGFILE,),
@@ -140,6 +145,15 @@ class O3Config(ConfigObject):
     # alternatives and this param applies the winner without code changes.
     pallas_b_tile = Param(int, 1024,
                           check=lambda v: v >= 128 and v % 128 == 0)
+    # µops unrolled per sequential grid step (state carried in registers,
+    # scratch written once per group): amortizes the per-grid-step overhead
+    # that dominates at small per-step work.  Must divide PALLAS_S_CHUNK.
+    # 2 is the round-4 on-chip winner (UNROLL_SWEEP_r04.json: 59.8k
+    # trials/s vs 54.8k at 1; u=4 equal within noise, u=8 blew up the
+    # Mosaic compile >28 min and was abandoned by the sweep watchdog).
+    pallas_u_steps = Param(int, 2,
+                           check=lambda v: v >= 1
+                           and PALLAS_S_CHUNK % v == 0)
     # SHREWD controls (reference enableShrewd/priorityToShadow params,
     # src/cpu/o3/BaseO3CPU.py:226-227; runtime pybind setters cpu.hh:298-302
     # — here TrialKernel.with_shrewd rebuilds the kernel instead of mutating).

@@ -34,7 +34,8 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from shrewd_tpu.isa import uops as U
-from shrewd_tpu.models.o3 import (Fault, KIND_FU, KIND_IQ_SRC1, KIND_IQ_SRC2,
+from shrewd_tpu.models.o3 import (PALLAS_S_CHUNK, Fault, KIND_FU,
+                                  KIND_IQ_SRC1, KIND_IQ_SRC2,
                                   KIND_LATCH_IMM, KIND_LATCH_OP,
                                   KIND_LSQ_ADDR, KIND_LSQ_DATA, KIND_REGFILE,
                                   KIND_ROB_DST)
@@ -46,7 +47,8 @@ i32 = jnp.int32
 u32 = jnp.uint32
 
 LANE = 128          # TPU lane width; B_TILE and n must be multiples
-S_CHUNK = 128       # per-step golden streams arrive in (15, S_CHUNK) SMEM
+S_CHUNK = PALLAS_S_CHUNK
+                    # per-step golden streams arrive in (15, S_CHUNK) SMEM
                     # blocks: the lowering block-shape check requires the
                     # last dim divisible by 128 (a (15, 1) block is
                     # rejected), and SMEM scalar reads take dynamic column
@@ -200,18 +202,26 @@ def _alu_vec(op, a, b, imm):
     return out
 
 
-def _make_kernel(n: int, k: int, nphys: int, mem_words: int, may_latch: bool):
-    """Grid-over-steps kernel: grid = (lane_tiles, n) with the step (µop)
-    axis as the LAST, sequential ("arbitrary") grid dimension — the Pallas
-    pipeline delivers the golden scalars as (15, S_CHUNK)/(1, S_CHUNK) SMEM
-    blocks and each step reads its column as SMEM scalars (dynamic SMEM
-    column indices are fine; it was dynamic *lane-dim VMEM* loads that
-    Mosaic rejected, and a 4096-step ``fori_loop`` with this body either
-    hung or crashed the Mosaic pass — VERDICT r2 weak #1).
+def _make_kernel(n: int, k: int, nphys: int, mem_words: int, may_latch: bool,
+                 u_steps: int = 1):
+    """Grid-over-steps kernel: grid = (lane_tiles, ceil(n/u_steps)) with the
+    step (µop) axis as the LAST, sequential ("arbitrary") grid dimension —
+    the Pallas pipeline delivers the golden scalars as
+    (15, S_CHUNK)/(1, S_CHUNK) SMEM blocks and each step reads its column
+    as SMEM scalars (dynamic SMEM column indices are fine; it was dynamic
+    *lane-dim VMEM* loads that Mosaic rejected, and a 4096-step
+    ``fori_loop`` with this body either hung or crashed the Mosaic pass —
+    VERDICT r2 weak #1).
+    ``u_steps`` µops are unrolled inside one grid step (state carried in
+    registers, scratch written once per grid step) to amortize the
+    per-grid-step overhead; over-run columns past n are zero-padded and
+    NOP (=0) columns are provably inert in every path (no write enables,
+    no mem/branch/div class, golden write flags 0).
     Deviation sets and outcome masks persist across steps in VMEM scratch;
-    outputs are flushed on the final step of each lane tile."""
+    outputs are flushed on the final grid step of each lane tile."""
     idx_mask = nphys - 1          # python ints: no captured traced constants
     EMPTY_C = -1
+    n_blocks = -(-n // u_steps)
 
     def kernel(sv_s, sc_s,
                kind_r, cycle_r, entry_r, bit_r, su_r, gaf_r, alt1_r, alt2_r,
@@ -222,8 +232,7 @@ def _make_kernel(n: int, k: int, nphys: int, mem_words: int, may_latch: bool):
         # crashes on rank-1 vectors (layout.h implicit-dim check), and
         # (1, B) broadcasts cleanly against the (k, B) sets.
         B = kind_r.shape[1]
-        i = pl.program_id(1)
-        j = i % S_CHUNK               # column inside the current SMEM block
+        blk = pl.program_id(1)
         kind = kind_r[...]
         cycle = cycle_r[...]
         entry = entry_r[...]
@@ -236,7 +245,7 @@ def _make_kernel(n: int, k: int, nphys: int, mem_words: int, may_latch: bool):
         index_mask = i32(1) << bit
         iota = jax.lax.broadcasted_iota(i32, (k, B), 0)
 
-        @pl.when(i == 0)
+        @pl.when(blk == 0)
         def _init():
             tags_sc[...] = jnp.full((k, B), EMPTY_C, dtype=i32)
             vals_sc[...] = jnp.zeros((k, B), dtype=i32)
@@ -272,170 +281,200 @@ def _make_kernel(n: int, k: int, nphys: int, mem_words: int, may_latch: bool):
         def remove(tags, tag, en):
             return jnp.where((tags == tag) & en, EMPTY_C, tags)
 
-        # per-step golden scalars (column j of the (15, S_CHUNK) SMEM
-        # block; ordering matches the sv stack in taint_fast_pallas)
+        # carried state: read scratch once per grid step, write once at the
+        # end of the unrolled group
         tags = tags_sc[...]
         vals = vals_sc[...]
-        live = live_sc[...] != 0
+        live0 = live_sc[...] != 0
         det_i = det_sc[...]
         trap_i = trap_sc[...]
         div_i = div_sc[...]
         esc_i = esc_sc[...]
         ovf_i = ovf_sc[...]
-        op0 = sv_s[0, j]
-        dstr = sv_s[1, j]
-        s1 = sv_s[2, j]
-        s2 = sv_s[3, j]
-        imm0 = sv_s[4, j]
-        tk = sv_s[5, j]
-        g_a = sv_s[6, j]
-        g_b = sv_s[7, j]
-        g_ea = sv_s[8, j]
-        g_res = sv_s[9, j]
-        g_st_old = sv_s[10, j]
-        g_dst_old = sv_s[11, j]
-        g_wr = sv_s[12, j] != 0
-        g_ld = sv_s[13, j] != 0
-        g_st = sv_s[14, j] != 0
-        sc = sc_s[0, j]
+        carry = (tags, vals, live0, det_i, trap_i, div_i, esc_i, ovf_i)
 
-        at_uop = entry == i
+        def one_step(carry, i, j):
+            """One µop step: i = µop index (traced scalar), j = column
+            inside the current SMEM block."""
+            tags, vals, live, det_i, trap_i, div_i, esc_i, ovf_i = carry
+            # per-step golden scalars (column j of the (15, S_CHUNK) SMEM
+            # block; ordering matches the sv stack in taint_fast_pallas)
+            op0 = sv_s[0, j]
+            dstr = sv_s[1, j]
+            s1 = sv_s[2, j]
+            s2 = sv_s[3, j]
+            imm0 = sv_s[4, j]
+            tk = sv_s[5, j]
+            g_a = sv_s[6, j]
+            g_b = sv_s[7, j]
+            g_ea = sv_s[8, j]
+            g_res = sv_s[9, j]
+            g_st_old = sv_s[10, j]
+            g_dst_old = sv_s[11, j]
+            g_wr = sv_s[12, j] != 0
+            g_ld = sv_s[13, j] != 0
+            g_st = sv_s[14, j] != 0
+            sc = sc_s[0, j]
 
-        # 1. REGFILE landing
-        flip = (kind == KIND_REGFILE) & (cycle == i) & live
-        ftag = entry & idx_mask
-        f0, v0 = lookup(tags, vals, ftag)
-        content0 = jnp.where(f0, v0, gold_at_fault)
-        tags, vals, o0 = upsert(tags, vals, ftag, content0 ^ bitmask, flip)
+            at_uop = entry == i
+            if n % u_steps:
+                # phantom over-run steps (i >= n): golden columns are inert
+                # zeros, but fault coordinates can still land there — the
+                # minor-latch sampler draws cycle/entry in [0, n+n_latches)
+                # (models/minor.py), and a LATCH_OP firing on a NOP column
+                # would fabricate a real opcode.  The XLA kernel runs
+                # exactly n steps, so mask to match it bit-for-bit.
+                at_uop = at_uop & (i < n)
 
-        # 2. operand read
-        if may_latch:
-            opv = jnp.full((1, B), op0, dtype=i32) ^ jnp.where(
-                (kind == KIND_LATCH_OP) & at_uop, index_mask, i32(0))
-            illegal = ((opv >= i32(U.N_OPCODES)) | (opv < 0)) & live
-            opv = jnp.clip(opv, 0, U.N_OPCODES - 1)
-        else:
-            opv = None
-            illegal = jnp.zeros((1, B), dtype=jnp.bool_)
-        immv = jnp.full((1, B), imm0, dtype=i32) ^ jnp.where(
-            (kind == KIND_LATCH_IMM) & at_uop, bitmask, i32(0))
-        iq1 = (kind == KIND_IQ_SRC1) & at_uop
-        iq2 = (kind == KIND_IQ_SRC2) & at_uop
-        tag1 = jnp.where(iq1, (s1 ^ index_mask) & idx_mask,
-                         jnp.full((1, B), s1, dtype=i32))
-        tag2 = jnp.where(iq2, (s2 ^ index_mask) & idx_mask,
-                         jnp.full((1, B), s2, dtype=i32))
-        f1, v1 = lookup(tags, vals, tag1)
-        f2, v2 = lookup(tags, vals, tag2)
-        a = jnp.where(f1, v1, jnp.where(iq1, alt1, g_a))
-        b = jnp.where(f2, v2, jnp.where(iq2, alt2, g_b))
+            # 1. REGFILE landing
+            flip = (kind == KIND_REGFILE) & (cycle == i) & live
+            if n % u_steps:
+                flip = flip & (i < n)
+            ftag = entry & idx_mask
+            f0, v0 = lookup(tags, vals, ftag)
+            content0 = jnp.where(f0, v0, gold_at_fault)
+            tags, vals, o0 = upsert(tags, vals, ftag, content0 ^ bitmask, flip)
 
-        # 3. execute
-        if may_latch:
-            raw = _alu_vec(opv, a, b, immv)
-            is_ld = opv == U.LOAD
-            is_st = opv == U.STORE
-            is_br = (opv >= U.BEQ) & (opv <= U.BGE)
-            writes_op = (((opv >= U.ADD) & (opv <= U.REMU))
-                             | ((opv >= U.FADD) & (opv <= U.MULHU)))
-            is_div_s = (opv == U.DIV) | (opv == U.REM)
-            is_div_u = (opv == U.DIVU) | (opv == U.REMU)
-        else:
-            raw = _alu_switch(op0, a, b, immv)
-            is_ld = jnp.full((1, B), op0 == U.LOAD)
-            is_st = jnp.full((1, B), op0 == U.STORE)
-            is_br = jnp.full((1, B), (op0 >= U.BEQ) & (op0 <= U.BGE))
-            writes_op = jnp.full((1, B), ((op0 >= U.ADD) & (op0 <= U.REMU))
-                                 | ((op0 >= U.FADD) & (op0 <= U.MULHU)))
-            is_div_s = jnp.full((1, B), (op0 == U.DIV) | (op0 == U.REM))
-            is_div_u = jnp.full((1, B), (op0 == U.DIVU)
-                                | (op0 == U.REMU))
-        fu_here = (kind == KIND_FU) & at_uop
-        eff = raw ^ jnp.where(fu_here, bitmask, i32(0))
-        det_now = fu_here & live & (shadow_u < sc)
+            # 2. operand read
+            if may_latch:
+                opv = jnp.full((1, B), op0, dtype=i32) ^ jnp.where(
+                    (kind == KIND_LATCH_OP) & at_uop, index_mask, i32(0))
+                illegal = ((opv >= i32(U.N_OPCODES)) | (opv < 0)) & live
+                opv = jnp.clip(opv, 0, U.N_OPCODES - 1)
+            else:
+                opv = None
+                illegal = jnp.zeros((1, B), dtype=jnp.bool_)
+            immv = jnp.full((1, B), imm0, dtype=i32) ^ jnp.where(
+                (kind == KIND_LATCH_IMM) & at_uop, bitmask, i32(0))
+            iq1 = (kind == KIND_IQ_SRC1) & at_uop
+            iq2 = (kind == KIND_IQ_SRC2) & at_uop
+            tag1 = jnp.where(iq1, (s1 ^ index_mask) & idx_mask,
+                             jnp.full((1, B), s1, dtype=i32))
+            tag2 = jnp.where(iq2, (s2 ^ index_mask) & idx_mask,
+                             jnp.full((1, B), s2, dtype=i32))
+            f1, v1 = lookup(tags, vals, tag1)
+            f2, v2 = lookup(tags, vals, tag2)
+            a = jnp.where(f1, v1, jnp.where(iq1, alt1, g_a))
+            b = jnp.where(f2, v2, jnp.where(iq2, alt2, g_b))
 
-        # 4. memory
-        addr = eff ^ jnp.where((kind == KIND_LSQ_ADDR) & at_uop,
-                               bitmask, i32(0))
-        word = _s(jax.lax.shift_right_logical(_u(addr), u32(2)))
-        # word is a logical >>2 of a 32-bit value → always fits
-        # non-negative i32, so a signed compare is safe
-        valid = ((addr & i32(3)) == 0) & (word < i32(mem_words))
-        is_mem = is_ld | is_st
-        # x86 #DE (ops/replay.py div_trap): corrupted divisor → DUE
-        bad_s = (b == 0) | ((a == i32(-(1 << 31))) & (b == i32(-1)))
-        bad_u = b == 0
-        div_trap = ((is_div_s & bad_s) | (is_div_u & bad_u)) & live
-        trap_now = (is_mem & ~valid & live) | illegal | div_trap
-        slot = word & i32(mem_words - 1)
-        slot_g = _s(jax.lax.shift_right_logical(_u(
-            jnp.full((1, B), g_ea, dtype=i32)), u32(2))) & i32(mem_words - 1)
-        mtag = i32(nphys) + slot
-        gtag = i32(nphys) + slot_g
-        same_slot = slot == slot_g
+            # 3. execute
+            if may_latch:
+                raw = _alu_vec(opv, a, b, immv)
+                is_ld = opv == U.LOAD
+                is_st = opv == U.STORE
+                is_br = (opv >= U.BEQ) & (opv <= U.BGE)
+                writes_op = (((opv >= U.ADD) & (opv <= U.REMU))
+                                 | ((opv >= U.FADD) & (opv <= U.MULHU)))
+                is_div_s = (opv == U.DIV) | (opv == U.REM)
+                is_div_u = (opv == U.DIVU) | (opv == U.REMU)
+            else:
+                raw = _alu_switch(op0, a, b, immv)
+                is_ld = jnp.full((1, B), op0 == U.LOAD)
+                is_st = jnp.full((1, B), op0 == U.STORE)
+                is_br = jnp.full((1, B), (op0 >= U.BEQ) & (op0 <= U.BGE))
+                writes_op = jnp.full((1, B), ((op0 >= U.ADD) & (op0 <= U.REMU))
+                                     | ((op0 >= U.FADD) & (op0 <= U.MULHU)))
+                is_div_s = jnp.full((1, B), (op0 == U.DIV) | (op0 == U.REM))
+                is_div_u = jnp.full((1, B), (op0 == U.DIVU)
+                                    | (op0 == U.REMU))
+            fu_here = (kind == KIND_FU) & at_uop
+            eff = raw ^ jnp.where(fu_here, bitmask, i32(0))
+            det_now = fu_here & live & (shadow_u < sc)
 
-        ld_here = is_ld & valid & live & ~trap_now
-        fm, vm = lookup(tags, vals, mtag)
-        golden_here = same_slot & (g_ld | g_st)
-        g_mem_val = jnp.where(g_ld, g_res, g_st_old)
-        ldval = jnp.where(fm, vm, jnp.where(golden_here, g_mem_val,
-                                            i32(0)))
-        esc_now = ld_here & ~fm & ~golden_here
+            # 4. memory
+            addr = eff ^ jnp.where((kind == KIND_LSQ_ADDR) & at_uop,
+                                   bitmask, i32(0))
+            word = _s(jax.lax.shift_right_logical(_u(addr), u32(2)))
+            # word is a logical >>2 of a 32-bit value → always fits
+            # non-negative i32, so a signed compare is safe
+            valid = ((addr & i32(3)) == 0) & (word < i32(mem_words))
+            is_mem = is_ld | is_st
+            # x86 #DE (ops/replay.py div_trap): corrupted divisor → DUE
+            bad_s = (b == 0) | ((a == i32(-(1 << 31))) & (b == i32(-1)))
+            bad_u = b == 0
+            div_trap = ((is_div_s & bad_s) | (is_div_u & bad_u)) & live
+            trap_now = (is_mem & ~valid & live) | illegal | div_trap
+            slot = word & i32(mem_words - 1)
+            slot_g = _s(jax.lax.shift_right_logical(_u(
+                jnp.full((1, B), g_ea, dtype=i32)), u32(2))) & i32(mem_words - 1)
+            mtag = i32(nphys) + slot
+            gtag = i32(nphys) + slot_g
+            same_slot = slot == slot_g
 
-        # 5. branch
-        taken_eff = is_br & (eff != 0)
-        div_now = (taken_eff != (tk != 0)) & live
+            ld_here = is_ld & valid & live & ~trap_now
+            fm, vm = lookup(tags, vals, mtag)
+            golden_here = same_slot & (g_ld | g_st)
+            g_mem_val = jnp.where(g_ld, g_res, g_st_old)
+            ldval = jnp.where(fm, vm, jnp.where(golden_here, g_mem_val,
+                                                i32(0)))
+            esc_now = ld_here & ~fm & ~golden_here
 
-        live_next = live & ~(det_now | trap_now | div_now | esc_now)
+            # 5. branch
+            taken_eff = is_br & (eff != 0)
+            div_now = (taken_eff != (tk != 0)) & live
 
-        # 4b. stores
-        st_data = b ^ jnp.where((kind == KIND_LSQ_DATA) & at_uop,
-                                bitmask, i32(0))
-        st_t = is_st & valid & live_next
-        match_st = st_t & g_st & same_slot & (st_data == g_b)
-        tags = remove(tags, mtag, match_st)
-        tags, vals, o1 = upsert(tags, vals, mtag, st_data,
-                                st_t & ~match_st)
-        miss_st = g_st & live_next & ~(st_t & same_slot)
-        fg, vg = lookup(tags, vals, gtag)
-        content_g = jnp.where(fg, vg, g_st_old)
-        m_coinc = miss_st & (content_g == g_b)
-        tags = remove(tags, gtag, m_coinc)
-        tags, vals, o2 = upsert(tags, vals, gtag, content_g,
-                                miss_st & ~m_coinc)
+            live_next = live & ~(det_now | trap_now | div_now | esc_now)
 
-        # 6. writeback
-        rob_here = (kind == KIND_ROB_DST) & at_uop
-        writes_t = (writes_op | is_ld) & live_next
-        result = jnp.where(is_ld, ldval, eff)
-        dstv = jnp.full((1, B), dstr, dtype=i32)
-        wtag = jnp.where(rob_here, (dstv ^ index_mask) & idx_mask, dstv)
-        same_dst = wtag == dstv
-        g_post = jnp.where(g_wr, g_res, g_dst_old)
-        match_w = writes_t & same_dst & (result == g_post)
-        tags = remove(tags, dstv, match_w)
-        tags, vals, o3 = upsert(tags, vals, wtag, result,
-                                writes_t & ~match_w)
-        miss_w = g_wr & live_next & ~(writes_t & same_dst)
-        fd, vd = lookup(tags, vals, dstv)
-        content_d = jnp.where(fd, vd, g_dst_old)
-        w_coinc = miss_w & (content_d == g_res)
-        tags = remove(tags, dstv, w_coinc)
-        tags, vals, o4 = upsert(tags, vals, dstv, content_d,
-                                miss_w & ~w_coinc)
+            # 4b. stores
+            st_data = b ^ jnp.where((kind == KIND_LSQ_DATA) & at_uop,
+                                    bitmask, i32(0))
+            st_t = is_st & valid & live_next
+            match_st = st_t & g_st & same_slot & (st_data == g_b)
+            tags = remove(tags, mtag, match_st)
+            tags, vals, o1 = upsert(tags, vals, mtag, st_data,
+                                    st_t & ~match_st)
+            miss_st = g_st & live_next & ~(st_t & same_slot)
+            fg, vg = lookup(tags, vals, gtag)
+            content_g = jnp.where(fg, vg, g_st_old)
+            m_coinc = miss_st & (content_g == g_b)
+            tags = remove(tags, gtag, m_coinc)
+            tags, vals, o2 = upsert(tags, vals, gtag, content_g,
+                                    miss_st & ~m_coinc)
 
-        ovf_now = o0 | o1 | o2 | o3 | o4
-        live_next = live_next & ~ovf_now
+            # 6. writeback
+            rob_here = (kind == KIND_ROB_DST) & at_uop
+            writes_t = (writes_op | is_ld) & live_next
+            result = jnp.where(is_ld, ldval, eff)
+            dstv = jnp.full((1, B), dstr, dtype=i32)
+            wtag = jnp.where(rob_here, (dstv ^ index_mask) & idx_mask, dstv)
+            same_dst = wtag == dstv
+            g_post = jnp.where(g_wr, g_res, g_dst_old)
+            match_w = writes_t & same_dst & (result == g_post)
+            tags = remove(tags, dstv, match_w)
+            tags, vals, o3 = upsert(tags, vals, wtag, result,
+                                    writes_t & ~match_w)
+            miss_w = g_wr & live_next & ~(writes_t & same_dst)
+            fd, vd = lookup(tags, vals, dstv)
+            content_d = jnp.where(fd, vd, g_dst_old)
+            w_coinc = miss_w & (content_d == g_res)
+            tags = remove(tags, dstv, w_coinc)
+            tags, vals, o4 = upsert(tags, vals, dstv, content_d,
+                                    miss_w & ~w_coinc)
+
+            ovf_now = o0 | o1 | o2 | o3 | o4
+            live_next = live_next & ~ovf_now
+            return (tags, vals, live_next,
+                    det_i | det_now.astype(i32),
+                    trap_i | trap_now.astype(i32),
+                    div_i | div_now.astype(i32),
+                    esc_i | esc_now.astype(i32),
+                    ovf_i | ovf_now.astype(i32))
+
+        base = blk * u_steps
+        base_j = base % S_CHUNK
+        for u in range(u_steps):
+            carry = one_step(carry, base + u, base_j + u)
+        tags, vals, live, det_i, trap_i, div_i, esc_i, ovf_i = carry
         tags_sc[...] = tags
         vals_sc[...] = vals
-        live_sc[...] = live_next.astype(i32)
-        det_sc[...] = det_i | det_now.astype(i32)
-        trap_sc[...] = trap_i | trap_now.astype(i32)
-        div_sc[...] = div_i | div_now.astype(i32)
-        esc_sc[...] = esc_i | esc_now.astype(i32)
-        ovf_sc[...] = ovf_i | ovf_now.astype(i32)
+        live_sc[...] = live.astype(i32)
+        det_sc[...] = det_i
+        trap_sc[...] = trap_i
+        div_sc[...] = div_i
+        esc_sc[...] = esc_i
+        ovf_sc[...] = ovf_i
 
-        @pl.when(i == n - 1)
+        @pl.when(blk == n_blocks - 1)
         def _flush():
             out_r[...] = det_sc[...] + trap_sc[...] * 2 + div_sc[...] * 4
             esc_r[...] = esc_sc[...]
@@ -447,12 +486,14 @@ def _make_kernel(n: int, k: int, nphys: int, mem_words: int, may_latch: bool):
 
 
 @functools.partial(jax.jit, static_argnames=("k", "compare_regs", "may_latch",
-                                             "b_tile", "interpret"))
+                                             "b_tile", "u_steps",
+                                             "interpret"))
 def taint_fast_pallas(gold: GoldenRecord, opcode, dst, src1, src2, imm,
                       taken, shadow_cov, faults: Fault,
                       gold_at_fault, alt1, alt2,
                       k: int = 16, compare_regs: bool = True,
                       may_latch: bool = True, b_tile: int = 512,
+                      u_steps: int = 1,
                       interpret: bool = False) -> TaintResult:
     """Pallas fast pass over a fault batch (padded to b_tile internally).
 
@@ -499,11 +540,16 @@ def taint_fast_pallas(gold: GoldenRecord, opcode, dst, src1, src2, imm,
         pad_lane(_s(gold_at_fault)), pad_lane(_s(alt1)), pad_lane(_s(alt2)),
     ]
 
-    kernel = _make_kernel(n, k, nphys, mem_words, may_latch)
-    grid = (B_pad // b_tile, n)
-    sv_spec = pl.BlockSpec((15, S_CHUNK), lambda b, i: (0, i // S_CHUNK),
+    # u_steps must divide S_CHUNK so an unrolled group never straddles two
+    # SMEM blocks (and ceil(n/u)·u then never exceeds n_pad)
+    assert S_CHUNK % u_steps == 0, (u_steps, S_CHUNK)
+    kernel = _make_kernel(n, k, nphys, mem_words, may_latch, u_steps)
+    grid = (B_pad // b_tile, -(-n // u_steps))
+    sv_spec = pl.BlockSpec((15, S_CHUNK),
+                           lambda b, i: (0, (i * u_steps) // S_CHUNK),
                            memory_space=pltpu.SMEM)
-    sc_spec = pl.BlockSpec((1, S_CHUNK), lambda b, i: (0, i // S_CHUNK),
+    sc_spec = pl.BlockSpec((1, S_CHUNK),
+                           lambda b, i: (0, (i * u_steps) // S_CHUNK),
                            memory_space=pltpu.SMEM)
     lane_spec = pl.BlockSpec((1, b_tile), lambda b, i: (0, b),
                              memory_space=pltpu.VMEM)

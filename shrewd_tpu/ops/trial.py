@@ -200,7 +200,8 @@ class TrialKernel:
             self.tr.src2, self.tr.imm, self.tr.taken, self.shadow_cov,
             faults, gaf, alt1, alt2, k=self.cfg.taint_k,
             compare_regs=self.cfg.compare_regs, may_latch=may_latch,
-            b_tile=self.cfg.pallas_b_tile, interpret=interp)
+            b_tile=self.cfg.pallas_b_tile,
+            u_steps=self.cfg.pallas_u_steps, interpret=interp)
 
     def sample_batch(self, keys: jax.Array, structure: str) -> Fault:
         """Jitted fault sampling (cached per structure)."""

@@ -79,3 +79,66 @@ def test_batch_padding():
 def test_pallas_off_uses_xla():
     k = make_kernel(seed=35, pallas="off")
     assert not k._pallas_enabled()
+
+
+@pytest.mark.parametrize("u", [2, 8])
+def test_unrolled_kernel_matches(u):
+    """u_steps-unrolled kernel is bit-identical to the XLA fast pass."""
+    k = make_kernel(seed=36, pallas_u_steps=u)
+    keys = prng.trial_keys(prng.campaign_key(16), 24)
+    for structure in ("regfile", "latch"):
+        faults = k.sample_batch(keys, structure)
+        ref = k.taint_batch(faults, False)
+        got = k.taint_fast(faults, may_latch=True)
+        np.testing.assert_array_equal(np.asarray(got.escaped),
+                                      np.asarray(ref.escaped))
+        np.testing.assert_array_equal(np.asarray(got.overflow),
+                                      np.asarray(ref.overflow))
+        resolved = ~np.asarray(ref.escaped | ref.overflow)
+        np.testing.assert_array_equal(np.asarray(got.outcome)[resolved],
+                                      np.asarray(ref.outcome)[resolved])
+
+
+def test_unrolled_kernel_overrun_padding():
+    """u=64 on n=160: the last grid step over-runs by 32 zero-padded (NOP)
+    columns, which must be inert (scalar ALU path keeps the trace small)."""
+    k = make_kernel(seed=37, pallas_u_steps=64)
+    keys = prng.trial_keys(prng.campaign_key(17), 12)
+    faults = k.sample_batch(keys, "regfile")
+    ref = k.taint_batch(faults, False)
+    got = k.taint_fast(faults, may_latch=False)
+    resolved = ~np.asarray(ref.escaped | ref.overflow)
+    np.testing.assert_array_equal(np.asarray(got.outcome)[resolved],
+                                  np.asarray(ref.outcome)[resolved])
+    np.testing.assert_array_equal(np.asarray(got.escaped),
+                                  np.asarray(ref.escaped))
+
+
+def test_unrolled_kernel_overrun_latch_faults():
+    """The dangerous combination: over-run phantom steps (u=64, n=160) with
+    LATCH faults whose cycle/entry can land in [n, n+n_latches) (the minor
+    sampler's range).  Without the i<n mask a LATCH_OP firing on a phantom
+    NOP column fabricates a real opcode; the XLA kernel runs exactly n
+    steps, so the two must stay bit-identical."""
+    from shrewd_tpu.models.o3 import (Fault, KIND_LATCH_IMM, KIND_LATCH_OP)
+
+    k = make_kernel(seed=38, pallas_u_steps=64)
+    keys = prng.trial_keys(prng.campaign_key(18), 8)
+    s = k.sample_batch(keys, "latch")
+    # direct the first lanes into the phantom range [n, ceil(n/64)*64)
+    faults = Fault(
+        kind=s.kind.at[0].set(KIND_LATCH_OP).at[1].set(KIND_LATCH_IMM)
+                   .at[2].set(KIND_LATCH_OP),
+        cycle=s.cycle.at[0].set(161).at[1].set(170).at[2].set(188),
+        entry=s.entry.at[0].set(161).at[1].set(170).at[2].set(188),
+        bit=s.bit.at[0].set(3).at[1].set(7).at[2].set(30),
+        shadow_u=s.shadow_u)
+    ref = k.taint_batch(faults, False)
+    got = k.taint_fast(faults, may_latch=True)
+    np.testing.assert_array_equal(np.asarray(got.escaped),
+                                  np.asarray(ref.escaped))
+    np.testing.assert_array_equal(np.asarray(got.overflow),
+                                  np.asarray(ref.overflow))
+    resolved = ~np.asarray(ref.escaped | ref.overflow)
+    np.testing.assert_array_equal(np.asarray(got.outcome)[resolved],
+                                  np.asarray(ref.outcome)[resolved])

@@ -22,7 +22,7 @@ import sys
 import threading
 import time
 
-WATCHDOG_S = 1500.0
+WATCHDOG_S = 2700.0
 
 
 def main() -> None:
@@ -65,13 +65,19 @@ def main() -> None:
            "configs": []}
     ref_tally = None
     # tile 0 = the XLA taint kernel (pallas off) — runs FIRST so it is the
-    # tally reference every Pallas tile is checked against
-    for tile in [0] + [int(t) for t in args.tiles.split(",")]:
-        label = "xla" if tile == 0 else f"b_tile={tile}"
+    # tally reference every Pallas tile is checked against.  Entries are
+    # TILE or TILE:U (U = pallas_u_steps unroll factor, default 1).
+    def parse(spec):
+        tile, _, u = spec.partition(":")
+        return int(tile), int(u or 1)
+
+    for tile, u in [(0, 1)] + [parse(t) for t in args.tiles.split(",")]:
+        label = "xla" if tile == 0 else (
+            f"b_tile={tile}" + (f",u={u}" if u != 1 else ""))
         try:
             cfg = O3Config(pallas="off") if tile == 0 else \
                 O3Config(pallas="auto" if on_tpu else "on",
-                         pallas_b_tile=tile)
+                         pallas_b_tile=tile, pallas_u_steps=u)
             kern = TrialKernel(trace, cfg)
             t0 = time.monotonic()
             tally = np.asarray(kern.run_keys(keys, "regfile"))
