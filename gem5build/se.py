@@ -22,6 +22,7 @@ import sys
 import m5
 from m5.objects import (
     AddrRange,
+    Cache,
     PcCountPair,
     PcCountTracker,
     PcCountTrackerManager,
@@ -34,6 +35,7 @@ from m5.objects import (
     SystemXBar,
     VoltageDomain,
     X86AtomicSimpleCPU,
+    X86O3CPU,
     X86TimingSimpleCPU,
 )
 
@@ -41,9 +43,21 @@ parser = argparse.ArgumentParser()
 parser.add_argument("mode", choices=["run", "checkpoint", "restore"])
 parser.add_argument("binary")
 parser.add_argument("--args", default="", help="guest argv tail")
-parser.add_argument("--cpu", default="atomic", choices=["atomic", "timing"])
+parser.add_argument("--cpu", default="atomic",
+                    choices=["atomic", "timing", "o3"])
 parser.add_argument("--ckpt-dir", default="m5ckpt")
 parser.add_argument("--marker-pc", type=lambda v: int(v, 0), default=0)
+parser.add_argument("--stop-pc", type=lambda v: int(v, 0), default=0,
+                    help="restore: exit at first retirement of this PC "
+                         "(the workload's kernel_end) — stats then cover "
+                         "exactly the marker window")
+parser.add_argument("--caches", action="store_true",
+                    help="32kB/8-way L1I+L1D (2-cycle) so O3 timing is "
+                         "dominated by the core, comparable to the "
+                         "framework's fixed-latency scoreboard")
+parser.add_argument("--reset-stats", action="store_true",
+                    help="m5.stats.reset() right after (restore-)"
+                         "instantiate; dump before exit")
 parser.add_argument("--max-ticks", type=int, default=0,
                     help="abs tick bound on restore (hang => DUE)")
 args = parser.parse_args()
@@ -54,14 +68,26 @@ system.clk_domain = SrcClockDomain(clock="3GHz",
 system.mem_mode = "atomic" if args.cpu == "atomic" else "timing"
 system.mem_ranges = [AddrRange("512MiB")]
 
-cpu_cls = X86AtomicSimpleCPU if args.cpu == "atomic" else X86TimingSimpleCPU
+cpu_cls = {"atomic": X86AtomicSimpleCPU, "timing": X86TimingSimpleCPU,
+           "o3": X86O3CPU}[args.cpu]
 system.cpu = cpu_cls()
 
 system.membus = SystemXBar()
 system.system_port = system.membus.cpu_side_ports
 
-system.cpu.icache_port = system.membus.cpu_side_ports
-system.cpu.dcache_port = system.membus.cpu_side_ports
+if args.caches:
+    def l1():
+        return Cache(size="32kB", assoc=8, tag_latency=2, data_latency=2,
+                     response_latency=2, mshrs=8, tgts_per_mshr=16)
+
+    system.l1i, system.l1d = l1(), l1()
+    system.cpu.icache_port = system.l1i.cpu_side
+    system.cpu.dcache_port = system.l1d.cpu_side
+    system.l1i.mem_side = system.membus.cpu_side_ports
+    system.l1d.mem_side = system.membus.cpu_side_ports
+else:
+    system.cpu.icache_port = system.membus.cpu_side_ports
+    system.cpu.dcache_port = system.membus.cpu_side_ports
 
 system.cpu.createInterruptController()
 system.cpu.interrupts[0].pio = system.membus.mem_side_ports
@@ -77,15 +103,23 @@ process = Process(executable=args.binary,
 system.cpu.workload = process
 system.cpu.createThreads()
 
+def attach_pc_tracker(pc):
+    """Exit the sim loop at the first retirement of ``pc`` (reference
+    src/cpu/probes/pc_count_tracker.cc:57, probe "RetiredInstsPC")."""
+    system.ptmanager = PcCountTrackerManager(targets=[PcCountPair(pc, 1)])
+    system.cpu.probeListener = PcCountTracker(
+        targets=[PcCountPair(pc, 1)], core=system.cpu,
+        ptmanager=system.ptmanager)
+
+
 if args.mode == "checkpoint":
     if not args.marker_pc:
         print("checkpoint mode needs --marker-pc", file=sys.stderr)
         sys.exit(2)
-    system.ptmanager = PcCountTrackerManager(
-        targets=[PcCountPair(args.marker_pc, 1)])
-    tracker = PcCountTracker(targets=[PcCountPair(args.marker_pc, 1)],
-                             core=system.cpu, ptmanager=system.ptmanager)
-    system.cpu.probeListener = tracker
+    attach_pc_tracker(args.marker_pc)
+
+if args.mode == "restore" and args.stop_pc:
+    attach_pc_tracker(args.stop_pc)
 
 root = Root(full_system=False, system=system)
 
@@ -93,6 +127,9 @@ if args.mode == "restore":
     m5.instantiate(args.ckpt_dir)
 else:
     m5.instantiate()
+
+if args.reset_stats:
+    m5.stats.reset()
 
 if args.mode == "checkpoint":
     ev = m5.simulate()
@@ -109,6 +146,11 @@ ev = m5.simulate(args.max_ticks) if args.max_ticks else m5.simulate()
 cause = ev.getCause()
 code = ev.getCode() if hasattr(ev, "getCode") else 0
 print(f"sim done: cause={cause!r} code={code} tick={m5.curTick()}")
+if args.reset_stats:
+    m5.stats.dump()
+if args.stop_pc and "simpoint starting point found" in cause:
+    print("STOP_PC_REACHED")
+    sys.exit(0)
 if "exiting with last active thread context" in cause:
     sys.exit(code & 0xFF)
 # tick bound hit (livelock) or anything else unexpected

@@ -23,8 +23,11 @@ TPU-native split:
   into (µop, cycle-within-residency) — occupancy-weighted fault placement
   as one gather, vmapped over the batch like every FaultSampler draw.
 
-The proxy remains the default (``O3Config.timing = "proxy"``); campaigns
-opt in with ``timing = "scoreboard"``.
+The scoreboard (with bimodal squash modeling) is the default since round 4
+(``O3Config.timing = "scoreboard"``) after external validation against
+host-silicon rdtsc and the actual gem5 X86O3CPU on the same marker window
+(TIMING_VALIDATE_r04, O3_TIMING_VALIDATE_r04); ``timing = "proxy"`` keeps
+the cheap 1-IPC heuristic available.
 """
 
 from __future__ import annotations
@@ -67,7 +70,12 @@ class TimingConfig(ConfigObject):
     fdiv_latency = Param(int, 12, "FDIV latency (overrides FloatMultDiv)")
     # --- speculation / wrong path (VERDICT r3 #7; reference: ROB squash
     # walk src/cpu/o3/rob.hh:207, bpred src/cpu/pred/bpred_unit.hh:99) ---
-    bpred = Param(str, "none", "branch predictor model: 'none' (perfect "
+    # default "bimodal" since round 4: the squash-modeling variant is the
+    # externally validated one — per-µop occupancy 1.056× the actual gem5
+    # X86O3CPU on the same window vs 0.25× without wrong-path mass
+    # (O3_TIMING_VALIDATE_r04), and its bimodal mispredict count (403)
+    # brackets gem5's committed 350 on the same window.
+    bpred = Param(str, "bimodal", "branch predictor model: 'none' (perfect "
                   "prediction, r3 behavior) or 'bimodal' (per-branch "
                   "2-bit saturating counters, the canonical simple model)",
                   check=lambda s: s in ("none", "bimodal"))

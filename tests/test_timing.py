@@ -221,12 +221,14 @@ class TestO3Integration:
         struck = np.asarray(tr.opcode)[np.asarray(f.entry)]
         assert np.asarray(U.is_mem(struck)).all()
 
-    def test_proxy_default_unchanged(self):
+    def test_scoreboard_is_default_proxy_optin(self):
+        """Round-4 default flip (O3_TIMING_VALIDATE_r04): the validated
+        scoreboard drives residency by default; proxy stays available."""
         from shrewd_tpu.models.o3 import FaultSampler
 
         tr = _trace(n=128)
-        s = FaultSampler(tr, "rob", O3Config())
-        assert s._res is None
+        assert FaultSampler(tr, "rob", O3Config())._res is not None
+        assert FaultSampler(tr, "rob", O3Config(timing="proxy"))._res is None
 
 
 class TestSquashModel:
@@ -278,7 +280,7 @@ class TestSquashModel:
 
     def test_redirect_bubble_delays_next_dispatch(self):
         t = self._branchy_trace()
-        sb_off = compute_scoreboard(t, TimingConfig())
+        sb_off = compute_scoreboard(t, TimingConfig(bpred="none"))
         sb_on = compute_scoreboard(
             t, TimingConfig(bpred="bimodal", redirect_penalty=5))
         mp = sb_on.mispredict

@@ -48,7 +48,7 @@ def main() -> int:
     # 2. model predictions on the lifted marker window
     paths = hd.build_tools(a.workload)
     trace, meta = hd.capture_and_lift(paths)
-    sb = compute_scoreboard(trace, TimingConfig())
+    sb = compute_scoreboard(trace, TimingConfig(bpred="none"))
     sb_sq = compute_scoreboard(trace, TimingConfig(bpred="bimodal"))
     out = {
         "workload": a.workload,
