@@ -1,0 +1,130 @@
+"""Three-way differential on identical fault coordinates:
+
+  gem5    — the actual reference binary (gem5build/, checkpoint-patch-
+            restore trials recorded in GEM5_GOLDEN_r04.json)
+  host    — real x86 silicon (hostsfi ptrace flips; re-run here to pin
+            run-to-run stability, must match the artifact's host column)
+  device  — this framework's replay kernel, 64-bit pair-lane lift
+            (ingest/lift64.py), diverged trials escalated to the
+            whole-program emulator oracle
+
+All three flip the same (reg, bit) of the same architected GPR at the
+same kernel_begin marker of the same binary and classify by program
+outcome (masked / sdc / due).  The gem5 leg is the reference's own
+restore+perturb loop (serialized thread context, the
+ThreadContext::setReg shape — reference src/cpu/thread_context.hh:190);
+the device leg is the TPU-native kernel this framework exists to run.
+
+Writes THREEWAY_r04.json.
+
+Usage: PYTHONPATH=/root/repo python tools/threeway_diff.py \
+           [--golden GEM5_GOLDEN_r04.json] [--out THREEWAY_r04.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+import numpy as np
+
+REPO = Path(__file__).resolve().parent.parent
+
+CLASSES = ("masked", "sdc", "due")
+
+
+def tally(seq):
+    t = {c: 0 for c in CLASSES}
+    for s in seq:
+        t[s] += 1
+    return t
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--golden", default=str(REPO / "GEM5_GOLDEN_r04.json"))
+    ap.add_argument("--out", default=str(REPO / "THREEWAY_r04.json"))
+    a = ap.parse_args()
+
+    with open(a.golden) as f:
+        golden = json.load(f)
+    trials = golden["trials"]
+    assert trials and "host" in trials[0], \
+        "golden artifact lacks per-trial host outcomes"
+
+    from shrewd_tpu.ingest.hostdiff import (HOST_OUTCOME, build_tools,
+                                            capture_and_lift_to_output,
+                                            run_device, run_host)
+    from shrewd_tpu.ingest.lift64 import lift64
+
+    names = {v: k for k, v in HOST_OUTCOME.items()}
+    paths = build_tools("workloads/sort.c")
+    coords = np.array([[0, t["reg"], t["bit"]] for t in trials],
+                      dtype=np.int64)
+
+    # host leg re-run: silicon outcomes must reproduce the artifact's
+    host = run_host(paths, coords)
+    host_cls = [names[int(h)] for h in host]
+    host_stable = sum(h == t["host"] for h, t in zip(host_cls, trials))
+
+    # device leg: 64-bit pair-lane lift on the replay kernel
+    trace, meta = capture_and_lift_to_output(paths, lifter=lift64)
+    report: dict = {}
+    dev = run_device(trace, meta, coords, paths=paths, report=report)
+    dev_cls = [names[int(d)] for d in dev]
+
+    n = len(trials)
+    gem5_cls = [t["gem5"] for t in trials]
+    pair = lambda x, y: sum(a == b for a, b in zip(x, y)) / n  # noqa: E731
+    vuln = lambda x, y: sum((a != "masked") == (b != "masked")  # noqa: E731
+                            for a, b in zip(x, y)) / n
+    avf = lambda c: sum(v != "masked" for v in c) / n           # noqa: E731
+
+    doc = {
+        "experiment": golden["experiment"],
+        "workload": golden["workload"],
+        "binary_sha": golden["binary_sha"],
+        "coords": n,
+        "tallies": {"gem5": tally(gem5_cls), "host": tally(host_cls),
+                    "device": tally(dev_cls)},
+        "avf": {"gem5": avf(gem5_cls), "host": avf(host_cls),
+                "device": avf(dev_cls)},
+        "agreement_exact": {
+            "gem5_vs_host": pair(gem5_cls, host_cls),
+            "gem5_vs_device": pair(gem5_cls, dev_cls),
+            "host_vs_device": pair(host_cls, dev_cls),
+            "all_three": sum(g == h == d for g, h, d in
+                             zip(gem5_cls, host_cls, dev_cls)) / n,
+        },
+        "agreement_vulnerable": {
+            "gem5_vs_device": vuln(gem5_cls, dev_cls),
+            "host_vs_device": vuln(host_cls, dev_cls),
+        },
+        "host_rerun_stability": host_stable / n,
+        "device_report": {k: int(v) if isinstance(v, (int, np.integer))
+                          else v for k, v in report.items()},
+        "disagreements": [
+            {"reg": t["reg"], "bit": t["bit"], "gem5": g, "host": h,
+             "device": d}
+            for t, g, h, d in zip(trials, gem5_cls, host_cls, dev_cls)
+            if not (g == h == d)][:64],
+        "note": ("One binary, one marker, one coordinate list, three "
+                 "executors.  The gem5 column is the reference binary's "
+                 "own checkpoint-perturb-restore loop; the device column "
+                 "is computed by this framework's replay kernel over the "
+                 "64-bit pair-lane lift, with diverged trials escalated "
+                 "to the whole-program emulator oracle."),
+    }
+    with open(a.out, "w") as f:
+        json.dump(doc, f, indent=1)
+        f.write("\n")
+    print(json.dumps({k: doc[k] for k in
+                      ("avf", "agreement_exact", "agreement_vulnerable",
+                       "host_rerun_stability")}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
