@@ -49,14 +49,40 @@ def sh(cmd, timeout=None, cwd=None):
                           timeout=timeout, cwd=cwd)
 
 
-def build_workload():
+def build_workload(workload_c="workloads/sort.c"):
     """The exact binary + marker the framework's host-diff path uses — one
     recipe, one artifact, one nm parse (BuildPaths.begin is kernel_begin),
     so the gem5 and silicon legs cannot drift apart."""
     sys.path.insert(0, REPO)
     from shrewd_tpu.ingest.hostdiff import build_tools
 
-    return build_tools(workload_c="workloads/sort.c")
+    return build_tools(workload_c=workload_c)
+
+
+def ensure_checkpoint(binary, pc, timeout=600.0):
+    """Shared marker-checkpoint cache (golden_campaign + o3_validate):
+    RUNDIR/ckpt-golden is valid only for the stamped binary sha + marker
+    PC; rebuilt otherwise.  Returns the checkpoint dir."""
+    binary_sha = sh(["sha256sum", binary]).stdout.split()[0]
+    ckpt = os.path.join(RUNDIR, "ckpt-golden")
+    stamp_path = os.path.join(RUNDIR, "ckpt-golden.stamp")
+    stamp = f"{binary_sha} 0x{pc:x}"
+    stale = True
+    if os.path.exists(os.path.join(ckpt, "m5.cpt")) \
+            and os.path.exists(stamp_path):
+        with open(stamp_path) as f:
+            stale = f.read().strip() != stamp
+    if stale:
+        shutil.rmtree(ckpt, ignore_errors=True)
+        rc, out, wall, _ = run_gem5("checkpoint", binary, ckpt,
+                                    [f"--marker-pc=0x{pc:x}"],
+                                    timeout=timeout)
+        assert rc == 0, f"checkpoint run failed rc={rc}\n{out[-2000:]}"
+        os.makedirs(RUNDIR, exist_ok=True)
+        with open(stamp_path, "w") as f:
+            f.write(stamp + "\n")
+        print(f"checkpoint at marker in {wall:.1f}s")
+    return ckpt
 
 
 def run_gem5(mode, binary, ckpt, extra=(), timeout=600):
@@ -159,33 +185,28 @@ def main():
     ap.add_argument("--seed", type=int, default=4)
     ap.add_argument("--timeout", type=float, default=300.0)
     ap.add_argument("--skip-host", action="store_true")
-    ap.add_argument("--out", default=os.path.join(REPO,
-                                                  "GEM5_GOLDEN_r04.json"))
+    ap.add_argument("--out", default=None,
+                    help="default: GEM5_GOLDEN_r04.json for sort.c, "
+                         "GEM5_GOLDEN_<STEM>_r04.json otherwise — a "
+                         "non-sort run cannot silently clobber the "
+                         "flagship artifact")
+    ap.add_argument("--workload", default="workloads/sort.c",
+                    help="workload .c with kernel_begin/kernel_end markers "
+                         "and the emit_checksum 8-hex-digit output shape")
     args = ap.parse_args()
+    if args.out is None:
+        stem = os.path.splitext(os.path.basename(args.workload))[0]
+        name = ("GEM5_GOLDEN_r04.json" if stem == "sort"
+                else f"GEM5_GOLDEN_{stem.upper()}_r04.json")
+        args.out = os.path.join(REPO, name)
 
     assert os.path.exists(GEM5), f"{GEM5} not built yet"
-    paths = build_workload()
+    paths = build_workload(args.workload)
     binary, pc = str(paths.workload), paths.begin
     binary_sha = sh(["sha256sum", binary]).stdout.split()[0]
     print(f"workload {binary} kernel_begin=0x{pc:x}")
 
-    ckpt = os.path.join(RUNDIR, "ckpt-golden")
-    stamp_path = os.path.join(RUNDIR, "ckpt-golden.stamp")
-    stamp = f"{binary_sha} 0x{pc:x}"
-    stale = True
-    if os.path.exists(os.path.join(ckpt, "m5.cpt")) \
-            and os.path.exists(stamp_path):
-        with open(stamp_path) as f:
-            stale = f.read().strip() != stamp
-    if stale:
-        shutil.rmtree(ckpt, ignore_errors=True)
-        rc, out, wall, _ = run_gem5("checkpoint", binary, ckpt,
-                                    [f"--marker-pc=0x{pc:x}"],
-                                    timeout=args.timeout)
-        assert rc == 0, f"checkpoint run failed rc={rc}\n{out[-2000:]}"
-        with open(stamp_path, "w") as f:
-            f.write(stamp + "\n")
-        print(f"checkpoint at marker in {wall:.1f}s")
+    ckpt = ensure_checkpoint(binary, pc, timeout=args.timeout)
 
     rc, out, wall, _ = run_gem5("restore", binary, ckpt,
                                 timeout=args.timeout)
@@ -243,7 +264,7 @@ def main():
     out_doc = {
         "experiment": "architected-GPR bit flip at kernel_begin, run to "
                       "completion",
-        "workload": "sort.c (gcc -O1 -static -fno-pie -no-pie)",
+        "workload": f"{args.workload} (gcc -O1 -static -fno-pie -no-pie)",
         "binary_sha": binary_sha,
         "marker_pc": hex(pc),
         "coords": len(coords),

@@ -35,7 +35,8 @@ REPO = os.path.dirname(HERE)
 sys.path.insert(0, REPO)
 sys.path.insert(0, HERE)
 
-from golden_campaign import GEM5, RUNDIR, run_gem5  # noqa: E402
+from golden_campaign import (GEM5, ensure_checkpoint,  # noqa: E402
+                             run_gem5)
 
 
 STATS = {
@@ -78,29 +79,8 @@ def main() -> int:
     import numpy as np
 
     paths = hd.build_tools(args.workload)
-    # same stamp discipline as golden_campaign.py: the shared checkpoint is
-    # only valid for this exact binary + marker PC
-    import hashlib
-    with open(paths.workload, "rb") as f:
-        sha = hashlib.sha256(f.read()).hexdigest()
-    stamp = f"{sha} 0x{paths.begin:x}"
-    ckpt = os.path.join(RUNDIR, "ckpt-golden")
-    stamp_path = os.path.join(RUNDIR, "ckpt-golden.stamp")
-    fresh = False
-    if os.path.exists(os.path.join(ckpt, "m5.cpt")) \
-            and os.path.exists(stamp_path):
-        with open(stamp_path) as f:
-            fresh = f.read().strip() == stamp
-    if not fresh:
-        import shutil
-        shutil.rmtree(ckpt, ignore_errors=True)
-        rc, out, wall, _ = run_gem5(
-            "checkpoint", str(paths.workload), ckpt,
-            [f"--marker-pc=0x{paths.begin:x}"], timeout=args.timeout)
-        assert rc == 0, f"checkpoint failed rc={rc}\n{out[-1500:]}"
-        os.makedirs(RUNDIR, exist_ok=True)
-        with open(stamp_path, "w") as f:
-            f.write(stamp + "\n")
+    ckpt = ensure_checkpoint(str(paths.workload), paths.begin,
+                             timeout=args.timeout)
 
     rc, out, wall, outdir = run_gem5(
         "restore", str(paths.workload), ckpt,

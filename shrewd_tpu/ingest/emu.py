@@ -604,21 +604,30 @@ class Emulator:
                 widths = [abs(o.width) // 8 for o in ops
                           if o.kind == "reg" and o.reg >= 0 and o.width]
                 esz = widths[0] if widths else 1
+            # ONE iteration per step(), pc held until rcx reaches 0 — the
+            # hardware model: a single-step trap fires after EVERY rep
+            # iteration, so ptrace (tools/hostsfi.cc), the capture
+            # (tools/nativetrace.cc), and the lifter all count per
+            # iteration.  Executing the whole rep as one step desynced
+            # every later fault coordinate by (iterations-1) — the r4
+            # strmix due→masked channel.  A corrupted rcx simply walks
+            # rdi/rsi out of the image and traps exactly where silicon
+            # segfaults (no plausibility guard needed).
             n = self.reg[RCX]
-            if n * esz > (1 << 26):
-                raise StopEmu("rep count implausible")
+            if n == 0:
+                self.pc = next_pc & M64
+                return
             if kind_s == "movs":
-                for i in range(n):
-                    self.store(self.reg[RDI] + i * esz, esz,
-                               self.load(self.reg[RSI] + i * esz, esz))
-                self.reg[RSI] = (self.reg[RSI] + n * esz) & M64
+                self.store(self.reg[RDI], esz,
+                           self.load(self.reg[RSI], esz))
+                self.reg[RSI] = (self.reg[RSI] + esz) & M64
             else:
-                v = self.reg[RAX] & ((1 << (8 * esz)) - 1)
-                for i in range(n):
-                    self.store(self.reg[RDI] + i * esz, esz, v)
-            self.reg[RDI] = (self.reg[RDI] + n * esz) & M64
-            self.reg[RCX] = 0
-            self.pc = next_pc & M64
+                self.store(self.reg[RDI], esz,
+                           self.reg[RAX] & ((1 << (8 * esz)) - 1))
+            self.reg[RDI] = (self.reg[RDI] + esz) & M64
+            self.reg[RCX] = (n - 1) & M64
+            if self.reg[RCX] == 0:
+                self.pc = next_pc & M64
             return
         if m in ("bsf", "bsr", "tzcnt", "lzcnt"):
             src_o, dst = ops

@@ -232,15 +232,29 @@ class TestSimdSubset:
             e.store(0x1000 + i, 1, b)
         e.reg[RSI], e.reg[RDI], e.reg[RCX] = 0x1000, 0x1010, 6
         e.insts[0] = Inst(0, 2, "rep movsb", [], None)
-        e.step()
+        # ONE iteration per step(), pc held until rcx==0 — the ptrace
+        # single-step contract (a trap fires per rep iteration), which
+        # keeps fault-coordinate step counts aligned with hostsfi and
+        # the capture.  Whole-rep-per-step desynced every later coord.
+        for i in range(6):
+            assert e.pc == 0
+            e.step()
+            assert e.reg[RCX] == 5 - i
         assert bytes(e.load(0x1010 + i, 1) for i in range(6)) == b"hello!"
-        assert e.reg[RCX] == 0
+        assert e.pc == 2                    # advanced on the last iteration
         e.pc = 0
         e.insts[0] = Inst(0, 2, "rep stos",
                           [self._op("reg", reg=RAX, width=8)], None)
         e.reg[RAX], e.reg[RDI], e.reg[RCX] = ord("x"), 0x1020, 5
-        e.step()
+        for _ in range(5):
+            e.step()
         assert bytes(e.load(0x1020 + i, 1) for i in range(5)) == b"xxxxx"
+        assert e.reg[RCX] == 0 and e.pc == 2
+        # rcx == 0 at entry: no-op, pc advances in one step
+        e.pc = 0
+        e.reg[RDI], e.reg[RCX] = 0x1030, 0
+        e.step()
+        assert e.pc == 2 and e.load(0x1030, 1) == 0
 
     def test_bsf_tzcnt(self):
         import numpy as np

@@ -60,7 +60,19 @@ def main() -> int:
     from shrewd_tpu.ingest.lift64 import lift64
 
     names = {v: k for k, v in HOST_OUTCOME.items()}
-    paths = build_tools("workloads/sort.c")
+    # the golden artifact records 'workloads/<x>.c (gcc ...)' — build the
+    # SAME workload and prove it is the same binary gem5 perturbed
+    workload_c = golden["workload"].split(" ")[0]
+    if "/" not in workload_c:        # pre---workload artifacts: bare stem
+        workload_c = f"workloads/{workload_c}"
+    paths = build_tools(workload_c)
+    import hashlib
+    with open(paths.workload, "rb") as f:
+        sha = hashlib.sha256(f.read()).hexdigest()
+    assert sha == golden["binary_sha"], (
+        f"built {workload_c} sha {sha[:12]} != golden artifact's "
+        f"{golden['binary_sha'][:12]} — the three-way would compare "
+        "different binaries")
     coords = np.array([[0, t["reg"], t["bit"]] for t in trials],
                       dtype=np.int64)
 
@@ -105,6 +117,8 @@ def main() -> int:
         "host_rerun_stability": host_stable / n,
         "device_report": {k: int(v) if isinstance(v, (int, np.integer))
                           else v for k, v in report.items()},
+        "disagreements_total": sum(not (g == h == d) for g, h, d in
+                                   zip(gem5_cls, host_cls, dev_cls)),
         "disagreements": [
             {"reg": t["reg"], "bit": t["bit"], "gem5": g, "host": h,
              "device": d}
