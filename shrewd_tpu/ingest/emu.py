@@ -569,10 +569,24 @@ class Emulator:
             return
         raise StopEmu(f"unsupported simd {m}")
 
-    def step(self) -> None:
+    def step(self, bulk_limit: int = 1) -> int:
+        """Execute at most ``bulk_limit`` hardware steps and return the
+        count consumed.  Every instruction consumes 1 except rep
+        movs/stos, where a hardware step = ONE iteration (a single-step
+        trap fires per iteration) — the rep handler may consume up to
+        ``bulk_limit`` iterations in one call so whole-program runs don't
+        pay a Python call per byte of a big memset, while callers that
+        need exact step alignment (fault injection, per-step window
+        validation) cap the bulk at their next boundary."""
+        self._consumed = 1
         inst = self.insts.get(self.pc)
         if inst is None:
             raise StopEmu("undecoded pc")
+        self._bulk_limit = max(1, bulk_limit)
+        self._step_body(inst)
+        return self._consumed
+
+    def _step_body(self, inst) -> None:
         m = inst.mnemonic
         ops = inst.operands
         next_pc = self.pc + inst.length
@@ -617,15 +631,20 @@ class Emulator:
             if n == 0:
                 self.pc = next_pc & M64
                 return
+            k = int(min(n, self._bulk_limit))
             if kind_s == "movs":
-                self.store(self.reg[RDI], esz,
-                           self.load(self.reg[RSI], esz))
-                self.reg[RSI] = (self.reg[RSI] + esz) & M64
+                for _ in range(k):
+                    self.store(self.reg[RDI], esz,
+                               self.load(self.reg[RSI], esz))
+                    self.reg[RSI] = (self.reg[RSI] + esz) & M64
+                    self.reg[RDI] = (self.reg[RDI] + esz) & M64
             else:
-                self.store(self.reg[RDI], esz,
-                           self.reg[RAX] & ((1 << (8 * esz)) - 1))
-            self.reg[RDI] = (self.reg[RDI] + esz) & M64
-            self.reg[RCX] = (n - 1) & M64
+                v = self.reg[RAX] & ((1 << (8 * esz)) - 1)
+                for _ in range(k):
+                    self.store(self.reg[RDI], esz, v)
+                    self.reg[RDI] = (self.reg[RDI] + esz) & M64
+            self.reg[RCX] = (n - k) & M64
+            self._consumed = k
             if self.reg[RCX] == 0:
                 self.pc = next_pc & M64
             return
@@ -928,8 +947,8 @@ def run_program(insts: dict[int, Inst], regs: np.ndarray,
                    fs_base=fs_base)
     steps = 0
     try:
-        for i in range(max_steps):
-            if fault is not None and i == fault[0]:
+        while steps < max_steps:
+            if fault is not None and steps == fault[0]:
                 if fault[1] >= 16:
                     # xmm[reg-16] low lane, the FP-bank coordinate space
                     # (hostsfi's PTRACE_SETFPREGS flip)
@@ -937,8 +956,13 @@ def run_program(insts: dict[int, Inst], regs: np.ndarray,
                 else:
                     emu.reg[fault[1]] ^= (1 << fault[2])
                     emu.reg[fault[1]] &= M64
-            emu.step()
-            steps += 1
+            # bulk rep execution up to the next boundary we must observe
+            # exactly: the fault-injection step, or the hang budget —
+            # per-iteration stepping stays the unit of accounting
+            limit = max_steps - steps
+            if fault is not None and steps < fault[0]:
+                limit = min(limit, fault[0] - steps)
+            steps += emu.step(limit)
         return ProgramResult("hang", bytes(emu.stdout), None, steps)
     except ExitedEmu as e:
         return ProgramResult("exit", bytes(emu.stdout), e.code, steps)

@@ -298,6 +298,67 @@ def _coords_to_phys(meta: dict, reg: np.ndarray,
     return reg, bit
 
 
+def _demoted_exposed(trace, meta: dict, coords: np.ndarray) -> np.ndarray:
+    """bool[n_coords]: faults in a register some LATER demoted instruction
+    READS on silicon, while the fault is still live in the replay.  The
+    replay never models a demoted instruction's register consumption —
+    e.g. a demoted ymm load through a corrupted base pointer is silicon's
+    crash channel but invisible to the replay (the r4 strmix due→masked
+    cell) — so those coordinates escalate to the whole-program emulator
+    oracle alongside the diverged set.  A replayed WRITE to the faulted
+    phys lane before the demoted step kills the fault on both executors
+    (the lift models partial-width writes), so such coords stay
+    on-device."""
+    from shrewd_tpu.isa import uops as U
+
+    dr = meta.get("demoted_reads") or []
+    out = np.zeros(len(coords), dtype=bool)
+    if not dr:
+        return out
+    uop_start = np.asarray(meta["uop_start"], dtype=np.int64)
+    n = trace.n
+    dst = np.asarray(trace.dst)
+    opcode = np.asarray(trace.opcode)
+    src1 = np.asarray(trace.src1)
+    src2 = np.asarray(trace.src2)
+    wd = np.asarray(U.writes_dest(opcode))
+    u1 = np.asarray(U.uses_src1(opcode))
+    u2 = np.asarray(U.uses_src2(opcode))
+    step, reg, bit = coords.T
+    phys, _ = _coords_to_phys(meta, reg, bit)
+    # demoted steps per arch read-reg (sorted by construction)
+    by_reg: dict[int, list[int]] = {}
+    wild: list[int] = []
+    for s, regs in dr:
+        for r in regs:
+            (wild if r == -1 else by_reg.setdefault(r, [])).append(s)
+    merged: dict[int, np.ndarray] = {}      # arch reg → sorted demoted steps
+    kills: dict[int, np.ndarray] = {}       # phys → killing-write µop idxs
+    for i in range(len(coords)):
+        a = int(reg[i])
+        if a not in merged:
+            merged[a] = np.asarray(sorted(by_reg.get(a, []) + wild),
+                                   dtype=np.int64)
+        dsteps = merged[a]
+        pos = np.searchsorted(dsteps, int(step[i]))
+        if pos >= len(dsteps):
+            continue
+        d_uop = uop_start[min(int(dsteps[pos]), len(uop_start) - 1)]
+        # a killing write replaces the whole lane WITHOUT reading it — a
+        # read-modify-write (sub-word merge) keeps the fault live in both
+        # executors and must not suppress the escalation
+        p = int(phys[i])
+        if p not in kills:
+            kills[p] = np.nonzero((dst == p) & wd
+                                  & ~((src1 == p) & u1)
+                                  & ~((src2 == p) & u2))[0]
+        writes = kills[p]
+        w = np.searchsorted(writes, uop_start[step[i]])
+        first_write = writes[w] if w < writes.size else n
+        out[i] = d_uop <= first_write
+    return out
+
+
 def _resync_severed(trace, meta: dict, coords: np.ndarray) -> np.ndarray:
     """bool[n_coords]: faults whose struck phys register's first touch at
     or after the landing cycle is a demotion-resync LUI — severed in the
@@ -422,16 +483,25 @@ def run_device(trace, meta: dict, coords: np.ndarray,
         # provably drops a corruption silicon keeps — escalate those to
         # the oracle along with the diverged trials (the low-lift-rate
         # workloads' dominant disagreement channel).
-        sev = _resync_severed(trace, meta, coords)
+        sev = _resync_severed(trace, meta, coords) \
+            | _demoted_exposed(trace, meta, coords)
         div_only = np.asarray(rfull.diverged) & ~trapped & ~detected
-        div = (div_only | sev) & ~trapped & ~detected
+        # severed/exposed trials escalate even when the replay trapped:
+        # when silicon's behavior ran through a demoted instruction the
+        # replay's own trap can be spurious (the emulator executes the
+        # real path); plain traps stay DUE on-device
+        div = (div_only | sev) & ~detected
         if report is not None:
             # device_diverged keeps its r04-artifact meaning (the
             # diverged escalation set); resync_severed counts the trials
-            # the severed test ADDS to it
+            # the severed/exposed tests ADD to it (incl. trapped ones —
+            # those escalate too); escalated_total = device_diverged +
+            # resync_severed = the oracle's input size, so the buckets
+            # reconcile with diverged_resolved
             report["device_diverged"] = int(div_only.sum())
-            report["resync_severed"] = int((sev & ~div_only & ~trapped
+            report["resync_severed"] = int((sev & ~div_only
                                             & ~detected).sum())
+            report["escalated_total"] = int(div.sum())
             report["device_memmap"] = k.memmap is not None
         if resolve_diverged and paths is not None and div.any():
             try:

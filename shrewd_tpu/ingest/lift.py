@@ -463,6 +463,12 @@ class Lifter:
         self.taken: list[int] = []
         self.mem_cluster: list[int] = []    # per-µop cluster idx (-1: none)
         self.resync_uops: list[int] = []    # LUIs emitted by demotions
+        # (macro step, arch regs the demoted inst READS): a fault in one
+        # of those registers flows into silicon behavior the replay never
+        # models (e.g. a demoted ymm load's address crash channel) — the
+        # host-diff harness escalates exactly those coords (hostdiff.py
+        # _demoted_exposed)
+        self.demoted_reads: list[tuple[int, list[int]]] = []
         self.uop_start: list[int] = []      # macro step -> first µop index
         # golden simulation state (the self-check oracle)
         self.reg = np.zeros(NPHYS, dtype=np.uint64)   # low-32 values (u64 buf)
@@ -814,6 +820,20 @@ class Lifter:
     def _vregion_of(self, mem: "Operand", pc: int, regs: np.ndarray):
         if mem.base < 0 or mem.index >= 0 or mem.rip_rel or mem.seg:
             return None
+        # the symbolic region record carries no µops, so a corrupted base
+        # register would otherwise never influence the replay even though
+        # the HARDWARE load through it is silicon's crash channel (strmix
+        # r4: hi-bit rdi flips → silicon segfault, replay masked).  Emit a
+        # word-aligned probe LOAD through the live/guarded address path:
+        # the golden lane reads a golden word (dead value), a deviated
+        # base trap/escapes exactly like any other access.
+        r = self._addr_uops(mem, pc, T3)
+        if r is None:
+            return None                    # dropped cluster → demote
+        base_r, disp = r
+        self._emit(U.ADDI, T3, base_r, ZERO, disp)
+        self._emit(U.ANDI, T3, T3, ZERO, 0xFFFFFFFC)
+        self._emit(U.LOAD, T6, T3, ZERO, 0)
         return self._VRegion(pc, mem.base, int(regs[mem.base]) & M32,
                              mem.disp)
 
@@ -2164,6 +2184,58 @@ class Lifter:
             for k in np.nonzero(self.reg[fb:fb + 16] != lanes)[0]:
                 self._emit_resync(fb + int(k), int(lanes[k]))
 
+    # x86-64 syscall convention: number in rax, args rdi/rsi/rdx/r10/r8/r9
+    # (canonical encoding indices)
+    _SYSCALL_READS = [0, 2, 6, 7, 8, 9, 10]
+    # implicit register reads by mnemonic family (canonical indices:
+    # rax=0 rcx=1 rdx=2 rsp=4 rbp=5 rsi=6 rdi=7) — operand lists don't
+    # carry these (objdump prints 'rep movsb' with no operands)
+    _IMPLICIT_READS = {
+        "movs": [1, 6, 7], "stos": [0, 1, 7], "lods": [0, 1, 6],
+        "scas": [0, 1, 7], "cmps": [1, 6, 7],
+        "push": [4], "pop": [4], "call": [4], "ret": [4],
+        "leave": [4, 5], "enter": [4, 5],
+        "div": [0, 2], "idiv": [0, 2], "mul": [0, 2],
+        "cwd": [0], "cdq": [0], "cqo": [0],
+    }
+
+    def _demoted_read_set(self, inst: "Inst | None") -> list[int]:
+        """Arch registers a demoted instruction READS on silicon: every
+        reg operand (conservatively incl. the dest — AT&T RMW), every mem
+        base/index, xmm regs as 16+k, plus implicit families (string ops
+        read rsi/rdi/rcx with no operand list; push/pop read rsp; div
+        reads rax/rdx).  Undecoded bytes return [-1] (wildcard)."""
+        if inst is None:
+            return [-1]
+        parts = inst.mnemonic.split()
+        m0 = parts[0]
+        if m0 == "syscall":
+            return list(self._SYSCALL_READS)
+        reads: set[int] = set()
+        # 'rep movsb' → family 'movs'; bare 'movsb'/'stosq' too; one-op
+        # div/mul ('divq (%rax)') keyed by stem.  String families apply
+        # only to the real string forms (no operands, or %ds:/%es:
+        # segment-printed ones) — 'movsd'/'movslq' also strip to 'movs'
+        # but are ordinary 2-operand moves.
+        STRING_FAMS = ("movs", "stos", "lods", "scas", "cmps")
+        stringish = (not inst.operands
+                     or any(getattr(o, "seg", "") for o in inst.operands))
+        for tok in parts[:2]:
+            stem = tok.rstrip("bwldq")
+            if stem in self._IMPLICIT_READS \
+                    and (stem not in STRING_FAMS or stringish):
+                reads.update(self._IMPLICIT_READS[stem])
+        for o in inst.operands:
+            if o.kind == "reg" and 0 <= o.reg < N_GPR:
+                reads.add(int(o.reg))
+            elif o.kind == "xmm" and 0 <= o.reg < 16:
+                reads.add(16 + int(o.reg))
+            if o.base >= 0:
+                reads.add(int(o.base))
+            if o.index >= 0:
+                reads.add(int(o.index))
+        return sorted(reads)
+
     def _emit_resync(self, phys: int, value: int) -> None:
         """A demotion-resync LUI, recorded for the severed-fault test —
         every resync emission MUST go through here (ingest/hostdiff.py
@@ -2223,6 +2295,8 @@ class Lifter:
                 mn = inst.mnemonic if inst else f"@{pc:x}"
                 self.stats.opaque_mnemonics[mn] = \
                     self.stats.opaque_mnemonics.get(mn, 0) + 1
+                self.demoted_reads.append(
+                    (i, self._demoted_read_set(inst)))
 
         self.stats.uops = len(self.opcode)
         if not self.opcode:                       # degenerate: empty window
@@ -2248,6 +2322,8 @@ class Lifter:
             "clusters": [tuple(int(v) for v in c) for c in self.clusters],
             "mem_cluster": [int(x) for x in self.mem_cluster],
             "resync_uops": [int(x) for x in self.resync_uops],
+            "demoted_reads": [(int(s), [int(r) for r in rs])
+                              for s, rs in self.demoted_reads],
             "map_regions": self.map_regions(),
             "stats": self.stats.to_dict(),
             "nphys": int(self.reg.shape[0]),
