@@ -105,3 +105,41 @@ def test_capture_window_matches_lift():
     w = hd.capture_window_macro_ops(paths)
     _tr, meta = hd.capture_and_lift(paths)
     assert w == meta["macro_ops"] > 0
+
+
+@pytest.mark.quick
+def test_demoted_exposed_escalation_rule():
+    """_demoted_exposed semantics on a synthetic window: a fault in a
+    register a LATER demoted instruction reads escalates, unless a pure
+    (non-RMW) replayed write to the faulted phys lane kills it first."""
+    import numpy as np
+
+    from shrewd_tpu.ingest.hostdiff import _demoted_exposed
+    from shrewd_tpu.isa import uops as U
+    from shrewd_tpu.trace.format import Trace
+
+    # 4 macro steps, 2 µops each: step2 writes r3 (pure LUI), others NOPs
+    op = np.full(8, U.NOP, np.int32)
+    dst = np.zeros(8, np.int32)
+    op[4] = U.LUI                      # step 2, first µop: r3 = const
+    dst[4] = 3
+    op[6] = U.ADDI                     # step 3: r5 += 1 (RMW of r5)
+    dst[6] = 5
+    src1 = np.zeros(8, np.int32)
+    src1[6] = 5
+    tr = Trace(opcode=op, dst=dst, src1=src1,
+               src2=np.zeros(8, np.int32), imm=np.zeros(8, np.uint32),
+               taken=np.zeros(8, np.int32),
+               init_reg=np.zeros(16, np.uint32),
+               init_mem=np.zeros(8, np.uint32))
+    meta = {"uop_start": [0, 2, 4, 6],
+            "demoted_reads": [(3, [3, 5])],    # step 3 demotes, reads r3+r5
+            "width": 32}
+    coords = np.array([
+        [0, 3, 1],    # fault r3 @0: killed by step2's pure LUI → clean
+        [3, 3, 1],    # fault r3 @3 (same step as demoted read) → exposed
+        [0, 5, 1],    # fault r5 @0: step3's ADDI is RMW → still exposed
+        [0, 1, 1],    # fault r1: never read by a demotion → clean
+    ], dtype=np.int64)
+    got = _demoted_exposed(tr, meta, coords)
+    assert got.tolist() == [False, True, True, False]

@@ -32,7 +32,8 @@ DEFAULT_WORKLOADS = [
 
 KEEP = ("trials", "host_avf", "device_avf", "avf_abs_err",
         "agreement_exact", "agreement_vulnerable", "cis_overlap",
-        "device_diverged", "diverged_resolved",
+        "device_diverged", "resync_severed", "escalated_total",
+        "diverged_resolved",
         "diverged_resolution_failed", "window_macro_ops_sampled")
 
 
