@@ -60,12 +60,14 @@ def build_workload(workload_c="workloads/sort.c"):
 
 
 def ensure_checkpoint(binary, pc, timeout=600.0):
-    """Shared marker-checkpoint cache (golden_campaign + o3_validate):
-    RUNDIR/ckpt-golden is valid only for the stamped binary sha + marker
-    PC; rebuilt otherwise.  Returns the checkpoint dir."""
+    """Shared marker-checkpoint cache (golden_campaign + o3_validate +
+    shrewd_validate): one directory per workload stem, valid only for the
+    stamped binary sha + marker PC; rebuilt otherwise.  Returns the
+    checkpoint dir."""
     binary_sha = sh(["sha256sum", binary]).stdout.split()[0]
-    ckpt = os.path.join(RUNDIR, "ckpt-golden")
-    stamp_path = os.path.join(RUNDIR, "ckpt-golden.stamp")
+    stem = os.path.splitext(os.path.basename(binary))[0]
+    ckpt = os.path.join(RUNDIR, f"ckpt-golden-{stem}")
+    stamp_path = ckpt + ".stamp"
     stamp = f"{binary_sha} 0x{pc:x}"
     stale = True
     if os.path.exists(os.path.join(ckpt, "m5.cpt")) \

@@ -60,6 +60,14 @@ parser.add_argument("--reset-stats", action="store_true",
                          "instantiate; dump before exit")
 parser.add_argument("--max-ticks", type=int, default=0,
                     help="abs tick bound on restore (hang => DUE)")
+parser.add_argument("--shrewd", default="off",
+                    choices=["off", "deferred", "priority"],
+                    help="o3 only: enable SHREWD shadow-FU redundant "
+                         "execution (cxx_exports setEnableShrewd / "
+                         "setPriorityToShadow, "
+                         "src/cpu/o3/BaseO3CPU.py:70-71); 'priority' "
+                         "claims the shadow at issue, 'deferred' in the "
+                         "post-issue pass (inst_queue.cc:1029-1066)")
 args = parser.parse_args()
 
 system = System()
@@ -127,6 +135,15 @@ if args.mode == "restore":
     m5.instantiate(args.ckpt_dir)
 else:
     m5.instantiate()
+
+if args.shrewd != "off":
+    if args.cpu != "o3":
+        print("--shrewd needs --cpu=o3", file=sys.stderr)
+        sys.exit(2)
+    # pybind-exported C++ setters on the instantiated CPU
+    # (BaseO3CPU.cxx_exports → o3::CPU::setEnableShrewd, cpu.hh:298-302)
+    system.cpu.setEnableShrewd(True)
+    system.cpu.setPriorityToShadow(args.shrewd == "priority")
 
 if args.reset_stats:
     m5.stats.reset()

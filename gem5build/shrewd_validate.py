@@ -1,0 +1,275 @@
+"""SHREWD shadow-FU model vs the reference binary's own mechanism.
+
+The fork's defining feature is redundant execution through shadow
+functional units (``src/cpu/o3/inst_queue.cc:897-903`` primary-path claim,
+``:1029-1066`` deferred pass, ``requestShadow`` ``:1082-1096``), with
+per-OpClass availability counters in the IQ
+(``src/cpu/o3/inst_queue.hh:581-606``).  This tool closes the last
+unvalidated loop (VERDICT r4 missing #1): run the *rebuilt reference
+binary* with ``setEnableShrewd``/``setPriorityToShadow`` (pybind exports,
+``src/cpu/o3/BaseO3CPU.py:70-71``) over the same marker windows the
+framework lifts, and compare its measured shadow-availability stats to
+``models/fupool.py``'s structural predictions, per OpClass, both
+``priorityToShadow`` settings.
+
+Comparison units (the µop decompositions differ — gem5's x86 microcode vs
+the framework's 31-op ISA — so counts are normalized):
+
+  availability  = <Class>ShadowAvailable / (Available + NotAvailable)
+  same_fu_frac  = ShadowIsSameFU / shadowAvailable   (exact vs approx mix)
+  request_rate  = shadow requests / issued µops
+
+gem5's fine OpClasses aggregate onto the framework's coarse ones
+(IntAlu→IntAlu; IntMult+IntDiv→IntMult; FloatAdd/Cmp/Cvt→FpAlu;
+FloatMult/MultAcc/Misc/Div/Sqrt→FpMult).
+
+Paired detected-class campaign: the same TrialKernel FU-fault campaign
+(same trace, same sampler, same PRNG keys) run twice — once with the
+structural model's per-µop coverage, once with a per-class coverage array
+built from gem5's measured availability — so any availability disagreement
+surfaces directly as a detected-fraction delta.
+
+Writes SHREWD_VALIDATE.json.
+
+Usage: PYTHONPATH=/root/repo python gem5build/shrewd_validate.py
+"""
+
+import argparse
+import json
+import os
+import re
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+sys.path.insert(0, REPO)
+sys.path.insert(0, HERE)
+
+from golden_campaign import GEM5, ensure_checkpoint, run_gem5  # noqa: E402
+
+# gem5 fine OpClass → framework coarse OpClass name
+COARSE = {
+    "IntAlu": "IntAlu",
+    "IntMult": "IntMult", "IntDiv": "IntMult",
+    "FloatAdd": "FpAlu", "FloatCmp": "FpAlu", "FloatCvt": "FpAlu",
+    "FloatMult": "FpMult", "FloatMultAcc": "FpMult", "FloatMisc": "FpMult",
+    "FloatDiv": "FpMult", "FloatSqrt": "FpMult",
+}
+
+SCALARS = {
+    "numCycles": r"system\.cpu\.numCycles\s+(\d+)",
+    "instsIssued": r"system\.cpu\.instsIssued\s+(\d+)",
+    "uops": r"system\.cpu\.commitStats0\.numOps\s+(\d+)",
+    "shadowAvailable": r"system\.cpu\.shadowAvailable\s+(\d+)",
+    "shadowNotAvailable": r"system\.cpu\.shadowNotAvailable\s+(\d+)",
+    "ShadowIsSameFU": r"system\.cpu\.ShadowIsSameFU\s+(\d+)",
+    "ShadowIsNotSameFU": r"system\.cpu\.ShadowIsNotSameFU\s+(\d+)",
+}
+
+
+def parse_stats(outdir):
+    with open(os.path.join(outdir, "stats.txt")) as f:
+        text = f.read()
+    out = {}
+    for key, pat in SCALARS.items():
+        m = re.findall(pat, text)
+        out[key] = int(m[-1]) if m else 0
+    coarse = {}
+    for fine, co in COARSE.items():
+        row = coarse.setdefault(co, {"available": 0, "not_available": 0})
+        for suffix, field in (("ShadowAvailable", "available"),
+                              ("ShadowNotAvailable", "not_available")):
+            m = re.findall(
+                rf"system\.cpu\.{fine}{suffix}\s+(\d+)", text)
+            if m:
+                row[field] += int(m[-1])
+    out["classes"] = {}
+    for co, row in coarse.items():
+        req = row["available"] + row["not_available"]
+        if req:
+            out["classes"][co] = {
+                **row, "requests": req,
+                "availability": round(row["available"] / req, 4)}
+    return out
+
+
+def gem5_leg(paths, mode, timeout):
+    ckpt = ensure_checkpoint(str(paths.workload), paths.begin,
+                             timeout=timeout)
+    rc, out, wall, outdir = run_gem5(
+        "restore", str(paths.workload), ckpt,
+        ["--cpu=o3", "--caches", "--reset-stats",
+         f"--stop-pc=0x{paths.end:x}", f"--shrewd={mode}"],
+        timeout=timeout)
+    assert rc == 0 and "STOP_PC_REACHED" in out, \
+        f"gem5 shrewd={mode} failed rc={rc}\n{out[-1500:]}"
+    g = parse_stats(outdir)
+    g["wall_s"] = round(wall, 1)
+    return g
+
+
+def make_schedule(trace):
+    """One scoreboard walk per workload — the schedule is independent of
+    the priorityToShadow flag, so both model legs share it."""
+    from shrewd_tpu.models.timing import (TimingConfig, compute_scoreboard,
+                                          nonpipelined_busy)
+
+    tcfg = TimingConfig(bpred="bimodal")    # the gem5-anchored defaults
+    sb = compute_scoreboard(trace, tcfg)
+    return tcfg, sb.issue, nonpipelined_busy(trace.opcode, tcfg)
+
+
+def model_leg(trace, priority, schedule):
+    from shrewd_tpu.isa import uops as U
+    from shrewd_tpu.models.fupool import FUPoolModel
+
+    tcfg, issue_cycle, busy = schedule
+    m = FUPoolModel(U.opclass_of(trace.opcode), issue_width=tcfg.issue_width,
+                    priority_to_shadow=priority, issue_cycle=issue_cycle,
+                    busy_cycles=busy)
+    av = m.availability()
+    # rename the framework's coarse names onto the comparison space
+    rename = {"IntAlu": "IntAlu", "IntMult": "IntMult",
+              "FpAlu": "FpAlu", "FpMult": "FpMult"}
+    classes = {rename[k]: v for k, v in av.items() if k in rename}
+    granted = int(m.shadow_granted.sum() + m.shadow_granted_approx.sum())
+    return m, {
+        "classes": classes,
+        "shadowAvailable": granted,
+        "shadowNotAvailable": int(m.shadow_denied.sum()),
+        "ShadowIsSameFU": int(m.shadow_granted.sum()),
+        "ShadowIsNotSameFU": int(m.shadow_granted_approx.sum()),
+        "issued_uops": int(trace.n),
+    }
+
+
+def paired_campaign(trace, gem5_classes, trials, memmap):
+    """Same FU-fault campaign twice: structural coverage vs gem5-measured
+    per-class availability as coverage.  Identical keys → the detected
+    fractions differ only through the availability numbers."""
+    import numpy as np
+
+    from shrewd_tpu.isa import uops as U
+    from shrewd_tpu.models.o3 import O3Config
+    from shrewd_tpu.ops import classify as C
+    from shrewd_tpu.ops.trial import TrialKernel
+    from shrewd_tpu.utils import prng
+
+    keys = prng.trial_keys(prng.campaign_key(503), trials)
+
+    cov_gem5 = [0.0] * U.N_OPCLASSES
+    name_to_oc = {"IntAlu": U.OC_INT_ALU, "IntMult": U.OC_INT_MULT,
+                  "FpAlu": U.OC_FP_ALU, "FpMult": U.OC_FP_MULT}
+    for name, row in gem5_classes.items():
+        if name in name_to_oc:
+            cov_gem5[name_to_oc[name]] = row["availability"]
+
+    out = {}
+    for label, cfg in (
+            ("fupool_model", O3Config(shadow_model="fupool")),
+            ("gem5_availability", O3Config(shadow_coverage=cov_gem5))):
+        k = TrialKernel(trace, cfg, memmap=memmap)
+        tally = np.asarray(k.run_keys(keys, "fu"))
+        out[label] = {
+            "tally": [int(x) for x in tally],
+            "detected_frac": round(
+                float(tally[C.OUTCOME_DETECTED]) / max(tally.sum(), 1), 4),
+        }
+    out["detected_delta"] = round(
+        abs(out["fupool_model"]["detected_frac"]
+            - out["gem5_availability"]["detected_frac"]), 4)
+    return out
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--workloads", nargs="+",
+                    default=["workloads/sort.c", "workloads/intmm.c",
+                             "workloads/bytehash.c", "workloads/divmix.c",
+                             "workloads/fpmix.c"])
+    ap.add_argument("--trials", type=int, default=4096)
+    ap.add_argument("--timeout", type=float, default=900.0)
+    ap.add_argument("--out", default=os.path.join(REPO,
+                                                  "SHREWD_VALIDATE.json"))
+    args = ap.parse_args()
+
+    assert os.path.exists(GEM5), f"{GEM5} not built yet"
+
+    from shrewd_tpu.ingest import hostdiff as hd
+
+    doc = {"tolerance_target": 0.10, "workloads": {}}
+    worst = 0.0
+    for wl in args.workloads:
+        paths = hd.build_tools(wl)
+        trace, meta = hd.capture_and_lift(paths)
+        memmap = hd.memmap_from_meta(meta)
+        row = {"window_uops": int(trace.n)}
+        schedule = make_schedule(trace)
+        for mode, priority in (("deferred", False), ("priority", True)):
+            g = gem5_leg(paths, mode, args.timeout)
+            m, fw = model_leg(trace, priority, schedule)
+            cmp_classes = {}
+            g_total = sum(c["requests"] for c in g["classes"].values())
+            f_total = sum(c["requests"] for c in fw["classes"].values())
+            for co in sorted(set(g["classes"]) | set(fw["classes"])):
+                ga = g["classes"].get(co, {}).get("availability")
+                fa = fw["classes"].get(co, {}).get("availability")
+                if ga is not None and fa is not None:
+                    delta = round(abs(ga - fa), 4)
+                else:
+                    # one-sided class: a structural disagreement, not a
+                    # skip — count it against the verdict unless the
+                    # present side's requests are de-minimis (µop-ISA
+                    # decomposition noise)
+                    req = (g["classes"].get(co) or fw["classes"]
+                           .get(co))["requests"]
+                    tot = g_total if co in g["classes"] else f_total
+                    delta = (1.0 if req >= max(32, 0.005 * tot)
+                             else None)
+                if delta is not None:
+                    worst = max(worst, delta)
+                cmp_classes[co] = {
+                    "gem5": g["classes"].get(co),
+                    "framework": fw["classes"].get(co),
+                    "abs_delta": delta,
+                }
+            tot_g = g["shadowAvailable"] + g["shadowNotAvailable"]
+            tot_f = fw["shadowAvailable"] + fw["shadowNotAvailable"]
+            row[mode] = {
+                "gem5": {k: g[k] for k in SCALARS},
+                "framework_totals": fw,
+                "classes": cmp_classes,
+                "overall_availability": {
+                    "gem5": round(g["shadowAvailable"] / max(tot_g, 1), 4),
+                    "framework": round(
+                        fw["shadowAvailable"] / max(tot_f, 1), 4),
+                },
+                "same_fu_frac": {
+                    "gem5": round(g["ShadowIsSameFU"]
+                                  / max(g["shadowAvailable"], 1), 4),
+                    "framework": round(fw["ShadowIsSameFU"]
+                                       / max(fw["shadowAvailable"], 1), 4),
+                },
+            }
+            print(f"{wl} {mode}: gem5 avail "
+                  f"{row[mode]['overall_availability']['gem5']} vs fw "
+                  f"{row[mode]['overall_availability']['framework']}")
+        row["paired_campaign"] = paired_campaign(
+            trace, row["deferred"]["classes"] and {
+                co: c["gem5"] for co, c in row["deferred"]["classes"].items()
+                if c["gem5"]},
+            args.trials, memmap)
+        doc["workloads"][wl] = row
+
+    doc["worst_class_abs_delta"] = round(worst, 4)
+    doc["pass"] = worst <= doc["tolerance_target"]
+    with open(args.out, "w") as f:
+        json.dump(doc, f, indent=1)
+        f.write("\n")
+    print(f"worst per-class |Δavailability| = {worst:.4f} "
+          f"({'PASS' if doc['pass'] else 'FAIL'} at ≤0.10)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -2196,7 +2196,11 @@ class Lifter:
         "push": [4], "pop": [4], "call": [4], "ret": [4],
         "leave": [4, 5], "enter": [4, 5],
         "div": [0, 2], "idiv": [0, 2], "mul": [0, 2],
+        # sign-extend family: Intel spellings AND the AT&T ones objdump
+        # actually prints (cwtd=cwd, cltd=cdq, cqto=cqo) — the lifter's
+        # own decode matches the AT&T forms
         "cwd": [0], "cdq": [0], "cqo": [0],
+        "cwtd": [0], "cltd": [0], "cqto": [0],
     }
 
     def _demoted_read_set(self, inst: "Inst | None") -> list[int]:
@@ -2221,7 +2225,12 @@ class Lifter:
         stringish = (not inst.operands
                      or any(getattr(o, "seg", "") for o in inst.operands))
         for tok in parts[:2]:
-            stem = tok.rstrip("bwldq")
+            # strip at most ONE trailing size-suffix letter ('pushq',
+            # 'stosb'); rstrip would eat into the mnemonic itself
+            # ('call'→'ca', 'mul'→'mu', 'cwd'/'cdq'→'c') and orphan those
+            # implicit-read entries
+            stem = tok if tok in self._IMPLICIT_READS else (
+                tok[:-1] if tok and tok[-1] in "bwldq" else tok)
             if stem in self._IMPLICIT_READS \
                     and (stem not in STRING_FAMS or stringish):
                 reads.update(self._IMPLICIT_READS[stem])

@@ -39,15 +39,21 @@ GRANT_APPROX = 2    # shadow on an approximate-capability unit
 
 
 class FUDesc(ConfigObject):
-    """One functional-unit type (``src/cpu/FuncUnitConfig.py`` analog).
+    """One functional-unit type (``src/cpu/o3/FuncUnitConfig.py`` analog).
 
     ``capabilities`` lists the OpClass codes the unit executes;
     ``approx_capabilities`` lists OpClasses it can *check* approximately when
     claimed as a shadow (the ``approx_capability`` relaxation of
-    ``FUPool::getUnit``, ``fu_pool.hh:175-180``)."""
+    ``FUPool::getUnit``, ``fu_pool.hh:175-180``).  ``pipelined`` units are
+    freed the cycle after issue regardless of ``op_lat``
+    (``FUPool::freeUnitNextCycle``, ``inst_queue.cc:934-963``); only
+    non-pipelined units (the reference's divider/sqrt ``OpDesc``s,
+    ``FuncUnitConfig.py``) stay busy for the full latency."""
 
     count = Param(int, 1, "number of units of this type")
     op_lat = Param(int, 1, "operation latency in cycles")
+    pipelined = Param(bool, True, "freed next cycle if true, else busy "
+                      "for op_lat cycles (reference OpDesc.pipelined)")
     capabilities = VectorParam(int, [], "OpClass codes executed")
     approx_capabilities = VectorParam(
         int, [], "OpClass codes checkable approximately as a shadow")
@@ -55,39 +61,50 @@ class FUDesc(ConfigObject):
 
 class IntALU(FUDesc):
     """Reference ``IntALU`` (count 6 in the default O3 pool,
-    ``src/cpu/o3/FUPool.py``); can approximately check multiplies (e.g. a
-    residue check) when claimed as a shadow."""
+    ``src/cpu/o3/FUPool.py``).  As a shadow it approximately checks the FP
+    classes — the reference's ``FloatAdd/Mult/Div/Sqrt → IntAlu`` fallback
+    (``fu_pool.cc:233-277``)."""
     count = Param(int, 6, "number of units of this type")
     capabilities = VectorParam(int, [U.OC_INT_ALU], "OpClass codes executed")
     approx_capabilities = VectorParam(
-        int, [U.OC_INT_MULT], "OpClass codes checkable approximately")
+        int, [U.OC_FP_ALU, U.OC_FP_MULT],
+        "OpClass codes checkable approximately")
 
 
 class IntMultDiv(FUDesc):
-    """Reference ``IntMultDiv`` (count 2 in the default pool)."""
+    """Reference ``IntMultDiv`` (count 2 in the default pool; IntMult
+    opLat 3 pipelined, IntDiv opLat 20 non-pipelined —
+    ``FuncUnitConfig.py:50-56``).  Nothing falls back *to* this unit in the
+    reference's shadow scheme (``fu_pool.cc:177-294``)."""
     count = Param(int, 2, "number of units of this type")
     op_lat = Param(int, 3, "operation latency in cycles")
     capabilities = VectorParam(int, [U.OC_INT_MULT], "OpClass codes executed")
 
 
 class FP_ALU(FUDesc):
-    """Reference ``FP_ALU`` (count 4, FloatAdd/Cmp/Cvt ops,
-    ``src/cpu/FuncUnitConfig.py``) — the unit class the SHREWD shadow
-    story chiefly targets (``fu_pool.cc:177-294``); can approximately
-    check FP multiplies when claimed as a shadow."""
+    """Reference ``FP_ALU`` (count 4, FloatAdd/Cmp/Cvt ops, opLat 2,
+    ``FuncUnitConfig.py:59-65``).  As a shadow it approximately checks
+    integer ALU ops — the reference's ``IntAlu → FloatAdd, FloatCmp``
+    fallback (``fu_pool.cc:193-209``)."""
     count = Param(int, 4, "number of units of this type")
     op_lat = Param(int, 2, "operation latency in cycles")
     capabilities = VectorParam(int, [U.OC_FP_ALU], "OpClass codes executed")
     approx_capabilities = VectorParam(
-        int, [U.OC_FP_MULT], "OpClass codes checkable approximately")
+        int, [U.OC_INT_ALU], "OpClass codes checkable approximately")
 
 
 class FP_MultDiv(FUDesc):
-    """Reference ``FP_MultDiv`` (count 2, FloatMult/Div/Sqrt)."""
+    """Reference ``FP_MultDiv`` (count 2; FloatMult opLat 4 pipelined,
+    FloatDiv/Sqrt non-pipelined, ``FuncUnitConfig.py:68-76``).  As a shadow
+    it approximately checks integer multiplies/divides — the reference's
+    ``IntMult → FloatMult`` / ``IntDiv → FloatDiv`` fallback
+    (``fu_pool.cc:210-231``)."""
     count = Param(int, 2, "number of units of this type")
     op_lat = Param(int, 4, "operation latency in cycles")
     capabilities = VectorParam(int, [U.OC_FP_MULT],
                                "OpClass codes executed")
+    approx_capabilities = VectorParam(
+        int, [U.OC_INT_MULT], "OpClass codes checkable approximately")
 
 
 class RdWrPort(FUDesc):
@@ -129,11 +146,26 @@ class FUPoolModel:
     detection-coverage array (``coverage()``) the replay kernel gathers from.
     Collects the per-OpClass availability counters the reference keeps in the
     IQ (``inst_queue.hh:581-606``) plus the classic ``statFuBusy`` analog.
+
+    ``issue_cycle`` (optional, int64[n]) assigns each µop its issue cycle —
+    pass ``Scoreboard.issue`` from ``models.timing.compute_scoreboard`` to
+    drive contention with the anchored timing model's schedule instead of
+    the dense ``i // issue_width`` proxy.  Within a cycle, µops contend in
+    trace order (the reference's oldest-first ``listOrder`` walk,
+    ``inst_queue.cc:850``).
+
+    ``busy_cycles`` (optional, int64[n]) overrides how long the *primary*
+    unit claimed by µop *i* stays busy — use it to mark non-pipelined divide
+    µops (reference ``OpDesc(pipelined=False)``, ``FuncUnitConfig.py:53``)
+    that hold a unit for their full latency while everything else frees the
+    next cycle.
     """
 
     def __init__(self, opclass: np.ndarray, issue_width: int = 8,
                  pool: FUPoolConfig | None = None,
-                 priority_to_shadow: bool = False):
+                 priority_to_shadow: bool = False,
+                 issue_cycle: np.ndarray | None = None,
+                 busy_cycles: np.ndarray | None = None):
         self.pool = pool if pool is not None else FUPoolConfig()
         self.issue_width = int(issue_width)
         self.priority_to_shadow = bool(priority_to_shadow)
@@ -142,7 +174,11 @@ class FUPoolModel:
 
         descs = self.pool.descs()
         counts = np.array([d.count for d in descs], dtype=np.int64)
-        op_lat = np.array([d.op_lat for d in descs], dtype=np.int64)
+        # Busy time of a claimed unit: pipelined units free next cycle
+        # (FUPool::freeUnitNextCycle, inst_queue.cc:934-963); non-pipelined
+        # ones at completion (FUCompletion::setFreeFU).
+        hold = np.array([1 if d.pipelined else d.op_lat for d in descs],
+                        dtype=np.int64)
         cap = np.zeros((len(descs), U.N_OPCLASSES), dtype=bool)
         approx = np.zeros_like(cap)
         for di, d in enumerate(descs):
@@ -160,28 +196,42 @@ class FUPoolModel:
 
         self.grants = np.zeros(self.n, dtype=np.int8)
 
-        # Flattened unit instances: per unit, its desc id and the cycle it
-        # frees up (op_lat > 1 keeps a claimed unit busy across cycles —
-        # FUCompletion scheduling in the reference, inst_queue.cc:934-963).
         unit_desc = np.repeat(np.arange(len(descs)), counts)
-        self._unit_lat = op_lat[unit_desc]
+        self._unit_hold = hold[unit_desc]
         self._free_at = np.zeros(len(unit_desc), dtype=np.int64)
+        self._busy = (None if busy_cycles is None
+                      else np.asarray(busy_cycles, dtype=np.int64))
+        if self._busy is not None and self._busy.shape[0] != self.n:
+            raise ValueError("busy_cycles length != opclass length")
         # Loop-invariant unit-scan lists per OpClass (pool order).
         cap_units = [list(np.nonzero(cap[unit_desc, c])[0])
                      for c in range(U.N_OPCLASSES)]
         approx_units = [list(np.nonzero(approx[unit_desc, c])[0])
                         for c in range(U.N_OPCLASSES)]
 
-        W = self.issue_width
-        for c0 in range(0, self.n, W):
-            cyc = c0 // W
-            cycle_uops = range(c0, min(c0 + W, self.n))
+        if issue_cycle is None:
+            W = self.issue_width
+            cyc_of = np.arange(self.n, dtype=np.int64) // W
+        else:
+            cyc_of = np.asarray(issue_cycle, dtype=np.int64)
+            if cyc_of.shape[0] != self.n:
+                raise ValueError("issue_cycle length != opclass length")
+
+        # Walk cycle groups in schedule order (trace order within a cycle).
+        order = np.argsort(cyc_of, kind="stable")
+        g0 = 0
+        while g0 < self.n:
+            g1 = g0
+            cyc = int(cyc_of[order[g0]])
+            while g1 < self.n and cyc_of[order[g1]] == cyc:
+                g1 += 1
             deferred: list[tuple[int, int]] = []
-            for i in cycle_uops:
+            for k in range(g0, g1):
+                i = int(order[k])
                 oc_i = int(oc[i])
                 if oc_i == U.OC_NONE:
                     continue
-                got_primary = self._primary(cyc, oc_i, cap_units)
+                got_primary = self._primary(cyc, i, oc_i, cap_units)
                 # requestShadow only fires when the primary got a valid FU
                 # (reference inst_queue.cc:1082+: idx != NoFreeFU /
                 # NoCapableFU guard before the shadow request)
@@ -196,18 +246,21 @@ class FUPoolModel:
             # (inst_queue.cc:1029-1066)
             for i, oc_i in deferred:
                 self._shadow(cyc, i, oc_i, cap_units, approx_units)
+            g0 = g1
 
-    def _claim(self, cyc: int, units) -> bool:
+    def _claim(self, cyc: int, units, hold_override: int = 0) -> bool:
         for u in units:
             if self._free_at[u] <= cyc:
-                self._free_at[u] = cyc + self._unit_lat[u]
+                h = hold_override if hold_override else self._unit_hold[u]
+                self._free_at[u] = cyc + h
                 return True
         return False
 
-    def _primary(self, cyc: int, oc_i: int, cap_units) -> bool:
-        if not self._claim(cyc, cap_units[oc_i]):
-            # Pool over-subscribed: the 1-IPC proxy has no stall model, so
-            # the µop proceeds without consuming a unit; record it (the
+    def _primary(self, cyc: int, i: int, oc_i: int, cap_units) -> bool:
+        h = int(self._busy[i]) if self._busy is not None else 0
+        if not self._claim(cyc, cap_units[oc_i], h):
+            # Pool over-subscribed: the schedule proxy has no stall model,
+            # so the µop proceeds without consuming a unit; record it (the
             # reference would hold it in the IQ — statFuBusy).
             self.fu_busy[oc_i] += 1
             return False
@@ -216,7 +269,12 @@ class FUPoolModel:
     def _shadow(self, cyc: int, i: int, oc_i: int, cap_units,
                 approx_units) -> None:
         self.shadow_requests[oc_i] += 1
-        if self._claim(cyc, cap_units[oc_i]):
+        # Exact shadows re-run the µop's own class — non-pipelined µops
+        # (divides) hold the shadow unit just like the primary; approximate
+        # shadows run as the granting unit's class (approx_capability,
+        # fu_pool.cc:188-294), so the unit's own hold applies.
+        h = int(self._busy[i]) if self._busy is not None else 0
+        if self._claim(cyc, cap_units[oc_i], h):
             self.shadow_granted[oc_i] += 1
             self.grants[i] = GRANT_EXACT
         elif self._claim(cyc, approx_units[oc_i]):
@@ -224,6 +282,29 @@ class FUPoolModel:
             self.grants[i] = GRANT_APPROX
         else:
             self.shadow_denied[oc_i] += 1    # NoShadowFU
+
+    def availability(self) -> dict[str, dict[str, float | int]]:
+        """Per-OpClass shadow availability, the reference's
+        ``<Class>ShadowAvailable / (Available + NotAvailable)`` ratio
+        (``inst_queue.hh:581-606``).  A *grant* of either kind counts as
+        available — the reference bumps ``shadowAvailable`` for exact and
+        approximate units alike (``requestShadow``,
+        ``inst_queue.cc:1082-1096``)."""
+        out = {}
+        for c in range(U.N_OPCLASSES):
+            req = int(self.shadow_requests[c])
+            if not req:
+                continue
+            avail = int(self.shadow_granted[c]
+                        + self.shadow_granted_approx[c])
+            out[U.OPCLASS_NAMES[c]] = {
+                "requests": req, "available": avail,
+                "not_available": int(self.shadow_denied[c]),
+                "availability": round(avail / req, 4),
+                "same_fu": int(self.shadow_granted[c]),
+                "not_same_fu": int(self.shadow_granted_approx[c]),
+            }
+        return out
 
     def coverage(self) -> np.ndarray:
         """Per-µop shadow detection probability, float32[n]."""

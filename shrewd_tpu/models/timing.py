@@ -155,6 +155,19 @@ def _latencies(opcode: np.ndarray, cfg: TimingConfig) -> np.ndarray:
     return np.maximum(lat, 1)
 
 
+def nonpipelined_busy(opcode: np.ndarray, cfg: TimingConfig) -> np.ndarray:
+    """int64[n]: FU-busy cycles for µops whose unit is NOT pipelined —
+    the divide family (reference ``OpDesc(pipelined=False)`` entries,
+    ``src/cpu/o3/FuncUnitConfig.py:53,73-74``) holds its unit for the full
+    latency; zero elsewhere (pipelined units free next cycle,
+    ``FUPool::freeUnitNextCycle``).  Feed to ``FUPoolModel(busy_cycles=)``."""
+    opcode = np.asarray(opcode)
+    busy = np.zeros(opcode.shape[0], np.int64)
+    busy[np.asarray(U.is_div(opcode))] = cfg.div_latency
+    busy[opcode == U.FDIV] = cfg.fdiv_latency
+    return busy
+
+
 def predict_mispredicts(trace, cfg: TimingConfig) -> np.ndarray:
     """bool[n]: branches whose captured direction a bimodal predictor
     mispredicts (reference: ``src/cpu/pred/bpred_unit.hh:99``; per-branch

@@ -52,8 +52,22 @@ class TrialKernel:
         self.init_mem = jnp.asarray(trace.init_mem, dtype=jnp.uint32)
         # Per-µop shadow detection coverage (availability folded in); the
         # structural model also yields the FU pool's availability stats.
+        # With scoreboard timing, the structural model contends under the
+        # scoreboard's real issue schedule (SHREWD_VALIDATE: the dense
+        # i//width proxy overstates contention ~3× vs the reference O3).
+        self._scoreboard = None     # timing="scoreboard": shared per kernel
+        issue_cycle = busy = None
+        if (self.cfg.shadow_model == "fupool"
+                and self.cfg.enable_shrewd
+                and self.cfg.timing == "scoreboard"):
+            from shrewd_tpu.models.timing import (compute_scoreboard,
+                                                  nonpipelined_busy)
+            self._scoreboard = compute_scoreboard(trace, self.cfg.timing_cfg)
+            issue_cycle = self._scoreboard.issue
+            busy = nonpipelined_busy(trace.opcode, self.cfg.timing_cfg)
         cov, self.fu_model = compute_shadow_cov(
-            U.opclass_of(trace.opcode), self.cfg)
+            U.opclass_of(trace.opcode), self.cfg,
+            issue_cycle=issue_cycle, busy_cycles=busy)
         self.shadow_cov = jnp.asarray(cov, dtype=jnp.float32)
         self._opclass = jnp.asarray(U.opclass_of(trace.opcode),
                                     dtype=jnp.int32)
@@ -64,7 +78,6 @@ class TrialKernel:
         self._golden_rec = None         # taint-kernel streams, lazy
         self._samplers: dict = {}
         self._sample_jits: dict = {}
-        self._scoreboard = None     # timing="scoreboard": shared per kernel
         # taint observability: escape counts feed campaign stats
         self.escapes = 0
         self.taint_trials = 0

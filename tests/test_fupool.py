@@ -29,13 +29,31 @@ def test_underutilized_cycle_grants_all_shadows():
     np.testing.assert_array_equal(m.coverage(), [1.0, 1.0])
 
 
-def test_saturated_cycle_denies_late_shadows():
-    # 4 ALU µops, one cycle, 6 units: 4 primaries + shadows for the first 2
-    # exhaust the pool; shadows 3 and 4 are denied (NoShadowFU).
+def test_saturated_cycle_spills_shadows_to_fp_alu():
+    # 4 ALU µops, one cycle, 6 IntALU units: 4 primaries + shadows for the
+    # first 2 exhaust the integer pool; shadows 3 and 4 fall back to the
+    # FP_ALU units — the reference's IntAlu → FloatAdd/FloatCmp approx
+    # fallback (fu_pool.cc:193-209).
     m = FUPoolModel(oc_seq(*[U.OC_INT_ALU] * 4), issue_width=8)
-    assert list(m.grants) == [GRANT_EXACT, GRANT_EXACT, GRANT_NONE, GRANT_NONE]
-    assert m.shadow_denied[U.OC_INT_ALU] == 2
+    assert list(m.grants) == [GRANT_EXACT, GRANT_EXACT,
+                              GRANT_APPROX, GRANT_APPROX]
+    assert m.shadow_granted_approx[U.OC_INT_ALU] == 2
+    assert m.shadow_denied.sum() == 0
     assert m.fu_busy.sum() == 0
+
+
+def test_full_pool_denies_shadows():
+    # 7 ALU µops, one cycle: 6 primaries on IntALU + 1 spilling primary
+    # (fu_busy — no shadow request per the issue guard).  The 6 issued
+    # shadows contend for the 4 FP_ALU approx units → 2 denied NoShadowFU.
+    m = FUPoolModel(oc_seq(*[U.OC_INT_ALU] * 7), issue_width=8)
+    assert m.fu_busy[U.OC_INT_ALU] == 1
+    assert m.shadow_requests[U.OC_INT_ALU] == 6
+    assert m.shadow_granted_approx[U.OC_INT_ALU] == 4
+    assert m.shadow_denied[U.OC_INT_ALU] == 2
+    av = m.availability()["IntAlu"]
+    assert av["requests"] == 6 and av["available"] == 4
+    assert av["availability"] == pytest.approx(4 / 6, abs=1e-4)
 
 
 def test_issue_width_splits_cycles():
@@ -44,9 +62,11 @@ def test_issue_width_splits_cycles():
     assert list(m.grants) == [GRANT_EXACT] * 4
 
 
-def test_mult_shadow_falls_back_to_approx_alu():
+def test_mult_shadow_falls_back_to_fp_multdiv():
     # 2 MUL µops, 2 IntMultDiv units: both primaries consume the mult units;
-    # shadows find no exact unit and fall back to approximate ALU checking.
+    # shadows find no exact unit and fall back to the FP_MultDiv units —
+    # the reference's IntMult → FloatMult approx fallback
+    # (fu_pool.cc:210-219).
     m = FUPoolModel(oc_seq(U.OC_INT_MULT, U.OC_INT_MULT), issue_width=8)
     assert list(m.grants) == [GRANT_APPROX, GRANT_APPROX]
     assert m.shadow_granted_approx[U.OC_INT_MULT] == 2
@@ -56,13 +76,24 @@ def test_mult_shadow_falls_back_to_approx_alu():
     np.testing.assert_allclose(m2.coverage(), [0.75, 0.75])
 
 
-def test_priority_to_shadow_starves_later_primaries_of_shadows():
-    # 3 ALU µops, pool shrunk to 4 ALU units.
+def test_fp_shadow_falls_back_to_int_alu():
+    # 4 FADD µops, 4 FP_ALU units: primaries take all four; shadows fall
+    # back to the IntALU units — the reference's FloatAdd → IntAlu approx
+    # fallback (fu_pool.cc:233-241).
+    m = FUPoolModel(oc_seq(*[U.OC_FP_ALU] * 4), issue_width=8)
+    assert list(m.grants) == [GRANT_APPROX] * 4
+    assert m.shadow_granted_approx[U.OC_FP_ALU] == 4
+
+
+def test_priority_to_shadow_starves_later_primaries():
+    # 3 ALU µops, pool shrunk to 4 ALU units and no FP fallback.
     # deferred (priorityToShadow=False): primaries take 3, one shadow unit
     #   left → only µop 0's shadow granted.
     # interleaved (True): µop0 primary+shadow (2), µop1 primary+shadow (2),
-    #   µop2 primary finds pool empty (fu_busy) and shadow denied.
-    pool = FUPoolConfig(int_alu=IntALU(count=4))
+    #   µop2 primary finds pool empty (fu_busy) and no shadow is requested.
+    from shrewd_tpu.models.fupool import FP_ALU
+    pool = FUPoolConfig(int_alu=IntALU(count=4),
+                        fp_alu=FP_ALU(approx_capabilities=[]))
     oc = oc_seq(*[U.OC_INT_ALU] * 3)
     m_def = FUPoolModel(oc, issue_width=8, pool=pool, priority_to_shadow=False)
     assert list(m_def.grants) == [GRANT_EXACT, GRANT_NONE, GRANT_NONE]
@@ -72,23 +103,42 @@ def test_priority_to_shadow_starves_later_primaries_of_shadows():
     assert m_pri.fu_busy[U.OC_INT_ALU] == 1
 
 
-def test_op_lat_keeps_units_busy_across_cycles():
-    # One MUL per cycle (issue_width=1) against 2 IntMultDiv units with
-    # op_lat=3: cycle 0 claims unit A (busy through cycle 2), its shadow
-    # claims unit B — so cycles 1 and 2 have no mult unit free: the primary
-    # fails (fu_busy) and, per the reference's issue-stage guard
-    # (requestShadow only fires for a successfully issued primary,
-    # inst_queue.cc:1082+), NO shadow is requested for those µops.
-    # Cycle 3 sees both units free again.
+def test_pipelined_units_free_next_cycle():
+    # One MUL per cycle (issue_width=1) against 2 IntMultDiv units: MUL is
+    # pipelined (reference OpDesc opLat=3 pipelined, FuncUnitConfig.py:52),
+    # so a claimed unit is free again the next cycle
+    # (FUPool::freeUnitNextCycle) — every µop gets primary + exact shadow.
     m = FUPoolModel(oc_seq(*[U.OC_INT_MULT] * 4), issue_width=1)
+    assert list(m.grants) == [GRANT_EXACT] * 4
+    assert m.fu_busy.sum() == 0
+
+
+def test_busy_cycles_models_nonpipelined_divides():
+    # Same stream marked as 20-cycle non-pipelined divides (reference
+    # IntDiv OpDesc, FuncUnitConfig.py:53): cycle 0 claims both IntMultDiv
+    # units (primary + exact shadow, each busy 20 cycles); cycles 1-3 find
+    # no unit → primary fails (fu_busy) and, per the issue guard
+    # (inst_queue.cc:1082+), no shadow is requested.  The FP_MultDiv
+    # fallback can't help the *primary* (primaries never approximate).
+    busy = np.full(4, 20, np.int64)
+    m = FUPoolModel(oc_seq(*[U.OC_INT_MULT] * 4), issue_width=1,
+                    busy_cycles=busy)
     assert list(m.grants) == [GRANT_EXACT, GRANT_NONE, GRANT_NONE,
-                              GRANT_EXACT]
-    assert m.fu_busy[U.OC_INT_MULT] == 2
-    assert m.shadow_requests[U.OC_INT_MULT] == 2   # µops 0 and 3 only
-    # with op_lat=1 units, every cycle is fresh
-    pool = FUPoolConfig(int_mult=IntMultDiv(op_lat=1))
-    m1 = FUPoolModel(oc_seq(*[U.OC_INT_MULT] * 4), issue_width=1, pool=pool)
-    assert list(m1.grants) == [GRANT_EXACT] * 4
+                              GRANT_NONE]
+    assert m.fu_busy[U.OC_INT_MULT] == 3
+    assert m.shadow_requests[U.OC_INT_MULT] == 1
+
+
+def test_issue_cycle_schedule_drives_contention():
+    # Eight ALU µops that a dense i//8 proxy would cram into one cycle
+    # (saturating the pool) issue two-per-cycle under a scoreboard-style
+    # schedule — pool never saturates, every shadow exact.
+    oc = oc_seq(*[U.OC_INT_ALU] * 8)
+    sched = np.repeat(np.arange(4, dtype=np.int64), 2)
+    m = FUPoolModel(oc, issue_width=8, issue_cycle=sched)
+    assert list(m.grants) == [GRANT_EXACT] * 8
+    dense = FUPoolModel(oc, issue_width=8)
+    assert (np.asarray(dense.grants) == GRANT_EXACT).sum() < 8
 
 
 def test_mem_and_nop_not_shadow_eligible():
@@ -104,7 +154,8 @@ def test_stats_group_rows():
     d = g.to_dict()
     assert d["shadow_requests"]["IntAlu"] == 4
     assert d["shadow_granted"]["IntAlu"] == 2
-    assert d["shadow_denied"]["IntAlu"] == 2
+    assert d["shadow_granted_approx"]["IntAlu"] == 2
+    assert d["shadow_denied"]["IntAlu"] == 0
 
 
 def test_compute_shadow_cov_paths():

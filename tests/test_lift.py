@@ -255,3 +255,39 @@ def test_evex_strlen_chain_lifts():
     assert st["opaque_mnemonics"].get("kmovd", 0) <= 10
     assert "vpxorq" not in st["opaque_mnemonics"]
     assert st["opaque_mnemonics"].get("tzcnt", 0) <= 4  # 64-bit forms only
+
+
+def test_implicit_read_keys_reachable_from_own_spelling():
+    """Every _IMPLICIT_READS key must be reachable from its own mnemonic
+    spelling and from a one-letter size-suffixed form (ADVICE r4: a greedy
+    rstrip("bwldq") turned 'call'→'ca', 'mul'→'mu', 'cwd'/'cdq'→'c',
+    silently orphaning those entries — their implicit rsp / rax+rdx reads
+    never escalated demoted fault coordinates)."""
+    from shrewd_tpu.ingest.lift import Inst, Lifter
+
+    lf = Lifter.__new__(Lifter)     # method uses only class attrs
+
+    def reads(mnemonic):
+        return lf._demoted_read_set(
+            Inst(pc=0x1000, length=2, mnemonic=mnemonic, operands=[],
+                 comment_addr=None))
+
+    for key, want in Lifter._IMPLICIT_READS.items():
+        assert set(want) <= set(reads(key)), (key, reads(key))
+    # one-letter size suffixes resolve to the family
+    assert 4 in reads("pushq") and 4 in reads("popq")
+    assert {0, 2} <= set(reads("divq")) and {0, 2} <= set(reads("mulq"))
+    # the exact spellings the old rstrip orphaned
+    assert 4 in reads("call")           # rsp
+    assert {0, 2} <= set(reads("mul"))  # rax, rdx
+    assert 0 in reads("cwd") and 0 in reads("cdq")
+    # AT&T spellings objdump actually emits for the sign-extend family
+    assert 0 in reads("cltd") and 0 in reads("cqto") and 0 in reads("cwtd")
+    # no false family hit: plain movsd/movslq (2-operand moves) are only
+    # string-family reads when the operand list says so (stringish gate) —
+    # with a register operand present, no rsi/rdi injection
+    from shrewd_tpu.ingest.lift import Operand
+    non_string = lf._demoted_read_set(
+        Inst(pc=0x1000, length=4, mnemonic="movsd",
+             operands=[Operand(kind="reg", reg=3)], comment_addr=None))
+    assert 6 not in non_string and 7 not in non_string

@@ -1,0 +1,65 @@
+"""SLICC transition-table validation harness tests (tools/mesi_slicc_check).
+
+The heavy all-scenario sweep is the tool's job (MESI_SLICC_VALIDATE_r05);
+these tests pin the extraction machinery and one representative closure so
+regressions in the parser or the model surface in CI without the full run.
+Reference-source-dependent pieces skip when /root/reference is absent.
+"""
+
+import sys
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "tools"))
+
+from mesi_slicc_check import (DEFAULT_SM_DIR, STABLE_L1, STABLE_L2,  # noqa: E402
+                              closure, l1_to_fw, l2_to_fw, parse_sm,
+                              run_model, scenarios)
+
+SM = Path(DEFAULT_SM_DIR)
+needs_ref = pytest.mark.skipif(not SM.exists(),
+                               reason="reference protocol sources absent")
+
+
+@needs_ref
+def test_parse_extracts_full_l1_table():
+    t = parse_sm(SM / "MESI_Two_Level-L1cache.sm")
+    # brace-list expansion: {NP,I} × {Load,...} rows all present
+    assert t[("NP", "Load")] == "IS" and t[("I", "Load")] == "IS"
+    assert t[("E", "Store")] == "M" and t[("M", "Store")] == "M"
+    assert t[("S", "Inv")] == "I"
+    # 2-arg transitions keep their state (z_stall / stay)
+    assert t[("S", "Load")] == "S"
+    assert len(t) > 150
+
+
+@needs_ref
+def test_closure_walks_transients_to_stable():
+    t = parse_sm(SM / "MESI_Two_Level-L1cache.sm")
+    end, path = closure(t, "I", "Store", ["Data_all_Acks"], STABLE_L1)
+    assert end == "M" and path == ["I", "IM", "M"]
+    end, path = closure(t, "M", "L1_Replacement", ["WB_Ack"], STABLE_L1)
+    assert end == "I" and path == ["M", "M_I", "I"]
+    # unknown event on the path fails loudly, not silently
+    with pytest.raises(KeyError):
+        closure(t, "I", "Bogus_Event", [], STABLE_L1)
+
+
+@needs_ref
+def test_one_scenario_end_to_end():
+    """store_invalidates_owner: the dirtiest cross-core path (M owner
+    forced to writeback + invalidate) agrees between the SLICC closure
+    and both framework implementations."""
+    l1 = parse_sm(SM / "MESI_Two_Level-L1cache.sm")
+    l2 = parse_sm(SM / "MESI_Two_Level-L2cache.sm")
+    name, stream, legs = next(s for s in scenarios()
+                              if s[0] == "store_invalidates_owner")
+    l1_state, dir_states = run_model(stream)
+    for key, (ctrl, start, trig, comp) in legs.items():
+        table, stable = (l1, STABLE_L1) if ctrl == "L1" else (l2, STABLE_L2)
+        end, _ = closure(table, start, trig, comp, stable)
+        if key[0] == "l1":
+            assert l1_state(key[1], key[2]) == l1_to_fw(end), key
+        else:
+            assert dir_states[key[1]] == l2_to_fw(end), key
