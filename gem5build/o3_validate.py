@@ -120,8 +120,9 @@ def main() -> int:
         # gem5's per-macro uses gem5's OWN committed-inst count (each model
         # per its own instruction stream; ADVICE r4: cpm(macros) silently
         # becomes wrong-unit if window alignment drifts)
-        "gem5_o3": {**g, "cycles_per_macro": round(
-                        g["numCycles"] / g["macro_insts"], 4),
+        "gem5_o3": {**g, "cycles_per_macro": (
+                        round(g["numCycles"] / g["macro_insts"], 4)
+                        if g.get("macro_insts") else None),
                     "config": "8-wide, ROB192, IQ64, LSQ32/32 (defaults), "
                               "32kB/8-way 2-cycle L1I+L1D, 3GHz"},
         "scoreboard": {"cycles": sb.n_cycles,

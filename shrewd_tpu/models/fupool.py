@@ -158,17 +158,48 @@ class FUPoolModel:
     unit claimed by µop *i* stays busy — use it to mark non-pipelined divide
     µops (reference ``OpDesc(pipelined=False)``, ``FuncUnitConfig.py:53``)
     that hold a unit for their full latency while everything else frees the
-    next cycle.
+    next cycle.  ``approx_busy_cycles`` does the same for an
+    *approximately-granted shadow* (an IntDiv checked on a FloatDiv unit
+    holds it for the non-pipelined FloatDiv latency, ``fu_pool.cc:221-231``
+    + ``FuncUnitConfig.py:73``).
+
+    ``retry_primary`` (default True) models the IQ's FU-busy retry loop:
+    a µop whose OpClass has no free unit stays in the ready list and
+    re-attempts each cycle (``statFuBusy`` bump + ``++order_it``,
+    ``inst_queue.cc:1020-1024``) — it *slips* to the first cycle a capable
+    unit frees, and its one-shot shadow request fires in that cycle.  With
+    ``retry_primary=False`` a failed µop abandons the claim (the pre-r5
+    behavior; µop proceeds unmodelled).
+
+    ``phantom_opclass``/``phantom_cycle`` inject *wrong-path* issue mass:
+    the reference issues down mispredicted paths and those µops claim FUs
+    and request shadows exactly like correct-path ones (their counters land
+    in the same IQ stats) until the squash walk kills them.  Phantoms
+    contend and are tallied in ``phantom_*`` counters (availability() can
+    fold them in) but never receive a ``grants`` entry — they have no
+    replay coordinates.  Phantoms do not retry (a squashed µop stops
+    re-attempting).
     """
 
     def __init__(self, opclass: np.ndarray, issue_width: int = 8,
                  pool: FUPoolConfig | None = None,
                  priority_to_shadow: bool = False,
                  issue_cycle: np.ndarray | None = None,
-                 busy_cycles: np.ndarray | None = None):
+                 busy_cycles: np.ndarray | None = None,
+                 approx_busy_cycles: np.ndarray | None = None,
+                 retry_primary: bool = True,
+                 phantom_opclass: np.ndarray | None = None,
+                 phantom_cycle: np.ndarray | None = None,
+                 phantom_busy_cycles: np.ndarray | None = None,
+                 phantom_approx_busy_cycles: np.ndarray | None = None,
+                 phantom_retry: bool = False):
         self.pool = pool if pool is not None else FUPoolConfig()
         self.issue_width = int(issue_width)
         self.priority_to_shadow = bool(priority_to_shadow)
+        self.retry_primary = bool(retry_primary)
+        # decomposition-mass phantoms (committed µops of a finer-grained
+        # ISA) retry like real µops; wrong-path phantoms die at the squash
+        self._ph_retry = bool(phantom_retry)
         oc = np.asarray(opclass, dtype=np.int32)
         self.n = int(oc.shape[0])
 
@@ -187,14 +218,23 @@ class FUPoolModel:
         eligible = np.zeros(U.N_OPCLASSES, dtype=bool)
         eligible[list(self.pool.shadow_eligible)] = True
 
-        # Stats (per OpClass).
+        # Stats (per OpClass).  phantom_* mirror the shadow_* trio for
+        # wrong-path contenders (the reference folds both into one counter
+        # set; kept separate here so real-µop coverage stays clean).
         self.shadow_requests = np.zeros(U.N_OPCLASSES, dtype=np.int64)
         self.shadow_granted = np.zeros(U.N_OPCLASSES, dtype=np.int64)
         self.shadow_granted_approx = np.zeros(U.N_OPCLASSES, dtype=np.int64)
         self.shadow_denied = np.zeros(U.N_OPCLASSES, dtype=np.int64)
         self.fu_busy = np.zeros(U.N_OPCLASSES, dtype=np.int64)
+        self.phantom_requests = np.zeros(U.N_OPCLASSES, dtype=np.int64)
+        self.phantom_granted = np.zeros(U.N_OPCLASSES, dtype=np.int64)
+        self.phantom_granted_approx = np.zeros(U.N_OPCLASSES,
+                                               dtype=np.int64)
+        self.phantom_denied = np.zeros(U.N_OPCLASSES, dtype=np.int64)
+        self.phantom_fu_busy = np.zeros(U.N_OPCLASSES, dtype=np.int64)
 
         self.grants = np.zeros(self.n, dtype=np.int8)
+        self.slip = np.zeros(self.n, dtype=np.int64)   # retry wait, cycles
 
         unit_desc = np.repeat(np.arange(len(descs)), counts)
         self._unit_hold = hold[unit_desc]
@@ -203,6 +243,12 @@ class FUPoolModel:
                       else np.asarray(busy_cycles, dtype=np.int64))
         if self._busy is not None and self._busy.shape[0] != self.n:
             raise ValueError("busy_cycles length != opclass length")
+        self._approx_busy = (None if approx_busy_cycles is None
+                             else np.asarray(approx_busy_cycles,
+                                             dtype=np.int64))
+        if self._approx_busy is not None \
+                and self._approx_busy.shape[0] != self.n:
+            raise ValueError("approx_busy_cycles length != opclass length")
         # Loop-invariant unit-scan lists per OpClass (pool order).
         cap_units = [list(np.nonzero(cap[unit_desc, c])[0])
                      for c in range(U.N_OPCLASSES)]
@@ -217,36 +263,101 @@ class FUPoolModel:
             if cyc_of.shape[0] != self.n:
                 raise ValueError("issue_cycle length != opclass length")
 
-        # Walk cycle groups in schedule order (trace order within a cycle).
-        order = np.argsort(cyc_of, kind="stable")
+        # Merge real µops (ids 0..n-1) and phantoms (ids ≥ n) into one
+        # cycle-ordered walk; within a cycle real µops go first (the
+        # wrong-path entries are younger than every already-ready
+        # correct-path µop in the reference's age-ordered listOrder walk).
+        if phantom_opclass is not None:
+            poc = np.asarray(phantom_opclass, dtype=np.int32)
+            pcyc = np.asarray(phantom_cycle, dtype=np.int64)
+            if poc.shape != pcyc.shape:
+                raise ValueError("phantom arrays must match in length")
+            all_oc = np.concatenate([oc, poc])
+            all_cyc = np.concatenate([cyc_of, pcyc])
+            for name, arr in (("phantom_busy_cycles", phantom_busy_cycles),
+                              ("phantom_approx_busy_cycles",
+                               phantom_approx_busy_cycles)):
+                if arr is not None and np.asarray(arr).shape != poc.shape:
+                    raise ValueError(f"{name} length != phantom length")
+            self._ph_busy = (None if phantom_busy_cycles is None
+                             else np.asarray(phantom_busy_cycles, np.int64))
+            self._ph_approx_busy = (
+                None if phantom_approx_busy_cycles is None
+                else np.asarray(phantom_approx_busy_cycles, np.int64))
+        else:
+            all_oc, all_cyc = oc, cyc_of
+            self._ph_busy = self._ph_approx_busy = None
+        total = all_oc.shape[0]
+
+        order = np.argsort(all_cyc, kind="stable")
+        # µops waiting for a free unit, keyed by their next attempt cycle
+        # (the IQ ready list: a FU-busy µop stays and re-attempts,
+        # inst_queue.cc:1020-1024).  Retried µops are older than any
+        # fresh µop of the attempt cycle, so they go first — that is what
+        # makes the reference's priority mode pair-atomic: at cycle start
+        # every pipelined unit is free, so the head-of-list retried µop
+        # always forms a full (primary, shadow) pair.
+        waiting: dict[int, list[tuple[int, int]]] = {}
+
         g0 = 0
-        while g0 < self.n:
-            g1 = g0
-            cyc = int(cyc_of[order[g0]])
-            while g1 < self.n and cyc_of[order[g1]] == cyc:
-                g1 += 1
+        while g0 < total or waiting:
+            cyc = None
+            if g0 < total:
+                cyc = int(all_cyc[order[g0]])
+            if waiting:
+                wmin = min(waiting)
+                cyc = wmin if cyc is None else min(cyc, wmin)
             deferred: list[tuple[int, int]] = []
-            for k in range(g0, g1):
-                i = int(order[k])
-                oc_i = int(oc[i])
-                if oc_i == U.OC_NONE:
-                    continue
-                got_primary = self._primary(cyc, i, oc_i, cap_units)
-                # requestShadow only fires when the primary got a valid FU
-                # (reference inst_queue.cc:1082+: idx != NoFreeFU /
-                # NoCapableFU guard before the shadow request)
-                if eligible[oc_i] and got_primary:
+
+            def attempt(i, oc_i):
+                real = i < self.n
+                if real:
+                    h = (int(self._busy[i])
+                         if self._busy is not None else 0)
+                else:
+                    h = (int(self._ph_busy[i - self.n])
+                         if self._ph_busy is not None else 0)
+                units = cap_units[oc_i]
+                if not units:
+                    return                               # NoCapableFU
+                busy_ctr = self.fu_busy if real else self.phantom_fu_busy
+                if not self._claim(cyc, units, h):
+                    if self.retry_primary and (real or self._ph_retry):
+                        # re-enter the ready list at the earliest cycle a
+                        # capable unit frees; statFuBusy counts the wait
+                        t = int(min(self._free_at[u] for u in units))
+                        t = max(t, cyc + 1)
+                        busy_ctr[oc_i] += t - cyc
+                        if real:
+                            self.slip[i] += t - cyc
+                        waiting.setdefault(t, []).append((i, oc_i))
+                    else:
+                        # phantoms die at the squash; non-retry abandons
+                        busy_ctr[oc_i] += 1
+                    return
+                # requestShadow only fires for a successfully issued
+                # primary (inst_queue.cc:1082+ guard)
+                if eligible[oc_i]:
                     if self.priority_to_shadow:
                         # shadow claimed immediately at issue
                         # (inst_queue.cc:897-903)
                         self._shadow(cyc, i, oc_i, cap_units, approx_units)
                     else:
                         deferred.append((i, oc_i))
+
+            # oldest first: matured retries, then this cycle's fresh µops
+            for i, oc_i in waiting.pop(cyc, []):
+                attempt(i, oc_i)
+            while g0 < total and all_cyc[order[g0]] == cyc:
+                i = int(order[g0])
+                g0 += 1
+                oc_i = int(all_oc[i])
+                if oc_i != U.OC_NONE:
+                    attempt(i, oc_i)
             # deferred shadow pass after all primaries issued
             # (inst_queue.cc:1029-1066)
             for i, oc_i in deferred:
                 self._shadow(cyc, i, oc_i, cap_units, approx_units)
-            g0 = g1
 
     def _claim(self, cyc: int, units, hold_override: int = 0) -> bool:
         for u in units:
@@ -256,53 +367,66 @@ class FUPoolModel:
                 return True
         return False
 
-    def _primary(self, cyc: int, i: int, oc_i: int, cap_units) -> bool:
-        h = int(self._busy[i]) if self._busy is not None else 0
-        if not self._claim(cyc, cap_units[oc_i], h):
-            # Pool over-subscribed: the schedule proxy has no stall model,
-            # so the µop proceeds without consuming a unit; record it (the
-            # reference would hold it in the IQ — statFuBusy).
-            self.fu_busy[oc_i] += 1
-            return False
-        return True
-
     def _shadow(self, cyc: int, i: int, oc_i: int, cap_units,
                 approx_units) -> None:
-        self.shadow_requests[oc_i] += 1
+        real = i < self.n
+        req = self.shadow_requests if real else self.phantom_requests
+        req[oc_i] += 1
         # Exact shadows re-run the µop's own class — non-pipelined µops
         # (divides) hold the shadow unit just like the primary; approximate
-        # shadows run as the granting unit's class (approx_capability,
-        # fu_pool.cc:188-294), so the unit's own hold applies.
-        h = int(self._busy[i]) if self._busy is not None else 0
-        if self._claim(cyc, cap_units[oc_i], h):
-            self.shadow_granted[oc_i] += 1
-            self.grants[i] = GRANT_EXACT
-        elif self._claim(cyc, approx_units[oc_i]):
-            self.shadow_granted_approx[oc_i] += 1
-            self.grants[i] = GRANT_APPROX
+        # shadows run as the approx_capability class (fu_pool.cc:188-294):
+        # per-µop approx_busy_cycles for div-family fallbacks, else the
+        # granting unit's own hold.
+        if real:
+            h = int(self._busy[i]) if self._busy is not None else 0
+            ah = (int(self._approx_busy[i])
+                  if self._approx_busy is not None else 0)
         else:
-            self.shadow_denied[oc_i] += 1    # NoShadowFU
+            h = (int(self._ph_busy[i - self.n])
+                 if self._ph_busy is not None else 0)
+            ah = (int(self._ph_approx_busy[i - self.n])
+                  if self._ph_approx_busy is not None else 0)
+        if self._claim(cyc, cap_units[oc_i], h):
+            (self.shadow_granted if real else self.phantom_granted)[oc_i] += 1
+            if real:
+                self.grants[i] = GRANT_EXACT
+        elif self._claim(cyc, approx_units[oc_i], ah):
+            (self.shadow_granted_approx if real
+             else self.phantom_granted_approx)[oc_i] += 1
+            if real:
+                self.grants[i] = GRANT_APPROX
+        else:
+            (self.shadow_denied if real else self.phantom_denied)[oc_i] += 1
 
-    def availability(self) -> dict[str, dict[str, float | int]]:
+    def availability(self, include_phantoms: bool = False
+                     ) -> dict[str, dict[str, float | int]]:
         """Per-OpClass shadow availability, the reference's
         ``<Class>ShadowAvailable / (Available + NotAvailable)`` ratio
         (``inst_queue.hh:581-606``).  A *grant* of either kind counts as
         available — the reference bumps ``shadowAvailable`` for exact and
         approximate units alike (``requestShadow``,
-        ``inst_queue.cc:1082-1096``)."""
+        ``inst_queue.cc:1082-1096``).  ``include_phantoms`` folds the
+        wrong-path contenders into the counters — the comparable surface
+        when checking against gem5, whose IQ stats don't distinguish
+        wrong-path requests."""
         out = {}
         for c in range(U.N_OPCLASSES):
-            req = int(self.shadow_requests[c])
+            exact = int(self.shadow_granted[c])
+            app = int(self.shadow_granted_approx[c])
+            den = int(self.shadow_denied[c])
+            if include_phantoms:
+                exact += int(self.phantom_granted[c])
+                app += int(self.phantom_granted_approx[c])
+                den += int(self.phantom_denied[c])
+            req = exact + app + den
             if not req:
                 continue
-            avail = int(self.shadow_granted[c]
-                        + self.shadow_granted_approx[c])
             out[U.OPCLASS_NAMES[c]] = {
-                "requests": req, "available": avail,
-                "not_available": int(self.shadow_denied[c]),
-                "availability": round(avail / req, 4),
-                "same_fu": int(self.shadow_granted[c]),
-                "not_same_fu": int(self.shadow_granted_approx[c]),
+                "requests": req, "available": exact + app,
+                "not_available": den,
+                "availability": round((exact + app) / req, 4),
+                "same_fu": exact,
+                "not_same_fu": app,
             }
         return out
 

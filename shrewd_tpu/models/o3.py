@@ -190,22 +190,21 @@ class O3Config(ConfigObject):
     timing_cfg = Child(TimingConfig)
 
 
-def compute_shadow_cov(opclass, cfg: O3Config, issue_cycle=None,
-                       busy_cycles=None):
+def compute_shadow_cov(opclass, cfg: O3Config, **schedule):
     """Per-µop shadow detection coverage → (float32[n], FUPoolModel | None).
 
     The single source the replay kernel gathers from; the FUPoolModel is
     returned (structural model only) so callers can harvest its per-OpClass
-    availability stats.  ``issue_cycle``/``busy_cycles`` (optional) drive
-    the structural model with a real issue schedule — TrialKernel passes
-    the scoreboard's when ``timing="scoreboard"``."""
+    availability stats.  ``schedule`` kwargs (issue_cycle, busy_cycles,
+    approx_busy_cycles, phantom_opclass, phantom_cycle) drive the
+    structural model with a real issue schedule + wrong-path contention —
+    TrialKernel passes the scoreboard's when ``timing="scoreboard"``."""
     opclass = np.asarray(opclass, dtype=np.int32)
     if not cfg.enable_shrewd:
         return np.zeros(opclass.shape[0], dtype=np.float32), None
     if cfg.shadow_model == "fupool":
         m = FUPoolModel(opclass, cfg.issue_width, cfg.fu_pool,
-                        cfg.priority_to_shadow, issue_cycle=issue_cycle,
-                        busy_cycles=busy_cycles)
+                        cfg.priority_to_shadow, **schedule)
         return m.coverage(), m
     return np.asarray(cfg.shadow_coverage, dtype=np.float32)[opclass], None
 

@@ -168,6 +168,58 @@ def nonpipelined_busy(opcode: np.ndarray, cfg: TimingConfig) -> np.ndarray:
     return busy
 
 
+def approx_shadow_busy(opcode: np.ndarray, cfg: TimingConfig) -> np.ndarray:
+    """int64[n]: unit-hold cycles when µop *i*'s shadow is granted on an
+    approximate-capability unit.  The div family's fallback target is the
+    FP divider (IntDiv → FloatDiv, ``fu_pool.cc:221-231``), which is
+    non-pipelined (``FuncUnitConfig.py:73``) — the shadow holds it for the
+    full FP-divide latency; every other fallback is pipelined (frees next
+    cycle, 0 → granting unit's default)."""
+    opcode = np.asarray(opcode)
+    busy = np.zeros(opcode.shape[0], np.int64)
+    busy[np.asarray(U.is_div(opcode))] = cfg.fdiv_latency
+    busy[opcode == U.FDIV] = cfg.div_latency    # FloatDiv → IntDiv check
+    return busy
+
+
+def wrongpath_phantoms(trace, sb: "Scoreboard", cfg: TimingConfig
+                       ) -> tuple[np.ndarray, np.ndarray]:
+    """Wrong-path issue mass → (opclass int32[P], issue_cycle int64[P]).
+
+    The reference issues down mispredicted paths until the squash walk
+    (``src/cpu/o3/rob.hh:207``); those µops claim FUs and request shadows,
+    landing in the same IQ counters as correct-path ones.  The framework's
+    trace is correct-path-only, so shadow-availability comparisons against
+    gem5 must re-inject that mass: per mispredicted branch, phantoms issue
+    from the cycle after the branch's dispatch until its writeback (the
+    same span the wrong-path ROB/IQ residency model uses,
+    ``compute_scoreboard``), at the window's average issue rate, with
+    opclasses drawn deterministically from the µops following the branch
+    (the wrong path is statistically the local code mix)."""
+    zero = (np.zeros(0, np.int32), np.zeros(0, np.int64))
+    if sb.mispredict is None or not sb.mispredict.any():
+        return zero
+    oc = np.asarray(U.opclass_of(np.asarray(trace.opcode)), np.int32)
+    n = oc.shape[0]
+    rate = max(1, round(n / max(sb.n_cycles, 1)))
+    ph_oc: list[int] = []
+    ph_cyc: list[int] = []
+    for i in np.nonzero(sb.mispredict)[0]:
+        lo = int(sb.dispatch[i]) + 1
+        hi = int(sb.writeback[i])
+        span = hi - lo + 1
+        if span <= 0:
+            continue
+        k = span * rate
+        src = np.arange(k) % max(n - i - 1, 1) + i + 1 if i + 1 < n \
+            else np.zeros(k, np.int64)
+        ph_oc.extend(int(x) for x in oc[src])
+        ph_cyc.extend(lo + j // rate for j in range(k))
+    if not ph_oc:
+        return zero
+    return np.asarray(ph_oc, np.int32), np.asarray(ph_cyc, np.int64)
+
+
 def predict_mispredicts(trace, cfg: TimingConfig) -> np.ndarray:
     """bool[n]: branches whose captured direction a bimodal predictor
     mispredicts (reference: ``src/cpu/pred/bpred_unit.hh:99``; per-branch

@@ -56,18 +56,24 @@ class TrialKernel:
         # scoreboard's real issue schedule (SHREWD_VALIDATE: the dense
         # i//width proxy overstates contention ~3× vs the reference O3).
         self._scoreboard = None     # timing="scoreboard": shared per kernel
-        issue_cycle = busy = None
+        sched = {}
         if (self.cfg.shadow_model == "fupool"
                 and self.cfg.enable_shrewd
                 and self.cfg.timing == "scoreboard"):
-            from shrewd_tpu.models.timing import (compute_scoreboard,
-                                                  nonpipelined_busy)
-            self._scoreboard = compute_scoreboard(trace, self.cfg.timing_cfg)
-            issue_cycle = self._scoreboard.issue
-            busy = nonpipelined_busy(trace.opcode, self.cfg.timing_cfg)
+            from shrewd_tpu.models.timing import (approx_shadow_busy,
+                                                  compute_scoreboard,
+                                                  nonpipelined_busy,
+                                                  wrongpath_phantoms)
+            tcfg = self.cfg.timing_cfg
+            self._scoreboard = compute_scoreboard(trace, tcfg)
+            ph_oc, ph_cyc = wrongpath_phantoms(trace, self._scoreboard, tcfg)
+            sched = dict(
+                issue_cycle=self._scoreboard.issue,
+                busy_cycles=nonpipelined_busy(trace.opcode, tcfg),
+                approx_busy_cycles=approx_shadow_busy(trace.opcode, tcfg),
+                phantom_opclass=ph_oc, phantom_cycle=ph_cyc)
         cov, self.fu_model = compute_shadow_cov(
-            U.opclass_of(trace.opcode), self.cfg,
-            issue_cycle=issue_cycle, busy_cycles=busy)
+            U.opclass_of(trace.opcode), self.cfg, **sched)
         self.shadow_cov = jnp.asarray(cov, dtype=jnp.float32)
         self._opclass = jnp.asarray(U.opclass_of(trace.opcode),
                                     dtype=jnp.int32)

@@ -43,17 +43,24 @@ def test_saturated_cycle_spills_shadows_to_fp_alu():
 
 
 def test_full_pool_denies_shadows():
-    # 7 ALU µops, one cycle: 6 primaries on IntALU + 1 spilling primary
-    # (fu_busy — no shadow request per the issue guard).  The 6 issued
-    # shadows contend for the 4 FP_ALU approx units → 2 denied NoShadowFU.
+    # 7 ALU µops, one cycle: 6 primaries on IntALU; the 7th finds no unit
+    # and RETRIES — it slips to cycle 1 (fu_busy counts the wait,
+    # inst_queue.cc:1020-1024) where it issues with an exact shadow.  The
+    # 6 cycle-0 shadows contend for the 4 FP_ALU approx units → 2 denied.
     m = FUPoolModel(oc_seq(*[U.OC_INT_ALU] * 7), issue_width=8)
     assert m.fu_busy[U.OC_INT_ALU] == 1
-    assert m.shadow_requests[U.OC_INT_ALU] == 6
+    assert m.slip[6] == 1
+    assert m.shadow_requests[U.OC_INT_ALU] == 7
+    assert m.shadow_granted[U.OC_INT_ALU] == 1       # the slipped µop
     assert m.shadow_granted_approx[U.OC_INT_ALU] == 4
     assert m.shadow_denied[U.OC_INT_ALU] == 2
     av = m.availability()["IntAlu"]
-    assert av["requests"] == 6 and av["available"] == 4
-    assert av["availability"] == pytest.approx(4 / 6, abs=1e-4)
+    assert av["requests"] == 7 and av["available"] == 5
+    # without retry the over-subscribed µop abandons (pre-r5 behavior)
+    m0 = FUPoolModel(oc_seq(*[U.OC_INT_ALU] * 7), issue_width=8,
+                     retry_primary=False)
+    assert m0.shadow_requests[U.OC_INT_ALU] == 6
+    assert list(m0.grants[6:]) == [GRANT_NONE]
 
 
 def test_issue_width_splits_cycles():
@@ -90,7 +97,8 @@ def test_priority_to_shadow_starves_later_primaries():
     # deferred (priorityToShadow=False): primaries take 3, one shadow unit
     #   left → only µop 0's shadow granted.
     # interleaved (True): µop0 primary+shadow (2), µop1 primary+shadow (2),
-    #   µop2 primary finds pool empty (fu_busy) and no shadow is requested.
+    #   µop2 primary finds the pool empty and retries into cycle 1, where
+    #   it issues with an exact shadow (retry_primary default).
     from shrewd_tpu.models.fupool import FP_ALU
     pool = FUPoolConfig(int_alu=IntALU(count=4),
                         fp_alu=FP_ALU(approx_capabilities=[]))
@@ -99,8 +107,12 @@ def test_priority_to_shadow_starves_later_primaries():
     assert list(m_def.grants) == [GRANT_EXACT, GRANT_NONE, GRANT_NONE]
     assert m_def.fu_busy.sum() == 0
     m_pri = FUPoolModel(oc, issue_width=8, pool=pool, priority_to_shadow=True)
-    assert list(m_pri.grants) == [GRANT_EXACT, GRANT_EXACT, GRANT_NONE]
-    assert m_pri.fu_busy[U.OC_INT_ALU] == 1
+    assert list(m_pri.grants) == [GRANT_EXACT, GRANT_EXACT, GRANT_EXACT]
+    assert m_pri.fu_busy[U.OC_INT_ALU] == 1 and m_pri.slip[2] == 1
+    # without retry: the starved µop proceeds unshadowed
+    m_nr = FUPoolModel(oc, issue_width=8, pool=pool, priority_to_shadow=True,
+                       retry_primary=False)
+    assert list(m_nr.grants) == [GRANT_EXACT, GRANT_EXACT, GRANT_NONE]
 
 
 def test_pipelined_units_free_next_cycle():
@@ -114,19 +126,67 @@ def test_pipelined_units_free_next_cycle():
 
 
 def test_busy_cycles_models_nonpipelined_divides():
-    # Same stream marked as 20-cycle non-pipelined divides (reference
-    # IntDiv OpDesc, FuncUnitConfig.py:53): cycle 0 claims both IntMultDiv
+    # Stream of 20-cycle non-pipelined divides (reference IntDiv OpDesc,
+    # FuncUnitConfig.py:53), no retry: cycle 0 claims both IntMultDiv
     # units (primary + exact shadow, each busy 20 cycles); cycles 1-3 find
     # no unit → primary fails (fu_busy) and, per the issue guard
     # (inst_queue.cc:1082+), no shadow is requested.  The FP_MultDiv
     # fallback can't help the *primary* (primaries never approximate).
     busy = np.full(4, 20, np.int64)
     m = FUPoolModel(oc_seq(*[U.OC_INT_MULT] * 4), issue_width=1,
-                    busy_cycles=busy)
+                    busy_cycles=busy, retry_primary=False)
     assert list(m.grants) == [GRANT_EXACT, GRANT_NONE, GRANT_NONE,
                               GRANT_NONE]
     assert m.fu_busy[U.OC_INT_MULT] == 3
     assert m.shadow_requests[U.OC_INT_MULT] == 1
+
+
+def test_retry_slips_divides_and_approx_busy_holds():
+    # With the IQ retry loop (default), div1 AND div2 both slip to cycle
+    # 20 (the first cycle a unit frees) and issue together on the two
+    # freed units — their deferred shadows then find no exact unit and
+    # fall back to the FP dividers, exactly the gem5 divmix pattern
+    # (IntDiv → FloatDiv, measured availability 0.66 in
+    # SHREWD_VALIDATE_r05).
+    busy = np.full(3, 20, np.int64)
+    m = FUPoolModel(oc_seq(*[U.OC_INT_MULT] * 3), issue_width=1,
+                    busy_cycles=busy)
+    assert list(m.grants) == [GRANT_EXACT, GRANT_APPROX, GRANT_APPROX]
+    assert m.slip[0] == 0 and m.slip[1] == 19 and m.slip[2] == 18
+    assert m.fu_busy[U.OC_INT_MULT] == 19 + 18
+    # approx_busy: force the fallback by removing the second exact unit
+    pool = FUPoolConfig(int_mult=IntMultDiv(count=1))
+    ab = np.full(2, 12, np.int64)
+    m2 = FUPoolModel(oc_seq(U.OC_INT_MULT, U.OC_INT_MULT), issue_width=8,
+                     pool=pool, busy_cycles=np.full(2, 20, np.int64),
+                     approx_busy_cycles=ab)
+    # µop0: primary takes the only IntMultDiv unit; shadow falls back to
+    # FP_MultDiv unit 0 holding it 12 cycles.  µop1: primary retries to
+    # cycle 20; shadow exact unavailable (same unit) → falls back to the
+    # second FP unit (unit 0 busy until 12 < 20 → actually free) — both
+    # approx grants; the 12-cycle hold is observable in unit state.
+    assert list(m2.grants) == [GRANT_APPROX, GRANT_APPROX]
+
+
+def test_phantom_contention_degrades_real_availability():
+    # 2 real ALU µops in cycle 0 + 8 phantoms (wrong-path mass) in the
+    # same cycle: phantoms claim 4 of the 6 IntALU units and on the
+    # shadow pass soak the FP_ALU fallbacks — real shadows spill or deny.
+    oc = oc_seq(U.OC_INT_ALU, U.OC_INT_ALU)
+    ph = np.full(8, U.OC_INT_ALU, np.int32)
+    phc = np.zeros(8, np.int64)
+    m = FUPoolModel(oc, issue_width=8, issue_cycle=np.zeros(2, np.int64),
+                    phantom_opclass=ph, phantom_cycle=phc)
+    assert m.phantom_requests[U.OC_INT_ALU] > 0
+    # phantoms contend: not every real shadow can be exact any more
+    assert m.shadow_granted[U.OC_INT_ALU] < 2
+    # without phantoms both real shadows are exact
+    m0 = FUPoolModel(oc, issue_width=8, issue_cycle=np.zeros(2, np.int64))
+    assert m0.shadow_granted[U.OC_INT_ALU] == 2
+    # availability() folds phantoms only when asked
+    av_real = m.availability()["IntAlu"]["requests"]
+    av_all = m.availability(include_phantoms=True)["IntAlu"]["requests"]
+    assert av_all > av_real == 2
 
 
 def test_issue_cycle_schedule_drives_contention():
