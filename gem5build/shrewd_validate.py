@@ -166,8 +166,6 @@ def decomposition_phantoms(trace, sb, gem5_issued):
     constants."""
     import numpy as np
 
-    from shrewd_tpu.isa import uops as U
-
     oc = np.asarray(U.opclass_of(trace.opcode))
     iss = np.asarray(sb.issue)
     n_cyc = max(int(sb.n_cycles), 1)
@@ -223,7 +221,6 @@ def decomposition_phantoms(trace, sb, gem5_issued):
 
 
 def model_leg(trace, priority, schedule, phantoms):
-    from shrewd_tpu.isa import uops as U
     from shrewd_tpu.models.fupool import FUPoolModel
 
     m = FUPoolModel(U.opclass_of(trace.opcode),
@@ -257,7 +254,6 @@ def paired_campaign(trace, gem5_classes, trials, memmap):
     fractions differ only through the availability numbers."""
     import numpy as np
 
-    from shrewd_tpu.isa import uops as U
     from shrewd_tpu.models.o3 import O3Config
     from shrewd_tpu.ops import classify as C
     from shrewd_tpu.ops.trial import TrialKernel

@@ -647,7 +647,7 @@ def compare(host: np.ndarray, dev: np.ndarray) -> dict:
 
 def run_diff(n_trials: int = 500, seed: int = 0,
              workload_c: str = "workloads/sort.c",
-             mode: str = "output") -> dict:
+             mode: str = "output", max_steps: int = 2_000_000) -> dict:
     """Paired host-vs-device differential AVF.
 
     ``mode``:
@@ -674,7 +674,7 @@ def run_diff(n_trials: int = 500, seed: int = 0,
     if mode == "emu64":
         # the emulator replays the raw capture — only the marker-window
         # *length* is needed, not a full lift of the window
-        window = capture_window_macro_ops(paths)
+        window = capture_window_macro_ops(paths, max_steps=max_steps)
         coords = sample_coords(n_trials, window, seed, bit_range=64)
         host = run_host(paths, coords)
         dev = run_device_emu64(paths, coords)
@@ -689,14 +689,16 @@ def run_diff(n_trials: int = 500, seed: int = 0,
             n_regs = 32
         elif mode == "device64":
             from shrewd_tpu.ingest.lift64 import lift64
-            trace, meta = capture_and_lift_to_output(paths, lifter=lift64)
+            trace, meta = capture_and_lift_to_output(paths, lifter=lift64,
+                                                     max_steps=max_steps)
             window = meta["window_macro_ops"]
             bit_range = 64
         elif mode == "output":
-            trace, meta = capture_and_lift_to_output(paths)
+            trace, meta = capture_and_lift_to_output(paths,
+                                                     max_steps=max_steps)
             window = meta["window_macro_ops"]
         else:
-            trace, meta = capture_and_lift(paths)
+            trace, meta = capture_and_lift(paths, max_steps=max_steps)
             window = meta["macro_ops"]
             if mode == "liveness":
                 from shrewd_tpu.ingest.liveness import post_window_liveness

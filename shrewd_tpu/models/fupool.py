@@ -308,9 +308,16 @@ class FUPoolModel:
                 wmin = min(waiting)
                 cyc = wmin if cyc is None else min(cyc, wmin)
             deferred: list[tuple[int, int]] = []
+            issued = [0]     # width-bounded issue per cycle (totalWidth)
 
             def attempt(i, oc_i):
                 real = i < self.n
+                if issued[0] >= self.issue_width:
+                    # the width-bounded issue loop never reaches this µop
+                    # this cycle — it stays in the ready list (no
+                    # statFuBusy: the FU was never asked)
+                    waiting.setdefault(cyc + 1, []).append((i, oc_i))
+                    return
                 if real:
                     h = (int(self._busy[i])
                          if self._busy is not None else 0)
@@ -335,6 +342,7 @@ class FUPoolModel:
                         # phantoms die at the squash; non-retry abandons
                         busy_ctr[oc_i] += 1
                     return
+                issued[0] += 1
                 # requestShadow only fires for a successfully issued
                 # primary (inst_queue.cc:1082+ guard)
                 if eligible[oc_i]:

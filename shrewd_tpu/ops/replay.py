@@ -197,8 +197,7 @@ def _alu(op: jax.Array, a: jax.Array, b: jax.Array, imm: jax.Array) -> jax.Array
 def replay(tr: TraceArrays, init_reg: jax.Array, init_mem: jax.Array,
            fault: Fault, shadow_cov: jax.Array,
            memmap: MemMap | None = None,
-           index_offset: jax.Array | int = 0,
-           init_flags: tuple | None = None) -> ReplayResult:
+           index_offset: jax.Array | int = 0) -> ReplayResult:
     """Propagate one trial. All inputs are device arrays; jit/vmap-safe.
 
     ``shadow_cov`` is the per-µop shadow detection probability, float32[n]
@@ -208,9 +207,9 @@ def replay(tr: TraceArrays, init_reg: jax.Array, init_mem: jax.Array,
 
     ``index_offset`` shifts the µop index stream: the chunked-replay path
     (ops/chunked.py) passes the chunk's global start so fault coordinates
-    (absolute µop/cycle indices) land correctly inside a sliced window.
-    ``init_flags`` optionally seeds ``(live, detected, trapped, diverged)``
-    for mid-window continuation."""
+    (absolute µop/cycle indices) land correctly inside a sliced window —
+    carried chunk continuations re-enter with live flags by construction
+    (a frozen trial resolves at its boundary and never carries)."""
     nphys = init_reg.shape[0]
     mem_words = init_mem.shape[0]
     idx_mask = i32(nphys - 1)
@@ -335,7 +334,7 @@ def replay(tr: TraceArrays, init_reg: jax.Array, init_mem: jax.Array,
                  trapped | trapped_now,
                  diverged | diverged_now), None)
 
-    xs = (jnp.arange(n, dtype=i32) + i32(0) + jnp.asarray(index_offset, i32),
+    xs = (jnp.arange(n, dtype=i32) + jnp.asarray(index_offset, i32),
           tr.opcode, tr.dst, tr.src1, tr.src2,
           tr.imm, tr.taken, shadow_cov.astype(jnp.float32))
     if memmap is not None:
@@ -347,13 +346,8 @@ def replay(tr: TraceArrays, init_reg: jax.Array, init_mem: jax.Array,
     # structure-wide constant and would stay unvarying.
     vary0 = (fault.cycle * 0).astype(u32)         # varying zero
     vary_false = fault.cycle != fault.cycle       # varying False
-    if init_flags is None:
-        live0, det0, trap0, div0 = (~vary_false, vary_false, vary_false,
-                                    vary_false)
-    else:
-        live0, det0, trap0, div0 = (f | vary_false for f in init_flags)
     init = (init_reg.astype(u32) ^ vary0, init_mem.astype(u32) ^ vary0,
-            live0, det0, trap0, div0)
+            ~vary_false, vary_false, vary_false, vary_false)
     (reg, mem, _live, detected, trapped, diverged), _ = jax.lax.scan(
         step, init, xs)
     return ReplayResult(reg=reg, mem=mem, detected=detected,

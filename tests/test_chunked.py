@@ -1,0 +1,117 @@
+"""Chunked hierarchical replay (ops/chunked.py).
+
+The contract is exact outcome parity with the dense full-window kernel —
+chunking is an execution strategy, not an approximation — plus the
+boundary/carry machinery working across chunk counts, padding, and
+batch-overflow waves."""
+
+import jax
+import numpy as np
+import pytest
+
+from shrewd_tpu.models.o3 import O3Config
+from shrewd_tpu.ops import classify as C
+from shrewd_tpu.ops.chunked import ChunkedCampaign
+from shrewd_tpu.ops.trial import TrialKernel
+from shrewd_tpu.trace.synth import WorkloadConfig, generate
+from shrewd_tpu.utils import prng
+
+
+def mk_kernel(n=384, seed=11, **cfg):
+    t = generate(WorkloadConfig(n=n, nphys=32, mem_words=64,
+                                working_set_words=32, seed=seed))
+    return TrialKernel(t, O3Config(**cfg))
+
+
+def dense_outcomes(kernel, keys, structure):
+    return np.asarray(kernel.outcomes_from_keys(keys, structure))
+
+
+@pytest.mark.parametrize("structure", ["regfile", "fu", "rob", "iq", "lsq"])
+def test_outcomes_match_dense_kernel(structure):
+    kernel = mk_kernel()
+    keys = prng.trial_keys(prng.campaign_key(21), 96)
+    dense = dense_outcomes(kernel, keys, structure)
+    ch = ChunkedCampaign(kernel, chunk=128)     # 3 chunks
+    np.testing.assert_array_equal(
+        ch.outcomes_from_keys(keys, structure), dense, err_msg=structure)
+
+
+def test_golden_boundaries_end_at_golden_final():
+    kernel = mk_kernel()
+    ch = ChunkedCampaign(kernel, chunk=100)     # padding: 384 = 3*100+84
+    np.testing.assert_array_equal(ch.gb_reg[ch.C],
+                                  np.asarray(kernel.golden.reg))
+    np.testing.assert_array_equal(ch.gb_mem[ch.C],
+                                  np.asarray(kernel.golden.mem))
+
+
+def test_padding_chunk_parity():
+    # chunk length that does NOT divide n: NOP padding must not perturb
+    # outcomes (NOP writes nothing, accesses nothing)
+    kernel = mk_kernel(n=300)
+    keys = prng.trial_keys(prng.campaign_key(5), 64)
+    dense = dense_outcomes(kernel, keys, "regfile")
+    ch = ChunkedCampaign(kernel, chunk=77)
+    np.testing.assert_array_equal(
+        ch.outcomes_from_keys(keys, "regfile"), dense)
+
+
+def test_small_batch_forces_waves_and_carry_overflow():
+    # B=8 with 96 trials over 2 chunks: many waves per chunk; survivors
+    # can exceed one batch — exercises the carry-slice path
+    kernel = mk_kernel()
+    keys = prng.trial_keys(prng.campaign_key(9), 96)
+    dense = dense_outcomes(kernel, keys, "regfile")
+    ch = ChunkedCampaign(kernel, chunk=192, max_batch=8)
+    np.testing.assert_array_equal(
+        ch.outcomes_from_keys(keys, "regfile"), dense)
+
+
+def test_single_chunk_degenerates_to_dense():
+    kernel = mk_kernel(n=128)
+    keys = prng.trial_keys(prng.campaign_key(3), 48)
+    ch = ChunkedCampaign(kernel, chunk=4096)    # C == 1
+    assert ch.C == 1
+    np.testing.assert_array_equal(
+        ch.outcomes_from_keys(keys, "fu"),
+        dense_outcomes(kernel, keys, "fu"))
+
+
+def test_tally_matches_outcomes():
+    kernel = mk_kernel()
+    keys = prng.trial_keys(prng.campaign_key(7), 64)
+    ch = ChunkedCampaign(kernel, chunk=128)
+    out = ch.outcomes_from_keys(keys, "regfile")
+    tally = ch.run_keys(keys, "regfile")
+    assert tally.sum() == 64
+    for k in range(C.N_OUTCOMES):
+        assert tally[k] == int((out == k).sum())
+
+
+def test_shadow_detection_survives_chunking():
+    kernel = mk_kernel(shadow_coverage=[1.0, 1.0, 0.0, 0.0, 0.0, 1.0, 1.0])
+    keys = prng.trial_keys(prng.campaign_key(13), 96)
+    dense = dense_outcomes(kernel, keys, "fu")
+    ch = ChunkedCampaign(kernel, chunk=96)
+    got = ch.outcomes_from_keys(keys, "fu")
+    np.testing.assert_array_equal(got, dense)
+    assert (got == C.OUTCOME_DETECTED).any()
+
+
+@pytest.mark.slow
+def test_lifted_window_parity():
+    """Real lifted window (sort.c) with the VA-space memmap: chunked
+    outcomes equal dense outcomes on every structure."""
+    from shrewd_tpu.ingest import hostdiff as hd
+
+    paths = hd.build_tools("workloads/sort.c")
+    trace, meta = hd.capture_and_lift(paths)
+    kernel = TrialKernel(trace, O3Config(),
+                         memmap=hd.memmap_from_meta(meta))
+    keys = prng.trial_keys(prng.campaign_key(31), 64)
+    ch = ChunkedCampaign(kernel, chunk=1024)
+    for structure in ("regfile", "fu", "lsq"):
+        np.testing.assert_array_equal(
+            ch.outcomes_from_keys(keys, structure),
+            dense_outcomes(kernel, keys, structure), err_msg=structure)

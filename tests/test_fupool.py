@@ -142,18 +142,24 @@ def test_busy_cycles_models_nonpipelined_divides():
 
 
 def test_retry_slips_divides_and_approx_busy_holds():
-    # With the IQ retry loop (default), div1 AND div2 both slip to cycle
-    # 20 (the first cycle a unit frees) and issue together on the two
-    # freed units — their deferred shadows then find no exact unit and
-    # fall back to the FP dividers, exactly the gem5 divmix pattern
-    # (IntDiv → FloatDiv, measured availability 0.66 in
-    # SHREWD_VALIDATE_r05).
+    # With the IQ retry loop (default) and the width-1 issue bound, the
+    # retried divides serialize: div1 matures at cycle 20 and issues
+    # alone (its exact sibling unit frees the same cycle), div2 is
+    # width-bumped to 21, re-slips to 40, and issues exact there too.
+    # (At issue_width 8 the two would issue together and their deferred
+    # shadows would spill to the FP dividers — the gem5 divmix pattern.)
     busy = np.full(3, 20, np.int64)
     m = FUPoolModel(oc_seq(*[U.OC_INT_MULT] * 3), issue_width=1,
                     busy_cycles=busy)
-    assert list(m.grants) == [GRANT_EXACT, GRANT_APPROX, GRANT_APPROX]
-    assert m.slip[0] == 0 and m.slip[1] == 19 and m.slip[2] == 18
-    assert m.fu_busy[U.OC_INT_MULT] == 19 + 18
+    assert list(m.grants) == [GRANT_EXACT, GRANT_EXACT, GRANT_EXACT]
+    # div2: 18 cycles to the first maturity + 19 after the width bump
+    # (the bump itself is not FU-busy wait — no unit was asked)
+    assert m.slip[0] == 0 and m.slip[1] == 19 and m.slip[2] == 37
+    # width-8: both retries issue at 20; exact pool exhausted -> approx
+    m8 = FUPoolModel(oc_seq(*[U.OC_INT_MULT] * 3),
+                     issue_cycle=np.array([0, 1, 2], np.int64),
+                     busy_cycles=busy)
+    assert list(m8.grants) == [GRANT_EXACT, GRANT_APPROX, GRANT_APPROX]
     # approx_busy: force the fallback by removing the second exact unit
     pool = FUPoolConfig(int_mult=IntMultDiv(count=1))
     ab = np.full(2, 12, np.int64)

@@ -1,16 +1,21 @@
-"""Large-window pipeline driver (VERDICT r3 #4: scale windows 100×).
+"""Large-window pipeline driver (VERDICT r3 #4 → r5 SimPoint scale).
 
-Captures the lzss compression workload once, lifts windows of several
-lengths, caches them as .npz traces, and measures replay throughput per
-window length on the current JAX platform.  The reference analog is the
-SPEC-SimPoint flow (30B-instruction measured regions,
+Captures a workload once, lifts windows of several lengths, caches them
+as .npz traces, and measures replay throughput per window length on the
+current JAX platform — dense kernel and/or the chunked hierarchical
+campaign (ops/chunked.py).  The reference analog is the SPEC-SimPoint
+flow (30B-instruction measured regions,
 ``x86_spec/x86-spec-cpu2017.py:404``); here the capture is a ptrace
-single-step of the marked kernel and the window is the lifted µop stream.
+single-step of the marked kernel and the window is the lifted µop
+stream.  ``workloads/lzss_big.c`` (~10M µops) is the r5 scaling target.
 
 Usage:
-    python tools/bigwindow.py --build            # capture + lift + cache
-    python tools/bigwindow.py --rate             # trials/s per length
-    python tools/bigwindow.py --build --rate --out WINDOW_SCALE.json
+    python tools/bigwindow.py --build                   # capture+lift
+    python tools/bigwindow.py --rate                    # dense trials/s
+    python tools/bigwindow.py --rate --chunked          # chunked trials/s
+    python tools/bigwindow.py --build --rate --chunked \
+        --workload workloads/lzss_big.c --lengths 0 \
+        --max-steps 10000000 --out WINDOW_SCALE.json    # 0 = full window
 """
 
 from __future__ import annotations
@@ -32,23 +37,25 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-def cache_path(n: int) -> Path:
-    return CACHE / f"lzss_w{n}.npz"
+def cache_path(stem: str, n: int) -> Path:
+    return CACHE / f"{stem}_w{'full' if n == 0 else n}.npz"
 
 
-def build(lengths=LENGTHS, workload="workloads/lzss.c") -> dict:
+def build(lengths=LENGTHS, workload="workloads/lzss.c",
+          max_steps=4_000_000) -> dict:
     from shrewd_tpu.ingest import hostdiff as hd
     from shrewd_tpu.ingest.lift import lift, read_nativetrace, static_decode
     from shrewd_tpu.trace import format as tfmt
 
+    stem = Path(workload).stem
     paths = hd.build_tools(workload)
-    trace_bin = CACHE / f"lzss_capture.{os.getpid()}.bin"
+    trace_bin = CACHE / f"{stem}_capture.{os.getpid()}.bin"
     info = {}
     try:
         t0 = time.time()
         subprocess.run([str(paths.tracer), str(trace_bin),
-                        f"{paths.begin:x}", f"{paths.end:x}", "4000000",
-                        str(paths.workload)],
+                        f"{paths.begin:x}", f"{paths.end:x}",
+                        str(max_steps), str(paths.workload)],
                        check=True, capture_output=True, text=True)
         nt = read_nativetrace(trace_bin)
         insts = static_decode(str(paths.workload))
@@ -59,21 +66,24 @@ def build(lengths=LENGTHS, workload="workloads/lzss.c") -> dict:
         for n in lengths:
             t0 = time.time()
             tr, meta = lift(str(trace_bin), str(paths.workload),
-                            max_uops=n, nt=nt, insts=insts)
-            tfmt.save(cache_path(n), tr, meta)
-            info[f"lift_{n}"] = {
+                            max_uops=n or None, nt=nt, insts=insts)
+            tfmt.save(cache_path(stem, n), tr, meta)
+            key = f"lift_{n or 'full'}"
+            info[key] = {
                 "uops": tr.n,
                 "lift_rate": round(meta["stats"]["lift_rate"], 4),
                 "seconds": round(time.time() - t0, 1),
             }
-            log(f"lift {n}: rate {info[f'lift_{n}']['lift_rate']} "
-                f"in {info[f'lift_{n}']['seconds']}s → {cache_path(n)}")
+            log(f"lift {n or 'full'}: {tr.n} µops, rate "
+                f"{info[key]['lift_rate']} in {info[key]['seconds']}s")
     finally:
         trace_bin.unlink(missing_ok=True)
     return info
 
 
-def rate(lengths=LENGTHS, batch=None, reps: int = 3) -> dict:
+def rate(lengths=LENGTHS, batch=None, reps: int = 3,
+         workload="workloads/lzss.c", chunked=False,
+         chunk: int = 65536, trials: int = 0) -> dict:
     import jax
     import numpy as np
 
@@ -82,39 +92,64 @@ def rate(lengths=LENGTHS, batch=None, reps: int = 3) -> dict:
     from shrewd_tpu.trace import format as tfmt
     from shrewd_tpu.utils import prng
 
+    stem = Path(workload).stem
     dev = jax.devices()[0]
     on_tpu = dev.platform in ("tpu", "axon")
-    out = {"platform": dev.platform, "rates": {}}
+    out = {"platform": dev.platform,
+           "mode": "chunked" if chunked else "dense", "rates": {}}
+    if chunked:
+        out["chunk"] = chunk
     for n in lengths:
-        p = cache_path(n)
+        p = cache_path(stem, n)
         if not p.exists():
             log(f"skip {n}: {p} missing (run --build)")
             continue
         tr, meta = tfmt.load(p)
-        # batch scaled so each length measures in seconds, not minutes:
-        # per-trial work grows linearly with window length
-        b = batch or max(256, min(131072 if on_tpu else 8192,
-                                  (1 << 29) // max(tr.n, 1)))
         k = TrialKernel(tr, O3Config())
-        keys = prng.trial_keys(prng.campaign_key(0), b)
-        t0 = time.time()
-        np.asarray(k.run_keys(keys, "regfile"))
-        compile_s = time.time() - t0
-        rates = []
-        for _ in range(reps):
+        row = {"lift_rate": round(meta["stats"]["lift_rate"], 4)
+               if "stats" in meta else None}
+        if chunked:
+            from shrewd_tpu.ops.chunked import ChunkedCampaign
+
+            b = trials or max(512, min(16384 if on_tpu else 2048,
+                                       (1 << 26) // max(tr.n // 64, 1)))
+            t0 = time.time()
+            ch = ChunkedCampaign(k, chunk=chunk)
+            row["setup_seconds"] = round(time.time() - t0, 1)
+            # warm the chunk-kernel compile with a tiny run, then time
+            # like the dense path (median of reps)
+            t0 = time.time()
+            ch.run_keys(prng.trial_keys(prng.campaign_key(1), 8), "regfile")
+            row["compile_seconds"] = round(time.time() - t0, 1)
+            keys = prng.trial_keys(prng.campaign_key(0), b)
+            rates = []
+            tally = None
+            for _ in range(reps):
+                t0 = time.time()
+                tally = ch.run_keys(keys, "regfile")
+                rates.append(b / (time.time() - t0))
+            rates.sort()
+            row.update(trials_per_sec=round(rates[len(rates) // 2], 2),
+                       batch=b, chunks=ch.C, lanes_per_call=ch.B,
+                       tally=[int(x) for x in tally])
+        else:
+            b = batch or max(256, min(131072 if on_tpu else 8192,
+                                      (1 << 29) // max(tr.n, 1)))
+            keys = prng.trial_keys(prng.campaign_key(0), b)
             t0 = time.time()
             np.asarray(k.run_keys(keys, "regfile"))
-            rates.append(b / (time.time() - t0))
-        rates.sort()
-        out["rates"][str(tr.n)] = {
-            "trials_per_sec": round(rates[len(rates) // 2], 2),
-            "batch": b,
-            "compile_seconds": round(compile_s, 1),
-            "lift_rate": round(meta["stats"]["lift_rate"], 4)
-            if "stats" in meta else None,
-        }
-        log(f"window {tr.n}: {out['rates'][str(tr.n)]['trials_per_sec']:,} "
-            f"trials/s (batch {b})")
+            row["compile_seconds"] = round(time.time() - t0, 1)
+            rates = []
+            for _ in range(reps):
+                t0 = time.time()
+                np.asarray(k.run_keys(keys, "regfile"))
+                rates.append(b / (time.time() - t0))
+            rates.sort()
+            row.update(trials_per_sec=round(rates[len(rates) // 2], 2),
+                       batch=b)
+        out["rates"][str(tr.n)] = row
+        log(f"window {tr.n}: {row['trials_per_sec']:,} trials/s "
+            f"({out['mode']})")
     return out
 
 
@@ -122,17 +157,25 @@ def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--build", action="store_true")
     ap.add_argument("--rate", action="store_true")
-    ap.add_argument("--lengths", type=int, nargs="*", default=list(LENGTHS))
+    ap.add_argument("--chunked", action="store_true")
+    ap.add_argument("--chunk", type=int, default=65536)
+    ap.add_argument("--lengths", type=int, nargs="*", default=list(LENGTHS),
+                    help="window lengths in µops; 0 = the full capture")
     ap.add_argument("--batch", type=int, default=None)
+    ap.add_argument("--trials", type=int, default=0,
+                    help="chunked mode: trial count per measurement")
     ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--max-steps", type=int, default=4_000_000)
     ap.add_argument("--workload", default="workloads/lzss.c")
     ap.add_argument("--out", default=None)
     a = ap.parse_args()
     result = {}
     if a.build:
-        result["build"] = build(a.lengths, a.workload)
+        result["build"] = build(a.lengths, a.workload, a.max_steps)
     if a.rate:
-        result["rate"] = rate(a.lengths, a.batch, a.reps)
+        result["rate"] = rate(a.lengths, a.batch, a.reps, a.workload,
+                              chunked=a.chunked, chunk=a.chunk,
+                              trials=a.trials)
     if a.out:
         with open(a.out, "w") as f:
             json.dump(result, f, indent=1)

@@ -43,6 +43,9 @@ def main() -> int:
     ap.add_argument("--trials", type=int, default=200)
     ap.add_argument("--seed", type=int, default=9)
     ap.add_argument("--workloads", nargs="*", default=DEFAULT_WORKLOADS)
+    ap.add_argument("--max-steps", type=int, default=2_000_000,
+                    help="ptrace capture budget (raise for full-length "
+                         "windows, e.g. the 2.1M-macro lzss capture)")
     ap.add_argument("--out", required=True)
     a = ap.parse_args()
 
@@ -56,7 +59,8 @@ def main() -> int:
         try:
             import jax
             jax.clear_caches()     # bound XLA-CPU compile-state growth
-            rep = run_diff(a.trials, a.seed, wl, mode=a.mode)
+            rep = run_diff(a.trials, a.seed, wl, mode=a.mode,
+                           max_steps=a.max_steps)
             row = {k: rep[k] for k in KEEP if k in rep}
             if "lift_stats" in rep:
                 row["lift_rate"] = round(rep["lift_stats"]["lift_rate"], 4)

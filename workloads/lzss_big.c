@@ -1,0 +1,7 @@
+/* lzss at SimPoint scale: ~9M macro-ops in the marker window (~10M+
+ * lifted µops) — the ≥10M-µop chunked-replay scaling target
+ * (reference bar: 30B-instruction SimPoint regions,
+ * x86_spec/x86-spec-cpu2017.py:403-436).  Same code as lzss.c, input
+ * scaled 4.75x. */
+#define IN_N 98304
+#include "lzss.c"
