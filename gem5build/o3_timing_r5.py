@@ -1,0 +1,227 @@
+"""Round-5 timing fidelity: distribution-level anchor vs the reference O3.
+
+VERDICT r4 weak #1/#2 + next-round #3: compare the scoreboard to the
+actual gem5 X86O3CPU *at the distribution level* — per-µop pipeline
+residencies from the reference's own O3PipeView trace
+(``src/cpu/o3/probe/elastic_trace.hh:93`` family; ``--debug-flags=
+O3PipeView``) — on every anchor window, one standardized normalization,
+with the r5 mechanisms in the model:
+
+  - TournamentBP (the reference's default predictor) — mispredict counts
+  - measured redirect penalty (refill bubble from the pipeview trace)
+  - classic L1D walk (32kB/8-way over 30ns memory — the se.py config)
+  - taken-branch fetch-group break
+
+Units reported per window:
+  per_macro   cycles / committed macro insts (each side its own count)
+  per_uop     cycles / committed µops (each side its own µop stream)
+  expansion   gem5 µops-per-macro / framework µops-per-macro — the
+              decomposition factor that separates the two units: the
+              framework's 31-op ISA re-encodes an x86 macro in ~0.7 µops
+              where gem5's microcode uses ~1.7 (ld/op/st splits, flag
+              and rip µops), so per-µop is the unit that compares
+              machine *dynamics*, per-macro compares absolute time.
+
+Residency distributions (committed µops only, cycles):
+  iq  = dispatch→issue,  fu = issue→complete,  rob = dispatch→retire
+compared by mean/median/p90 and the 1-Wasserstein distance (EMD) between
+the normalized histograms.
+
+Writes O3_TIMING_VALIDATE_r05.json.
+
+Usage: PYTHONPATH=/root/repo python gem5build/o3_timing_r5.py
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+sys.path.insert(0, REPO)
+sys.path.insert(0, HERE)
+
+import numpy as np  # noqa: E402
+
+from golden_campaign import GEM5, RUNDIR, SE, ensure_checkpoint  # noqa: E402
+from o3_validate import parse_stats  # noqa: E402
+
+WORKLOADS = ["workloads/sort.c", "workloads/intmm.c",
+             "workloads/bytehash.c", "workloads/divmix.c",
+             "workloads/ptrchase.c", "workloads/memops.c",
+             "workloads/rotmix.c"]
+
+
+def run_gem5_pipeview(binary, ckpt, stop_pc, timeout):
+    import subprocess
+
+    outdir = os.path.join(RUNDIR, f"m5out-o3r5-{os.getpid()}")
+    pv = os.path.join(outdir, "pipeview.out")
+    cmd = [GEM5, "-r", "--stdout-file=simout", f"--outdir={outdir}",
+           "--debug-flags=O3PipeView", "--debug-file=pipeview.out",
+           SE, "restore", binary, f"--ckpt-dir={ckpt}",
+           "--cpu=o3", "--caches", "--reset-stats",
+           f"--stop-pc=0x{stop_pc:x}"]
+    t0 = time.monotonic()
+    r = subprocess.run(cmd, capture_output=True, text=True, timeout=timeout)
+    wall = time.monotonic() - t0
+    simout = ""
+    p = os.path.join(outdir, "simout")
+    if os.path.exists(p):
+        simout = open(p, errors="replace").read()
+    assert r.returncode == 0 and "STOP_PC_REACHED" in simout, \
+        f"gem5 pipeview run failed rc={r.returncode}\n{simout[-1200:]}"
+    return outdir, pv, wall
+
+
+def parse_pipeview(path, period=333):
+    """Committed-µop stage timestamps (cycles) from an O3PipeView trace."""
+    fetch = []
+    disp = []
+    issue = []
+    comp = []
+    retire = []
+    cur: dict = {}
+    for line in open(path):
+        p = line.split(":")
+        if p[1] == "fetch":
+            cur = {"fetch": int(p[2])}
+        elif p[1] in ("dispatch", "issue", "complete"):
+            cur[p[1]] = int(p[2])
+        elif p[1] == "retire":
+            rt = int(p[2])
+            if rt > 0 and cur.get("dispatch", 0) > 0:
+                fetch.append(cur["fetch"])
+                disp.append(cur["dispatch"])
+                issue.append(cur["issue"])
+                comp.append(cur["complete"])
+                retire.append(rt)
+    f, d, i, c, r = (np.asarray(x, np.int64) // period
+                     for x in (fetch, disp, issue, comp, retire))
+    return {"iq": i - d, "fu": c - i, "rob": r - d, "frontend": d - f}
+
+
+def dist_stats(a: np.ndarray) -> dict:
+    return {"mean": round(float(a.mean()), 2),
+            "median": float(np.median(a)),
+            "p90": float(np.percentile(a, 90))}
+
+
+def emd(a: np.ndarray, b: np.ndarray, cap: int = 200) -> float:
+    """1-Wasserstein distance between two nonneg integer samples (cycles),
+    clipped at ``cap`` (the tail above is one bucket)."""
+    ha = np.bincount(np.clip(a, 0, cap), minlength=cap + 1) / max(len(a), 1)
+    hb = np.bincount(np.clip(b, 0, cap), minlength=cap + 1) / max(len(b), 1)
+    return round(float(np.abs(np.cumsum(ha - hb)).sum()), 3)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--workloads", nargs="*", default=WORKLOADS)
+    ap.add_argument("--timeout", type=float, default=900.0)
+    ap.add_argument("--out",
+                    default=os.path.join(REPO, "O3_TIMING_VALIDATE_r05.json"))
+    args = ap.parse_args()
+    assert os.path.exists(GEM5), f"{GEM5} not built yet"
+
+    from shrewd_tpu.ingest import hostdiff as hd
+    from shrewd_tpu.isa import uops as U
+    from shrewd_tpu.models.timing import (TimingConfig, compute_scoreboard,
+                                          predict_mispredicts)
+
+    doc = {"model_defaults": TimingConfig().to_dict(),
+           "normalization": "per_uop = cycles / committed µops (each side "
+                            "its own stream) — the standardized unit; "
+                            "per_macro reported alongside with the "
+                            "measured decomposition factor",
+           "windows": {}}
+    ratios_uop = []
+    ratios_macro = []
+    for wl in args.workloads:
+        paths = hd.build_tools(wl)
+        ckpt = ensure_checkpoint(str(paths.workload), paths.begin,
+                                 timeout=args.timeout)
+        outdir, pv, wall = run_gem5_pipeview(
+            str(paths.workload), ckpt, paths.end, args.timeout)
+        g = parse_stats(outdir)
+        gdist = parse_pipeview(pv)
+
+        trace, meta = hd.capture_and_lift(paths)
+        cfg = TimingConfig()
+        sb = compute_scoreboard(trace, cfg)
+        fw_mispred = int(predict_mispredicts(trace, cfg).sum())
+        fw_branches = int(np.asarray(
+            U.is_branch(np.asarray(trace.opcode))).sum())
+        fdist = {"iq": sb.issue - sb.dispatch,
+                 "fu": sb.writeback - sb.issue,
+                 "rob": sb.commit - sb.dispatch}
+
+        macros = meta["macro_ops"]
+        per_uop_g = g["numCycles"] / g["uops"]
+        per_uop_f = sb.n_cycles / trace.n
+        per_macro_g = g["numCycles"] / g["macro_insts"]
+        per_macro_f = sb.n_cycles / macros
+        expansion = (g["uops"] / g["macro_insts"]) / (trace.n / macros)
+        row = {
+            "window": {"fw_macros": macros, "fw_uops": trace.n,
+                       "gem5_macros": g["macro_insts"],
+                       "gem5_uops": g["uops"],
+                       "gem5_cycles": g["numCycles"],
+                       "fw_cycles": int(sb.n_cycles),
+                       "gem5_wall_s": round(wall, 1)},
+            "per_uop": {"gem5": round(per_uop_g, 4),
+                        "framework": round(per_uop_f, 4),
+                        "ratio": round(per_uop_f / per_uop_g, 3)},
+            "per_macro": {"gem5": round(per_macro_g, 4),
+                          "framework": round(per_macro_f, 4),
+                          "ratio": round(per_macro_f / per_macro_g, 3)},
+            "uop_decomposition": {
+                "gem5_uops_per_macro": round(g["uops"] / g["macro_insts"],
+                                             3),
+                "fw_uops_per_macro": round(trace.n / macros, 3),
+                "expansion_ratio": round(expansion, 3)},
+            "mispredicts": {
+                "framework_tournament": fw_mispred,
+                "framework_branches": fw_branches,
+                "gem5_committed": g["mispredicts"],
+                "gem5_cond_branches": g["cond_branches"],
+                "count_ratio": round(fw_mispred / max(g["mispredicts"], 1),
+                                     3)},
+            "residency": {},
+        }
+        for k in ("iq", "fu", "rob"):
+            row["residency"][k] = {
+                "gem5": dist_stats(gdist[k]),
+                "framework": dist_stats(np.asarray(fdist[k])),
+                "emd_cycles": emd(gdist[k], np.asarray(fdist[k])),
+            }
+        row["residency"]["gem5_frontend_depth"] = dist_stats(
+            gdist["frontend"])
+        doc["windows"][wl] = row
+        ratios_uop.append(row["per_uop"]["ratio"])
+        ratios_macro.append(row["per_macro"]["ratio"])
+        print(f"{wl}: per-µop {row['per_uop']['ratio']} per-macro "
+              f"{row['per_macro']['ratio']} (expansion {expansion:.2f}) "
+              f"mispred {fw_mispred} vs {g['mispredicts']}")
+
+    doc["summary"] = {
+        "per_uop_ratio_range": [min(ratios_uop), max(ratios_uop)],
+        "per_macro_ratio_range": [min(ratios_macro), max(ratios_macro)],
+        "note": ("per_macro × expansion_ratio ≈ per_uop: the absolute-"
+                 "cycle gap decomposes into machine dynamics (per-µop, "
+                 "the unit residency sampling uses) and the deliberate "
+                 "ISA re-encoding (the 31-op ISA emits ~0.7 µops/macro "
+                 "vs x86 microcode's ~1.7)."),
+    }
+    with open(args.out, "w") as f:
+        json.dump(doc, f, indent=1)
+        f.write("\n")
+    print(f"per-µop ratios {doc['summary']['per_uop_ratio_range']}, "
+          f"per-macro {doc['summary']['per_macro_ratio_range']}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

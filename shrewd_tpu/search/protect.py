@@ -46,20 +46,44 @@ from shrewd_tpu.ops import classify as C
 
 
 class Scheme(NamedTuple):
-    """One protection option (applies to a single structure)."""
+    """One protection option (applies to a single structure).
+
+    ``detect`` may hide outcome correlation: a structural scheme's
+    coverage varies per fault site, and sites whose faults would be SDC
+    can have below-average coverage (PROTECT_VALIDATE_r05 measured the
+    uniform-mean model underpredicting shadow-FU SDC by 26%).
+    ``detect_sdc``/``detect_due`` optionally carry the
+    outcome-conditioned detection probabilities E[cov | outcome] —
+    estimable from an UNPROTECTED campaign (per-trial outcome × the
+    fault site's coverage), so the search still never needs protected
+    runs.  None falls back to the scalar."""
 
     name: str
     detect: float    # P(fault intercepted and reported)
     correct: float   # P(fault scrubbed before consumption)
     area: float      # area multiplier on the protected structure
+    detect_sdc: float | None = None   # E[detect | fault would be SDC]
+    detect_due: float | None = None   # E[detect | fault would be DUE]
 
     def validate(self) -> "Scheme":
-        if not (0.0 <= self.detect and 0.0 <= self.correct
-                and self.detect + self.correct <= 1.0):
-            raise ValueError(f"{self.name}: need detect+correct in [0,1]")
+        for d in (self.detect, self.detect_sdc, self.detect_due):
+            if d is None:
+                continue
+            if not (0.0 <= d and 0.0 <= self.correct
+                    and d + self.correct <= 1.0):
+                raise ValueError(
+                    f"{self.name}: need detect+correct in [0,1]")
         if self.area < 1.0:
             raise ValueError(f"{self.name}: area multiplier < 1")
         return self
+
+    @property
+    def d_sdc(self) -> float:
+        return self.detect if self.detect_sdc is None else self.detect_sdc
+
+    @property
+    def d_due(self) -> float:
+        return self.detect if self.detect_due is None else self.detect_due
 
 
 # The classic SEU-protection ladder.  Area factors are the conventional
@@ -73,7 +97,8 @@ TMR = Scheme("tmr", 0.0, 1.0, 3.0)
 DEFAULT_SCHEMES = [NONE, PARITY, SECDED, DMR, TMR]
 
 
-def shadow_scheme(kernel, area: float = 1.5, name: str = "shadow") -> Scheme:
+def shadow_scheme(kernel, area: float = 1.5, name: str = "shadow",
+                  keys=None, structure: str = "fu") -> Scheme:
     """The SHREWD scheme itself: redundant execution on shadow FUs.
 
     Detection probability = the availability-derated per-µop coverage the FU
@@ -81,9 +106,44 @@ def shadow_scheme(kernel, area: float = 1.5, name: str = "shadow") -> Scheme:
     the reference's per-OpClass availability stats (inst_queue.hh:581-606)
     aggregate to.  ``area`` is the FU-pool overhead of provisioning shadows
     (no extra architectural state, so the default is a logic-area estimate).
-    """
+
+    With ``keys``, also estimates the outcome-CONDITIONED detection
+    probabilities from one *unprotected* campaign: coverage is structural
+    (pool pressure at the fault µop's issue cycle) and correlates with the
+    fault's would-be outcome, so ``E[cov | SDC]`` differs from the uniform
+    mean (measured 26% lower on the sort window, PROTECT_VALIDATE_r05).
+    Still zero protected runs — the search's core economy."""
     cov = np.asarray(kernel.shadow_cov, dtype=np.float64)
-    return Scheme(name, float(cov.mean()), 0.0, float(area)).validate()
+    d = float(cov.mean())
+    d_sdc = d_due = None
+    if keys is not None:
+        if structure != "fu":
+            raise ValueError("shadow_scheme conditions on FU fault sites; "
+                             f"structure={structure!r} samplers emit "
+                             "entries (sentinels, register indices) that "
+                             "are not µop coverage sites")
+        faults = kernel.sampler(structure).sample_batch(keys)
+        k_off = kernel.with_shrewd(enable=False)
+        out = np.asarray(k_off.run_batch(faults))
+        entry = np.asarray(faults.entry)
+        assert ((0 <= entry) & (entry < cov.shape[0])).all(), \
+            "FU sampler produced out-of-window entries"
+        site_cov = cov[entry]
+        # the scalar must be the coverage mean over the SAMPLER's site
+        # distribution (residency-weighted), not the trace-uniform mean —
+        # P(detected) = E_sampled[cov] (PROTECT_VALIDATE_r05: the
+        # trace-uniform mean read 0.52 where the sampled mean is 0.26)
+        d = float(site_cov.mean())
+        for code, which in ((C.OUTCOME_SDC, "sdc"), (C.OUTCOME_DUE, "due")):
+            sel = out == code
+            if sel.any():
+                val = float(site_cov[sel].mean())
+                if which == "sdc":
+                    d_sdc = val
+                else:
+                    d_due = val
+    return Scheme(name, d, 0.0, float(area),
+                  detect_sdc=d_sdc, detect_due=d_due).validate()
 
 
 class StructureProfile(NamedTuple):
@@ -154,17 +214,21 @@ class DesignSpace:
         self._fit = jnp.asarray([p.fit for p in self.profiles])
         self._bits = jnp.asarray([float(p.bits) for p in self.profiles])
         self._det = jnp.asarray([s.detect for s in self.schemes])
+        self._det_sdc = jnp.asarray([s.d_sdc for s in self.schemes])
+        self._det_due = jnp.asarray([s.d_due for s in self.schemes])
         self._cor = jnp.asarray([s.correct for s in self.schemes])
         self._area = jnp.asarray([s.area for s in self.schemes])
 
         def one(cfg):
-            det = self._det[cfg]
             cor = self._cor[cfg]
             areaf = self._area[cfg]
-            resid = 1.0 - det - cor
+            # outcome-conditioned residuals: the SDC term uses
+            # E[detect | SDC-bound fault] (see Scheme docstring)
+            resid_sdc = 1.0 - self._det_sdc[cfg] - cor
+            resid_due = 1.0 - self._det_due[cfg] - cor
             rate = self._fit * areaf          # protection bits are targets too
-            sdc = jnp.sum(rate * resid * self._p[:, C.OUTCOME_SDC])
-            due = jnp.sum(rate * resid * self._p[:, C.OUTCOME_DUE])
+            sdc = jnp.sum(rate * resid_sdc * self._p[:, C.OUTCOME_SDC])
+            due = jnp.sum(rate * resid_due * self._p[:, C.OUTCOME_DUE])
             area = jnp.sum(self._bits * areaf)
             return sdc, due, area
 
