@@ -1,0 +1,130 @@
+"""Wrong-path-dataflow error bound, measured with the reference binary.
+
+VERDICT r4 missing #5: the reference executes the wrong path (squash
+walk over really-executed entries, ``src/cpu/o3/rob.hh:207``), so FU and
+LSQ state carries wrong-path entries a fault can strike — and a fault
+striking one is masked by the squash.  A sampler drawing only
+correct-path sites therefore OVERSTATES FU/LSQ AVF by the wrong-path
+share of structure occupancy:
+
+    AVF_true = (1 − w) · AVF_correct_path      (wrong-path strikes mask)
+
+This tool measures ``w`` from the reference binary itself on every
+anchor window — the issued-but-never-committed µop share,
+``(instsIssued − numOps) / instsIssued`` (an upper bound on the FU
+wrong-path share: re-issued correct-path replays are also counted) —
+and compares it against the scoreboard's modeled wrong-path FU mass
+share (``Scoreboard.wp_mass_fu``), which the FaultSampler folds into
+fault placement as squash-masked cross-section.
+
+Writes WRONGPATH_BOUND_r05.json with, per window:
+  gem5_wp_issue_share     measured upper bound on w
+  model_wp_fu_share       wp_mass_fu / (wp_mass_fu + correct FU mass)
+  avf_overstatement_bound the multiplicative AVF error ignoring wp
+                          (= 1/(1−w) − 1)
+
+Usage: PYTHONPATH=/root/repo python gem5build/wrongpath_bound.py
+"""
+
+import argparse
+import json
+import os
+import re
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+sys.path.insert(0, REPO)
+sys.path.insert(0, HERE)
+
+import numpy as np  # noqa: E402
+
+from golden_campaign import GEM5, ensure_checkpoint, run_gem5  # noqa: E402
+
+WORKLOADS = ["workloads/sort.c", "workloads/intmm.c",
+             "workloads/bytehash.c", "workloads/divmix.c",
+             "workloads/ptrchase.c", "workloads/memops.c",
+             "workloads/rotmix.c"]
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--workloads", nargs="*", default=WORKLOADS)
+    ap.add_argument("--timeout", type=float, default=900.0)
+    ap.add_argument("--out",
+                    default=os.path.join(REPO, "WRONGPATH_BOUND_r05.json"))
+    args = ap.parse_args()
+    assert os.path.exists(GEM5), f"{GEM5} not built yet"
+
+    from shrewd_tpu.ingest import hostdiff as hd
+    from shrewd_tpu.isa import uops as U
+    from shrewd_tpu.models.timing import TimingConfig, compute_scoreboard
+
+    doc = {"windows": {}, "model": "AVF_true = (1-w)·AVF_correct; "
+           "wrong-path strikes are squash-masked (rob.hh:207)"}
+    for wl in args.workloads:
+        paths = hd.build_tools(wl)
+        ckpt = ensure_checkpoint(str(paths.workload), paths.begin,
+                                 timeout=args.timeout)
+        rc, out, wall, outdir = run_gem5(
+            "restore", str(paths.workload), ckpt,
+            ["--cpu=o3", "--caches", "--reset-stats",
+             f"--stop-pc=0x{paths.end:x}"], timeout=args.timeout)
+        assert rc == 0 and "STOP_PC_REACHED" in out, f"{wl} rc={rc}"
+        text = open(os.path.join(outdir, "stats.txt")).read()
+
+        def stat(pat):
+            m = re.findall(rf"system\.cpu\.{pat}\s+(\d+)", text)
+            return int(m[-1]) if m else 0
+
+        issued = stat("instsIssued")
+        committed = stat(r"commitStats0\.numOps")
+        squashed_issued = stat(r"squashedInstsIssued")
+        w_meas = (issued - committed) / max(issued, 1)
+
+        trace, meta = hd.capture_and_lift(paths)
+        sb = compute_scoreboard(trace, TimingConfig())
+        fu_correct = int((sb.writeback - sb.issue).sum())
+        w_model = sb.wp_mass_fu / max(sb.wp_mass_fu + fu_correct, 1)
+        mem_mask = np.asarray(U.is_mem(np.asarray(trace.opcode)))
+        ls, le = sb.occupancy("lsq", mem_mask)
+        lsq_correct = int((le - ls).sum())
+        w_model_lsq = sb.wp_mass_lsq / max(sb.wp_mass_lsq + lsq_correct, 1)
+
+        doc["windows"][wl] = {
+            "gem5": {"issued_uops": issued, "committed_uops": committed,
+                     "squashed_issued": squashed_issued,
+                     "wp_issue_share": round(w_meas, 4)},
+            "model": {"wp_mass_fu": int(sb.wp_mass_fu),
+                      "fu_correct_mass": fu_correct,
+                      "wp_fu_share": round(w_model, 4),
+                      "wp_mass_lsq": int(sb.wp_mass_lsq),
+                      "lsq_correct_mass": lsq_correct,
+                      "wp_lsq_share": round(w_model_lsq, 4)},
+            "avf_overstatement_bound_pct": round(
+                100.0 * (1.0 / (1.0 - min(w_meas, 0.95)) - 1.0), 1),
+            "share_abs_delta": round(abs(w_meas - w_model), 4),
+        }
+        print(f"{wl}: gem5 wp share {w_meas:.3f}, model fu share "
+              f"{w_model:.3f}, lsq {w_model_lsq:.3f}")
+
+    shares = [r["gem5"]["wp_issue_share"] for r in doc["windows"].values()]
+    deltas = [r["share_abs_delta"] for r in doc["windows"].values()]
+    doc["summary"] = {
+        "gem5_wp_share_range": [min(shares), max(shares)],
+        "max_share_abs_delta": max(deltas),
+        "note": ("the sampler now folds wp_mass_fu/wp_mass_lsq into "
+                 "FU/LSQ fault placement (squash-masked sentinel), so "
+                 "the former overstatement is modeled, not ignored; the "
+                 "gem5 share is an upper bound (it counts correct-path "
+                 "re-issues as wrong path)"),
+    }
+    with open(args.out, "w") as f:
+        json.dump(doc, f, indent=1)
+        f.write("\n")
+    print(json.dumps(doc["summary"]))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

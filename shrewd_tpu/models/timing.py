@@ -144,6 +144,8 @@ class Scoreboard(NamedTuple):
     mispredict: np.ndarray | None = None
     wp_mass_rob: int = 0
     wp_mass_iq: int = 0
+    wp_mass_fu: int = 0
+    wp_mass_lsq: int = 0
 
     @property
     def n_cycles(self) -> int:
@@ -157,9 +159,13 @@ class Scoreboard(NamedTuple):
         """Squashed-entry residency mass added to a structure's strike
         cross-section (zero unless a predictor model ran).  Wrong-path
         µops occupy ROB and IQ slots from their dispatch to the branch's
-        resolution; wrong-path execution (FU) and wrong-path memory ops
-        (LSQ) are second-order and not modeled."""
-        return {"rob": self.wp_mass_rob, "iq": self.wp_mass_iq}.get(
+        resolution; they also *execute* (the reference really runs the
+        wrong path — squash walk ``src/cpu/o3/rob.hh:207`` over
+        really-executed entries) so FU and LSQ carry wrong-path mass
+        too (r5; bound validated against the reference's own
+        issued-vs-committed gap, WRONGPATH_BOUND_r05)."""
+        return {"rob": self.wp_mass_rob, "iq": self.wp_mass_iq,
+                "fu": self.wp_mass_fu, "lsq": self.wp_mass_lsq}.get(
             structure, 0)
 
     def occupancy(self, structure: str, mem_mask: np.ndarray | None = None
@@ -237,7 +243,7 @@ def wrongpath_phantoms(trace, sb: "Scoreboard", cfg: TimingConfig
         return zero
     oc = np.asarray(U.opclass_of(np.asarray(trace.opcode)), np.int32)
     n = oc.shape[0]
-    rate = max(1, round(n / max(sb.n_cycles, 1)))
+    rate = _wrongpath_issue_rate(n, sb.n_cycles, cfg)
     ph_oc: list[int] = []
     ph_cyc: list[int] = []
     for i in np.nonzero(sb.mispredict)[0]:
@@ -276,6 +282,16 @@ def _branch_identity_hash(trace, bits: int) -> tuple[np.ndarray, np.ndarray]:
          ^ src2.astype(np.uint64) * np.uint64(0xC2B2AE3D27D4EB4F)
          ^ imm)
     return is_br, ((h >> np.uint64(bits)) ^ h).astype(np.int64) & mask
+
+
+def _wrongpath_issue_rate(n: int, n_cycles: int, cfg: TimingConfig) -> int:
+    """Wrong-path issue rate (µops/cycle): the machine runs down the
+    wrong path at roughly the window's average issue rate, width-capped —
+    ONE definition shared by the phantom FU-pressure mass
+    (``wrongpath_phantoms``) and the wp strike mass
+    (``compute_scoreboard``), so calibrating one cannot silently diverge
+    from the other."""
+    return min(cfg.issue_width, max(1, round(n / max(n_cycles, 1))))
 
 
 def predict_mispredicts(trace, cfg: TimingConfig) -> np.ndarray:
@@ -496,8 +512,16 @@ def compute_scoreboard(trace, cfg: TimingConfig | None = None) -> Scoreboard:
             # resumes redirect_penalty cycles later
             pending_redirect = writeback[i] + cfg.redirect_penalty
 
-    wp_rob = wp_iq = 0
+    wp_rob = wp_iq = wp_fu = wp_lsq = 0
     if mispredict is not None:
+        # wrong-path EXECUTION mass: the machine issues and executes down
+        # the wrong path at roughly the window's issue rate until the
+        # squash; each executed wrong-path µop holds an FU ~1 cycle and
+        # the mem fraction of them occupies LSQ slots to the squash
+        issue_rate = _wrongpath_issue_rate(
+            n, int(commit[-1]) + 1 if n else 1, cfg)
+        mem_frac = float(np.asarray(mem).mean()) if n else 0.0
+        wp_span_total = 0
         # Residency mass of the squashed wrong-path entries: per
         # mispredicted branch, the front end dispatches dispatch_width
         # µops/cycle into the free ROB space from dispatch+1 until the
@@ -524,10 +548,32 @@ def compute_scoreboard(trace, cfg: TimingConfig | None = None) -> Scoreboard:
             # wrong-path µops wait in the IQ too (their operands hang on
             # the unresolved branch's shadow); same mass, IQ-capped
             wp_iq += min(mass, cfg.iq_size * max(span, 0))
+            # executed wrong-path µops: issue-rate × span capped by the
+            # count that actually DISPATCHED (a ROB-full mispredict
+            # admits no wrong-path µops at all), ~1 FU-cycle each; the
+            # mem fraction sits in the LSQ from issue to squash (~half
+            # the span on average)
+            executed = min(issue_rate * span, filled)
+            wp_fu += executed
+            wp_lsq += min(int(mem_frac * executed * max(span, 2) / 2),
+                          cfg.lsq_size * span)
+            wp_span_total += span
+        # overlap cap: dense mispredicts (random-outcome synthetic
+        # streams hit ~50% rates) produce overlapping wrong-path spans,
+        # but the machine has only n_cycles of wrong-path time — scale
+        # every wp mass down to the physically available span budget
+        n_cyc = int(commit[-1]) + 1 if n else 1
+        if wp_span_total > n_cyc:
+            f = n_cyc / wp_span_total
+            wp_rob = int(wp_rob * f)
+            wp_iq = int(wp_iq * f)
+            wp_fu = int(wp_fu * f)
+            wp_lsq = int(wp_lsq * f)
 
     return Scoreboard(dispatch, issue, writeback, commit,
                       mispredict=mispredict,
-                      wp_mass_rob=int(wp_rob), wp_mass_iq=int(wp_iq))
+                      wp_mass_rob=int(wp_rob), wp_mass_iq=int(wp_iq),
+                      wp_mass_fu=int(wp_fu), wp_mass_lsq=int(wp_lsq))
 
 
 class ResidencySampler:

@@ -126,9 +126,11 @@ def shadow_scheme(kernel, area: float = 1.5, name: str = "shadow",
         k_off = kernel.with_shrewd(enable=False)
         out = np.asarray(k_off.run_batch(faults))
         entry = np.asarray(faults.entry)
-        assert ((0 <= entry) & (entry < cov.shape[0])).all(), \
-            "FU sampler produced out-of-window entries"
-        site_cov = cov[entry]
+        # wrong-path draws carry the past-window sentinel (entry == n,
+        # squash-masked, never detected) — their coverage is zero
+        onpath = (0 <= entry) & (entry < cov.shape[0])
+        site_cov = np.where(onpath, cov[np.clip(entry, 0,
+                                                cov.shape[0] - 1)], 0.0)
         # the scalar must be the coverage mean over the SAMPLER's site
         # distribution (residency-weighted), not the trace-uniform mean —
         # P(detected) = E_sampled[cov] (PROTECT_VALIDATE_r05: the

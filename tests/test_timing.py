@@ -203,9 +203,14 @@ class TestO3Integration:
         s = FaultSampler(tr, "fu", O3Config(timing="scoreboard"))
         keys = prng.trial_keys(prng.campaign_key(3), 2048)
         f = jax.vmap(s.sample)(keys)
+        entry = np.asarray(f.entry)
+        # wrong-path FU mass draws the past-window sentinel (entry == n,
+        # squash-masked in replay, r5); the on-path draws carry the
+        # latency weighting
+        onpath = entry[entry < tr.n]
+        assert onpath.size > 0.5 * entry.size
         struck_div = float(
-            np.asarray(U.is_div(np.asarray(tr.opcode)[np.asarray(f.entry)]))
-            .mean())
+            np.asarray(U.is_div(np.asarray(tr.opcode)[onpath])).mean())
         # 20-cycle divides must be struck far above their static share
         assert struck_div > 3 * div_frac_static
 
@@ -218,7 +223,14 @@ class TestO3Integration:
         s = FaultSampler(tr, "lsq", O3Config(timing="scoreboard"))
         keys = prng.trial_keys(prng.campaign_key(4), 512)
         f = jax.vmap(s.sample)(keys)
-        struck = np.asarray(tr.opcode)[np.asarray(f.entry)]
+        entry = np.asarray(f.entry)
+        onpath = entry[entry < tr.n]       # drop wrong-path sentinels (r5)
+        # non-vacuous: enough on-path draws to test the mem-only property
+        # (this tiny cold-miss-dominated window legitimately carries a
+        # LARGE wrong-path LSQ share: miss-fed mispredicts let the wrong
+        # path run ~90 cycles deep, filling the LSQ — so no 50% floor)
+        assert onpath.size >= 30
+        struck = np.asarray(tr.opcode)[onpath]
         assert np.asarray(U.is_mem(struck)).all()
 
     def test_scoreboard_is_default_proxy_optin(self):
