@@ -40,11 +40,7 @@ sys.path.insert(0, HERE)
 import numpy as np  # noqa: E402
 
 from golden_campaign import GEM5, ensure_checkpoint, run_gem5  # noqa: E402
-
-WORKLOADS = ["workloads/sort.c", "workloads/intmm.c",
-             "workloads/bytehash.c", "workloads/divmix.c",
-             "workloads/ptrchase.c", "workloads/memops.c",
-             "workloads/rotmix.c"]
+from o3_timing_r5 import WORKLOADS  # noqa: E402 — ONE anchor-window set
 
 
 def main() -> int:
@@ -75,12 +71,18 @@ def main() -> int:
 
         def stat(pat):
             m = re.findall(rf"system\.cpu\.{pat}\s+(\d+)", text)
-            return int(m[-1]) if m else 0
+            assert m, f"stat {pat!r} absent from {wl} stats.txt — " \
+                "gem5 stat layout changed; refusing to emit garbage"
+            return int(m[-1])
 
         issued = stat("instsIssued")
         committed = stat(r"commitStats0\.numOps")
-        squashed_issued = stat(r"squashedInstsIssued")
-        w_meas = (issued - committed) / max(issued, 1)
+        # informational only; gem5 omits never-bumped stats entirely
+        m = re.findall(r"system\.cpu\.\S*squashedInstsIssued\s+(\d+)",
+                       text)
+        squashed_issued = int(m[-1]) if m else 0
+        # clamp: µop-counting differences can put committed above issued
+        w_meas = min(max((issued - committed) / max(issued, 1), 0.0), 0.99)
 
         trace, meta = hd.capture_and_lift(paths)
         sb = compute_scoreboard(trace, TimingConfig())

@@ -294,7 +294,10 @@ class FaultSampler:
                 # residency-weighted over mem µops (non-mem intervals carry
                 # zero mass); the data field only exists on stores
                 entry, cycle = self._res.sample(ke)
-                is_st = self._store_mask[entry]
+                # wrong-path draws carry the sentinel entry == n (masked
+                # in replay); clip the store-mask gather explicitly
+                # rather than relying on XLA OOB-clamp semantics
+                is_st = self._store_mask[jnp.clip(entry, 0, self.n - 1)]
                 kind = jnp.where(which & is_st, jnp.int32(KIND_LSQ_DATA),
                                  jnp.int32(KIND_LSQ_ADDR))
             else:

@@ -518,8 +518,8 @@ def compute_scoreboard(trace, cfg: TimingConfig | None = None) -> Scoreboard:
         # the wrong path at roughly the window's issue rate until the
         # squash; each executed wrong-path µop holds an FU ~1 cycle and
         # the mem fraction of them occupies LSQ slots to the squash
-        issue_rate = _wrongpath_issue_rate(
-            n, int(commit[-1]) + 1 if n else 1, cfg)
+        n_cyc = int(commit[-1]) + 1 if n else 1
+        issue_rate = _wrongpath_issue_rate(n, n_cyc, cfg)
         mem_frac = float(np.asarray(mem).mean()) if n else 0.0
         wp_span_total = 0
         # Residency mass of the squashed wrong-path entries: per
@@ -562,7 +562,6 @@ def compute_scoreboard(trace, cfg: TimingConfig | None = None) -> Scoreboard:
         # streams hit ~50% rates) produce overlapping wrong-path spans,
         # but the machine has only n_cycles of wrong-path time — scale
         # every wp mass down to the physically available span budget
-        n_cyc = int(commit[-1]) + 1 if n else 1
         if wp_span_total > n_cyc:
             f = n_cyc / wp_span_total
             wp_rob = int(wp_rob * f)

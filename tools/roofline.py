@@ -1,0 +1,145 @@
+"""Roofline analysis of the Pallas taint kernel (VERDICT r4 weak #5).
+
+Quantifies which hardware bound the flagship kernel sits against, so
+"N trials/s" stops being a bare number:
+
+- **HBM traffic model** (analytic, from the committed shapes): per grid
+  block of ``B_TILE`` lanes the kernel streams the golden record
+  (15 per-step values, shared across lanes) from HBM once, plus the
+  per-lane deviation-set outputs.  bytes/trial ≈ 15·n·4/B_TILE + out.
+- **VPU work model**: per step each lane updates a k-deep deviation set
+  (tag compare + select per slot) on (8,128) int32 tiles — ~`k · C_OPS`
+  vector ops per lane-step.
+- **measurement**: the committed-default kernel rate on the current
+  device; achieved bytes/s and ops/s against the device peaks (v4 chip:
+  ~1.2 TB/s HBM, ~4·10¹¹ int32 VPU lane-ops/s/core × 2 cores).
+
+The binding bound and the achieved fraction go to ROOFLINE_r05.json.
+On CPU the traffic/ops model still prints (the measurement is labeled
+platform=cpu and is not a roofline claim).
+
+Usage: python tools/roofline.py [--batch 131072] [--uops 4096]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+# v4-chip peaks (public TPU v4 datasheet figures; per chip = 2 cores)
+HBM_PEAK_GBS = 1200.0
+VPU_PEAK_OPS = 8e11        # int32 lane-ops/s/chip (8x128 VPU, ~940 MHz, 2 cores, ~4 issue)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=131072)
+    ap.add_argument("--uops", type=int, default=4096)
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--out", default=str(REPO / "ROOFLINE_r05.json"))
+    a = ap.parse_args()
+
+    import jax
+    import numpy as np
+
+    from shrewd_tpu import native
+    from shrewd_tpu.models.o3 import PALLAS_S_CHUNK, O3Config
+    from shrewd_tpu.ops.trial import TrialKernel
+    from shrewd_tpu.utils import prng
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform in ("tpu", "axon")
+    n = a.uops
+    batch = a.batch if on_tpu else min(a.batch, 8192)
+
+    cfg = O3Config()
+    b_tile = int(getattr(cfg, "pallas_b_tile", 1024))
+    k = int(getattr(cfg, "taint_k", 8))
+
+    # ---- analytic models (committed shapes) -----------------------------
+    n_blocks = max(batch // b_tile, 1)
+    stream_bytes = 15 * n * 4                  # golden record per block
+    out_bytes_per_lane = k * 8 + 16            # dev set (tag+val) + flags
+    bytes_per_trial = (stream_bytes * n_blocks / batch
+                       + out_bytes_per_lane)
+    # per lane-step deviation-set update: tag compare, select, ALU lanes
+    C_OPS = 12                                  # vector ops per slot-step
+    ops_per_trial = n * k * C_OPS
+
+    doc = {
+        "platform": dev.platform,
+        "window_uops": n, "batch": batch,
+        "b_tile": b_tile, "taint_k": k, "s_chunk": int(PALLAS_S_CHUNK),
+        "traffic_model": {
+            "bytes_per_trial": round(bytes_per_trial, 1),
+            "stream_bytes_per_block": stream_bytes,
+            "note": "golden streams shared per block; deviation sets "
+                    "live in VMEM for the whole window",
+        },
+        "compute_model": {
+            "vpu_lane_ops_per_trial": ops_per_trial,
+            "ops_per_slot_step": C_OPS,
+        },
+    }
+
+    # ---- measurement ----------------------------------------------------
+    trace = native.generate_trace(seed=1, n=n, nphys=256, mem_words=4096,
+                                  working_set_words=1024)
+    kernel = TrialKernel(trace, cfg)
+    keys = prng.trial_keys(prng.campaign_key(0), batch)
+    np.asarray(kernel.run_keys(keys, "regfile"))       # compile
+    rates = []
+    for _ in range(a.reps):
+        t0 = time.monotonic()
+        np.asarray(kernel.run_keys(keys, "regfile"))
+        rates.append(batch / (time.monotonic() - t0))
+    rates.sort()
+    rate = rates[len(rates) // 2]
+    doc["measured_trials_per_sec"] = round(rate, 1)
+
+    if on_tpu:
+        hbm = rate * bytes_per_trial
+        vpu = rate * ops_per_trial
+        doc["roofline"] = {
+            "achieved_hbm_gbs": round(hbm / 1e9, 2),
+            "hbm_peak_gbs": HBM_PEAK_GBS,
+            "hbm_fraction": round(hbm / (HBM_PEAK_GBS * 1e9), 4),
+            "achieved_vpu_ops": round(vpu / 1e9, 2),
+            "vpu_peak_gops": VPU_PEAK_OPS / 1e9,
+            "vpu_fraction": round(vpu / VPU_PEAK_OPS, 4),
+            "binding_bound": ("vpu" if vpu / VPU_PEAK_OPS
+                              > hbm / (HBM_PEAK_GBS * 1e9) else "hbm"),
+        }
+        bb = doc["roofline"]["binding_bound"]
+        frac = doc["roofline"][f"{bb}_fraction"]
+        doc["headroom_note"] = (
+            f"binding bound {bb} at {frac:.1%} of peak — "
+            + ("near-roofline; higher rates need algorithmic change "
+               "(smaller k, shorter windows, chunked replay)"
+               if frac > 0.5 else
+               "headroom exists; the gap is lowering overheads "
+               "(scalar-loop step dispatch, S_CHUNK re-reads), not the "
+               "hardware bound"))
+    else:
+        doc["roofline"] = None
+        doc["headroom_note"] = ("CPU measurement only — roofline claims "
+                                "need the TPU (tunnel was wedged; rerun "
+                                "on a healthy chip)")
+
+    with open(a.out, "w") as f:
+        json.dump(doc, f, indent=1)
+        f.write("\n")
+    print(json.dumps({k: doc[k] for k in
+                      ("platform", "measured_trials_per_sec",
+                       "headroom_note")}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
