@@ -156,6 +156,15 @@ class ChunkedCampaign:
 
     # ---- driver ----------------------------------------------------------
 
+    def lane_width(self, n_trials: int) -> int:
+        """Device lanes per kernel call for a campaign of ``n_trials``:
+        the memory-budget cap, shrunk to the pow2 bucket of the campaign
+        size (a 256-trial run at B=1024 would waste 4× compute).  Each
+        distinct bucket is its own XLA compile — callers warming the
+        kernel must warm at the SAME bucket they will time."""
+        return int(min(self.B,
+                       1 << int(np.ceil(np.log2(max(n_trials, 8))))))
+
     def outcomes_from_keys(self, keys: jax.Array, structure: str
                            ) -> np.ndarray:
         """Per-trial outcome classes (host int32[B_total], key order) —
@@ -164,6 +173,7 @@ class ChunkedCampaign:
         faults = kernel.sampler(structure).sample_batch(keys)
         f_host = {k: np.asarray(v) for k, v in faults._asdict().items()}
         n_tr = f_host["cycle"].shape[0]
+        B = self.lane_width(n_tr)
         # the fault's landing µop: REGFILE flips at `cycle`, every other
         # kind applies at µop `entry` (ops/replay.py step phases 1-2)
         landing = np.where(f_host["kind"] == KIND_REGFILE,
@@ -185,21 +195,21 @@ class ChunkedCampaign:
             gb_m1 = jnp.asarray(self.gb_mem[c + 1])
             cpos = fpos = 0
             while cpos < n_prev or fpos < fresh.size:
-                k_carry = min(self.B, n_prev - cpos)
+                k_carry = min(B, n_prev - cpos)
                 carry_sl = slice(cpos, cpos + k_carry)
                 cpos += k_carry
-                room = self.B - k_carry
+                room = B - k_carry
                 new_idx = fresh[fpos:fpos + room]
                 fpos += new_idx.size
                 b = k_carry + new_idx.size
-                pad = self.B - b
+                pad = B - b
                 # assemble lanes: carried first, then fresh (golden-boundary
                 # start), then inert padding
                 gb_r, gb_m = gb_r0, gb_m0
                 regs = []
                 mems = []
                 fl: dict[str, list] = {k: [] for k in f_host}
-                orig = np.full(self.B, -1, np.int64)
+                orig = np.full(B, -1, np.int64)
                 if k_carry:
                     regs.append(prev.reg[carry_sl])
                     mems.append(prev.mem[carry_sl])

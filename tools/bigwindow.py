@@ -116,12 +116,12 @@ def rate(lengths=LENGTHS, batch=None, reps: int = 3,
             t0 = time.time()
             ch = ChunkedCampaign(k, chunk=chunk)
             row["setup_seconds"] = round(time.time() - t0, 1)
-            # warm the chunk-kernel compile with a tiny run, then time
-            # like the dense path (median of reps)
-            t0 = time.time()
-            ch.run_keys(prng.trial_keys(prng.campaign_key(1), 8), "regfile")
-            row["compile_seconds"] = round(time.time() - t0, 1)
             keys = prng.trial_keys(prng.campaign_key(0), b)
+            # warm at the SAME lane-width bucket the timed reps use (the
+            # chunk kernel compiles per bucket)
+            t0 = time.time()
+            ch.run_keys(keys, "regfile")
+            row["compile_seconds"] = round(time.time() - t0, 1)
             rates = []
             tally = None
             for _ in range(reps):
@@ -130,7 +130,8 @@ def rate(lengths=LENGTHS, batch=None, reps: int = 3,
                 rates.append(b / (time.time() - t0))
             rates.sort()
             row.update(trials_per_sec=round(rates[len(rates) // 2], 2),
-                       batch=b, chunks=ch.C, lanes_per_call=ch.B,
+                       batch=b, chunks=ch.C,
+                       lanes_per_call=ch.lane_width(b),
                        tally=[int(x) for x in tally])
         else:
             b = batch or max(256, min(131072 if on_tpu else 8192,
