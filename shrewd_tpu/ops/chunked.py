@@ -183,6 +183,10 @@ class ChunkedCampaign:
         outcomes = np.full(n_tr, -1, np.int32)
         null_leaves = dict(kind=0, cycle=-1, entry=-1, bit=0, shadow_u=1.0)
         carry: _Carry | None = None
+        # observability: how the campaign resolved (self.last_stats)
+        st = {"waves": 0, "lanes_run": 0, "resolved_frozen": 0,
+              "resolved_eq": 0, "carried": 0, "resolved_at_end": 0,
+              "chunk_replays": 0}
 
         for c in range(self.C):
             fresh = np.nonzero(land_chunk == c)[0]
@@ -251,6 +255,11 @@ class ChunkedCampaign:
                 resolved = lane_out >= 0
                 outcomes[orig[:b][resolved]] = lane_out[resolved]
                 surv = np.nonzero(~resolved)[0]
+                st["waves"] += 1
+                st["lanes_run"] += b
+                st["chunk_replays"] += B     # padded lanes included
+                st["resolved_frozen"] += int((det | trap | div).sum())
+                st["resolved_eq"] += int((eq & ~(det | trap | div)).sum())
                 if c == self.C - 1:
                     # window end: classify survivors against golden final
                     if surv.size:
@@ -264,8 +273,10 @@ class ChunkedCampaign:
                                 r, self.golden_final,
                                 kernel.cfg.compare_regs))(res))
                         outcomes[orig[:b][surv]] = cls
+                        st["resolved_at_end"] += int(surv.size)
                     new_carry = None
                 elif surv.size:
+                    st["carried"] += int(surv.size)
                     sidx = jnp.asarray(surv)
                     new_carry = _Carry(
                         reg=jnp.take(reg_o, sidx, axis=0),
@@ -286,6 +297,7 @@ class ChunkedCampaign:
                                 jnp.asarray(getattr(new_carry.fault, k))])
                             for k in f_host}),
                         orig=np.concatenate([carry.orig, new_carry.orig])))
+        self.last_stats = st
         assert (outcomes >= 0).all(), "unresolved trials after last chunk"
         return outcomes
 
