@@ -82,6 +82,7 @@ class ChunkedCampaign:
             max_batch = int(np.clip(1 << int(np.log2(max(budget, 8))),
                                     8, 1024))
         self.B = max_batch
+        self.last_stats: dict | None = None   # set by outcomes_from_keys
 
         pad = self.C * self.S - self.n
         tr = kernel.tr
@@ -187,6 +188,7 @@ class ChunkedCampaign:
         st = {"waves": 0, "lanes_run": 0, "resolved_frozen": 0,
               "resolved_eq": 0, "carried": 0, "resolved_at_end": 0,
               "chunk_replays": 0}
+        self.last_stats = st    # live view — valid even on a failed run
 
         for c in range(self.C):
             fresh = np.nonzero(land_chunk == c)[0]
