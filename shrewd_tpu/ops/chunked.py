@@ -57,6 +57,7 @@ class _Carry(NamedTuple):
     mem: jax.Array       # u32[K, mem_words]
     fault: Fault         # leaves [K]
     orig: np.ndarray     # int64[K] original trial indices (host)
+    age: np.ndarray      # int64[K] chunks carried so far (host)
 
 
 class ChunkedCampaign:
@@ -69,8 +70,21 @@ class ChunkedCampaign:
     memory images stay under ~256 MB)."""
 
     def __init__(self, kernel, chunk: int = 65536,
-                 max_batch: int | None = None):
+                 max_batch: int | None = None,
+                 carry_horizon: int | None = None):
+        """``carry_horizon`` (optional): classify a trial that stays
+        divergent-but-live for more than this many chunks as SDC without
+        replaying the rest of the window.  The only relabelings this can
+        produce are masked→SDC (late reconvergence, rare past the
+        overwrite horizon) and DUE→SDC (a trap further down the window)
+        — the vulnerable set (SDC+DUE) never shrinks, the same
+        conservative stance as the taint path's escape-budget overflow
+        (ops/trial.py); tests/test_chunked.py pins the contract.  None =
+        exact (every carried trial replays to the window end).  The knob
+        is what makes SDC-heavy campaigns scale: per-trial cost drops
+        from ~n/2 to ~(horizon+1)·S."""
         self.kernel = kernel
+        self.carry_horizon = carry_horizon
         trace = kernel.trace
         self.n = int(trace.n)
         self.S = int(min(chunk, self.n))
@@ -187,7 +201,7 @@ class ChunkedCampaign:
         # observability: how the campaign resolved (self.last_stats)
         st = {"waves": 0, "lanes_run": 0, "resolved_frozen": 0,
               "resolved_eq": 0, "carried": 0, "resolved_at_end": 0,
-              "chunk_replays": 0}
+              "chunk_replays": 0, "horizon_sdc": 0}
         self.last_stats = st    # live view — valid even on a failed run
 
         for c in range(self.C):
@@ -216,6 +230,7 @@ class ChunkedCampaign:
                 mems = []
                 fl: dict[str, list] = {k: [] for k in f_host}
                 orig = np.full(B, -1, np.int64)
+                ages = np.zeros(B, np.int64)
                 if k_carry:
                     regs.append(prev.reg[carry_sl])
                     mems.append(prev.mem[carry_sl])
@@ -223,6 +238,7 @@ class ChunkedCampaign:
                         fl[k].append(
                             np.asarray(getattr(prev.fault, k))[carry_sl])
                     orig[:k_carry] = prev.orig[carry_sl]
+                    ages[:k_carry] = prev.age[carry_sl]
                 if new_idx.size:
                     regs.append(jnp.broadcast_to(
                         gb_r, (new_idx.size, self.nphys)))
@@ -278,6 +294,19 @@ class ChunkedCampaign:
                         st["resolved_at_end"] += int(surv.size)
                     new_carry = None
                 elif surv.size:
+                    surv_age = ages[:b][surv] + 1
+                    if self.carry_horizon is not None:
+                        # divergent past the overwrite horizon: classify
+                        # SDC without replaying the rest of the window
+                        # (conservative; see __init__ docstring)
+                        over = surv_age > self.carry_horizon
+                        if over.any():
+                            outcomes[orig[:b][surv[over]]] = C.OUTCOME_SDC
+                            st["horizon_sdc"] += int(over.sum())
+                            surv = surv[~over]
+                            surv_age = surv_age[~over]
+                    if surv.size == 0:
+                        continue
                     st["carried"] += int(surv.size)
                     sidx = jnp.asarray(surv)
                     new_carry = _Carry(
@@ -286,7 +315,8 @@ class ChunkedCampaign:
                         fault=Fault(**{
                             k: jnp.take(getattr(fault_b, k), sidx)
                             for k in f_host}),
-                        orig=orig[:b][surv])
+                        orig=orig[:b][surv],
+                        age=surv_age)
                 else:
                     new_carry = None
                 if new_carry is not None:
@@ -298,7 +328,8 @@ class ChunkedCampaign:
                                 jnp.asarray(getattr(carry.fault, k)),
                                 jnp.asarray(getattr(new_carry.fault, k))])
                             for k in f_host}),
-                        orig=np.concatenate([carry.orig, new_carry.orig])))
+                        orig=np.concatenate([carry.orig, new_carry.orig]),
+                        age=np.concatenate([carry.age, new_carry.age])))
         self.last_stats = st
         assert (outcomes >= 0).all(), "unresolved trials after last chunk"
         return outcomes

@@ -115,3 +115,30 @@ def test_lifted_window_parity():
         np.testing.assert_array_equal(
             ch.outcomes_from_keys(keys, structure),
             dense_outcomes(kernel, keys, structure), err_msg=structure)
+
+
+def test_carry_horizon_is_conservative_and_bounded():
+    """carry_horizon classifies long-divergent trials SDC without full
+    replay: outcomes may differ from exact ONLY as masked→SDC (the
+    conservative direction), and on this window they do not differ at
+    all (divergent state never re-converges past the overwrite
+    horizon)."""
+    kernel = mk_kernel(n=512, seed=17)
+    keys = prng.trial_keys(prng.campaign_key(23), 128)
+    exact = ChunkedCampaign(kernel, chunk=64)
+    oe = exact.outcomes_from_keys(keys, "regfile")
+    fast = ChunkedCampaign(kernel, chunk=64, carry_horizon=1)
+    of = fast.outcomes_from_keys(keys, "regfile")
+    diff = oe != of
+    # a horizon cut can only relabel a long-carried trial: would-be
+    # masked (late reconvergence) or would-be DUE (trap further down
+    # the window) become SDC; detected/frozen classes are untouched and
+    # the vulnerable set (SDC+DUE) never shrinks
+    assert np.isin(oe[diff], [C.OUTCOME_MASKED, C.OUTCOME_DUE]).all()
+    assert (of[diff] == C.OUTCOME_SDC).all()
+    vuln = lambda o: ((o == C.OUTCOME_SDC) | (o == C.OUTCOME_DUE)).sum()
+    assert vuln(of) >= vuln(oe)
+    # the fast path genuinely cut work
+    assert fast.last_stats["horizon_sdc"] >= int(diff.sum())
+    assert fast.last_stats["horizon_sdc"] > 0
+

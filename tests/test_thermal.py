@@ -1,0 +1,94 @@
+"""Thermal RC network tests (utils/thermal.py).
+
+Pinned against closed-form RC physics — the same checks one would run on
+the reference's ThermalModel (``src/sim/power/thermal_model.cc``):
+single-RC exponential step response, steady-state nodal balance, and the
+activity→power→temperature→fault-rate chain end to end."""
+
+import numpy as np
+import pytest
+
+from shrewd_tpu.utils.thermal import (KELVIN, ThermalNetwork,
+                                      activity_power)
+
+
+def single_rc(r=2.0, c=5.0, step=0.01, ambient=45.0):
+    return (ThermalNetwork(n_nodes=1, ambient_c=ambient, step_s=step)
+            .resistor(0, -1, r).capacitor(0, -1, c).build())
+
+
+def test_step_response_matches_closed_form():
+    # constant power P into one RC node: T(t) = amb + P·R·(1 − e^{−t/RC})
+    r, c, p = 2.0, 5.0, 10.0
+    step = 0.01
+    model = single_rc(r=r, c=c, step=step)
+    steps = 12000                       # 12·RC: fully settled
+    traj = np.asarray(model.trajectory(np.full((steps, 1), p)))
+    t = (np.arange(1, steps + 1)) * step
+    exact = 45.0 + p * r * (1.0 - np.exp(-t / (r * c)))
+    # backward Euler at dt = RC/1000: sub-0.1K accuracy
+    assert np.abs(traj[:, 0] - exact).max() < 0.1
+    # equilibrium: amb + P·R
+    assert traj[-1, 0] == pytest.approx(45.0 + p * r, abs=0.05)
+
+
+def test_steady_state_solve():
+    model = single_rc(r=3.0, c=1.0)
+    ss = np.asarray(model.steady_state(np.array([7.0])))
+    assert ss[0] == pytest.approx(45.0 + 21.0, abs=1e-3)
+
+
+def test_two_node_chain_gradient():
+    # die → heat-spreader → ambient: power at the die; at equilibrium the
+    # full P flows through both resistors, so T_die = amb + P(R1+R2),
+    # T_spread = amb + P·R2
+    net = (ThermalNetwork(n_nodes=2, ambient_c=40.0, step_s=0.01)
+           .resistor(0, 1, 1.5).resistor(1, -1, 0.5)
+           .capacitor(0, -1, 2.0).capacitor(1, -1, 10.0))
+    model = net.build()
+    ss = np.asarray(model.steady_state(np.array([8.0, 0.0])))
+    assert ss[0] == pytest.approx(40.0 + 8.0 * 2.0, abs=1e-3)
+    assert ss[1] == pytest.approx(40.0 + 8.0 * 0.5, abs=1e-3)
+    # the transient converges to the same point
+    traj = np.asarray(model.trajectory(
+        np.broadcast_to(np.array([8.0, 0.0]), (30000, 2))))
+    np.testing.assert_allclose(traj[-1], ss, atol=0.05)
+
+
+def test_cooling_from_hot_start():
+    model = single_rc(r=2.0, c=5.0)
+    traj = np.asarray(model.trajectory(np.zeros((12000, 1)),
+                                       t0_c=np.array([95.0])))
+    assert traj[0, 0] < 95.0 and traj[-1, 0] == pytest.approx(45.0,
+                                                              abs=0.2)
+    assert (np.diff(traj[:, 0]) <= 1e-9).all()      # monotone cooling
+
+
+def test_activity_power_chain_to_fault_rate():
+    """window activity → power trace → temperature → NoC fault-rate
+    acceleration (the reference's power/thermal/fault chain)."""
+    from shrewd_tpu.models.noc import temperature_factor
+    from shrewd_tpu.models.timing import TimingConfig, compute_scoreboard
+    from shrewd_tpu.trace.synth import WorkloadConfig, generate
+
+    tr = generate(WorkloadConfig(n=2048, nphys=64, mem_words=256,
+                                 working_set_words=64, seed=3))
+    sb = compute_scoreboard(tr, TimingConfig())
+    p = activity_power(tr, sb, interval_cycles=256)
+    assert p.shape[0] >= 1 and (p > 0).all()
+    model = single_rc(r=1.0, c=0.05, step=0.001)
+    traj = np.asarray(model.trajectory(p[:, None]))
+    assert (traj >= 45.0 - 1e-6).all()
+    # hotter die ⇒ accelerated upset rates in every susceptibility class
+    f_hot = np.asarray(temperature_factor(float(traj.max())))
+    f_amb = np.asarray(temperature_factor(45.0))
+    assert (f_hot >= f_amb - 1e-12).all()
+
+
+def test_empty_network_rejected():
+    with pytest.raises(ValueError):
+        ThermalNetwork(n_nodes=1).build()
+    with pytest.raises(ValueError):
+        ThermalNetwork(n_nodes=1).resistor(0, -1, -2.0)
+    with pytest.raises(ValueError):
+        ThermalNetwork(n_nodes=1).capacitor(0, -1, 0.0)
