@@ -83,7 +83,7 @@ def build(lengths=LENGTHS, workload="workloads/lzss.c",
 
 def rate(lengths=LENGTHS, batch=None, reps: int = 3,
          workload="workloads/lzss.c", chunked=False,
-         chunk: int = 65536, trials: int = 0) -> dict:
+         chunk: int = 65536, trials: int = 0, horizon: int = 0) -> dict:
     import jax
     import numpy as np
 
@@ -114,7 +114,10 @@ def rate(lengths=LENGTHS, batch=None, reps: int = 3,
             b = trials or max(512, min(16384 if on_tpu else 2048,
                                        (1 << 26) // max(tr.n // 64, 1)))
             t0 = time.time()
-            ch = ChunkedCampaign(k, chunk=chunk)
+            ch = ChunkedCampaign(k, chunk=chunk,
+                                 carry_horizon=horizon or None)
+            if horizon:
+                row["carry_horizon"] = horizon
             row["setup_seconds"] = round(time.time() - t0, 1)
             keys = prng.trial_keys(prng.campaign_key(0), b)
             # warm at the SAME lane-width bucket the timed reps use (the
@@ -132,7 +135,8 @@ def rate(lengths=LENGTHS, batch=None, reps: int = 3,
             row.update(trials_per_sec=round(rates[len(rates) // 2], 2),
                        batch=b, chunks=ch.C,
                        lanes_per_call=ch.lane_width(b),
-                       tally=[int(x) for x in tally])
+                       tally=[int(x) for x in tally],
+                       resolution=dict(ch.last_stats))
         else:
             b = batch or max(256, min(131072 if on_tpu else 8192,
                                       (1 << 29) // max(tr.n, 1)))
@@ -160,6 +164,8 @@ def main() -> int:
     ap.add_argument("--rate", action="store_true")
     ap.add_argument("--chunked", action="store_true")
     ap.add_argument("--chunk", type=int, default=65536)
+    ap.add_argument("--horizon", type=int, default=0,
+                    help="chunked mode: carry_horizon (0 = exact)")
     ap.add_argument("--lengths", type=int, nargs="*", default=list(LENGTHS),
                     help="window lengths in µops; 0 = the full capture")
     ap.add_argument("--batch", type=int, default=None)
@@ -176,7 +182,7 @@ def main() -> int:
     if a.rate:
         result["rate"] = rate(a.lengths, a.batch, a.reps, a.workload,
                               chunked=a.chunked, chunk=a.chunk,
-                              trials=a.trials)
+                              trials=a.trials, horizon=a.horizon)
     if a.out:
         with open(a.out, "w") as f:
             json.dump(result, f, indent=1)
