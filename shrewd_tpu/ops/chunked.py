@@ -113,9 +113,12 @@ class ChunkedCampaign:
             taken=padded(tr.taken))
         self.cov_pad = padded(np.asarray(kernel.shadow_cov, np.float32))
         self.memmap = kernel.memmap
+        # placeholder when no memmap: _big_args passes ONE cached buffer
+        # (a fresh per-call alloc would be pure waste)
         self.mm_cluster_pad = (padded(np.asarray(self.memmap.uop_cluster),
                                       -1)
-                               if self.memmap is not None else None)
+                               if self.memmap is not None
+                               else jnp.zeros(1, i32))
 
         # golden boundary states (host: C+1 × state; device transfers are
         # one boundary image per chunk step)
@@ -139,27 +142,43 @@ class ChunkedCampaign:
             diverged=jnp.asarray(False))
 
     # ---- chunk kernels ---------------------------------------------------
+    #
+    # The window-length arrays (trace, coverage, cluster map) are passed
+    # as ARGUMENTS, not closed over: a closure-captured concrete array is
+    # embedded in the jaxpr as a constant, and at SimPoint scale that
+    # means hundreds of MB of literals per compile (the r4 524k dense
+    # kernel's 217 s compile was exactly this).  As arguments they are
+    # device buffers referenced by the executable.
 
-    def _chunk_arrays(self, start):
+    def _big_args(self):
+        return self.tr_pad, self.cov_pad, self.mm_cluster_pad
+
+    def _slice_chunk(self, tr_pad, cov_pad, mm_cluster, start):
         sl = partial(jax.lax.dynamic_slice_in_dim, start_index=start,
                      slice_size=self.S)
-        tr = TraceArrays(*(sl(a) for a in self.tr_pad))
-        cov = sl(self.cov_pad)
+        tr = TraceArrays(*(sl(a) for a in tr_pad))
+        cov = sl(cov_pad)
         mm = None
         if self.memmap is not None:
-            mm = self.memmap._replace(uop_cluster=sl(self.mm_cluster_pad))
+            mm = self.memmap._replace(uop_cluster=sl(mm_cluster))
         return tr, cov, mm
 
     @partial(jax.jit, static_argnums=0)
-    def _golden_chunk(self, reg, mem, fault, start):
-        tr, cov, mm = self._chunk_arrays(start)
+    def _golden_chunk_impl(self, tr_pad, cov_pad, mm_cluster, reg, mem,
+                           fault, start):
+        tr, cov, mm = self._slice_chunk(tr_pad, cov_pad, mm_cluster, start)
         return replay(tr, reg, mem, fault, cov, memmap=mm,
                       index_offset=start)
 
+    def _golden_chunk(self, reg, mem, fault, start):
+        return self._golden_chunk_impl(*self._big_args(), reg, mem,
+                                       fault, start)
+
     @partial(jax.jit, static_argnums=0)
-    def _trial_chunk(self, reg_b, mem_b, fault_b, start, gb_reg, gb_mem):
+    def _trial_chunk_impl(self, tr_pad, cov_pad, mm_cluster, reg_b, mem_b,
+                          fault_b, start, gb_reg, gb_mem):
         """One chunk for B lanes → (reg', mem', det, trap, div, eq)."""
-        tr, cov, mm = self._chunk_arrays(start)
+        tr, cov, mm = self._slice_chunk(tr_pad, cov_pad, mm_cluster, start)
 
         def one(reg, mem, fault):
             r = replay(tr, reg, mem, fault, cov, memmap=mm,
@@ -168,6 +187,10 @@ class ChunkedCampaign:
             return r.reg, r.mem, r.detected, r.trapped, r.diverged, eq
 
         return jax.vmap(one)(reg_b, mem_b, fault_b)
+
+    def _trial_chunk(self, reg_b, mem_b, fault_b, start, gb_reg, gb_mem):
+        return self._trial_chunk_impl(*self._big_args(), reg_b, mem_b,
+                                      fault_b, start, gb_reg, gb_mem)
 
     # ---- driver ----------------------------------------------------------
 

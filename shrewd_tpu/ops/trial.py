@@ -77,16 +77,30 @@ class TrialKernel:
         self.shadow_cov = jnp.asarray(cov, dtype=jnp.float32)
         self._opclass = jnp.asarray(U.opclass_of(trace.opcode),
                                     dtype=jnp.int32)
-        # Golden replay once per kernel: device-vs-device comparison makes
-        # MASKED exact by construction (the CheckerCPU-style scalar oracle is
-        # a separate differential test, not the classification baseline).
-        self.golden: ReplayResult = jax.jit(self._replay_one)(null_fault())
+        # Golden replay once per kernel (LAZY since r5: the dense jit
+        # embeds the whole trace as constants, a multi-minute compile at
+        # SimPoint scale — ops/chunked.py computes its own boundary
+        # goldens and never needs this): device-vs-device comparison
+        # makes MASKED exact by construction (the CheckerCPU-style
+        # scalar oracle is a separate differential test, not the
+        # classification baseline).
+        self._golden: ReplayResult | None = None
         self._golden_rec = None         # taint-kernel streams, lazy
         self._samplers: dict = {}
         self._sample_jits: dict = {}
         # taint observability: escape counts feed campaign stats
         self.escapes = 0
         self.taint_trials = 0
+
+    @property
+    def golden(self) -> ReplayResult:
+        if self._golden is None:
+            # first touch may happen inside a jit trace (run_batch →
+            # _outcomes); force concrete evaluation so the cache never
+            # holds leaked tracers (same pattern as sampler()/golden_rec)
+            with jax.ensure_compile_time_eval():
+                self._golden = jax.jit(self._replay_one)(null_fault())
+        return self._golden
 
     def with_shrewd(self, enable: bool | None = None,
                     priority_to_shadow: bool | None = None) -> "TrialKernel":
