@@ -1,0 +1,101 @@
+"""Assemble WINDOW_SCALE_r05.json from the round's build + rate runs.
+
+Collates the window-scaling story: lift pipeline at every length (4096 →
+26.2M µops), dense vs chunked rates on the current platform, resolution
+statistics, and the honest scaling model:
+
+  per-trial work (exact)    ≈ S·E[chunks replayed]  — resolution-mix
+                              dependent (SDC-heavy trials carry to the
+                              window end)
+  per-trial work (horizon)  ≤ S·(horizon+1)         — bounded, with only
+                              vulnerable-preserving relabelings
+
+plus the TPU projection: measured CPU lane-throughput scales by the
+r4-measured TPU/CPU dense ratio on the same kernel family (934 vs 22.6
+trials/s at 131k µops — BENCH/WINDOW_SCALE r4), clearly labeled as a
+projection while the tunnel is down.
+
+Usage: python tools/window_scale_r05.py --big-rate /tmp/ws_big.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rates", default="/tmp/bw_rate2.log",
+                    help="log holding the lzss chunked-rate json line")
+    ap.add_argument("--big-rate", default="/tmp/ws_big.json")
+    ap.add_argument("--out", default=str(REPO / "WINDOW_SCALE_r05.json"))
+    a = ap.parse_args()
+
+    doc = {
+        "build": {
+            "lzss": {"capture_steps": 2124394, "capture_seconds": 205.0,
+                     "lifts": {"4096": 1.0, "65546": 0.9999,
+                               "524288": 0.9998, "5338673": 0.9998}},
+            "lzss_big": {"capture_steps": 10490203,
+                         "capture_seconds": 1427.4,
+                         "lift_uops": 26220818, "lift_rate": 0.9998,
+                         "lift_seconds": 7063.1},
+        },
+        "dense_cpu_r4": {"4096": 297.09, "65546": 22.56, "524288": 5.26},
+        "dense_tpu_r4": {"131072": 934.0,
+                         "note": "BENCH_TPU_r04 131k-µop stage"},
+        "chunked_cpu_exact": {"4096": 137.03, "65546": 20.73,
+                              "524288": 5.07, "5338673": 0.27},
+        "notes": [
+            "chunked == dense outcomes bit-for-bit (tests/test_chunked)",
+            "exact chunked pays for the resolution mix: the 5.3M regfile "
+            "campaign is 73% SDC — divergent trials replay to the window "
+            "end, so exact per-trial work ≈ n/2 and chunking's win is "
+            "the masked/frozen fraction plus constant-compile cost",
+            "carry_horizon bounds per-trial work at (horizon+1) chunks "
+            "with only masked→SDC / DUE→SDC relabelings (vulnerable set "
+            "never shrinks)",
+            "compile cost no longer scales with window length: the "
+            "chunk kernel takes window arrays as arguments (one "
+            "executable for any n); the r4 524k dense kernel spent 217 s "
+            "compiling its embedded constants",
+            "CPU numbers only — the TPU tunnel was wedged the whole "
+            "session (bench.py --probe watchdog); the projection column "
+            "applies the r4-measured TPU/CPU ratio of the same dense "
+            "kernel family (41×) and is labeled as such",
+        ],
+    }
+    big = Path(a.big_rate)
+    if big.exists():
+        d = json.loads(big.read_text())
+        rates = d.get("rate", d).get("rates", {})
+        for n, row in rates.items():
+            doc.setdefault("chunked_cpu_horizon2", {})[n] = row
+    doc["tpu_projection"] = {
+        "method": "rate_tpu ≈ rate_cpu × (tpu lane-throughput / cpu "
+                  "lane-throughput); r4 measured 934 trials/s at 131k "
+                  "(TPU) vs 22.56 at 65.5k (CPU) → ~20.7× per-lane-step",
+        "chunked_horizon2_26M_trials_per_sec": None,   # filled below
+    }
+    h2 = doc.get("chunked_cpu_horizon2", {})
+    for n, row in h2.items():
+        cpu_rate = row.get("trials_per_sec")
+        if cpu_rate:
+            doc["tpu_projection"]["chunked_horizon2_26M_trials_per_sec"] \
+                = round(cpu_rate * 20.7, 1)
+    with open(a.out, "w") as f:
+        json.dump(doc, f, indent=1)
+        f.write("\n")
+    print(json.dumps({"chunked_cpu_horizon2": h2 and {
+        n: r.get("trials_per_sec") for n, r in h2.items()},
+        "projection": doc["tpu_projection"]}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
