@@ -45,18 +45,31 @@ import statistics
 import subprocess
 import sys
 import time
+from functools import partial
+
+# jax-free by contract (resilience.py import discipline): the supervisor
+# must never touch a backend, only subprocesses do
+from shrewd_tpu.resilience import (BackoffPolicy, DeviceWatchdog,
+                                   DispatchTimeout, ReprobeQueue)
 
 PLATFORM_TIMEOUTS = (("axon", 560.0), ("cpu", 600.0))
 WORKER_STAGE_BUDGET_S = 330.0  # optional stages start only inside this
 PROBE_SELF_EXIT_S = 55.0       # watchdog inside the probe process
 PROBE_WAIT_S = 75.0            # supervisor grace = watchdog + margin
-# Retry horizon before the CPU fallback (VERDICT r4 weak #3: the r4
-# driver bench fell back to CPU although the tunnel healed later in the
-# window): probe attempts × cool-down ≈ 8 min of recovery headroom by
-# default, overridable for tighter driver windows.
-PROBE_RETRIES = max(1, int(os.environ.get("BENCH_PROBE_RETRIES", "4")))
+# Re-probe cadence while the tunnel is wedged.  The old design retried on
+# a fixed schedule at bench start and only then surrendered to the CPU
+# fallback (VERDICT r4 weak #3: the tunnel healed later in the window and
+# the bench missed it); now the CPU fallback runs immediately while a
+# session-long ReprobeQueue watches for the first healthy window, and the
+# deferred TPU attempt fires the moment one opens (up to the deadline).
 PROBE_RETRY_COOLDOWN_S = float(
     os.environ.get("BENCH_PROBE_COOLDOWN_S", "120"))
+TUNNEL_DEADLINE_S = float(
+    os.environ.get("BENCH_TUNNEL_DEADLINE_S", "420"))
+# per-dispatch watchdog inside the worker: a wedged first compile must
+# surface in bounded time, not eat the whole supervisor window
+WORKER_DISPATCH_TIMEOUT_S = float(
+    os.environ.get("BENCH_DISPATCH_TIMEOUT_S", "300"))
 BASELINE_PIN = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                             "BASELINE_MEASURED.json")
 
@@ -120,6 +133,54 @@ def probe_tunnel(plat: str = "axon") -> bool:
     return ok
 
 
+def _run_platform(plat: str, tmo: float, worker_args: list,
+                  errors: list) -> str | None:
+    """One worker attempt on one platform → its final JSON line, or None
+    (failure appended to ``errors``).  A timeout still salvages the
+    provisional line the worker prints after its first timed batch."""
+    cmd = [sys.executable, os.path.abspath(__file__),
+           "--worker", "--platform", plat] + worker_args
+    env = dict(os.environ, JAX_PLATFORMS=plat)
+    if plat == "cpu":
+        env = _strip_axon_site(env)
+    log(f"bench supervisor: trying platform={plat} timeout={tmo:.0f}s")
+    t0 = time.monotonic()
+    try:
+        proc = subprocess.run(cmd, timeout=tmo, capture_output=True,
+                              text=True, env=env)
+    except subprocess.TimeoutExpired as e:
+        out_txt = ""
+        for stream in (e.stderr, e.stdout):
+            if stream:
+                txt = (stream.decode(errors="replace")
+                       if isinstance(stream, bytes) else stream)
+                sys.stderr.write(txt)
+                if stream is e.stdout:
+                    out_txt = txt
+        line = _last_json_line(out_txt)
+        if line:
+            log(f"bench supervisor: platform={plat} timed out but "
+                "reported a provisional rate")
+            return line
+        errors.append(f"{plat}: timeout after {tmo:.0f}s (backend hang)")
+        log(errors[-1])
+        return None
+    sys.stderr.write(proc.stderr)
+    line = _last_json_line(proc.stdout)
+    if line:
+        if proc.returncode != 0:
+            log(f"bench supervisor: platform={plat} rc="
+                f"{proc.returncode} but a rate was reported — using it")
+        else:
+            log(f"bench supervisor: platform={plat} ok "
+                f"in {time.monotonic() - t0:.0f}s")
+        return line
+    errors.append(f"{plat}: rc={proc.returncode} "
+                  f"stdout={proc.stdout[-200:]!r}")
+    log(errors[-1])
+    return None
+
+
 def supervise(args) -> None:
     platforms = list(PLATFORM_TIMEOUTS)
     env_plat = args.platform or os.environ.get("JAX_PLATFORMS")
@@ -138,8 +199,11 @@ def supervise(args) -> None:
         worker_args += ["--batch", str(args.batch)]
     if args.uops:
         worker_args += ["--uops", str(args.uops)]
-    errors = []
+    errors: list[str] = []
     tunnel = None
+    deferred: tuple[str, float] | None = None   # TPU attempt awaiting health
+    queue: ReprobeQueue | None = None
+    t_start = time.monotonic()
 
     def reprint(line: str) -> None:
         """Re-emit the worker's JSON line with the tunnel verdict folded
@@ -152,73 +216,65 @@ def supervise(args) -> None:
         except json.JSONDecodeError:
             print(line)
 
+    def try_deferred() -> str | None:
+        """Run the deferred TPU attempt if its tunnel healed: a queue that
+        turned healthy at ANY point (even after the deadline passed while
+        the fallback ran — the r4 weakness) fires immediately; otherwise
+        wait out whatever deadline remains.  Returns the attempt's JSON
+        line, or None."""
+        nonlocal tunnel
+        if deferred is None or queue is None:
+            return None
+        budget = TUNNEL_DEADLINE_S - (time.monotonic() - t_start)
+        if not (queue.healthy or (budget > 0 and queue.wait(budget))):
+            queue.stop()
+            return None
+        log(f"bench supervisor: tunnel healed after {queue.probes} "
+            f"re-probes — running deferred {deferred[0]} bench")
+        tunnel = f"healthy-after-{queue.probes}-reprobes"
+        dline = _run_platform(*deferred, worker_args, errors)
+        queue.stop()
+        if dline is None:
+            tunnel = "wedged"   # healed probe, failed worker
+        return dline
+
     for plat, tmo in platforms:
         if plat not in ("cpu",) and not args.no_probe:
-            # bench at the FIRST healthy probe; keep retrying across the
-            # horizon before surrendering to the CPU fallback
-            tunnel = "wedged"
-            for attempt in range(PROBE_RETRIES):
-                if probe_tunnel(plat):
-                    tunnel = ("healthy" if attempt == 0
-                              else f"healthy-after-{attempt}-retries")
-                    break
-                if attempt < PROBE_RETRIES - 1:
-                    log(f"bench supervisor: probe {attempt + 1}/"
-                        f"{PROBE_RETRIES} failed — cool-down "
-                        f"{PROBE_RETRY_COOLDOWN_S:.0f}s")
-                    time.sleep(PROBE_RETRY_COOLDOWN_S)
-            if tunnel == "wedged":
-                errors.append(f"{plat}: tunnel probe failed "
-                              f"{PROBE_RETRIES}× over "
-                              f"{(PROBE_RETRIES - 1) * PROBE_RETRY_COOLDOWN_S:.0f}s"
-                              " — skipped (relay wedge suspected)")
-                log(errors[-1])
-                continue
-        cmd = [sys.executable, os.path.abspath(__file__),
-               "--worker", "--platform", plat] + worker_args
-        env = dict(os.environ, JAX_PLATFORMS=plat)
-        if plat == "cpu":
-            env = _strip_axon_site(env)
-        log(f"bench supervisor: trying platform={plat} timeout={tmo:.0f}s")
-        t0 = time.monotonic()
-        try:
-            proc = subprocess.run(cmd, timeout=tmo, capture_output=True,
-                                  text=True, env=env)
-        except subprocess.TimeoutExpired as e:
-            out_txt = ""
-            for stream in (e.stderr, e.stdout):
-                if stream:
-                    txt = (stream.decode(errors="replace")
-                           if isinstance(stream, bytes) else stream)
-                    sys.stderr.write(txt)
-                    if stream is e.stdout:
-                        out_txt = txt
-            # the worker prints a provisional JSON line after its FIRST
-            # timed batch — a platform too slow to finish all reps still
-            # reports a measured rate instead of nothing
-            line = _last_json_line(out_txt)
-            if line:
-                log(f"bench supervisor: platform={plat} timed out but "
-                    "reported a provisional rate")
-                reprint(line)
-                return
-            errors.append(f"{plat}: timeout after {tmo:.0f}s (backend hang)")
-            log(errors[-1])
-            continue
-        sys.stderr.write(proc.stderr)
-        line = _last_json_line(proc.stdout)
-        if line:
-            if proc.returncode != 0:
-                log(f"bench supervisor: platform={plat} rc="
-                    f"{proc.returncode} but a rate was reported — using it")
+            if probe_tunnel(plat):
+                tunnel = "healthy"
             else:
-                log(f"bench supervisor: platform={plat} ok "
-                    f"in {time.monotonic() - t0:.0f}s")
-            reprint(line)
-            return
-        errors.append(f"{plat}: rc={proc.returncode} "
-                      f"stdout={proc.stdout[-200:]!r}")
-        log(errors[-1])
+                # do NOT block on a fixed retry schedule here: start the
+                # session-long re-probe queue, fall through to the CPU
+                # fallback now, and fire the deferred TPU attempt at the
+                # first healthy window (resilience.ReprobeQueue)
+                tunnel = "wedged"
+                queue = ReprobeQueue(
+                    partial(probe_tunnel, plat),
+                    backoff=BackoffPolicy(base=PROBE_RETRY_COOLDOWN_S,
+                                          cap=4 * PROBE_RETRY_COOLDOWN_S,
+                                          jitter=0.1)).start()
+                deferred = (plat, tmo)
+                log(f"bench supervisor: {plat} tunnel wedged — running the "
+                    f"CPU fallback now; TPU attempt deferred to the first "
+                    f"healthy re-probe window "
+                    f"(deadline {TUNNEL_DEADLINE_S:.0f}s)")
+                continue
+        line = _run_platform(plat, tmo, worker_args, errors)
+        if line is None:
+            continue
+        # a fallback number is in hand; prefer the deferred TPU number if
+        # the tunnel healed
+        dline = try_deferred()
+        reprint(dline if dline is not None else line)
+        return
+    # even the fallbacks failed — the deferred TPU attempt is the only
+    # hope left
+    dline = try_deferred()
+    if dline is not None:
+        reprint(dline)
+        return
+    if queue is not None:
+        queue.stop()
     # every platform failed: emit a diagnostic JSON line, not a crash
     out = {
         "metric": "sfi_trials_per_sec_per_chip",
@@ -403,14 +459,26 @@ def run_worker(args) -> None:
     kernel = TrialKernel(trace, cfg)
     keys = prng.trial_keys(prng.campaign_key(0), batch)
 
+    # per-dispatch watchdog on the wedge-prone stages (warm-up and first
+    # compile): a stuck backend surfaces as a bounded-time rc=4 the
+    # supervisor can act on, instead of silently eating its whole window.
+    # Timed reps below run direct — the thread hop must not touch them.
+    watchdog = DeviceWatchdog(WORKER_DISPATCH_TIMEOUT_S, name=dev.platform)
+
     # pre-warm with a tiny compile first so a compiler problem surfaces fast
     warm_keys = prng.trial_keys(prng.campaign_key(99), 8)
     t0 = time.monotonic()
-    np.asarray(kernel.run_keys(warm_keys, "regfile"))
-    log(f"warm-up compile (8 trials): {time.monotonic() - t0:.1f}s")
+    try:
+        watchdog.call(
+            lambda: np.asarray(kernel.run_keys(warm_keys, "regfile")))
+        log(f"warm-up compile (8 trials): {time.monotonic() - t0:.1f}s")
 
-    t0 = time.monotonic()
-    tally = np.asarray(kernel.run_keys(keys, "regfile"))
+        t0 = time.monotonic()
+        tally = watchdog.call(
+            lambda: np.asarray(kernel.run_keys(keys, "regfile")))
+    except DispatchTimeout as e:
+        log(f"worker: {e} — backend wedged, exiting for the supervisor")
+        sys.exit(4)
     log(f"compile+first batch: {time.monotonic() - t0:.1f}s tally={tally}")
 
     def emit(rate, extra=None):

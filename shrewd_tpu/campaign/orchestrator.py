@@ -18,13 +18,13 @@ no device computation is in flight).
 
 from __future__ import annotations
 
-import json
 import os
 import time
 from typing import Iterator, NamedTuple
 
 import numpy as np
 
+from shrewd_tpu import resilience as resil
 from shrewd_tpu import stats as statsmod
 from shrewd_tpu.campaign.plan import COHERENCE_SP_NAME, CampaignPlan
 from shrewd_tpu.models.o3 import STRUCTURES
@@ -33,13 +33,14 @@ from shrewd_tpu.ops.trial import TrialKernel
 from shrewd_tpu.parallel import stopping
 from shrewd_tpu.parallel.campaign import ShardedCampaign
 from shrewd_tpu.parallel.mesh import make_mesh
+from shrewd_tpu.resilience import TIERS
 from shrewd_tpu.sim.exit_event import ExitEvent
 from shrewd_tpu.utils import probes
 from shrewd_tpu.utils import debug, prng
 
 debug.register_flag("Campaign", "orchestrator progress")
 
-CKPT_VERSION = 3
+CKPT_VERSION = 4
 
 # Campaign-checkpoint upgraders — the ``util/cpt_upgraders/`` analog
 # (reference keeps one script per version tag and applies them in sequence
@@ -72,7 +73,20 @@ def _upgrade_v2(doc: dict) -> None:
     doc["version"] = 3
 
 
-CKPT_UPGRADERS = {1: _upgrade_v1, 2: _upgrade_v2}
+def _upgrade_v3(doc: dict) -> None:
+    """v3 → v4: per-tier trial accounting (the escalation budget) plus a
+    content checksum on every new write.  Pre-v4 trials carry no tier
+    provenance — they default to zeros (not attributed to 'device': that
+    would fabricate exactly the hybrid-throughput claim the budget exists
+    to police), so a resumed old campaign's escalation rate covers only
+    post-upgrade batches."""
+    for per_structure in doc.get("state", {}).values():
+        for st_doc in per_structure.values():
+            st_doc.setdefault("tier_trials", [0] * len(TIERS))
+    doc["version"] = 4
+
+
+CKPT_UPGRADERS = {1: _upgrade_v1, 2: _upgrade_v2, 3: _upgrade_v3}
 
 
 def upgrade_checkpoint(doc: dict) -> dict:
@@ -98,6 +112,24 @@ class BatchInfo(NamedTuple):
     trials: int             # cumulative trials for this (simpoint, structure)
     tallies: np.ndarray     # cumulative outcome tallies
     avf: float
+    tier: int = resil.TIER_DEVICE   # resilience tier that ran this batch
+
+
+class DegradeInfo(NamedTuple):
+    """Payload of ``ExitEvent.BACKEND_DEGRADED``."""
+    simpoint: str
+    structure: str
+    batch_id: int
+    tier: int               # TIERS index the batch actually ran on
+    attempts: int           # dispatch attempts consumed (retries included)
+
+
+class EscalationInfo(NamedTuple):
+    """Payload of ``ExitEvent.ESCALATION_EXCEEDED``."""
+    rate: float
+    threshold: float
+    action: str             # "warn" | "abort"
+    tier_trials: dict       # {tier name: trials}
 
 
 class StructureResult(NamedTuple):
@@ -127,6 +159,9 @@ class _State:
         # v3: strata history for the post-stratified estimator (None when
         # the campaign runs unstratified or predates v3)
         self.strata: np.ndarray | None = None
+        # v4: which resilience tier ran each trial (device/cpu/oracle) —
+        # the per-structure half of the escalation budget
+        self.tier_trials = np.zeros(len(TIERS), dtype=np.int64)
 
     @property
     def trials(self) -> int:
@@ -138,7 +173,8 @@ class _State:
                 "converged": self.converged, "done": self.done,
                 "escapes": self.escapes, "taint_trials": self.taint_trials,
                 "strata": (None if self.strata is None
-                           else self.strata.tolist())}
+                           else self.strata.tolist()),
+                "tier_trials": self.tier_trials.tolist()}
 
     @classmethod
     def from_dict(cls, d: dict) -> "_State":
@@ -151,6 +187,7 @@ class _State:
         st.taint_trials = int(d["taint_trials"])
         if d.get("strata") is not None:
             st.strata = np.asarray(d["strata"], dtype=np.int64)
+        st.tier_trials = np.asarray(d["tier_trials"], dtype=np.int64)
         return st
 
 
@@ -196,6 +233,21 @@ class Orchestrator:
         self._traces: dict[int, object] = {}
         self._tier_kernels: dict = {}
         self._campaigns: dict[tuple[int, str], ShardedCampaign] = {}
+        # backend resilience: one watchdog + escalation budget per
+        # orchestrator (backend health is a process property, not a
+        # per-structure one); dispatchers are per-campaign ladders
+        self.rcfg = plan.resilience
+        self.watchdog = resil.DeviceWatchdog(self.rcfg.dispatch_timeout)
+        self.budget = resil.EscalationBudget()
+        # resume re-arm: the gate below fires only at/above this rate, so
+        # a run aborted by the budget can be resumed against a healed
+        # backend (rate falls → completes) yet still re-aborts while the
+        # escalation is not improving (rate holds or grows)
+        self._esc_baseline = 0.0
+        self._dispatchers: dict[tuple[int, str],
+                                resil.ResilientDispatcher] = {}
+        self._esc_flagged = False
+        self.aborted = False
         # probe points (utils/probes; gem5 ProbePoint pattern): listeners
         # attach without the orchestrator knowing who observes.  Payloads
         # are batch-granular — BatchInfo / StructureResult / ckpt path.
@@ -203,6 +255,7 @@ class Orchestrator:
         self.pp_batch = self.probes.add_point("BatchComplete")
         self.pp_structure = self.probes.add_point("StructureComplete")
         self.pp_checkpoint = self.probes.add_point("Checkpoint")
+        self.pp_degraded = self.probes.add_point("BackendDegraded")
         self._build_stats()
 
     # --- stats tree (statistics::Group bound to the object tree) ---
@@ -226,12 +279,35 @@ class Orchestrator:
                 sg.avf = statsmod.Formula(
                     "avf", lambda st=st: float(C.avf(st.tallies)),
                     "(SDC+DUE)/trials")
+                sg.tiers = statsmod.Vector(
+                    "tier_trials", len(TIERS),
+                    "trials per resilience tier", subnames=list(TIERS))
+        # campaign-level escalation accounting: the 'is the device number
+        # really a device number' ledger (resilience.EscalationBudget)
+        rg = statsmod.Group("resilience")
+        self.stats.resilience = rg
+        rg.tier_trials = statsmod.Formula(
+            "tier_trials",
+            lambda: {t: int(c) for t, c in zip(TIERS, self.budget.counts)},
+            "trials per execution tier, campaign-wide")
+        rg.escalation_rate = statsmod.Formula(
+            "escalation_rate", lambda: self.budget.rate(),
+            "fraction of trials that ran below the device tier")
+        rg.dispatch_timeouts = statsmod.Formula(
+            "dispatch_timeouts", lambda: self.watchdog.timeouts,
+            "dispatches the watchdog declared wedged")
+        rg.retries = statsmod.Formula(
+            "retries",
+            lambda: sum(d.retries for d in self._dispatchers.values()),
+            "re-dispatch attempts beyond each first try")
         # refresh from restored state (resume path)
         for (spn, s), st in self.state.items():
             sg = getattr(getattr(self.stats, f"sp_{spn}"), f"st_{s}")
             sg.trials.set(st.trials)
             sg.outcomes.reset()
             sg.outcomes += st.tallies
+            sg.tiers.reset()
+            sg.tiers += st.tier_trials
 
     # --- lazy elaboration ---
 
@@ -300,9 +376,27 @@ class Orchestrator:
             kernel, sub = self.kernel_for(sp_idx, structure)
             stratify = (self.plan.stratify
                         and hasattr(kernel, "run_keys_stratified"))
+            # the shared watchdog guards only the jitted device step inside
+            # the campaign (ShardedCampaign._dispatch): a timed-out step
+            # raises BEFORE any host-side counter mutation, so an orphaned
+            # dispatch thread that completes late ran only pure device work
+            # and cannot corrupt kernel.escapes/taint_trials
             self._campaigns[key] = ShardedCampaign(kernel, self.mesh, sub,
-                                                   stratify=stratify)
+                                                   stratify=stratify,
+                                                   watchdog=self.watchdog)
         return self._campaigns[key]
+
+    def dispatcher(self, sp_idx: int, structure: str
+                   ) -> resil.ResilientDispatcher:
+        """The retry/degradation ladder for one campaign (resilience.py):
+        shares the orchestrator's watchdog so backend health is judged
+        across structures, not per-structure."""
+        key = (sp_idx, structure)
+        if key not in self._dispatchers:
+            self._dispatchers[key] = resil.dispatcher_for_campaign(
+                self.campaign(sp_idx, structure), self.rcfg,
+                watchdog=self.watchdog)
+        return self._dispatchers[key]
 
     # --- the drive loop ---
 
@@ -315,6 +409,8 @@ class Orchestrator:
                 if st.done:
                     continue
                 yield from self._run_structure(sp_idx, sp.name, structure, st)
+                if self.aborted:
+                    return    # escalation budget: no CAMPAIGN_COMPLETE
             yield ExitEvent.SIMPOINT_COMPLETE, sp.name
         if self._plan_level:
             # coherence tiers (mesi:/noc:) measure plan-level synthetic
@@ -325,6 +421,8 @@ class Orchestrator:
                     continue
                 yield from self._run_structure(
                     _COHERENCE_SP_ID, COHERENCE_SP_NAME, structure, st)
+                if self.aborted:
+                    return
             yield ExitEvent.SIMPOINT_COMPLETE, COHERENCE_SP_NAME
         yield ExitEvent.CAMPAIGN_COMPLETE, dict(self.results)
 
@@ -387,31 +485,59 @@ class Orchestrator:
             # and resume restores prior counts — assignment would clobber)
             esc0 = int(getattr(camp.kernel, "escapes", 0))
             tt0 = int(getattr(camp.kernel, "taint_trials", 0))
+            # dispatch through the resilience ladder: retries/backoff on
+            # the device tier, then CPU-JAX, then the host oracle — the
+            # same frozen keys on every tier, so the tally is bit-identical
+            # regardless of where it ran
+            res = self.dispatcher(sp_idx, structure).tally_batch(
+                keys, stratified=camp.stratify)
             if camp.stratify:
-                th = np.asarray(camp.tally_batch_stratified(keys),
-                                dtype=np.int64)
                 if st.strata is None:
-                    st.strata = np.zeros_like(th)
-                st.strata += th
-                tally = th.sum(axis=0)
-            else:
-                tally = np.asarray(camp.tally_batch(keys), dtype=np.int64)
+                    st.strata = np.zeros_like(res.strata)
+                st.strata += res.strata
+            tally = res.tally
             st.tallies += tally
             st.next_batch += 1
             st.escapes += int(getattr(camp.kernel, "escapes", 0)) - esc0
             st.taint_trials += (int(getattr(camp.kernel, "taint_trials", 0))
                                 - tt0)
+            st.tier_trials[res.tier] += plan.batch_size
+            self.budget.record(res.tier, plan.batch_size)
             sg.trials += plan.batch_size
             sg.outcomes += tally
+            sg.tiers.add(res.tier, plan.batch_size)
             avf_live = float(C.avf(st.tallies))
-            debug.dprintf("Campaign", "%s/%s batch %d: trials=%d avf=%.4f",
-                          sp_name, structure, st.next_batch, st.trials,
-                          avf_live)
+            debug.dprintf("Campaign", "%s/%s batch %d: trials=%d avf=%.4f"
+                          " tier=%s", sp_name, structure, st.next_batch,
+                          st.trials, avf_live, TIERS[res.tier])
             info = BatchInfo(
                 sp_name, structure, st.next_batch - 1, st.trials,
-                st.tallies.copy(), avf_live)
+                st.tallies.copy(), avf_live, res.tier)
+            if res.tier != resil.TIER_DEVICE:
+                dinfo = DegradeInfo(sp_name, structure, st.next_batch - 1,
+                                    res.tier, res.attempts)
+                self.pp_degraded.notify(dinfo)
+                yield ExitEvent.BACKEND_DEGRADED, dinfo
             self.pp_batch.notify(info)
             yield ExitEvent.BATCH_COMPLETE, info
+
+            if (self.rcfg.escalation_action != "off"
+                    and not self._esc_flagged
+                    and self.budget.over(self.rcfg.escalation_threshold)
+                    and self.budget.rate() >= self._esc_baseline):
+                self._esc_flagged = True
+                einfo = EscalationInfo(
+                    self.budget.rate(), self.rcfg.escalation_threshold,
+                    self.rcfg.escalation_action,
+                    {t: int(c) for t, c in zip(TIERS, self.budget.counts)})
+                yield ExitEvent.ESCALATION_EXCEEDED, einfo
+                if self.rcfg.escalation_action == "abort":
+                    # leave a resumable checkpoint, then end the stream
+                    # (events() sees .aborted and never claims completion)
+                    self.aborted = True
+                    if self.outdir:
+                        self.checkpoint()
+                    return
 
             if (plan.checkpoint_every and self.outdir and
                     st.next_batch % plan.checkpoint_every == 0):
@@ -442,7 +568,13 @@ class Orchestrator:
 
     def checkpoint(self, ckpt_dir: str | None = None) -> str:
         """Write campaign progress; any batch is re-derivable from its
-        coordinates, so this plus the plan is the whole campaign state."""
+        coordinates, so this plus the plan is the whole campaign state.
+
+        Crash-safety (v4): tmp + fsync + rename (a kill mid-write can only
+        truncate the tmp file), a content checksum in the document (a
+        torn/corrupted file is *detected*, not trusted), and one-deep
+        rotation — the previous checkpoint survives as campaign.prev.json
+        so resume always has a valid fallback."""
         if ckpt_dir is None:
             if not self.outdir:
                 raise ValueError("no outdir and no explicit ckpt_dir")
@@ -456,22 +588,51 @@ class Orchestrator:
             "plan": self.plan.to_dict(),
             "state": state_doc,
         }
-        tmp = os.path.join(ckpt_dir, "campaign.json.tmp")
-        with open(tmp, "w") as f:
-            json.dump(doc, f, indent=1, default=str)
-        os.replace(tmp, os.path.join(ckpt_dir, "campaign.json"))
+        doc["checksum"] = resil.doc_checksum(doc)
+        path = os.path.join(ckpt_dir, "campaign.json")
+        if os.path.exists(path):
+            os.replace(path, os.path.join(ckpt_dir, "campaign.prev.json"))
+        resil.write_json_atomic(path, doc)
         return ckpt_dir
+
+    @staticmethod
+    def load_checkpoint_doc(ckpt_dir: str) -> dict:
+        """Newest *valid* checkpoint document: a truncated or
+        checksum-failing campaign.json falls back to campaign.prev.json
+        (auto-resume must survive a kill mid-checkpoint; skipped batches
+        re-run from their PRNG coordinates, so falling back one
+        checkpoint costs work, never correctness)."""
+        errors = []
+        for name in ("campaign.json", "campaign.prev.json"):
+            path = os.path.join(ckpt_dir, name)
+            try:
+                doc = resil.load_json_verified(path)
+            except (OSError, ValueError) as e:
+                errors.append(f"{name}: {e}")
+                debug.dprintf("Campaign", "checkpoint %s unusable: %s",
+                              name, e)
+                continue
+            if name != "campaign.json":
+                debug.dprintf("Campaign",
+                              "latest checkpoint invalid — resuming from "
+                              "previous valid checkpoint %s", name)
+            return doc
+        raise ValueError(
+            f"no valid campaign checkpoint in {ckpt_dir}: "
+            + "; ".join(errors))
 
     @classmethod
     def resume(cls, ckpt_dir: str, mesh=None,
                outdir: str | None = None) -> "Orchestrator":
-        with open(os.path.join(ckpt_dir, "campaign.json")) as f:
-            doc = json.load(f)
+        doc = cls.load_checkpoint_doc(ckpt_dir)
         upgrade_checkpoint(doc)
         plan = CampaignPlan.from_dict(doc["plan"])
         orch = cls(plan, mesh=mesh, outdir=outdir)
         for spn, per_structure in doc["state"].items():
             for s, st_doc in per_structure.items():
                 orch.state[(spn, s)] = _State.from_dict(st_doc)
+        orch.budget = resil.EscalationBudget.from_states(
+            st.tier_trials for st in orch.state.values())
+        orch._esc_baseline = orch.budget.rate()
         orch._build_stats()   # rebind formulas/counters to restored state
         return orch

@@ -20,6 +20,7 @@ from shrewd_tpu.models.mesi import MesiConfig
 from shrewd_tpu.models.noc import NocConfig
 from shrewd_tpu.models.o3 import O3Config, STRUCTURES
 from shrewd_tpu.models.ruby import CacheConfig
+from shrewd_tpu.resilience import ResilienceConfig
 from shrewd_tpu.trace import synth
 from shrewd_tpu.trace.format import Trace
 from shrewd_tpu.utils.config import (Child, ConfigObject, Param, VectorParam)
@@ -121,6 +122,11 @@ class CampaignPlan(ConfigObject):
     checkpoint_every = Param(int, 0,
                              "batches between campaign checkpoints (0=off)")
     machine = Child(O3Config)
+    # backend failure posture: watchdog timeout, retry/backoff, the
+    # device→cpu→oracle degradation ladder, and the escalation budget
+    # (shrewd_tpu/resilience.py) — part of the plan so a campaign's
+    # resilience behavior is reproducible from its config dump
+    resilience = Child(ResilienceConfig)
     # non-O3 fault tiers (used only when a tier-qualified structure is in
     # ``structures``)
     cache = Child(CacheConfig)

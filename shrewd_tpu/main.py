@@ -37,12 +37,29 @@ def _apply_common(args) -> None:
         jax.config.update("jax_platforms", args.platform)
 
 
+def _apply_resilience_overrides(orch, args) -> None:
+    """CLI flags override the plan's resilience posture (and land in the
+    config/checkpoint dumps, so the overridden run stays reproducible)."""
+    cfg = orch.rcfg
+    if getattr(args, "escalation_threshold", None) is not None:
+        cfg.escalation_threshold = args.escalation_threshold
+    if getattr(args, "escalation_action", None):
+        cfg.escalation_action = args.escalation_action
+    if getattr(args, "dispatch_timeout", None) is not None:
+        cfg.dispatch_timeout = args.dispatch_timeout
+        orch.watchdog.timeout = float(args.dispatch_timeout)
+    if getattr(args, "max_retries", None) is not None:
+        cfg.max_retries = args.max_retries
+
+
 def _drive(orch, args) -> int:
     """Drive the orchestrator's event loop to completion (the stdlib
     Simulator.run analog: typed exit events → handlers,
     ``python/gem5/simulate/simulator.py:530``)."""
+    from shrewd_tpu.resilience import TIERS
     from shrewd_tpu.sim.exit_event import ExitEvent
 
+    _apply_resilience_overrides(orch, args)
     t0 = time.monotonic()
     n_batches = 0
     ckpt_every = orch.plan.checkpoint_every
@@ -57,6 +74,16 @@ def _drive(orch, args) -> int:
             _log(f"  {r.simpoint}/{r.structure}: trials={r.trials} "
                  f"avf={r.avf:.4f} ±{hw:.4f}"
                  + ("" if r.converged else " (trial cap, unconverged)"))
+        elif event == ExitEvent.BACKEND_DEGRADED:
+            d = payload
+            _log(f"  {d.simpoint}/{d.structure} batch {d.batch_id}: "
+                 f"ran on {TIERS[d.tier]} tier "
+                 f"({d.attempts} dispatch attempts)")
+        elif event == ExitEvent.ESCALATION_EXCEEDED:
+            e = payload
+            _log(f"ESCALATION BUDGET EXCEEDED: {e.rate:.1%} of trials ran "
+                 f"below the device tier (threshold {e.threshold:.1%}, "
+                 f"action={e.action}) — tiers {e.tier_trials}")
         elif event == ExitEvent.SIMPOINT_COMPLETE:
             _log(f"simpoint {payload}: done")
         elif event == ExitEvent.CAMPAIGN_COMPLETE:
@@ -64,6 +91,16 @@ def _drive(orch, args) -> int:
     orch.write_outputs()
     if orch.outdir:
         orch.checkpoint()
+    esc = orch.budget
+    if esc.escalated:
+        _log(f"escalation: {esc.escalated}/{esc.total} trials "
+             f"({esc.rate():.1%}) ran below the device tier "
+             f"({', '.join(f'{t}={int(c)}' for t, c in zip(TIERS, esc.counts))})")
+    if orch.aborted:
+        _log(f"campaign ABORTED by escalation budget after {n_batches} "
+             f"batches in {time.monotonic() - t0:.1f}s"
+             + (f" → {orch.outdir} (resumable)" if orch.outdir else ""))
+        return 3
     _log(f"campaign complete: {n_batches} batches in "
          f"{time.monotonic() - t0:.1f}s"
          + (f" → {orch.outdir}" if orch.outdir else ""))
@@ -172,15 +209,31 @@ def main(argv: list[str] | None = None) -> int:
         parents=[common])
     sub = ap.add_subparsers(dest="cmd", required=True)
 
+    resil = argparse.ArgumentParser(add_help=False)
+    resil.add_argument("--escalation-threshold", type=float, default=None,
+                       help="max fraction of trials allowed off the device "
+                            "tier before the run is flagged "
+                            "(plan.resilience.escalation_threshold)")
+    resil.add_argument("--escalation-action", default=None,
+                       choices=("off", "warn", "abort"),
+                       help="what to do when the escalation budget is "
+                            "exceeded (abort exits rc=3, resumable)")
+    resil.add_argument("--dispatch-timeout", type=float, default=None,
+                       help="watchdog seconds per device dispatch "
+                            "(0 = no watchdog)")
+    resil.add_argument("--max-retries", type=int, default=None,
+                       help="re-dispatch attempts per tier before the "
+                            "ladder degrades")
+
     p = sub.add_parser("run", help="run a campaign plan to completion",
-                       parents=[common])
+                       parents=[common, resil])
     p.add_argument("plan", help="CampaignPlan config.json")
     p.add_argument("--outdir", default="m5out",
                    help="artifact directory (config.json/stats.txt/json)")
     p.set_defaults(fn=cmd_run)
 
     p = sub.add_parser("resume", help="resume a checkpointed campaign",
-                       parents=[common])
+                       parents=[common, resil])
     p.add_argument("ckpt_dir", help="campaign_ckpt directory")
     p.add_argument("--outdir", default="m5out")
     p.set_defaults(fn=cmd_resume)

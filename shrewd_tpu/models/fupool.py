@@ -315,8 +315,11 @@ class FUPoolModel:
                 if issued[0] >= self.issue_width:
                     # the width-bounded issue loop never reaches this µop
                     # this cycle — it stays in the ready list (no
-                    # statFuBusy: the FU was never asked)
-                    waiting.setdefault(cyc + 1, []).append((i, oc_i))
+                    # statFuBusy: the FU was never asked).  Phantoms die
+                    # at the squash unless phantom_retry says otherwise —
+                    # same squash semantics as the FU-busy branch below.
+                    if real or self._ph_retry:
+                        waiting.setdefault(cyc + 1, []).append((i, oc_i))
                     return
                 if real:
                     h = (int(self._busy[i])

@@ -212,15 +212,20 @@ def nonpipelined_busy(opcode: np.ndarray, cfg: TimingConfig) -> np.ndarray:
 
 def approx_shadow_busy(opcode: np.ndarray, cfg: TimingConfig) -> np.ndarray:
     """int64[n]: unit-hold cycles when µop *i*'s shadow is granted on an
-    approximate-capability unit.  The div family's fallback target is the
-    FP divider (IntDiv → FloatDiv, ``fu_pool.cc:221-231``), which is
+    approximate-capability unit.  The integer-div family's fallback target
+    is the FP divider (IntDiv → FloatDiv, ``fu_pool.cc:221-231``), which is
     non-pipelined (``FuncUnitConfig.py:73``) — the shadow holds it for the
-    full FP-divide latency; every other fallback is pipelined (frees next
-    cycle, 0 → granting unit's default)."""
+    full FP-divide latency.  Every other fallback is pipelined and frees
+    next cycle (0 → granting unit's default) — including FDIV's, whose
+    fallback target is IntAlu: the hold is governed by
+    ``isPipelined(shadow_op_class)``, true for IntAlu
+    (``inst_queue.cc:1050-1061``), so charging it the non-pipelined
+    integer-divide latency inflated IntALU contention in FP-div-heavy
+    windows."""
     opcode = np.asarray(opcode)
     busy = np.zeros(opcode.shape[0], np.int64)
     busy[np.asarray(U.is_div(opcode))] = cfg.fdiv_latency
-    busy[opcode == U.FDIV] = cfg.div_latency    # FloatDiv → IntDiv check
+    busy[opcode == U.FDIV] = 0    # FloatDiv → IntAlu check, pipelined
     return busy
 
 

@@ -216,15 +216,26 @@ class ChunkedCampaign:
         # kind applies at µop `entry` (ops/replay.py step phases 1-2)
         landing = np.where(f_host["kind"] == KIND_REGFILE,
                            f_host["cycle"], f_host["entry"])
-        land_chunk = np.clip(landing, 0, self.n - 1) // self.S
-
         outcomes = np.full(n_tr, -1, np.int32)
+        # Out-of-window landings (sentinel coordinates: ResidencySampler
+        # wrong-path entry == n; latch entries < 0 or in [n, n+n_latches))
+        # never match any µop of the dense window, so they are MASKED by
+        # construction there — but the padded chunk stream runs indices up
+        # to C*S-1, where e.g. KIND_LATCH_OP would flip a padded NOP into
+        # a real (or illegal) op and misclassify as SDC/DUE.  Resolve them
+        # here, before any replay, to match the dense kernel exactly.
+        oow = (landing < 0) | (landing >= self.n)
+        outcomes[oow] = C.OUTCOME_MASKED
+        land_chunk = np.clip(landing, 0, self.n - 1) // self.S
+        land_chunk[oow] = -1          # never scheduled into a wave
+
         null_leaves = dict(kind=0, cycle=-1, entry=-1, bit=0, shadow_u=1.0)
         carry: _Carry | None = None
         # observability: how the campaign resolved (self.last_stats)
         st = {"waves": 0, "lanes_run": 0, "resolved_frozen": 0,
               "resolved_eq": 0, "carried": 0, "resolved_at_end": 0,
-              "chunk_replays": 0, "horizon_sdc": 0}
+              "chunk_replays": 0, "horizon_sdc": 0,
+              "oow_masked": int(oow.sum())}
         self.last_stats = st    # live view — valid even on a failed run
 
         for c in range(self.C):

@@ -96,10 +96,16 @@ class TrialKernel:
     def golden(self) -> ReplayResult:
         if self._golden is None:
             # first touch may happen inside a jit trace (run_batch →
-            # _outcomes); force concrete evaluation so the cache never
-            # holds leaked tracers (same pattern as sampler()/golden_rec)
-            with jax.ensure_compile_time_eval():
-                self._golden = jax.jit(self._replay_one)(null_fault())
+            # _outcomes).  ensure_compile_time_eval can no longer force a
+            # scan-containing jit concrete there (jax 0.4.37: scan's eval
+            # path hits the impl-less `empty` primitive, and values built
+            # under the ephemeral eval trace leak into the ambient one),
+            # so: cache only when no trace is ambient; inside a trace,
+            # replay golden as part of THAT trace and leave the cache
+            # empty — correct in every trace, concrete on first eager use.
+            if not jax.core.trace_state_clean():
+                return self._replay_one(null_fault())
+            self._golden = jax.jit(self._replay_one)(null_fault())
         return self._golden
 
     def with_shrewd(self, enable: bool | None = None,
@@ -175,10 +181,16 @@ class TrialKernel:
             with_mem_t = self.trace.n * self.trace.mem_words * 4 <= mem_budget
             reg_budget = self.cfg.taint_reg_timeline_mb * (1 << 20)
             with_reg_t = self.trace.n * self.trace.nphys * 4 <= reg_budget
-            with jax.ensure_compile_time_eval():
-                self._golden_rec = record_golden(
+            if not jax.core.trace_state_clean():
+                # same discipline as `golden`: never cache under an
+                # ambient trace (ShardedCampaign materializes before
+                # tracing, so this path is the uncommon one)
+                return record_golden(
                     self.tr, self.init_reg, self.init_mem, with_mem_t,
                     reg_timeline=with_reg_t)
+            self._golden_rec = record_golden(
+                self.tr, self.init_reg, self.init_mem, with_mem_t,
+                reg_timeline=with_reg_t)
         return self._golden_rec
 
     def _setup_batch(self, faults: Fault):

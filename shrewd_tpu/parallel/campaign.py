@@ -20,7 +20,8 @@ from jax.sharding import PartitionSpec as P
 
 from shrewd_tpu.ops import classify as C
 from shrewd_tpu.parallel import stopping
-from shrewd_tpu.parallel.mesh import TRIAL_AXIS, shard_keys
+from shrewd_tpu.parallel.mesh import TRIAL_AXIS, shard_keys, shard_map
+from shrewd_tpu.resilience import DeviceWatchdog, TIERS
 from shrewd_tpu.utils import debug, prng
 
 debug.register_flag("CampaignStep", "per-batch sharded campaign steps")
@@ -43,7 +44,12 @@ class ShardedCampaign:
     """
 
     def __init__(self, kernel, mesh, structure: str,
-                 resolution: str = "device", stratify: bool = False):
+                 resolution: str = "device", stratify: bool = False,
+                 watchdog: DeviceWatchdog | None = None):
+        """``watchdog`` (resilience.DeviceWatchdog, optional): every jitted
+        device step routes through ``watchdog.call`` so a wedged dispatch
+        surfaces as ``DispatchTimeout`` in bounded time instead of hanging
+        the campaign loop forever.  None = direct dispatch (no overhead)."""
         if resolution not in ("device", "host"):
             raise ValueError(f"unknown resolution {resolution!r}")
         if stratify and not hasattr(kernel, "run_keys_stratified"):
@@ -59,6 +65,7 @@ class ShardedCampaign:
         self.structure = structure
         self.resolution = resolution
         self.stratify = stratify
+        self.watchdog = watchdog
         self.mode = getattr(getattr(kernel, "cfg", None),
                             "replay_kernel", "dense")
         may_latch = structure == "latch"
@@ -69,7 +76,7 @@ class ShardedCampaign:
             outs = kernel.outcomes_from_keys(keys, structure)
             return jax.lax.psum(C.tally(outs), TRIAL_AXIS)
 
-        self._step = jax.jit(jax.shard_map(
+        self._step = jax.jit(shard_map(
             local_step, mesh=mesh,
             in_specs=P(TRIAL_AXIS), out_specs=P()))
 
@@ -83,7 +90,7 @@ class ShardedCampaign:
                 return (jax.lax.psum(tally_h, TRIAL_AXIS),
                         jax.lax.psum(n_unres, TRIAL_AXIS))
 
-            self._strat_step = jax.jit(jax.shard_map(
+            self._strat_step = jax.jit(shard_map(
                 strat_step, mesh=mesh,
                 in_specs=P(TRIAL_AXIS), out_specs=(P(), P())))
         if self.mode != "dense":
@@ -94,7 +101,7 @@ class ShardedCampaign:
                     return (jax.lax.psum(tally, TRIAL_AXIS),
                             jax.lax.psum(n_unres, TRIAL_AXIS))
 
-                self._device_step = jax.jit(jax.shard_map(
+                self._device_step = jax.jit(shard_map(
                     device_step, mesh=mesh,
                     in_specs=P(TRIAL_AXIS), out_specs=(P(), P())))
             else:
@@ -103,10 +110,21 @@ class ShardedCampaign:
                     res = kernel.taint_fast(faults, may_latch=may_latch)
                     return res.outcome, res.escaped, res.overflow
 
-                self._taint_step = jax.jit(jax.shard_map(
+                self._taint_step = jax.jit(shard_map(
                     taint_step, mesh=mesh,
                     in_specs=P(TRIAL_AXIS),
                     out_specs=(P(TRIAL_AXIS),) * 3))
+
+    def _dispatch(self, step, *args):
+        """One jitted device step, through the watchdog when configured.
+        ``block_until_ready`` inside the guarded call: jax dispatch is
+        async, so without it a wedged backend would 'return' instantly
+        and hang later at the np.asarray materialization — outside the
+        deadline."""
+        if self.watchdog is None:
+            return step(*args)
+        return self.watchdog.call(
+            lambda: jax.block_until_ready(step(*args)))
 
     def tally_batch_stratified(self, keys: jax.Array) -> jax.Array:
         """Sharded keys (B,) → replicated (N_STRATA, N_OUTCOMES) tally for
@@ -114,7 +132,8 @@ class ShardedCampaign:
         ``tally_batch`` exactly (same outcomes, same resolution)."""
         if self._strat_step is None:
             raise ValueError("campaign built without stratify=True")
-        tally_h, n_unres = self._strat_step(shard_keys(self.mesh, keys))
+        tally_h, n_unres = self._dispatch(
+            self._strat_step, shard_keys(self.mesh, keys))
         if self.mode != "dense":    # dense replay has no escape machinery
             self.kernel.escapes += int(n_unres)
             self.kernel.taint_trials += int(keys.shape[0])
@@ -123,14 +142,15 @@ class ShardedCampaign:
     def tally_batch(self, keys: jax.Array) -> jax.Array:
         """Sharded keys (B,) → replicated tally (N_OUTCOMES,)."""
         if self._device_step is not None:
-            tally, n_unres = self._device_step(shard_keys(self.mesh, keys))
+            tally, n_unres = self._dispatch(self._device_step,
+                                            shard_keys(self.mesh, keys))
             self.kernel.escapes += int(n_unres)
             self.kernel.taint_trials += int(keys.shape[0])
             return tally
         if self._taint_step is None:
-            return self._step(shard_keys(self.mesh, keys))
+            return self._dispatch(self._step, shard_keys(self.mesh, keys))
         keys_sh = shard_keys(self.mesh, keys)
-        out, esc, ovf = self._taint_step(keys_sh)
+        out, esc, ovf = self._dispatch(self._taint_step, keys_sh)
         out = np.asarray(out).copy()
         esc = np.asarray(esc)
         ovf = np.asarray(ovf)
@@ -161,6 +181,8 @@ class CampaignResult(NamedTuple):
     trials_per_second: float
     converged: bool
     strata_tallies: np.ndarray | None = None   # (N_STRATA, N_OUTCOMES)
+    tier_trials: np.ndarray | None = None      # (len(TIERS),) per-tier count
+    escalation_rate: float = 0.0               # fraction run below device
 
 
 def run_until_ci(campaign: ShardedCampaign, *, seed: int, simpoint_id: int,
@@ -169,14 +191,20 @@ def run_until_ci(campaign: ShardedCampaign, *, seed: int, simpoint_id: int,
                  max_trials: int = 1_000_000, min_trials: int = 1000,
                  start_batch: int = 0,
                  initial_tallies: np.ndarray | None = None,
-                 initial_strata: np.ndarray | None = None) -> CampaignResult:
+                 initial_strata: np.ndarray | None = None,
+                 dispatcher=None) -> CampaignResult:
     """Accumulate batches until the AVF CI is tight enough (the north-star
     wall-clock loop).  ``start_batch``/``initial_tallies`` (and, for a
     stratified campaign, ``initial_strata``) resume a checkpointed campaign
     without replaying old batches.  A stratified run resumed WITHOUT its
     strata (or capped before its first batch) falls back to the pooled
     Wilson interval over everything it has, so the reported interval always
-    covers every counted trial."""
+    covers every counted trial.
+
+    ``dispatcher`` (resilience.ResilientDispatcher, optional): route every
+    batch through the retry/degradation ladder; the result then carries
+    per-tier trial counts and the escalation rate so a degraded run is
+    self-describing."""
     sk = prng.structure_key(
         prng.simpoint_key(prng.campaign_key(seed), simpoint_id), structure_id)
     stratified = campaign.stratify
@@ -197,9 +225,16 @@ def run_until_ci(campaign: ShardedCampaign, *, seed: int, simpoint_id: int,
     def _strata_pairs():
         return stopping.pairs_from_strata(strata)
 
+    tier_trials = np.zeros(len(TIERS), dtype=np.int64)
     while trials < max_trials:
         keys = prng.trial_keys(prng.batch_key(sk, batch_id), batch_size)
-        if stratified:
+        if dispatcher is not None:
+            res = dispatcher.tally_batch(keys, stratified=stratified)
+            tier_trials[res.tier] += batch_size
+            if stratified:
+                strata += res.strata
+            t = res.tally
+        elif stratified:
             th = np.asarray(campaign.tally_batch_stratified(keys),
                             dtype=np.int64)
             strata += th
@@ -247,4 +282,8 @@ def run_until_ci(campaign: ShardedCampaign, *, seed: int, simpoint_id: int,
         if wall > 0 else float("inf"),
         converged=converged,
         strata_tallies=strata,
+        tier_trials=tier_trials if dispatcher is not None else None,
+        escalation_rate=(
+            float(tier_trials[1:].sum() / max(tier_trials.sum(), 1))
+            if dispatcher is not None else 0.0),
     )

@@ -17,6 +17,19 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+try:                            # public name, jax ≥ 0.6
+    from jax import shard_map
+except ImportError:             # 0.4.x home
+    import functools
+
+    from jax.experimental.shard_map import shard_map as _shard_map_expt
+
+    # 0.4.x's replication checker false-positives on scan carries inside
+    # psum-reducing bodies (the taint/device kernels) — the error text
+    # itself prescribes check_rep=False; out_specs still enforce the
+    # sharding contract
+    shard_map = functools.partial(_shard_map_expt, check_rep=False)
+
 TRIAL_AXIS = "trials"
 
 

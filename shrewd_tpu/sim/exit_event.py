@@ -22,6 +22,13 @@ class ExitEvent(enum.Enum):
     MAX_TRIALS = "max_trials"
     # campaign checkpoint written (payload: checkpoint dir)
     CHECKPOINT = "checkpoint"
+    # a batch ran below the device tier (payload: DegradeInfo) — the
+    # resilience ladder substituted CPU-JAX or the host oracle
+    BACKEND_DEGRADED = "backend_degraded"
+    # the device→host escalation rate crossed the configured budget
+    # (payload: EscalationInfo); emitted once, then the run continues
+    # (action=warn) or the event stream ends early (action=abort)
+    ESCALATION_EXCEEDED = "escalation_exceeded"
     # one simpoint finished all structures (payload: simpoint name)
     SIMPOINT_COMPLETE = "simpoint_complete"
     # the whole plan finished (payload: {(simpoint, structure): result})

@@ -1,3 +1,15 @@
-from shrewd_tpu.utils import config, debug, prng, probes, units
+import importlib
+
+from shrewd_tpu.utils import config, debug, probes, units
 
 __all__ = ["config", "debug", "prng", "probes", "units"]
+
+
+def __getattr__(name):
+    # prng is the one jax-heavy utils module; load it lazily (PEP 562) so
+    # jax-free consumers — bench.py's supervisor process imports
+    # shrewd_tpu.resilience and must never touch a backend — don't pay
+    # (or risk) a jax import just for debug/config
+    if name == "prng":
+        return importlib.import_module("shrewd_tpu.utils.prng")
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -56,6 +56,11 @@ QUICK_MODULES = {
     # ingest, trace format, the dedicated smoke module
     "test_utils", "test_stats", "test_ingest", "test_trace",
     "test_quick_smoke", "test_bench",
+    # backend resilience: mostly sub-second unit tests (watchdog, backoff,
+    # re-probe, budget, ladder, checkpoint IO) plus a handful of ~4-13 s
+    # injected-wedge / torn-checkpoint campaign integrations — the
+    # failure-path smoke belongs in the on-every-push tier by design
+    "test_resilience",
 }
 QUICK_TESTS = {
     # one representative per subsystem (≈4-10 s each, compile-dominated)
@@ -65,6 +70,7 @@ QUICK_TESTS = {
     "test_fp_fault_propagates_to_sdc",     # FP µop lanes
     "test_lift_rate_is_high",              # capture → x86 lift
     "test_mulhu_bit_exact_across_backends",  # MULHU parity
+    "test_latch_structure_parity_with_padding",  # chunked replay + oow fix
 }
 QUICK_CLASSES = {
     "TestSuffixStems", "TestSimdSubset",   # emulator units, no capture

@@ -143,6 +143,7 @@ def test_resume_rejects_unknown_version(tmp_path):
     ckpt = orch.checkpoint()
     doc = json.loads((tmp_path / "campaign_ckpt" / "campaign.json").read_text())
     doc["version"] = 99
+    doc.pop("checksum", None)   # forged doc: no stale-checksum rejection
     (tmp_path / "campaign_ckpt" / "campaign.json").write_text(json.dumps(doc))
     with pytest.raises(ValueError, match="upgrade path"):
         Orchestrator.resume(ckpt)
@@ -157,6 +158,7 @@ def test_resume_upgrades_v1_checkpoint(tmp_path):
     path = tmp_path / "campaign_ckpt" / "campaign.json"
     doc = json.loads(path.read_text())
     doc["version"] = 1
+    doc.pop("checksum", None)   # v1-era checkpoints predate checksums
     for per_structure in doc["state"].values():
         for st_doc in per_structure.values():
             st_doc.pop("escapes", None)

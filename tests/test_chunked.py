@@ -99,6 +99,23 @@ def test_shadow_detection_survives_chunking():
     assert (got == C.OUTCOME_DETECTED).any()
 
 
+def test_latch_structure_parity_with_padding():
+    """Latch faults with a chunk length that does NOT divide n: latch
+    entry coordinates can land out-of-window (sentinel entries < 0 or in
+    [n, n+n_latches)), where the padded chunk stream used to replay them
+    onto NOP padding and misclassify — they must resolve MASKED, matching
+    the dense kernel by construction."""
+    kernel = mk_kernel(n=300)
+    keys = prng.trial_keys(prng.campaign_key(21), 96)
+    dense = dense_outcomes(kernel, keys, "latch")
+    ch = ChunkedCampaign(kernel, chunk=77)      # 300 = 3*77 + 69
+    np.testing.assert_array_equal(
+        ch.outcomes_from_keys(keys, "latch"), dense)
+    # the out-of-window resolver actually fired on this sample and those
+    # trials are all masked (never replayed onto padding)
+    assert ch.last_stats["oow_masked"] > 0
+
+
 @pytest.mark.slow
 def test_lifted_window_parity():
     """Real lifted window (sort.c) with the VA-space memmap: chunked

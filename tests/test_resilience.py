@@ -1,0 +1,681 @@
+"""Backend resilience layer (shrewd_tpu/resilience.py + orchestrator wiring).
+
+The contract under test is the ISSUE acceptance criterion: a campaign with
+injected backend faults (wedged dispatch, dispatch timeout, kill
+mid-checkpoint) completes via the degradation ladder and, after resume,
+produces tallies bit-identical to an uninterrupted run — with every trial's
+execution tier accounted for and the escalation budget enforced.
+"""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from shrewd_tpu import resilience as resil
+from shrewd_tpu.resilience import (BackendError, BackoffPolicy,
+                                   DeviceWatchdog, DispatchTimeout,
+                                   EscalationBudget, LadderExhausted,
+                                   ReprobeQueue, ResilienceConfig,
+                                   ResilientDispatcher, TIER_CPU,
+                                   TIER_DEVICE, TIER_ORACLE, TIERS)
+
+
+# --- backoff -----------------------------------------------------------------
+
+def test_backoff_exponential_and_capped():
+    p = BackoffPolicy(base=0.1, cap=1.0, jitter=0.0)
+    assert p.delay(0) == pytest.approx(0.1)
+    assert p.delay(1) == pytest.approx(0.2)
+    assert p.delay(2) == pytest.approx(0.4)
+    assert p.delay(10) == pytest.approx(1.0)    # capped
+
+
+def test_backoff_jitter_bounded_and_sleeper_injectable():
+    slept = []
+    p = BackoffPolicy(base=0.2, cap=5.0, jitter=0.5, seed=1,
+                      sleeper=slept.append)
+    for a in range(20):
+        d = p.delay(0)
+        assert 0.1 <= d <= 0.3                  # ±50% around base
+    p.sleep(0)
+    assert len(slept) == 1                      # never wall-waited
+
+
+# --- watchdog ----------------------------------------------------------------
+
+def test_watchdog_passes_fast_calls_and_counts():
+    w = DeviceWatchdog(timeout=5.0)
+    assert w.call(lambda a, b: a + b, 2, 3) == 5
+    assert w.dispatches == 1 and w.timeouts == 0 and w.healthy
+
+
+def test_watchdog_zero_timeout_runs_in_caller_thread():
+    w = DeviceWatchdog(timeout=0.0)
+    assert w.call(threading.get_ident) == threading.get_ident()
+
+
+def test_watchdog_times_out_wedged_dispatch_then_recovers():
+    w = DeviceWatchdog(timeout=0.1)
+    with pytest.raises(DispatchTimeout):
+        w.call(time.sleep, 10.0)
+    assert not w.healthy and w.timeouts == 1
+    # the wedged thread is orphaned: the next dispatch gets a fresh one
+    assert w.call(lambda: 42) == 42
+    assert w.healthy
+
+
+def test_watchdog_wedged_thread_is_daemon():
+    # a ThreadPoolExecutor worker would be non-daemon and joined by the
+    # concurrent.futures atexit hook — a wedged dispatch would then block
+    # interpreter exit forever; the watchdog must leave only daemon threads
+    w = DeviceWatchdog(timeout=0.05)
+    with pytest.raises(DispatchTimeout):
+        w.call(time.sleep, 3.0)
+    stuck = [t for t in threading.enumerate()
+             if t.name.startswith("watchdog-device")]
+    assert stuck and all(t.daemon for t in stuck)
+
+
+def test_watchdog_propagates_exceptions_unchanged():
+    w = DeviceWatchdog(timeout=5.0)
+    with pytest.raises(ZeroDivisionError):
+        w.call(lambda: 1 // 0)
+
+
+def test_watchdog_probe_verdicts():
+    w = DeviceWatchdog(timeout=1.0)
+    assert w.probe(lambda: None)
+    assert not w.probe(lambda: (_ for _ in ()).throw(RuntimeError("down")))
+    assert not w.healthy
+
+
+# --- re-probe queue ----------------------------------------------------------
+
+def test_reprobe_queue_fires_deferred_at_first_healthy_window():
+    verdicts = [False, False, True]
+    fired = []
+    q = ReprobeQueue(lambda: verdicts.pop(0),
+                     backoff=BackoffPolicy(base=0.01, jitter=0.0))
+    q.defer(lambda: fired.append("a"))
+    q.start()
+    assert q.wait(5.0)
+    q.stop()
+    assert fired == ["a"]
+    assert q.probes == 3                        # exactly at first healthy
+
+
+def test_reprobe_defer_when_already_healthy_runs_immediately():
+    q = ReprobeQueue(lambda: True,
+                     backoff=BackoffPolicy(base=0.01, jitter=0.0)).start()
+    assert q.wait(5.0)
+    fired = []
+    q.defer(lambda: fired.append(1))
+    q.stop()
+    assert fired == [1]
+
+
+def test_reprobe_probe_exception_counts_as_unhealthy():
+    calls = []
+
+    def probe():
+        calls.append(1)
+        if len(calls) == 1:
+            raise RuntimeError("tunnel reset")
+        return True
+
+    q = ReprobeQueue(probe, backoff=BackoffPolicy(base=0.01, jitter=0.0))
+    q.start()
+    assert q.wait(5.0)
+    q.stop()
+    assert len(calls) == 2
+
+
+# --- escalation budget -------------------------------------------------------
+
+def test_escalation_budget_accounting():
+    b = EscalationBudget()
+    b.record(TIER_DEVICE, 900)
+    b.record(TIER_CPU, 64)
+    b.record(TIER_ORACLE, 36)
+    assert b.total == 1000 and b.escalated == 100
+    assert b.rate() == pytest.approx(0.1)
+    assert b.over(0.05) and not b.over(0.15)
+    d = b.to_dict()
+    assert d["tier_trials"] == {"device": 900, "cpu": 64, "oracle": 36}
+
+
+def test_escalation_budget_empty_is_not_over():
+    assert not EscalationBudget().over(0.0)
+
+
+def test_escalation_budget_from_states():
+    b = EscalationBudget.from_states([[10, 2, 0], [5, 0, 3]])
+    assert b.total == 20 and b.escalated == 5
+
+
+# --- dispatcher ladder (fake tiers: mechanism, not kernels) ------------------
+
+def _tally_of(keys):
+    """Deterministic stand-in kernel: a pure function of the keys."""
+    return np.bincount(np.asarray(keys, dtype=np.int64).ravel() % 4,
+                       minlength=4)
+
+
+def _fast_cfg(**kw):
+    cfg = ResilienceConfig()
+    cfg.backoff_base = 0.0
+    cfg.backoff_max = 0.0
+    for k, v in kw.items():
+        setattr(cfg, k, v)
+    return cfg
+
+
+def test_dispatcher_retries_then_succeeds_on_same_tier():
+    keys = np.arange(16)
+    calls = []
+
+    def flaky(k, stratified):
+        calls.append(1)
+        if len(calls) == 1:
+            raise BackendError("transient")
+        return _tally_of(k), None
+
+    d = ResilientDispatcher([(TIER_DEVICE, flaky)],
+                            _fast_cfg(max_retries=2))
+    res = d.tally_batch(keys)
+    assert res.tier == TIER_DEVICE and res.attempts == 2
+    assert d.retries == 1 and d.degradations == 0
+    np.testing.assert_array_equal(res.tally, _tally_of(keys))
+
+
+def test_dispatcher_degrades_with_bit_identical_tally():
+    keys = np.arange(32)
+
+    def wedged(k, stratified):
+        raise BackendError("injected wedge")
+
+    d = ResilientDispatcher(
+        [(TIER_DEVICE, wedged), (TIER_CPU, lambda k, s: (_tally_of(k), None))],
+        _fast_cfg(max_retries=1))
+    res = d.tally_batch(keys)
+    assert res.tier == TIER_CPU
+    assert d.degradations == 1
+    np.testing.assert_array_equal(res.tally, _tally_of(keys))
+
+
+def test_dispatcher_watchdog_timeout_triggers_degradation():
+    keys = np.arange(8)
+
+    def wedged(k, stratified):
+        time.sleep(10.0)
+
+    d = ResilientDispatcher(
+        [(TIER_DEVICE, wedged), (TIER_CPU, lambda k, s: (_tally_of(k), None))],
+        _fast_cfg(max_retries=0, dispatch_timeout=0.1))
+    res = d.tally_batch(keys)
+    assert res.tier == TIER_CPU
+    assert d.watchdog.timeouts == 1
+
+
+def test_dispatcher_ladder_exhausted_raises():
+    def wedged(k, stratified):
+        raise BackendError("down")
+
+    d = ResilientDispatcher([(TIER_DEVICE, wedged), (TIER_CPU, wedged)],
+                            _fast_cfg(max_retries=0))
+    with pytest.raises(LadderExhausted):
+        d.tally_batch(np.arange(4))
+
+
+# --- crash-safe document IO --------------------------------------------------
+
+def test_atomic_write_and_verified_load_roundtrip(tmp_path):
+    path = str(tmp_path / "doc.json")
+    doc = {"version": 4, "state": {"a": [1, 2, 3]}}
+    doc["checksum"] = resil.doc_checksum(doc)
+    resil.write_json_atomic(path, doc)
+    assert resil.load_json_verified(path) == doc
+    assert not os.path.exists(path + ".tmp")
+
+
+def test_verified_load_rejects_truncation_and_tampering(tmp_path):
+    path = str(tmp_path / "doc.json")
+    doc = {"version": 4, "state": {"a": 1}}
+    doc["checksum"] = resil.doc_checksum(doc)
+    resil.write_json_atomic(path, doc)
+    blob = open(path).read()
+    # truncation (the kill-mid-write shape)
+    with open(path, "w") as f:
+        f.write(blob[:len(blob) // 2])
+    with pytest.raises(ValueError, match="truncated|corrupt"):
+        resil.load_json_verified(path)
+    # valid JSON, tampered content
+    doc["state"]["a"] = 2
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    with pytest.raises(ValueError, match="checksum"):
+        resil.load_json_verified(path)
+
+
+def test_checksum_ignores_key_order():
+    a = {"x": 1, "y": [1, 2]}
+    b = {"y": [1, 2], "x": 1}
+    assert resil.doc_checksum(a) == resil.doc_checksum(b)
+
+
+# --- orchestrator integration ------------------------------------------------
+
+def _tiny_plan(**kw):
+    from shrewd_tpu.campaign.plan import CampaignPlan, WorkloadSpec
+    from shrewd_tpu.trace.synth import WorkloadConfig
+
+    defaults = dict(structures=["regfile"], batch_size=64,
+                    target_halfwidth=0.2, confidence=0.95,
+                    max_trials=128, min_trials=64)
+    defaults.update(kw)
+    return CampaignPlan(
+        simpoints=[WorkloadSpec(
+            name="w0", workload=WorkloadConfig(n=96, nphys=32, mem_words=64,
+                                               working_set_words=32,
+                                               seed=7))],
+        **defaults)
+
+
+def _final_results(orch):
+    from shrewd_tpu.sim.exit_event import ExitEvent
+
+    events = list(orch.events())
+    return events, dict(events[-1][1]) if (
+        events[-1][0] is ExitEvent.CAMPAIGN_COMPLETE) else None
+
+
+def _wedge_device_tier(monkeypatch, fail=lambda calls: True):
+    """Patch the ladder builder so the device tier raises BackendError
+    whenever ``fail(call_number)`` is true, falling back to the REAL
+    dispatch labeled as the cpu tier — the injected-wedge harness."""
+    real_builder = resil.dispatcher_for_campaign
+    calls = [0]
+
+    def patched(campaign, cfg=None, watchdog=None):
+        real_fn = resil._device_tier(campaign)
+
+        def wedgy(keys, stratified):
+            calls[0] += 1
+            if fail(calls[0]):
+                raise BackendError("injected wedge")
+            return real_fn(keys, stratified)
+
+        cfg = cfg if cfg is not None else ResilienceConfig()
+        return ResilientDispatcher(
+            [(TIER_DEVICE, wedgy), (TIER_CPU, real_fn)], cfg,
+            watchdog=watchdog)
+
+    monkeypatch.setattr(resil, "dispatcher_for_campaign", patched)
+    return real_builder
+
+
+def test_injected_wedge_degrades_and_tallies_bit_identical(monkeypatch):
+    from shrewd_tpu.campaign.orchestrator import Orchestrator
+    from shrewd_tpu.sim.exit_event import ExitEvent
+
+    # healthy reference run
+    _, clean = _final_results(Orchestrator(_tiny_plan()))
+    assert clean is not None
+
+    # every device dispatch wedges → every batch degrades one tier
+    _wedge_device_tier(monkeypatch)
+    plan = _tiny_plan()
+    plan.resilience.max_retries = 0
+    plan.resilience.backoff_base = 0.0
+    plan.resilience.escalation_threshold = 0.25
+    orch = Orchestrator(plan)
+    events, results = _final_results(orch)
+    assert results is not None
+    kinds = [e for e, _ in events]
+    assert ExitEvent.BACKEND_DEGRADED in kinds
+    assert ExitEvent.ESCALATION_EXCEEDED in kinds    # action=warn continues
+    # bit-identity: same frozen keys on the fallback tier → same tallies
+    for k in clean:
+        np.testing.assert_array_equal(clean[k].tallies, results[k].tallies)
+    # every trial accounted to the cpu tier
+    assert orch.budget.rate() == pytest.approx(1.0)
+    assert orch.budget.counts[TIER_CPU] == orch.budget.total
+    st = orch.state[("w0", "regfile")]
+    assert int(st.tier_trials[TIER_CPU]) == st.trials
+
+
+def test_transient_wedge_retries_on_device_tier(monkeypatch):
+    from shrewd_tpu.campaign.orchestrator import Orchestrator
+
+    # only the first dispatch fails → retry keeps everything on-device
+    _wedge_device_tier(monkeypatch, fail=lambda n: n == 1)
+    plan = _tiny_plan()
+    plan.resilience.max_retries = 2
+    plan.resilience.backoff_base = 0.0
+    orch = Orchestrator(plan)
+    _, results = _final_results(orch)
+    assert results is not None
+    assert orch.budget.escalated == 0
+    assert orch.budget.counts[TIER_DEVICE] == orch.budget.total
+
+
+def test_escalation_budget_abort_leaves_resumable_checkpoint(
+        monkeypatch, tmp_path):
+    from shrewd_tpu.campaign.orchestrator import Orchestrator
+    from shrewd_tpu.sim.exit_event import ExitEvent
+
+    _wedge_device_tier(monkeypatch)
+    plan = _tiny_plan()
+    plan.resilience.max_retries = 0
+    plan.resilience.backoff_base = 0.0
+    plan.resilience.escalation_threshold = 0.01
+    plan.resilience.escalation_action = "abort"
+    orch = Orchestrator(plan, outdir=str(tmp_path))
+    events = list(orch.events())
+    kinds = [e for e, _ in events]
+    assert orch.aborted
+    assert ExitEvent.ESCALATION_EXCEEDED in kinds
+    assert ExitEvent.CAMPAIGN_COMPLETE not in kinds   # never claims success
+    # the abort checkpoint is resumable and carries the tier ledger
+    ckpt = os.path.join(str(tmp_path), "campaign_ckpt")
+    orch2 = Orchestrator.resume(ckpt)
+    assert orch2.budget.escalated > 0
+    st = orch2.state[("w0", "regfile")]
+    assert st.trials > 0 and int(st.tier_trials.sum()) == st.trials
+
+
+def test_escalation_abort_resume_rearms_not_relitigates(
+        monkeypatch, tmp_path):
+    """Resuming a budget-aborted run must not re-abort on frozen history:
+    while the backend is still wedged (rate not improving) it re-aborts,
+    but once the backend heals the restored rate only falls and the run
+    completes — the 'resumable' promise of escalation_action=abort."""
+    from shrewd_tpu.campaign.orchestrator import Orchestrator
+    from shrewd_tpu.sim.exit_event import ExitEvent
+
+    # 3 cap-limited batches: abort #1 leaves work for the wedged resume,
+    # which leaves a real device batch for the healed resume to run
+    knobs = dict(target_halfwidth=0.001, max_trials=192)
+    _, clean = _final_results(Orchestrator(_tiny_plan(**knobs)))
+
+    real_builder = _wedge_device_tier(monkeypatch)
+    plan = _tiny_plan(**knobs)
+    plan.resilience.max_retries = 0
+    plan.resilience.backoff_base = 0.0
+    plan.resilience.escalation_threshold = 0.01
+    plan.resilience.escalation_action = "abort"
+    orch = Orchestrator(plan, outdir=str(tmp_path))
+    list(orch.events())
+    assert orch.aborted
+    ckpt = os.path.join(str(tmp_path), "campaign_ckpt")
+
+    # still wedged: escalation keeps pace with history → re-abort
+    orch2 = Orchestrator.resume(ckpt, outdir=str(tmp_path))
+    kinds2 = [e for e, _ in orch2.events()]
+    assert orch2.aborted
+    assert ExitEvent.ESCALATION_EXCEEDED in kinds2
+
+    # healed: restored rate is the baseline, device-only batches only
+    # lower it → the gate stays quiet and the campaign completes
+    monkeypatch.setattr(resil, "dispatcher_for_campaign", real_builder)
+    orch3 = Orchestrator.resume(ckpt, outdir=str(tmp_path))
+    events3 = list(orch3.events())
+    kinds3 = [e for e, _ in events3]
+    assert not orch3.aborted
+    assert ExitEvent.CAMPAIGN_COMPLETE in kinds3
+    assert ExitEvent.ESCALATION_EXCEEDED not in kinds3
+    results = dict(events3[-1][1])
+    for k in clean:
+        np.testing.assert_array_equal(clean[k].tallies, results[k].tallies)
+
+
+def test_resume_from_truncated_checkpoint_uses_previous_valid(tmp_path):
+    """Kill-mid-checkpoint: the torn campaign.json is detected (checksum)
+    and resume falls back to campaign.prev.json; the finished campaign is
+    bit-identical to an uninterrupted run (skipped batches re-run from
+    their PRNG coordinates)."""
+    from shrewd_tpu.campaign.orchestrator import Orchestrator
+    from shrewd_tpu.sim.exit_event import ExitEvent
+
+    # force 3 batches (uncapped halfwidth would converge after one, and a
+    # single checkpoint never rotates a .prev to fall back on)
+    knobs = dict(target_halfwidth=0.001, max_trials=192)
+    _, clean = _final_results(Orchestrator(_tiny_plan(**knobs)))
+
+    plan = _tiny_plan(checkpoint_every=1, **knobs)
+    orch = Orchestrator(plan, outdir=str(tmp_path))
+    ckpts = 0
+    ckpt_dir = None
+    for ev, payload in orch.events():
+        if ev is ExitEvent.CHECKPOINT:
+            ckpts += 1
+            ckpt_dir = payload
+            if ckpts == 2:      # both campaign.json and .prev.json exist
+                break
+    assert ckpt_dir is not None
+    latest = os.path.join(ckpt_dir, "campaign.json")
+    prev = os.path.join(ckpt_dir, "campaign.prev.json")
+    assert os.path.exists(latest) and os.path.exists(prev)
+    # tear the latest checkpoint mid-write
+    blob = open(latest).read()
+    with open(latest, "w") as f:
+        f.write(blob[:len(blob) // 3])
+
+    orch2 = Orchestrator.resume(ckpt_dir)
+    # fell back one checkpoint: some progress restored, not all lost
+    assert any(st.trials > 0 for st in orch2.state.values())
+    _, resumed = _final_results(orch2)
+    assert resumed is not None
+    for k in clean:
+        np.testing.assert_array_equal(clean[k].tallies, resumed[k].tallies)
+        assert clean[k].trials == resumed[k].trials
+
+
+def test_resume_with_no_valid_checkpoint_raises(tmp_path):
+    from shrewd_tpu.campaign.orchestrator import Orchestrator
+
+    orch = Orchestrator(_tiny_plan(), outdir=str(tmp_path))
+    ckpt = orch.checkpoint()
+    for name in ("campaign.json", "campaign.prev.json"):
+        path = os.path.join(ckpt, name)
+        if os.path.exists(path):
+            with open(path, "w") as f:
+                f.write("{ torn")
+    with pytest.raises(ValueError, match="no valid campaign checkpoint"):
+        Orchestrator.resume(ckpt)
+
+
+def test_checkpoint_v4_format_and_v3_upgrade(tmp_path):
+    from shrewd_tpu.campaign.orchestrator import (CKPT_VERSION, Orchestrator,
+                                                  upgrade_checkpoint)
+
+    orch = Orchestrator(_tiny_plan(), outdir=str(tmp_path))
+    list(orch.events())
+    ckpt = orch.checkpoint()
+    doc = resil.load_json_verified(os.path.join(ckpt, "campaign.json"))
+    assert doc["version"] == CKPT_VERSION == 4
+    assert doc["checksum"] == resil.doc_checksum(doc)
+    for per_s in doc["state"].values():
+        for st_doc in per_s.values():
+            assert len(st_doc["tier_trials"]) == len(TIERS)
+
+    # a v3-era document (no tier provenance) upgrades to zeroed ledgers —
+    # old trials must NOT be attributed to the device tier
+    for per_s in doc["state"].values():
+        for st_doc in per_s.values():
+            del st_doc["tier_trials"]
+    doc["version"] = 3
+    upgrade_checkpoint(doc)
+    assert doc["version"] == 4
+    for per_s in doc["state"].values():
+        for st_doc in per_s.values():
+            assert st_doc["tier_trials"] == [0] * len(TIERS)
+
+
+def test_stats_report_tier_vector_and_escalation(monkeypatch, tmp_path):
+    from shrewd_tpu.campaign.orchestrator import Orchestrator
+
+    _wedge_device_tier(monkeypatch)
+    plan = _tiny_plan()
+    plan.resilience.max_retries = 0
+    plan.resilience.backoff_base = 0.0
+    orch = Orchestrator(plan, outdir=str(tmp_path))
+    _, results = _final_results(orch)
+    assert results is not None
+    orch.write_outputs()
+    text = (tmp_path / "stats.txt").read_text()
+    assert "tier_trials" in text
+    assert "escalation_rate" in text
+
+
+# --- real-ladder construction ------------------------------------------------
+
+def test_dispatcher_for_campaign_cpu_mesh_skips_cpu_tier():
+    """On a cpu mesh the ladder is device(+oracle) — re-dispatching to the
+    same platform is pointless; the oracle tier joins when the native
+    golden kernel covers the structure."""
+    from shrewd_tpu.models.o3 import O3Config
+    from shrewd_tpu.ops.trial import TrialKernel
+    from shrewd_tpu.parallel.campaign import ShardedCampaign
+    from shrewd_tpu.parallel.mesh import make_mesh
+    from shrewd_tpu.trace.synth import WorkloadConfig, generate
+
+    t = generate(WorkloadConfig(n=96, nphys=32, mem_words=64,
+                                working_set_words=32, seed=7))
+    camp = ShardedCampaign(TrialKernel(t, O3Config()), make_mesh(),
+                           "regfile")
+    d = resil.dispatcher_for_campaign(camp)
+    tiers = [t for t, _ in d.tiers]
+    assert tiers[0] == TIER_DEVICE
+    assert TIER_CPU not in tiers
+    assert resil.oracle_available(camp) == (TIER_ORACLE in tiers)
+
+
+def test_oracle_tier_bit_identical_to_device():
+    """The acceptance-criterion core, on the REAL ladder: the host-oracle
+    tier classifies the same frozen keys to the same tally as the device
+    dispatch (the CheckerCPU-parity contract, tests/test_native_diff.py)."""
+    from shrewd_tpu.models.o3 import O3Config
+    from shrewd_tpu.ops.trial import TrialKernel
+    from shrewd_tpu.parallel.campaign import ShardedCampaign
+    from shrewd_tpu.parallel.mesh import make_mesh
+    from shrewd_tpu.trace.synth import WorkloadConfig, generate
+    from shrewd_tpu.utils import prng
+
+    t = generate(WorkloadConfig(n=96, nphys=32, mem_words=64,
+                                working_set_words=32, seed=7))
+    camp = ShardedCampaign(TrialKernel(t, O3Config()), make_mesh(),
+                           "regfile")
+    if not resil.oracle_available(camp):
+        pytest.skip("native golden kernel not available")
+    keys = prng.trial_keys(prng.campaign_key(0), 64)
+    dev = np.asarray(camp.tally_batch(keys))
+
+    def wedged(k, stratified):
+        raise BackendError("injected wedge")
+
+    d = ResilientDispatcher(
+        [(TIER_DEVICE, wedged),
+         (TIER_ORACLE, resil._oracle_tier(camp))],
+        _fast_cfg(max_retries=0))
+    res = d.tally_batch(keys)
+    assert res.tier == TIER_ORACLE
+    np.testing.assert_array_equal(res.tally, dev)
+
+
+def _mini_campaign(stratify=False):
+    from shrewd_tpu.models.o3 import O3Config
+    from shrewd_tpu.ops.trial import TrialKernel
+    from shrewd_tpu.parallel.campaign import ShardedCampaign
+    from shrewd_tpu.parallel.mesh import make_mesh
+    from shrewd_tpu.trace.synth import WorkloadConfig, generate
+
+    t = generate(WorkloadConfig(n=96, nphys=32, mem_words=64,
+                                working_set_words=32, seed=7))
+    return ShardedCampaign(TrialKernel(t, O3Config()), make_mesh(),
+                           "regfile", stratify=stratify)
+
+
+def test_device_tier_wraps_crashing_backend_into_ladder(monkeypatch):
+    """A backend that CRASHES (device lost / runtime aborted) — not just
+    wedges — must engage the ladder too: generic device-tier exceptions
+    become BackendError and degrade."""
+    from shrewd_tpu.utils import prng
+
+    camp = _mini_campaign()
+    if not resil.oracle_available(camp):
+        pytest.skip("native golden kernel not available")
+    keys = prng.trial_keys(prng.campaign_key(0), 64)
+    want = np.asarray(camp.tally_batch(keys))
+    monkeypatch.setattr(
+        camp, "tally_batch",
+        lambda k: (_ for _ in ()).throw(RuntimeError("device lost")))
+    d = resil.dispatcher_for_campaign(camp, _fast_cfg(max_retries=0))
+    res = d.tally_batch(keys)
+    assert res.tier == TIER_ORACLE
+    np.testing.assert_array_equal(res.tally, want)
+
+
+def test_orchestrator_campaign_shares_watchdog():
+    """The per-step deadline lives INSIDE the campaign (around only the
+    pure jitted step — no host counter mutation can come from an orphaned
+    late dispatch), and the dispatcher then must not stack a second
+    deadline around the same call."""
+    from shrewd_tpu.campaign.orchestrator import Orchestrator
+
+    orch = Orchestrator(_tiny_plan())
+    camp = orch.campaign(0, "regfile")
+    assert camp.watchdog is orch.watchdog
+    assert orch.dispatcher(0, "regfile").device_deadline is False
+
+
+def test_run_until_ci_with_dispatcher_degrades_bit_identical():
+    """The standalone driver loop (parallel.campaign.run_until_ci) carries
+    the same ladder contract: flaky device tier → fallback on the same
+    frozen keys → bit-identical tallies, per-tier counts in the result."""
+    from shrewd_tpu.parallel.campaign import run_until_ci
+
+    camp = _mini_campaign()
+    knobs = dict(seed=3, simpoint_id=0, structure_id=0, batch_size=64,
+                 target_halfwidth=1e-9, max_trials=128, min_trials=64)
+    plain = run_until_ci(camp, **knobs)
+
+    real_fn = resil._device_tier(camp)
+    calls = [0]
+
+    def flaky(keys, stratified):
+        calls[0] += 1
+        if calls[0] == 1:
+            raise BackendError("injected wedge")
+        return real_fn(keys, stratified)
+
+    d = ResilientDispatcher([(TIER_DEVICE, flaky), (TIER_CPU, real_fn)],
+                            _fast_cfg(max_retries=0))
+    res = run_until_ci(camp, dispatcher=d, **knobs)
+    np.testing.assert_array_equal(res.tallies, plain.tallies)
+    assert res.tier_trials is not None
+    assert int(res.tier_trials.sum()) == res.trials
+    assert res.tier_trials[TIER_CPU] > 0          # first batch degraded
+    assert 0.0 < res.escalation_rate <= 1.0
+
+
+# --- standalone probe tool ---------------------------------------------------
+
+def test_backend_probe_cpu_healthy():
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "backend_probe.py"),
+         "--platform", "cpu", "--timeout", "120"],
+        capture_output=True, text=True, timeout=180,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert proc.returncode == 0, proc.stderr[-500:]
+    verdict = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert verdict["ok"] is True
+    assert verdict["platform"] == "cpu"

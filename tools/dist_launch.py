@@ -81,6 +81,32 @@ def worker(args) -> int:
 
 def supervise(args) -> int:
     env = _worker_env(args.local_devices)
+    if not args.skip_probe:
+        # pre-flight health probe (tools/backend_probe.py): N workers
+        # joining a coordinator all hang together if the backend is
+        # wedged — spend one bounded subprocess finding out first
+        try:
+            probe = subprocess.run(
+                [sys.executable, os.path.join(os.path.dirname(
+                    os.path.abspath(__file__)), "backend_probe.py"),
+                 "--platform", "cpu", "--timeout", str(args.probe_timeout)],
+                env=env, capture_output=True, text=True,
+                timeout=args.probe_timeout + 20)
+            failed = probe.returncode != 0
+            detail = f"{probe.stdout}{probe.stderr}" if failed else ""
+        except subprocess.TimeoutExpired:
+            # the child wedged before its own watchdog thread could start
+            # (interpreter/site import hanging on the same broken backend
+            # the probe exists to detect) — that is a failed probe, not a
+            # supervisor crash
+            failed = True
+            detail = (f"probe child unresponsive after "
+                      f"{args.probe_timeout + 20:.0f}s")
+        if failed:
+            print(f"backend probe failed:\n{detail}", file=sys.stderr)
+            print(json.dumps({"ok": False,
+                              "error": "backend probe failed"}))
+            return 1
     procs = []
     for pid in range(args.num_processes):
         procs.append(subprocess.Popen(
@@ -175,6 +201,10 @@ def main() -> int:
     ap.add_argument("--uops", type=int, default=96)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--timeout", type=float, default=420.0)
+    ap.add_argument("--skip-probe", action="store_true",
+                    help="skip the pre-flight backend health probe")
+    ap.add_argument("--probe-timeout", type=float, default=55.0,
+                    help="backend_probe.py self-exit watchdog seconds")
     args = ap.parse_args()
     if args.role == "worker":
         return worker(args)
