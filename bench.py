@@ -48,7 +48,9 @@ import time
 from functools import partial
 
 # jax-free by contract (resilience.py import discipline): the supervisor
-# must never touch a backend, only subprocesses do
+# must never touch a backend, only subprocesses do; integrity.py keeps the
+# same discipline (host-pure tally invariants)
+from shrewd_tpu.integrity import tally_violations
 from shrewd_tpu.resilience import (BackoffPolicy, DeviceWatchdog,
                                    DispatchTimeout, ReprobeQueue)
 
@@ -481,6 +483,14 @@ def run_worker(args) -> None:
         sys.exit(4)
     log(f"compile+first batch: {time.monotonic() - t0:.1f}s tally={tally}")
 
+    # tally invariants on the measured batch (integrity layer): a perf
+    # number from a tally that doesn't even sum to its batch size is not a
+    # perf number — every headline line now ships with this check
+    tally_viol = tally_violations(tally, batch)
+    if tally_viol:
+        log(f"WARNING: tally invariant violations on measured batch: "
+            f"{tally_viol}")
+
     def emit(rate, extra=None):
         out = {
             "metric": "sfi_trials_per_sec_per_chip",
@@ -488,6 +498,7 @@ def run_worker(args) -> None:
             "unit": "trials/sec/chip",
             "vs_baseline": 0.0,
             "platform": dev.platform,
+            "tally_invariants": "ok" if not tally_viol else tally_viol,
         }
         if pallas_note:
             out["pallas"] = pallas_note

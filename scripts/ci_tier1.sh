@@ -3,6 +3,17 @@
 # cannot drift.  Exits with pytest's status; prints DOTS_PASSED for the
 # driver's pass-count comparison.
 #
-# Usage: scripts/ci_tier1.sh   (from the repo root)
+# Usage: scripts/ci_tier1.sh   (from anywhere — the script resolves the
+# repo root from its own path, so CI and local invocations cannot diverge
+# on the working directory)
+
+cd "$(dirname "$0")/.." || exit 1
+
+# Non-fatal backend-probe smoke: catches probe drift (import breakage,
+# verdict-format changes) in tier-1 without ever affecting the pass/fail
+# status — the probe is the first thing operators reach for when a
+# backend misbehaves, so it must not rot silently.
+timeout -k 10 180 env JAX_PLATFORMS=cpu python tools/backend_probe.py --platform cpu --timeout 120 \
+  || echo "WARNING: backend_probe smoke failed (non-fatal)"
 
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

@@ -24,6 +24,7 @@ from typing import Iterator, NamedTuple
 
 import numpy as np
 
+from shrewd_tpu import integrity as integ
 from shrewd_tpu import resilience as resil
 from shrewd_tpu import stats as statsmod
 from shrewd_tpu.campaign.plan import COHERENCE_SP_NAME, CampaignPlan
@@ -40,7 +41,7 @@ from shrewd_tpu.utils import debug, prng
 
 debug.register_flag("Campaign", "orchestrator progress")
 
-CKPT_VERSION = 4
+CKPT_VERSION = 5
 
 # Campaign-checkpoint upgraders — the ``util/cpt_upgraders/`` analog
 # (reference keeps one script per version tag and applies them in sequence
@@ -86,7 +87,18 @@ def _upgrade_v3(doc: dict) -> None:
     doc["version"] = 4
 
 
-CKPT_UPGRADERS = {1: _upgrade_v1, 2: _upgrade_v2, 3: _upgrade_v3}
+def _upgrade_v4(doc: dict) -> None:
+    """v4 → v5: campaign-level integrity state (mismatch ledger, canary/
+    invariant counters, quarantine log).  Pre-v5 campaigns ran with no
+    in-loop auditing, so the upgrade records exactly that — an empty
+    monitor (the faithful unknown): a resumed old campaign's audit rate
+    covers only post-upgrade batches, like the v4 tier ledger."""
+    doc.setdefault("integrity", None)
+    doc["version"] = 5
+
+
+CKPT_UPGRADERS = {1: _upgrade_v1, 2: _upgrade_v2, 3: _upgrade_v3,
+                  4: _upgrade_v4}
 
 
 def upgrade_checkpoint(doc: dict) -> dict:
@@ -248,6 +260,18 @@ class Orchestrator:
                                 resil.ResilientDispatcher] = {}
         self._esc_flagged = False
         self.aborted = False
+        self.abort_reason = ""
+        # result-integrity layer (integrity.py): one monitor per
+        # orchestrator (result trust is a campaign property, like backend
+        # health); dispatch goes through per-campaign checked wrappers
+        self.icfg = plan.integrity
+        self.monitor = integ.IntegrityMonitor(self.icfg)
+        self._checked: dict[tuple[int, str], integ.CheckedDispatcher] = {}
+        # resume re-arm, mirroring the escalation gate: an audit-aborted
+        # run resumed against healthy kernels completes once the mismatch
+        # rate falls below its restored baseline
+        self._audit_flagged = False
+        self._audit_baseline = 0.0
         # probe points (utils/probes; gem5 ProbePoint pattern): listeners
         # attach without the orchestrator knowing who observes.  Payloads
         # are batch-granular — BatchInfo / StructureResult / ckpt path.
@@ -300,6 +324,43 @@ class Orchestrator:
             "retries",
             lambda: sum(d.retries for d in self._dispatchers.values()),
             "re-dispatch attempts beyond each first try")
+        # result-integrity accounting: the 'and the tallies were audited'
+        # ledger (integrity.IntegrityMonitor) — canary outcomes, invariant
+        # checks, differential-audit mismatches, quarantine/recovery
+        mon = self.monitor
+        ig = statsmod.Group("integrity")
+        self.stats.integrity = ig
+        ig.canary_trials = statsmod.Formula(
+            "canary_trials", lambda: mon.canary_trials,
+            "canary trials run (known-outcome salting)")
+        ig.canary_failures = statsmod.Formula(
+            "canary_failures", lambda: mon.canary_failures,
+            "canary trials that missed their constructed outcome")
+        ig.invariant_checks = statsmod.Formula(
+            "invariant_checks", lambda: mon.invariant_checks +
+            sum(c.shard_checks for c in self._campaigns.values()),
+            "tally/monotone/shard invariant evaluations")
+        ig.invariant_violations = statsmod.Formula(
+            "invariant_violations", lambda: mon.invariant_violations,
+            "invariant evaluations that failed")
+        ig.audited_trials = statsmod.Formula(
+            "audited_trials", lambda: mon.ledger.audited,
+            "trials re-run on the alternate kernel")
+        ig.audit_mismatches = statsmod.Formula(
+            "audit_mismatches", lambda: mon.ledger.mismatched,
+            "audited trials whose outcomes disagreed")
+        ig.audit_mismatch_rate = statsmod.Formula(
+            "audit_mismatch_rate", lambda: mon.ledger.rate(),
+            "mismatched / audited")
+        ig.quarantined_batches = statsmod.Formula(
+            "quarantined_batches", lambda: mon.quarantined,
+            "batches whose tally failed canary/invariant checks")
+        ig.requeues = statsmod.Formula(
+            "requeues", lambda: mon.requeues,
+            "quarantined-batch re-dispatches down the ladder")
+        ig.recovered_batches = statsmod.Formula(
+            "recovered_batches", lambda: mon.recovered,
+            "quarantined batches recovered with a clean tally")
         # refresh from restored state (resume path)
         for (spn, s), st in self.state.items():
             sg = getattr(getattr(self.stats, f"sp_{spn}"), f"st_{s}")
@@ -381,9 +442,10 @@ class Orchestrator:
             # raises BEFORE any host-side counter mutation, so an orphaned
             # dispatch thread that completes late ran only pure device work
             # and cannot corrupt kernel.escapes/taint_trials
-            self._campaigns[key] = ShardedCampaign(kernel, self.mesh, sub,
-                                                   stratify=stratify,
-                                                   watchdog=self.watchdog)
+            self._campaigns[key] = ShardedCampaign(
+                kernel, self.mesh, sub, stratify=stratify,
+                watchdog=self.watchdog,
+                integrity_check=self.icfg.invariants)
         return self._campaigns[key]
 
     def dispatcher(self, sp_idx: int, structure: str
@@ -397,6 +459,29 @@ class Orchestrator:
                 self.campaign(sp_idx, structure), self.rcfg,
                 watchdog=self.watchdog)
         return self._dispatchers[key]
+
+    def checked_dispatcher(self, sp_idx: int, sp_name: str, structure: str
+                           ) -> integ.CheckedDispatcher:
+        """The integrity-enforcing wrapper around one campaign's resilient
+        dispatch (canaries + tally invariants + differential audit);
+        shares the orchestrator-wide monitor."""
+        key = (sp_idx, structure)
+        if key not in self._checked:
+            sk = self._structure_prng_key(sp_idx, structure)
+            self._checked[key] = integ.checked_dispatcher_for(
+                self.dispatcher(sp_idx, structure),
+                self.campaign(sp_idx, structure), self.monitor,
+                sp_name, structure, structure_key=sk)
+        return self._checked[key]
+
+    def _structure_prng_key(self, sp_idx: int, structure: str):
+        """The frozen PRNG key every batch of one (simpoint, structure)
+        campaign derives from — the single source both the drive loop and
+        the seed-canary stream must share (a divergence here would verify
+        canaries against the wrong fault stream)."""
+        return prng.structure_key(
+            prng.simpoint_key(prng.campaign_key(self.plan.seed), sp_idx),
+            _structure_id(structure))
 
     # --- the drive loop ---
 
@@ -430,9 +515,7 @@ class Orchestrator:
                        st: _State) -> Iterator[tuple[ExitEvent, object]]:
         plan = self.plan
         camp = self.campaign(sp_idx, structure)
-        sk = prng.structure_key(
-            prng.simpoint_key(prng.campaign_key(plan.seed), sp_idx),
-            _structure_id(structure))
+        sk = self._structure_prng_key(sp_idx, structure)
         sg = getattr(getattr(self.stats, f"sp_{sp_name}"), f"st_{structure}")
         t0 = time.monotonic()
         while True:
@@ -485,17 +568,60 @@ class Orchestrator:
             # and resume restores prior counts — assignment would clobber)
             esc0 = int(getattr(camp.kernel, "escapes", 0))
             tt0 = int(getattr(camp.kernel, "taint_trials", 0))
-            # dispatch through the resilience ladder: retries/backoff on
-            # the device tier, then CPU-JAX, then the host oracle — the
-            # same frozen keys on every tier, so the tally is bit-identical
-            # regardless of where it ran
-            res = self.dispatcher(sp_idx, structure).tally_batch(
-                keys, stratified=camp.stratify)
+            # dispatch through the integrity-checked resilience ladder:
+            # retries/backoff on the device tier, then CPU-JAX, then the
+            # host oracle — the same frozen keys on every tier, so the
+            # tally is bit-identical regardless of where it ran; canaries,
+            # tally invariants and the sampled differential audit run on
+            # every batch before its tally is believed
+            try:
+                res = self.checked_dispatcher(
+                    sp_idx, sp_name, structure).tally_batch(
+                        keys, stratified=camp.stratify,
+                        batch_id=st.next_batch)
+            except integ.IntegrityError:
+                # unrecoverable corruption: every re-dispatch failed the
+                # checks.  The corrupt batch is NOT counted; leave the
+                # evidence + a resumable checkpoint and end the stream
+                # (events() sees .aborted; the CLI exits rc 3)
+                self.aborted = True
+                self.abort_reason = "integrity violation"
+                self._persist_evidence()
+                for ev in self.monitor.take_events():
+                    yield ExitEvent.INTEGRITY_VIOLATION, ev
+                if self.outdir:
+                    self.checkpoint()
+                return
             if camp.stratify:
                 if st.strata is None:
                     st.strata = np.zeros_like(res.strata)
                 st.strata += res.strata
             tally = res.tally
+            # cumulative-monotonicity invariant: belt-and-braces over the
+            # per-batch checks (a non-negative tally cannot regress the
+            # cumulative counters, so a trip here means host-side state
+            # corruption — not requeueable, abort resumable)
+            if self.icfg.invariants:
+                self.monitor.invariant_checks += 1
+                mviol = integ.monotone_violations(st.tallies,
+                                                  st.tallies + tally)
+                if mviol:
+                    self.monitor.invariant_violations += 1
+                    self.monitor.record_quarantine({
+                        "kind": "invariant", "simpoint": sp_name,
+                        "structure": structure,
+                        "batch_id": st.next_batch,
+                        "problems": [{"kind": "invariant",
+                                      "violations": mviol}],
+                        "fatal": True})
+                    self.aborted = True
+                    self.abort_reason = "integrity violation"
+                    self._persist_evidence()
+                    for ev in self.monitor.take_events():
+                        yield ExitEvent.INTEGRITY_VIOLATION, ev
+                    if self.outdir:
+                        self.checkpoint()
+                    return
             st.tallies += tally
             st.next_batch += 1
             st.escapes += int(getattr(camp.kernel, "escapes", 0)) - esc0
@@ -521,6 +647,35 @@ class Orchestrator:
             self.pp_batch.notify(info)
             yield ExitEvent.BATCH_COMPLETE, info
 
+            # integrity evidence (quarantine/recovery/shard events) from
+            # the checked dispatch surfaces as typed events after the
+            # batch that produced it, with the record already on disk
+            events = self.monitor.take_events()
+            if events:
+                self._persist_evidence()
+                for ev in events:
+                    yield ExitEvent.INTEGRITY_VIOLATION, ev
+
+            # audit mismatch budget — the differential-audit mirror of
+            # the escalation gate below (same re-arm-on-resume shape)
+            if (self.icfg.audit_action != "off"
+                    and not self._audit_flagged
+                    and self.monitor.ledger.over(self.icfg.audit_threshold)
+                    and self.monitor.ledger.rate() >= self._audit_baseline):
+                self._audit_flagged = True
+                ainfo = integ.AuditBudgetInfo(
+                    self.monitor.ledger.rate(), self.icfg.audit_threshold,
+                    self.icfg.audit_action,
+                    dict(self.monitor.ledger.reasons))
+                self._persist_evidence()
+                yield ExitEvent.INTEGRITY_VIOLATION, ainfo
+                if self.icfg.audit_action == "abort":
+                    self.aborted = True
+                    self.abort_reason = "audit mismatch budget"
+                    if self.outdir:
+                        self.checkpoint()
+                    return
+
             if (self.rcfg.escalation_action != "off"
                     and not self._esc_flagged
                     and self.budget.over(self.rcfg.escalation_threshold)
@@ -535,6 +690,7 @@ class Orchestrator:
                     # leave a resumable checkpoint, then end the stream
                     # (events() sees .aborted and never claims completion)
                     self.aborted = True
+                    self.abort_reason = "escalation budget"
                     if self.outdir:
                         self.checkpoint()
                     return
@@ -587,6 +743,10 @@ class Orchestrator:
             "version": CKPT_VERSION,
             "plan": self.plan.to_dict(),
             "state": state_doc,
+            # v5: the integrity monitor (mismatch ledger, canary/invariant
+            # counters, quarantine log) rides the checkpoint so the audit
+            # budget and evidence survive resume
+            "integrity": self.monitor.to_dict(),
         }
         doc["checksum"] = resil.doc_checksum(doc)
         path = os.path.join(ckpt_dir, "campaign.json")
@@ -634,5 +794,21 @@ class Orchestrator:
         orch.budget = resil.EscalationBudget.from_states(
             st.tier_trials for st in orch.state.values())
         orch._esc_baseline = orch.budget.rate()
+        orch.monitor = integ.IntegrityMonitor.from_dict(
+            doc.get("integrity"), orch.icfg)
+        orch._audit_baseline = orch.monitor.ledger.rate()
         orch._build_stats()   # rebind formulas/counters to restored state
         return orch
+
+    def _persist_evidence(self) -> None:
+        """Persist the integrity evidence record
+        (``outdir/integrity_evidence.json``, atomic): quarantine log +
+        mismatch ledger, so a violated run is inspectable without parsing
+        checkpoints."""
+        if not self.outdir:
+            return
+        os.makedirs(self.outdir, exist_ok=True)
+        resil.write_json_atomic(
+            os.path.join(self.outdir, "integrity_evidence.json"),
+            {"quarantine": list(self.monitor.quarantine_log),
+             "ledger": self.monitor.ledger.to_dict()})

@@ -16,6 +16,7 @@ SimPoint sources polymorph over ``SimPointSpec``:
 
 from __future__ import annotations
 
+from shrewd_tpu.integrity import IntegrityConfig
 from shrewd_tpu.models.mesi import MesiConfig
 from shrewd_tpu.models.noc import NocConfig
 from shrewd_tpu.models.o3 import O3Config, STRUCTURES
@@ -127,6 +128,11 @@ class CampaignPlan(ConfigObject):
     # (shrewd_tpu/resilience.py) — part of the plan so a campaign's
     # resilience behavior is reproducible from its config dump
     resilience = Child(ResilienceConfig)
+    # result-integrity posture: canary trials, tally invariants, and the
+    # continuous differential audit (shrewd_tpu/integrity.py) — like the
+    # resilience child, part of the plan so a campaign's self-validation
+    # behavior is reproducible from its config dump
+    integrity = Child(IntegrityConfig)
     # non-O3 fault tiers (used only when a tier-qualified structure is in
     # ``structures``)
     cache = Child(CacheConfig)

@@ -50,6 +50,15 @@ def _apply_resilience_overrides(orch, args) -> None:
         orch.watchdog.timeout = float(args.dispatch_timeout)
     if getattr(args, "max_retries", None) is not None:
         cfg.max_retries = args.max_retries
+    icfg = orch.icfg
+    if getattr(args, "audit_rate", None) is not None:
+        icfg.audit_rate = args.audit_rate
+    if getattr(args, "audit_threshold", None) is not None:
+        icfg.audit_threshold = args.audit_threshold
+    if getattr(args, "audit_action", None):
+        icfg.audit_action = args.audit_action
+    if getattr(args, "canary_trials", None) is not None:
+        icfg.canary_trials = args.canary_trials
 
 
 def _drive(orch, args) -> int:
@@ -79,6 +88,15 @@ def _drive(orch, args) -> int:
             _log(f"  {d.simpoint}/{d.structure} batch {d.batch_id}: "
                  f"ran on {TIERS[d.tier]} tier "
                  f"({d.attempts} dispatch attempts)")
+        elif event == ExitEvent.INTEGRITY_VIOLATION:
+            from shrewd_tpu.integrity import AuditBudgetInfo
+            if isinstance(payload, AuditBudgetInfo):
+                _log(f"AUDIT MISMATCH BUDGET EXCEEDED: {payload.rate:.1%} "
+                     f"of audited trials disagreed (threshold "
+                     f"{payload.threshold:.1%}, action={payload.action}) "
+                     f"— reasons {payload.reasons}")
+            else:
+                _log(f"INTEGRITY: {payload}")
         elif event == ExitEvent.ESCALATION_EXCEEDED:
             e = payload
             _log(f"ESCALATION BUDGET EXCEEDED: {e.rate:.1%} of trials ran "
@@ -96,9 +114,17 @@ def _drive(orch, args) -> int:
         _log(f"escalation: {esc.escalated}/{esc.total} trials "
              f"({esc.rate():.1%}) ran below the device tier "
              f"({', '.join(f'{t}={int(c)}' for t, c in zip(TIERS, esc.counts))})")
+    mon = orch.monitor
+    if mon.ledger.audited or mon.canary_trials or mon.quarantined:
+        _log(f"integrity: {mon.canary_trials} canary trials "
+             f"({mon.canary_failures} failed), {mon.ledger.audited} "
+             f"audited ({mon.ledger.mismatched} mismatched), "
+             f"{mon.quarantined} batches quarantined "
+             f"({mon.recovered} recovered)")
     if orch.aborted:
-        _log(f"campaign ABORTED by escalation budget after {n_batches} "
-             f"batches in {time.monotonic() - t0:.1f}s"
+        _log(f"campaign ABORTED by "
+             f"{orch.abort_reason or 'escalation budget'} after "
+             f"{n_batches} batches in {time.monotonic() - t0:.1f}s"
              + (f" → {orch.outdir} (resumable)" if orch.outdir else ""))
         return 3
     _log(f"campaign complete: {n_batches} batches in "
@@ -224,6 +250,21 @@ def main(argv: list[str] | None = None) -> int:
     resil.add_argument("--max-retries", type=int, default=None,
                        help="re-dispatch attempts per tier before the "
                             "ladder degrades")
+    resil.add_argument("--audit-rate", type=float, default=None,
+                       help="fraction of each batch re-run on the "
+                            "alternate kernel for the differential audit "
+                            "(plan.integrity.audit_rate, default 0.01; "
+                            "0 disables)")
+    resil.add_argument("--audit-threshold", type=float, default=None,
+                       help="max audited-trial mismatch rate before the "
+                            "run is flagged (plan.integrity)")
+    resil.add_argument("--audit-action", default=None,
+                       choices=("off", "warn", "abort"),
+                       help="what to do when the audit mismatch budget is "
+                            "exceeded (abort exits rc=3, resumable)")
+    resil.add_argument("--canary-trials", type=int, default=None,
+                       help="seed-canary trials salted per batch "
+                            "(0 disables canaries)")
 
     p = sub.add_parser("run", help="run a campaign plan to completion",
                        parents=[common, resil])

@@ -207,8 +207,16 @@ class ChunkedCampaign:
                            ) -> np.ndarray:
         """Per-trial outcome classes (host int32[B_total], key order) —
         bit-identical to the dense kernel's on the same keys."""
+        faults = self.kernel.sampler(structure).sample_batch(keys)
+        return self.outcomes_of_faults(faults)
+
+    def outcomes_of_faults(self, faults) -> np.ndarray:
+        """Fault-level core of ``outcomes_from_keys`` — public so the
+        integrity layer can run *constructed* trials (canary faults whose
+        outcome is known by construction, audit re-runs of sampled faults)
+        through the chunked strategy without inventing keys that would
+        sample them."""
         kernel = self.kernel
-        faults = kernel.sampler(structure).sample_batch(keys)
         f_host = {k: np.asarray(v) for k, v in faults._asdict().items()}
         n_tr = f_host["cycle"].shape[0]
         B = self.lane_width(n_tr)

@@ -281,6 +281,30 @@ class TrialKernel:
                                     np.asarray(res.escaped),
                                     np.asarray(res.overflow))
 
+    def oracle_outcomes(self, faults: Fault) -> np.ndarray:
+        """Per-trial outcomes from the host oracle — the serial C++ golden
+        kernel (the CheckerCPU analog, csrc/) when it covers this kernel,
+        else the dense in-framework oracle.  The trusted reference side of
+        the integrity layer's seed canaries and differential audit
+        (shrewd_tpu/integrity.py): exact semantics, no taint machinery, no
+        escape budget."""
+        if self.memmap is None:
+            try:
+                from shrewd_tpu import native
+
+                f = [np.asarray(x) for x in faults]
+                return np.asarray(native.golden_trials(
+                    self.trace, *f, np.asarray(self.shadow_cov),
+                    compare_regs=self.cfg.compare_regs))
+            except Exception as e:  # noqa: BLE001 — a missing/broken
+                # native build must degrade to the dense oracle, not take
+                # the audit down with it
+                from shrewd_tpu.utils import debug as _debug
+                _debug.dprintf("Integrity",
+                               "native oracle unavailable (%s) — dense "
+                               "fallback", e)
+        return np.asarray(self.run_batch(faults))
+
     def resolve_escapes(self, faults: Fault, outcomes: np.ndarray,
                         esc: np.ndarray, ovf: np.ndarray) -> np.ndarray:
         """Host-side passes 2+3 of the hybrid: row-enabled taint for load

@@ -45,11 +45,19 @@ class ShardedCampaign:
 
     def __init__(self, kernel, mesh, structure: str,
                  resolution: str = "device", stratify: bool = False,
-                 watchdog: DeviceWatchdog | None = None):
+                 watchdog: DeviceWatchdog | None = None,
+                 integrity_check: bool = False):
         """``watchdog`` (resilience.DeviceWatchdog, optional): every jitted
         device step routes through ``watchdog.call`` so a wedged dispatch
         surfaces as ``DispatchTimeout`` in bounded time instead of hanging
-        the campaign loop forever.  None = direct dispatch (no overhead)."""
+        the campaign loop forever.  None = direct dispatch (no overhead).
+
+        ``integrity_check``: the jitted steps additionally return each
+        shard's LOCAL tally (pre-psum), and every ``tally_batch`` verifies
+        the locals sum to the replicated psum result — the shard-vs-psum
+        invariant of the integrity layer (shrewd_tpu/integrity.py).  A
+        mismatch raises ``integrity.IntegrityError``; the extra output is
+        a few dozen integers per batch, so the hot path is unaffected."""
         if resolution not in ("device", "host"):
             raise ValueError(f"unknown resolution {resolution!r}")
         if stratify and not hasattr(kernel, "run_keys_stratified"):
@@ -66,6 +74,9 @@ class ShardedCampaign:
         self.resolution = resolution
         self.stratify = stratify
         self.watchdog = watchdog
+        self.integrity_check = integrity_check
+        self.shard_checks = 0        # shard-vs-psum verifications run
+        self.shard_mismatches = 0    # ... that failed (each also raises)
         self.mode = getattr(getattr(kernel, "cfg", None),
                             "replay_kernel", "dense")
         may_latch = structure == "latch"
@@ -74,11 +85,14 @@ class ShardedCampaign:
             # the traceable campaign protocol (ops.trial.TrialKernel,
             # models.ruby.CacheKernel): keys → per-trial outcome classes
             outs = kernel.outcomes_from_keys(keys, structure)
-            return jax.lax.psum(C.tally(outs), TRIAL_AXIS)
+            t = C.tally(outs)
+            if integrity_check:
+                return jax.lax.psum(t, TRIAL_AXIS), t[None, :]
+            return jax.lax.psum(t, TRIAL_AXIS)
 
         self._step = jax.jit(shard_map(
-            local_step, mesh=mesh,
-            in_specs=P(TRIAL_AXIS), out_specs=P()))
+            local_step, mesh=mesh, in_specs=P(TRIAL_AXIS),
+            out_specs=((P(), P(TRIAL_AXIS)) if integrity_check else P())))
 
         self._taint_step = None
         self._device_step = None
@@ -87,23 +101,31 @@ class ShardedCampaign:
             def strat_step(keys):
                 tally_h, n_unres = kernel.run_keys_stratified(keys,
                                                               structure)
-                return (jax.lax.psum(tally_h, TRIAL_AXIS),
-                        jax.lax.psum(n_unres, TRIAL_AXIS))
+                out = (jax.lax.psum(tally_h, TRIAL_AXIS),
+                       jax.lax.psum(n_unres, TRIAL_AXIS))
+                if integrity_check:
+                    return out + (tally_h[None],)
+                return out
 
             self._strat_step = jax.jit(shard_map(
-                strat_step, mesh=mesh,
-                in_specs=P(TRIAL_AXIS), out_specs=(P(), P())))
+                strat_step, mesh=mesh, in_specs=P(TRIAL_AXIS),
+                out_specs=((P(), P(), P(TRIAL_AXIS)) if integrity_check
+                           else (P(), P()))))
         if self.mode != "dense":
             _ = kernel.golden_rec     # materialize before tracing
             if resolution == "device":
                 def device_step(keys):
                     tally, n_unres = kernel.run_keys_device(keys, structure)
-                    return (jax.lax.psum(tally, TRIAL_AXIS),
-                            jax.lax.psum(n_unres, TRIAL_AXIS))
+                    out = (jax.lax.psum(tally, TRIAL_AXIS),
+                           jax.lax.psum(n_unres, TRIAL_AXIS))
+                    if integrity_check:
+                        return out + (tally[None],)
+                    return out
 
                 self._device_step = jax.jit(shard_map(
-                    device_step, mesh=mesh,
-                    in_specs=P(TRIAL_AXIS), out_specs=(P(), P())))
+                    device_step, mesh=mesh, in_specs=P(TRIAL_AXIS),
+                    out_specs=((P(), P(), P(TRIAL_AXIS)) if integrity_check
+                               else (P(), P()))))
             else:
                 def taint_step(keys):
                     faults = kernel.sampler(structure).sample_batch(keys)
@@ -126,14 +148,30 @@ class ShardedCampaign:
         return self.watchdog.call(
             lambda: jax.block_until_ready(step(*args)))
 
+    def _verify_shards(self, local, total) -> None:
+        """The shard-vs-psum invariant (integrity layer): the locals each
+        shard computed must sum to the replicated reduction everyone
+        received — a corrupted collective or stale donated buffer cannot
+        pass."""
+        from shrewd_tpu import integrity as integ
+
+        self.shard_checks += 1
+        viol = integ.shard_sum_violations(np.asarray(local),
+                                          np.asarray(total))
+        if viol:
+            self.shard_mismatches += 1
+            raise integ.IntegrityError(f"{self.structure}: {viol[0]}")
+
     def tally_batch_stratified(self, keys: jax.Array) -> jax.Array:
         """Sharded keys (B,) → replicated (N_STRATA, N_OUTCOMES) tally for
         the post-stratified estimator; summing over strata reproduces
         ``tally_batch`` exactly (same outcomes, same resolution)."""
         if self._strat_step is None:
             raise ValueError("campaign built without stratify=True")
-        tally_h, n_unres = self._dispatch(
-            self._strat_step, shard_keys(self.mesh, keys))
+        out = self._dispatch(self._strat_step, shard_keys(self.mesh, keys))
+        tally_h, n_unres = out[0], out[1]
+        if self.integrity_check:
+            self._verify_shards(out[2], tally_h)
         if self.mode != "dense":    # dense replay has no escape machinery
             self.kernel.escapes += int(n_unres)
             self.kernel.taint_trials += int(keys.shape[0])
@@ -142,13 +180,21 @@ class ShardedCampaign:
     def tally_batch(self, keys: jax.Array) -> jax.Array:
         """Sharded keys (B,) → replicated tally (N_OUTCOMES,)."""
         if self._device_step is not None:
-            tally, n_unres = self._dispatch(self._device_step,
-                                            shard_keys(self.mesh, keys))
+            out = self._dispatch(self._device_step,
+                                 shard_keys(self.mesh, keys))
+            tally, n_unres = out[0], out[1]
+            if self.integrity_check:
+                self._verify_shards(out[2], tally)
             self.kernel.escapes += int(n_unres)
             self.kernel.taint_trials += int(keys.shape[0])
             return tally
         if self._taint_step is None:
-            return self._dispatch(self._step, shard_keys(self.mesh, keys))
+            out = self._dispatch(self._step, shard_keys(self.mesh, keys))
+            if self.integrity_check:
+                tally, local = out
+                self._verify_shards(local, tally)
+                return tally
+            return out
         keys_sh = shard_keys(self.mesh, keys)
         out, esc, ovf = self._dispatch(self._taint_step, keys_sh)
         out = np.asarray(out).copy()

@@ -373,6 +373,20 @@ class ResilientDispatcher:
         self.retries = 0          # re-dispatches beyond each first attempt
         self.degradations = 0     # tier descents taken
 
+    def sub_ladder(self, below: int) -> "ResilientDispatcher | None":
+        """The ladder restricted to tiers strictly below ``below`` (in
+        descent order), or None when nothing is left.  Used by the
+        integrity layer to re-dispatch a quarantined batch on a tier other
+        than the one that produced the corrupt result; watchdog/config/
+        backoff are shared so health accounting stays campaign-wide."""
+        pos = next((i for i, (t, _) in enumerate(self.tiers) if t == below),
+                   None)
+        if pos is None or pos + 1 >= len(self.tiers):
+            return None
+        return ResilientDispatcher(
+            self.tiers[pos + 1:], self.cfg, watchdog=self.watchdog,
+            backoff=self.backoff, device_deadline=self.device_deadline)
+
     def tally_batch(self, keys, stratified: bool = False) -> DispatchResult:
         attempts = 0
         errors: list[str] = []

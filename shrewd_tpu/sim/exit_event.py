@@ -29,6 +29,14 @@ class ExitEvent(enum.Enum):
     # (payload: EscalationInfo); emitted once, then the run continues
     # (action=warn) or the event stream ends early (action=abort)
     ESCALATION_EXCEEDED = "escalation_exceeded"
+    # a batch failed the integrity layer's canary/invariant checks or the
+    # audit mismatch budget was exceeded (payload: evidence dict from
+    # integrity.IntegrityMonitor, or integrity.AuditBudgetInfo for the
+    # budget gate).  Quarantined batches that recover via re-dispatch emit
+    # this with kind="recovered"; an unrecoverable violation or an
+    # audit_action=abort breach ends the stream after a resumable
+    # checkpoint (rc 3)
+    INTEGRITY_VIOLATION = "integrity_violation"
     # one simpoint finished all structures (payload: simpoint name)
     SIMPOINT_COMPLETE = "simpoint_complete"
     # the whole plan finished (payload: {(simpoint, structure): result})

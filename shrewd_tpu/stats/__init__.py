@@ -362,10 +362,30 @@ def to_dict(group: Group) -> dict:
     return group.to_dict()
 
 
+def _json_safe(v: Any) -> Any:
+    """Strict-JSON projection: ``NaN``/``±inf`` (e.g. ``Distribution.mean``
+    with zero samples) become ``null`` — ``json.dumps``'s non-strict
+    default would emit bare ``NaN``/``Infinity`` tokens that strict
+    parsers reject."""
+    if isinstance(v, dict):
+        return {k: _json_safe(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_json_safe(x) for x in v]
+    if isinstance(v, (np.floating, np.integer)):
+        v = v.item()
+    if isinstance(v, float) and not math.isfinite(v):
+        return None
+    return v
+
+
 def dump_json(group: Group, fileobj=None) -> str:
     """Structured dump (the ``get_simstat`` analog,
-    reference ``python/m5/stats/gem5stats.py:351``)."""
-    text = json.dumps(group.to_dict(), indent=2, default=float)
+    reference ``python/m5/stats/gem5stats.py:351``).  Strict JSON:
+    non-finite values serialize as ``null`` (``allow_nan=False`` enforces
+    the contract — a regression reappearing fails loudly here, not in the
+    consumer's parser)."""
+    text = json.dumps(_json_safe(group.to_dict()), indent=2, default=float,
+                      allow_nan=False)
     if fileobj is not None:
         fileobj.write(text)
     return text

@@ -61,6 +61,11 @@ QUICK_MODULES = {
     # injected-wedge / torn-checkpoint campaign integrations — the
     # failure-path smoke belongs in the on-every-push tier by design
     "test_resilience",
+    # result integrity: invariant/ledger units plus the canary/audit/
+    # quarantine campaign integrations and the v1→v5 upgrader chain —
+    # same rationale as test_resilience (the corruption-path smoke must
+    # run on every push)
+    "test_integrity",
 }
 QUICK_TESTS = {
     # one representative per subsystem (≈4-10 s each, compile-dominated)

@@ -1,0 +1,516 @@
+"""Result-integrity layer (shrewd_tpu/integrity.py + orchestrator wiring).
+
+The contract under test is the ISSUE acceptance criterion: a campaign with
+the differential audit on completes with zero mismatches and reports
+canary/audit/invariant stats; an injected tally corruption (test hook)
+triggers quarantine + re-dispatch with bit-identical recovered tallies; and
+exceeding the audit threshold with audit_action=abort exits rc 3 and
+resumes cleanly from the v5 checkpoint.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from shrewd_tpu import integrity as integ
+from shrewd_tpu import resilience as resil
+from shrewd_tpu.ops import classify as C
+
+
+# --- tally invariants (pure host checks) ------------------------------------
+
+def test_clean_tally_passes_all_invariants():
+    assert integ.tally_violations([10, 3, 2, 1], 16) == []
+    strata = np.zeros((8, 4), np.int64)
+    strata[0] = [10, 3, 2, 1]
+    assert integ.tally_violations([10, 3, 2, 1], 16, strata) == []
+
+
+def test_each_corruption_trips_exactly_one_invariant():
+    # each deliberately corrupted tally trips its own check, exactly once
+    cases = [
+        ([10, 3, 2, 2], 16, "tally sum"),          # sum != batch
+        ([17, -1, 0, 0], 16, "negative"),          # negative count
+        ([float("nan"), 0, 0, 0], 16, "non-finite"),
+        ([15.5, 0.5, 0, 0], 16, "non-integral"),
+    ]
+    for tally, batch, needle in cases:
+        viol = integ.tally_violations(tally, batch)
+        assert len(viol) == 1, (tally, viol)
+        assert needle in viol[0]
+
+
+def test_strata_must_refine_the_pooled_tally():
+    strata = np.zeros((8, 4), np.int64)
+    strata[0] = [9, 3, 2, 1]                       # sums to 15, tally says 16
+    viol = integ.tally_violations([10, 3, 2, 1], 16, strata)
+    assert len(viol) == 1 and "strata" in viol[0]
+
+
+def test_monotone_and_shard_sum_checks():
+    assert integ.monotone_violations([5, 1, 0, 0], [6, 1, 0, 0]) == []
+    assert len(integ.monotone_violations([5, 1, 0, 0], [4, 1, 0, 0])) == 1
+    local = np.asarray([[3, 1, 0, 0], [2, 0, 1, 0]])
+    assert integ.shard_sum_violations(local, [5, 1, 1, 0]) == []
+    assert len(integ.shard_sum_violations(local, [5, 1, 0, 0])) == 1
+
+
+def test_mismatch_ledger_accounting_and_roundtrip():
+    led = integ.MismatchLedger()
+    led.record(10, [])
+    led.record(10, [{"reason": "sdc->masked@oracle", "trial_index": 3}],
+               context={"batch_id": 7})
+    assert led.audited == 20 and led.mismatched == 1
+    assert led.rate() == pytest.approx(0.05)
+    assert led.over(0.01) and not led.over(0.10)
+    assert led.entries[0]["batch_id"] == 7
+    back = integ.MismatchLedger.from_dict(
+        json.loads(json.dumps(led.to_dict())))
+    assert back.audited == 20 and back.reasons == led.reasons
+
+
+def test_evidence_ring_is_bounded():
+    led = integ.MismatchLedger()
+    for i in range(integ.MAX_EVIDENCE + 50):
+        led.record(1, [{"reason": "x", "trial_index": i}])
+    assert led.mismatched == integ.MAX_EVIDENCE + 50   # counters exact
+    assert len(led.entries) == integ.MAX_EVIDENCE      # ring bounded
+
+
+# --- canary construction ------------------------------------------------------
+
+def _kernel(n=96, **cfg_kw):
+    from shrewd_tpu.models.o3 import O3Config
+    from shrewd_tpu.ops.trial import TrialKernel
+    from shrewd_tpu.trace.synth import WorkloadConfig, generate
+
+    t = generate(WorkloadConfig(n=n, nphys=32, mem_words=64,
+                                working_set_words=32, seed=7))
+    return TrialKernel(t, O3Config(**cfg_kw))
+
+
+def test_constructed_canaries_masked_on_dense_kernel():
+    kernel = _kernel()
+    fault, notes = integ.constructed_canaries(kernel)
+    out = np.asarray(kernel.run_batch(fault))
+    assert len(notes) == out.shape[0]
+    for i, note in enumerate(notes):
+        assert int(out[i]) == C.OUTCOME_MASKED, note
+
+
+def test_constructed_canaries_masked_on_hybrid_kernel():
+    kernel = _kernel()
+    fault, notes = integ.constructed_canaries(kernel)
+    out = np.asarray(kernel.run_batch_hybrid(fault))
+    for i, note in enumerate(notes):
+        assert int(out[i]) == C.OUTCOME_MASKED, note
+
+
+def test_constructed_canaries_masked_on_chunked_kernel_ragged():
+    from shrewd_tpu.ops.chunked import ChunkedCampaign
+
+    kernel = _kernel()
+    n = int(kernel.trace.n)
+    chunk = 40
+    assert n % chunk != 0       # the ragged-tail shape the ISSUE pins
+    camp = ChunkedCampaign(kernel, chunk=chunk)
+    fault, notes = integ.constructed_canaries(kernel)
+    out = np.asarray(camp.outcomes_of_faults(fault))
+    for i, note in enumerate(notes):
+        assert int(out[i]) == C.OUTCOME_MASKED, note
+    # the zero-mask canary lands IN-window: it must have replayed its
+    # landing chunk and converged state-equal, not taken the oow shortcut
+    assert camp.last_stats["oow_masked"] == 2
+    assert camp.last_stats["resolved_eq"] >= 1
+
+
+def test_canary_battery_catches_corrupt_tier():
+    """A tier function returning a wrong tally for the frozen seed keys is
+    a canary miss — the whole batch is declared corrupt."""
+    from shrewd_tpu.parallel.campaign import ShardedCampaign
+    from shrewd_tpu.parallel.mesh import make_mesh
+    from shrewd_tpu.utils import prng
+
+    kernel = _kernel()
+    camp = ShardedCampaign(kernel, make_mesh(), "regfile")
+    keys = prng.trial_keys(prng.batch_key(
+        prng.campaign_key(0), integ.CANARY_BATCH_ID), 8)
+    battery = integ.CanaryBattery(camp, "regfile", seed_keys=keys)
+
+    good = lambda k, s: (np.asarray(camp.tally_batch(k)), None)
+    res = battery.check(resil.TIER_DEVICE, good)
+    assert res.ok and res.trials > 0
+
+    def corrupt(k, s):
+        t = np.asarray(camp.tally_batch(k)).copy()
+        t[C.OUTCOME_MASKED] -= 1
+        t[C.OUTCOME_SDC] += 1
+        return t, None
+
+    res = battery.check(resil.TIER_DEVICE, corrupt)
+    assert not res.ok
+    assert any(f["canary"].startswith("seed@") for f in res.failures)
+
+
+def test_shard_consistency_check_raises_on_mismatch():
+    from shrewd_tpu.parallel.campaign import ShardedCampaign
+    from shrewd_tpu.parallel.mesh import make_mesh
+    from shrewd_tpu.utils import prng
+
+    camp = ShardedCampaign(_kernel(), make_mesh(), "regfile",
+                           integrity_check=True)
+    keys = prng.trial_keys(prng.campaign_key(0), 64)
+    tally = np.asarray(camp.tally_batch(keys))
+    assert int(tally.sum()) == 64
+    assert camp.shard_checks == 1 and camp.shard_mismatches == 0
+    with pytest.raises(integ.IntegrityError, match="shard"):
+        camp._verify_shards(np.asarray([[1, 0, 0, 0]]), tally)
+    assert camp.shard_mismatches == 1
+
+
+# --- orchestrator integration -------------------------------------------------
+
+def _tiny_plan(integrity=None, **kw):
+    from shrewd_tpu.campaign.plan import CampaignPlan, WorkloadSpec
+    from shrewd_tpu.trace.synth import WorkloadConfig
+
+    defaults = dict(structures=["regfile"], batch_size=64,
+                    target_halfwidth=0.2, confidence=0.95,
+                    max_trials=128, min_trials=64)
+    defaults.update(kw)
+    plan = CampaignPlan(
+        simpoints=[WorkloadSpec(
+            name="w0", workload=WorkloadConfig(n=96, nphys=32, mem_words=64,
+                                               working_set_words=32,
+                                               seed=7))],
+        **defaults)
+    for k, v in (integrity or {}).items():
+        setattr(plan.integrity, k, v)
+    return plan
+
+
+def _final_results(orch):
+    from shrewd_tpu.sim.exit_event import ExitEvent
+
+    events = list(orch.events())
+    return events, (dict(events[-1][1])
+                    if events[-1][0] is ExitEvent.CAMPAIGN_COMPLETE
+                    else None)
+
+
+@pytest.fixture(scope="module")
+def clean_results():
+    """Reference tallies from an integrity-off run (the bit-identity
+    baseline every integrity-on run must reproduce)."""
+    from shrewd_tpu.campaign.orchestrator import Orchestrator
+
+    orch = Orchestrator(_tiny_plan(
+        integrity=dict(canary_trials=0, audit_rate=0.0, invariants=False)))
+    _, res = _final_results(orch)
+    assert res is not None
+    return res
+
+
+def test_integrity_on_campaign_is_bit_identical_and_audits_clean(
+        clean_results, tmp_path):
+    """The acceptance-criterion core: audit on → zero mismatches, canary/
+    audit/invariant stats in stats.txt, tallies unperturbed (canary keys
+    are drawn from a reserved PRNG stream, audits re-run existing keys)."""
+    from shrewd_tpu.campaign.orchestrator import Orchestrator
+
+    orch = Orchestrator(_tiny_plan(
+        integrity=dict(canary_trials=2, audit_rate=0.05)),
+        outdir=str(tmp_path))
+    _, res = _final_results(orch)
+    assert res is not None
+    for k in clean_results:
+        np.testing.assert_array_equal(clean_results[k].tallies,
+                                      res[k].tallies)
+    mon = orch.monitor
+    assert mon.canary_trials > 0 and mon.canary_failures == 0
+    assert mon.ledger.audited > 0 and mon.ledger.mismatched == 0
+    assert mon.invariant_checks > 0 and mon.invariant_violations == 0
+    assert mon.quarantined == 0
+    orch.write_outputs()
+    text = (tmp_path / "stats.txt").read_text()
+    for name in ("canary_trials", "canary_failures", "audited_trials",
+                 "audit_mismatch_rate", "invariant_checks",
+                 "quarantined_batches"):
+        assert name in text, name
+    # stats.json stays strict-parseable with the integrity group present
+    json.loads((tmp_path / "stats.json").read_text(),
+               parse_constant=lambda s: pytest.fail(f"non-strict {s}"))
+
+
+def test_injected_corruption_quarantines_and_recovers_bit_identical(
+        clean_results):
+    from shrewd_tpu.campaign.orchestrator import Orchestrator
+    from shrewd_tpu.sim.exit_event import ExitEvent
+
+    orch = Orchestrator(_tiny_plan(
+        integrity=dict(canary_trials=0, audit_rate=0.0)))
+
+    def corrupt(t):
+        t = t.copy()
+        t[C.OUTCOME_MASKED] += 7        # breaks sum == batch
+        return t
+
+    orch.monitor.arm_corruption(corrupt)
+    events, res = _final_results(orch)
+    assert res is not None
+    kinds = [e for e, _ in events]
+    assert ExitEvent.INTEGRITY_VIOLATION in kinds
+    payloads = [p for e, p in events
+                if e is ExitEvent.INTEGRITY_VIOLATION]
+    assert any(p.get("kind") == "invariant" for p in payloads)
+    assert any(p.get("kind") == "recovered" for p in payloads)
+    # bit-identical recovery: the requeue re-ran the SAME frozen keys
+    for k in clean_results:
+        np.testing.assert_array_equal(clean_results[k].tallies,
+                                      res[k].tallies)
+    mon = orch.monitor
+    assert mon.quarantined == 1 and mon.requeues == 1 and mon.recovered == 1
+    assert mon.invariant_violations == 1
+
+
+def test_unrecoverable_corruption_aborts_resumably(tmp_path, clean_results):
+    """Corruption that survives every re-dispatch is fatal: resumable
+    checkpoint, no CAMPAIGN_COMPLETE, evidence on disk; a resume with the
+    hook disarmed completes bit-identical."""
+    from shrewd_tpu.campaign.orchestrator import Orchestrator
+    from shrewd_tpu.sim.exit_event import ExitEvent
+
+    orch = Orchestrator(_tiny_plan(
+        integrity=dict(canary_trials=0, audit_rate=0.0, max_requeue=1)),
+        outdir=str(tmp_path))
+    orch.monitor.arm_corruption(lambda t: t + 1, times=100)
+    events = list(orch.events())
+    kinds = [e for e, _ in events]
+    assert orch.aborted and orch.abort_reason == "integrity violation"
+    assert ExitEvent.CAMPAIGN_COMPLETE not in kinds
+    assert ExitEvent.INTEGRITY_VIOLATION in kinds
+    evidence = json.loads(
+        (tmp_path / "integrity_evidence.json").read_text())
+    assert evidence["quarantine"]
+    assert any(q.get("fatal") for q in evidence["quarantine"])
+
+    orch2 = Orchestrator.resume(os.path.join(str(tmp_path),
+                                             "campaign_ckpt"))
+    assert orch2.monitor.quarantined >= 2     # ledger survived resume
+    _, res = _final_results(orch2)
+    assert res is not None
+    for k in clean_results:
+        np.testing.assert_array_equal(clean_results[k].tallies,
+                                      res[k].tallies)
+
+
+def test_audit_threshold_abort_rc3_and_v5_resume(tmp_path, monkeypatch,
+                                                 clean_results):
+    """Exceeding --audit-threshold with --audit-action abort exits rc 3
+    (CLI) and resumes cleanly from the v5 checkpoint once the kernels
+    agree again (re-arm baseline, mirroring the escalation gate)."""
+    from shrewd_tpu import main as cli
+    from shrewd_tpu.campaign.orchestrator import CKPT_VERSION
+
+    plan_path = tmp_path / "plan.json"
+    plan_path.write_text(json.dumps(_tiny_plan().to_dict()))
+    out = tmp_path / "out"
+
+    # force every audited trial to mismatch
+    def fake_audit(self, keys, idx):
+        return [{"trial_index": int(i), "primary": "masked",
+                 "alternate": "sdc", "reason": "masked->sdc@test"}
+                for i in idx]
+
+    monkeypatch.setattr(integ.AuditOracle, "audit", fake_audit)
+    rc = cli.main(["run", str(plan_path), "--outdir", str(out),
+                   "--audit-rate", "0.05", "--audit-threshold", "0.01",
+                   "--audit-action", "abort", "--canary-trials", "0"])
+    assert rc == 3
+    ckpt = out / "campaign_ckpt"
+    doc = resil.load_json_verified(str(ckpt / "campaign.json"))
+    assert doc["version"] == CKPT_VERSION == 5
+    assert doc["integrity"]["ledger"]["mismatched"] > 0
+    evidence = json.loads((out / "integrity_evidence.json").read_text())
+    assert evidence["ledger"]["reasons"]["masked->sdc@test"] > 0
+
+    # healed kernels: the restored mismatch rate is the baseline; clean
+    # audits only lower it, so the resumed run completes (rc 0)
+    monkeypatch.undo()
+    out2 = tmp_path / "out2"
+    rc2 = cli.main(["resume", str(ckpt), "--outdir", str(out2),
+                    "--audit-action", "abort"])
+    assert rc2 == 0
+    stats = json.loads((out2 / "stats.json").read_text())
+    camp = stats["w0"]["regfile"]
+    want = clean_results[("w0", "regfile")].tallies
+    got = [camp["outcomes"][name] for name in C.OUTCOME_NAMES]
+    np.testing.assert_array_equal(want, np.asarray(got, np.int64))
+
+
+def test_canary_dispatch_failure_degrades_not_crashes(monkeypatch,
+                                                      clean_results):
+    """A backend failure DURING the canary run (wedge, transient XLA
+    error) must behave like any dispatch failure — quarantine + requeue
+    down the ladder — never crash the campaign (the PR-1 degradation
+    guarantee extends to the integrity layer's own device work)."""
+    from shrewd_tpu.campaign.orchestrator import Orchestrator
+    from shrewd_tpu.sim.exit_event import ExitEvent
+
+    real_check = integ.CanaryBattery.check
+    calls = [0]
+
+    def flaky(self, tier, fn):
+        calls[0] += 1
+        if calls[0] == 1:
+            raise RuntimeError("transient XLA error")
+        return real_check(self, tier, fn)
+
+    monkeypatch.setattr(integ.CanaryBattery, "check", flaky)
+    orch = Orchestrator(_tiny_plan(
+        integrity=dict(canary_trials=2, audit_rate=0.0)))
+    events, res = _final_results(orch)
+    assert res is not None                   # completed despite the crash
+    payloads = [p for e, p in events
+                if e is ExitEvent.INTEGRITY_VIOLATION]
+    assert any(p.get("kind") == "canary_dispatch" for p in payloads)
+    assert orch.monitor.recovered == 1
+    for k in clean_results:
+        np.testing.assert_array_equal(clean_results[k].tallies,
+                                      res[k].tallies)
+
+
+def test_tier_structure_campaign_with_canaries():
+    """Tier-qualified structures (cache:data) route kernel-facing canary
+    calls through the SUBSTRUCTURE name; constructed canaries and the
+    audit are TrialKernel-only and must skip silently — the seed canary
+    (sharded psum path vs unsharded protocol) still runs."""
+    from shrewd_tpu.campaign.orchestrator import Orchestrator
+
+    orch = Orchestrator(_tiny_plan(
+        structures=["cache:data"], max_trials=64,
+        integrity=dict(canary_trials=2, audit_rate=0.05)))
+    _, res = _final_results(orch)
+    assert res is not None
+    mon = orch.monitor
+    assert mon.canary_trials > 0 and mon.canary_failures == 0
+    assert mon.ledger.audited == 0          # no fault-level API → skipped
+    assert mon.quarantined == 0
+
+
+# --- checkpoint upgrader chain ------------------------------------------------
+
+def test_upgrade_chain_v1_to_v5_roundtrip(tmp_path):
+    from shrewd_tpu.campaign.orchestrator import (CKPT_VERSION,
+                                                  Orchestrator,
+                                                  upgrade_checkpoint)
+
+    orch = Orchestrator(_tiny_plan(
+        integrity=dict(canary_trials=0, audit_rate=0.0)),
+        outdir=str(tmp_path))
+    _, res = _final_results(orch)
+    ckpt = orch.checkpoint()
+    path = os.path.join(ckpt, "campaign.json")
+    doc = resil.load_json_verified(path)
+
+    # strip the document back to v1 shape (no escape counters, no strata,
+    # no tier ledger, no integrity state)
+    for per_s in doc["state"].values():
+        for st_doc in per_s.values():
+            for key in ("escapes", "taint_trials", "strata", "tier_trials"):
+                del st_doc[key]
+    del doc["integrity"]
+    doc["version"] = 1
+
+    up = json.loads(json.dumps(doc))
+    upgrade_checkpoint(up)
+    assert up["version"] == CKPT_VERSION == 5
+    for per_s in up["state"].values():
+        for st_doc in per_s.values():
+            assert st_doc["escapes"] == 0 and st_doc["taint_trials"] == 0
+            assert st_doc["strata"] is None
+            assert st_doc["tier_trials"] == [0] * len(resil.TIERS)
+    assert up["integrity"] is None      # pre-v5 history reads as unaudited
+
+    # a v1 document on disk resumes through the whole chain
+    doc["checksum"] = resil.doc_checksum(doc)
+    resil.write_json_atomic(path, doc)
+    prev = os.path.join(ckpt, "campaign.prev.json")
+    if os.path.exists(prev):      # the v5 prev would shadow the v1 doc
+        os.unlink(prev)
+    orch2 = Orchestrator.resume(ckpt)
+    assert orch2.monitor.ledger.audited == 0
+    st = orch2.state[("w0", "regfile")]
+    assert st.trials == res[("w0", "regfile")].trials
+
+
+def test_unknown_version_still_raises():
+    from shrewd_tpu.campaign.orchestrator import upgrade_checkpoint
+
+    with pytest.raises(ValueError, match="no upgrade path"):
+        upgrade_checkpoint({"version": -1})
+
+
+def test_torn_latest_falls_back_then_resumes_with_ledger(tmp_path,
+                                                         clean_results):
+    """Kill-mid-checkpoint with integrity state present: the torn latest
+    is detected, resume falls back to .prev (quarantine/audit ledger
+    intact), and the finished campaign is bit-identical."""
+    from shrewd_tpu.campaign.orchestrator import Orchestrator
+    from shrewd_tpu.sim.exit_event import ExitEvent
+
+    plan = _tiny_plan(checkpoint_every=1, target_halfwidth=0.001,
+                      max_trials=192,
+                      integrity=dict(canary_trials=0, audit_rate=0.05))
+    clean = Orchestrator(_tiny_plan(
+        target_halfwidth=0.001, max_trials=192,
+        integrity=dict(canary_trials=0, audit_rate=0.0,
+                       invariants=False)))
+    _, want = _final_results(clean)
+
+    orch = Orchestrator(plan, outdir=str(tmp_path))
+    orch.monitor.arm_corruption(lambda t: t - 1)   # one quarantine early
+    ckpts = 0
+    ckpt_dir = None
+    for ev, payload in orch.events():
+        if ev is ExitEvent.CHECKPOINT:
+            ckpts += 1
+            ckpt_dir = payload
+            if ckpts == 2:
+                break
+    assert ckpt_dir is not None
+    latest = os.path.join(ckpt_dir, "campaign.json")
+    blob = open(latest).read()
+    with open(latest, "w") as f:
+        f.write(blob[:len(blob) // 3])
+
+    orch2 = Orchestrator.resume(ckpt_dir)
+    mon = orch2.monitor
+    assert mon.quarantined == 1 and mon.ledger.audited > 0   # ledger there
+    _, res = _final_results(orch2)
+    assert res is not None
+    for k in want:
+        np.testing.assert_array_equal(want[k].tallies, res[k].tallies)
+
+
+# --- probe --canary -----------------------------------------------------------
+
+def test_backend_probe_canary_reports_trustworthy():
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "backend_probe.py"),
+         "--platform", "cpu", "--timeout", "150", "--canary"],
+        capture_output=True, text=True, timeout=200,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert proc.returncode == 0, proc.stderr[-500:]
+    verdict = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert verdict["ok"] is True
+    assert verdict["integrity"]["trustworthy"] is True
+    assert verdict["integrity"]["canaries"] == 3
+    assert verdict["integrity"]["canary_misses"] == []
+    assert verdict["integrity"]["invariant_violations"] == []

@@ -277,12 +277,19 @@ def _tiny_plan(**kw):
                     target_halfwidth=0.2, confidence=0.95,
                     max_trials=128, min_trials=64)
     defaults.update(kw)
-    return CampaignPlan(
+    plan = CampaignPlan(
         simpoints=[WorkloadSpec(
             name="w0", workload=WorkloadConfig(n=96, nphys=32, mem_words=64,
                                                working_set_words=32,
                                                seed=7))],
         **defaults)
+    # canaries/audit off: these tests target the resilience ladder, and
+    # the integrity layer's per-campaign canary/audit compiles would only
+    # slow the failure-path smoke (tests/test_integrity.py owns that
+    # coverage; the free tally invariants stay on)
+    plan.integrity.canary_trials = 0
+    plan.integrity.audit_rate = 0.0
+    return plan
 
 
 def _final_results(orch):
@@ -489,7 +496,7 @@ def test_resume_with_no_valid_checkpoint_raises(tmp_path):
         Orchestrator.resume(ckpt)
 
 
-def test_checkpoint_v4_format_and_v3_upgrade(tmp_path):
+def test_checkpoint_format_and_v3_upgrade(tmp_path):
     from shrewd_tpu.campaign.orchestrator import (CKPT_VERSION, Orchestrator,
                                                   upgrade_checkpoint)
 
@@ -497,20 +504,24 @@ def test_checkpoint_v4_format_and_v3_upgrade(tmp_path):
     list(orch.events())
     ckpt = orch.checkpoint()
     doc = resil.load_json_verified(os.path.join(ckpt, "campaign.json"))
-    assert doc["version"] == CKPT_VERSION == 4
+    assert doc["version"] == CKPT_VERSION == 5
     assert doc["checksum"] == resil.doc_checksum(doc)
+    assert "integrity" in doc                     # v5: monitor state rides
     for per_s in doc["state"].values():
         for st_doc in per_s.values():
             assert len(st_doc["tier_trials"]) == len(TIERS)
 
-    # a v3-era document (no tier provenance) upgrades to zeroed ledgers —
-    # old trials must NOT be attributed to the device tier
+    # a v3-era document (no tier provenance, no integrity state) upgrades
+    # to zeroed ledgers — old trials must NOT be attributed to the device
+    # tier, and pre-v5 history must read as unaudited
     for per_s in doc["state"].values():
         for st_doc in per_s.values():
             del st_doc["tier_trials"]
+    del doc["integrity"]
     doc["version"] = 3
     upgrade_checkpoint(doc)
-    assert doc["version"] == 4
+    assert doc["version"] == 5
+    assert doc["integrity"] is None
     for per_s in doc["state"].values():
         for st_doc in per_s.values():
             assert st_doc["tier_trials"] == [0] * len(TIERS)

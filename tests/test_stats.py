@@ -164,6 +164,25 @@ def test_json_dump():
     assert "inject_cycle" in d["o3"]
 
 
+def test_json_dump_serializes_non_finite_as_null():
+    """A Distribution with zero samples has mean()/stdev() = NaN and
+    min/max = ±inf; json.dumps' non-strict default would emit bare
+    NaN/Infinity tokens that strict parsers reject — they must land as
+    null (regression: stats.json from any fresh campaign group)."""
+    from shrewd_tpu.stats import Distribution, Formula, Group
+
+    g = Group("c")
+    g.lat = Distribution("lat", 0, 10, 5, "empty distribution")
+    g.bad = Formula("bad", lambda: float("inf"), "derived inf")
+    text = stats.dump_json(g)
+    d = json.loads(text, parse_constant=lambda s: pytest.fail(
+        f"non-strict JSON token {s!r} leaked into stats.json"))
+    assert d["lat"]["mean"] is None
+    assert d["lat"]["min"] is None and d["lat"]["max"] is None
+    assert d["bad"] is None
+    assert d["lat"]["samples"] == 0          # finite values untouched
+
+
 def test_dump_hdf5_roundtrip(tmp_path):
     """HDF5 backend (reference src/base/stats/hdf5.cc analog)."""
     import numpy as np

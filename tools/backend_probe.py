@@ -17,9 +17,17 @@ Design rules learned the hard way (VERDICT r3 weak #1):
 - Exactly one JSON line on stdout, always:
       {"platform": ..., "ok": bool, "seconds": ..., "device"|"error": ...}
 
+With ``--canary`` the probe also runs one canary batch through the trial
+kernel (shrewd_tpu/integrity.py: constructed MASKED-by-construction faults
+plus a tally-invariant check on a real key batch), so operators can
+distinguish "backend up" from "backend *trustworthy*" before committing a
+campaign to it.  The JSON verdict then carries an ``integrity`` object and
+``ok`` goes false on any canary miss.
+
 Usage:
     python tools/backend_probe.py --platform axon --timeout 55
     python tools/backend_probe.py --platform cpu   # rc 0 healthy, 3 not
+    python tools/backend_probe.py --platform cpu --canary --timeout 180
 """
 
 from __future__ import annotations
@@ -36,7 +44,36 @@ def emit(obj: dict) -> None:
     print(json.dumps(obj), flush=True)
 
 
-def probe(platform: str, timeout: float) -> int:
+def _canary_check() -> dict:
+    """One canary batch on the selected backend (requires the repo on the
+    path — the probe may be launched from anywhere)."""
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import numpy as np
+
+    from shrewd_tpu import integrity as integ
+    from shrewd_tpu.models.o3 import O3Config
+    from shrewd_tpu.ops import classify as C
+    from shrewd_tpu.ops.trial import TrialKernel
+    from shrewd_tpu.trace.synth import WorkloadConfig, generate
+    from shrewd_tpu.utils import prng
+
+    trace = generate(WorkloadConfig(n=64, nphys=32, mem_words=64,
+                                    working_set_words=32, seed=11))
+    kernel = TrialKernel(trace, O3Config(pallas="off"))
+    fault, notes = integ.constructed_canaries(kernel)
+    out = np.asarray(kernel.run_batch(fault))
+    misses = [notes[i] for i in range(len(notes))
+              if int(out[i]) != C.OUTCOME_MASKED]
+    keys = prng.trial_keys(prng.campaign_key(0), 16)
+    tally = np.asarray(kernel.run_keys(keys, "regfile"))
+    viol = integ.tally_violations(tally, 16)
+    return {"canaries": len(notes), "canary_misses": misses,
+            "invariant_violations": viol,
+            "trustworthy": not misses and not viol}
+
+
+def probe(platform: str, timeout: float, canary: bool = False) -> int:
     t0 = time.monotonic()
 
     def _watchdog():
@@ -57,15 +94,20 @@ def probe(platform: str, timeout: float) -> int:
         dev = jax.devices()[0]
         val = int(jax.numpy.add(20, 22))       # one trivial device op
         assert val == 42
+        integrity = _canary_check() if canary else None
     except Exception as e:  # noqa: BLE001 — any failure is "unhealthy"
         emit({"platform": platform, "ok": False,
               "seconds": round(time.monotonic() - t0, 1),
               "error": f"{type(e).__name__}: {str(e)[:300]}"})
         return 3
-    emit({"platform": platform, "ok": True,
-          "seconds": round(time.monotonic() - t0, 1),
-          "device": str(dev)})
-    return 0
+    verdict = {"platform": platform,
+               "ok": integrity["trustworthy"] if integrity else True,
+               "seconds": round(time.monotonic() - t0, 1),
+               "device": str(dev)}
+    if integrity is not None:
+        verdict["integrity"] = integrity
+    emit(verdict)
+    return 0 if verdict["ok"] else 3
 
 
 def probe_subprocess(platform: str, timeout: float,
@@ -97,8 +139,11 @@ def main() -> int:
         "JAX_PLATFORMS", "cpu"), help="jax platform to probe")
     ap.add_argument("--timeout", type=float, default=55.0,
                     help="self-exit watchdog seconds")
+    ap.add_argument("--canary", action="store_true",
+                    help="also run one canary batch (integrity layer): "
+                         "'backend up' vs 'backend trustworthy'")
     args = ap.parse_args()
-    return probe(args.platform, args.timeout)
+    return probe(args.platform, args.timeout, canary=args.canary)
 
 
 if __name__ == "__main__":
