@@ -16,4 +16,45 @@ cd "$(dirname "$0")/.." || exit 1
 timeout -k 10 180 env JAX_PLATFORMS=cpu python tools/backend_probe.py --platform cpu --timeout 120 \
   || echo "WARNING: backend_probe smoke failed (non-fatal)"
 
+# Non-fatal chaos smoke: a single-process campaign with two injected
+# faults (a permanent device-tier failure and a corrupt batch tally) must
+# finish with a tally bit-identical to the undisturbed run — the fastest
+# end-to-end proof that the ladder and the integrity quarantine still
+# compose (shrewd_tpu/chaos.py).  Never affects the pass/fail status.
+timeout -k 10 240 env JAX_PLATFORMS=cpu python - <<'CHAOS_SMOKE' \
+  || echo "WARNING: chaos smoke failed (non-fatal)"
+import numpy as np
+from shrewd_tpu.campaign.orchestrator import Orchestrator
+from shrewd_tpu.campaign.plan import CampaignPlan, WorkloadSpec
+from shrewd_tpu.chaos import ChaosEngine
+from shrewd_tpu.trace.synth import WorkloadConfig
+
+def plan():
+    p = CampaignPlan(
+        simpoints=[WorkloadSpec(name="w0", workload=WorkloadConfig(
+            n=64, nphys=32, mem_words=64, working_set_words=32, seed=3))],
+        structures=["regfile"], batch_size=32, target_halfwidth=0.5,
+        max_trials=64, min_trials=64)
+    p.integrity.canary_trials = 0
+    p.integrity.audit_rate = 0.0
+    p.resilience.backoff_base = 0.0
+    return p
+
+clean = dict(list(Orchestrator(plan()).events())[-1][1])
+orch = Orchestrator(plan())
+orch.attach_chaos(ChaosEngine({"faults": [
+    {"kind": "backend_error", "at_batch": 0, "tier": "device",
+     "permanent": True},
+    {"kind": "corrupt_tally", "at_batch": 1, "delta": 1},
+]}))
+res = dict(list(orch.events())[-1][1])
+for k in clean:
+    np.testing.assert_array_equal(clean[k].tallies, res[k].tallies)
+assert orch.chaos.injected == {"backend_error": 1, "corrupt_tally": 1}, \
+    orch.chaos.injected
+assert orch.chaos.survived == orch.chaos.injected, orch.chaos.survived
+print(f"chaos smoke: injected {orch.chaos.injected} -> survived, "
+      "tally bit-identical")
+CHAOS_SMOKE
+
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

@@ -20,10 +20,12 @@ from __future__ import annotations
 
 import os
 import time
+import warnings
 from typing import Iterator, NamedTuple
 
 import numpy as np
 
+from shrewd_tpu import chaos as chaosmod
 from shrewd_tpu import integrity as integ
 from shrewd_tpu import resilience as resil
 from shrewd_tpu import stats as statsmod
@@ -31,9 +33,10 @@ from shrewd_tpu.campaign.plan import COHERENCE_SP_NAME, CampaignPlan
 from shrewd_tpu.models.o3 import STRUCTURES
 from shrewd_tpu.ops import classify as C
 from shrewd_tpu.ops.trial import TrialKernel
+from shrewd_tpu.parallel import elastic as elastic_mod
 from shrewd_tpu.parallel import stopping
 from shrewd_tpu.parallel.campaign import ShardedCampaign
-from shrewd_tpu.parallel.mesh import make_mesh
+from shrewd_tpu.parallel.mesh import make_mesh, round_up_to_mesh
 from shrewd_tpu.resilience import TIERS
 from shrewd_tpu.sim.exit_event import ExitEvent
 from shrewd_tpu.utils import probes
@@ -233,6 +236,18 @@ class Orchestrator:
         self.plan = plan
         self.mesh = mesh if mesh is not None else make_mesh()
         self.outdir = outdir
+        # the plan's batch_size need not divide the mesh (and cannot be
+        # expected to once elastic re-meshing shrinks the device count):
+        # round up to the next mesh multiple instead of crashing at the
+        # first shard_keys call.  PRNG note: the effective batch size is a
+        # pure function of (plan, mesh size), so reproducibility holds —
+        # re-run on the same mesh, or checkpoint/resume, sees the same keys
+        self.batch_size = round_up_to_mesh(plan.batch_size, self.mesh.size)
+        if self.batch_size != plan.batch_size:
+            warnings.warn(
+                f"plan batch_size {plan.batch_size} is not divisible by "
+                f"the {self.mesh.size}-device mesh — rounded up to "
+                f"{self.batch_size}", RuntimeWarning, stacklevel=2)
         self._per_sp = [s for s in plan.structures if not _is_plan_level(s)]
         self._plan_level = [s for s in plan.structures if _is_plan_level(s)]
         self.state: dict[tuple[str, str], _State] = {
@@ -272,6 +287,21 @@ class Orchestrator:
         # rate falls below its restored baseline
         self._audit_flagged = False
         self._audit_baseline = 0.0
+        # graceful preemption (SIGTERM/SIGINT drain): the handler only
+        # sets a flag, the loop finishes its in-flight batch, checkpoints
+        # and ends the stream with ExitEvent.PREEMPTED (CLI rc 4)
+        self._drain = False
+        self.preempted = False
+        # deterministic chaos harness (chaos.py): injected faults fire at
+        # hook points in the watchdog/ladder/integrity/checkpoint paths
+        self.chaos: chaosmod.ChaosEngine | None = None
+        eng = plan.chaos.build()
+        if eng is not None:
+            self.attach_chaos(eng)
+        # elastic multi-host context (parallel/elastic.py): when attached,
+        # batches are leased from the shared board instead of computed
+        # unconditionally, and peer results are adopted bit-identically
+        self._elastic = None
         # probe points (utils/probes; gem5 ProbePoint pattern): listeners
         # attach without the orchestrator knowing who observes.  Payloads
         # are batch-granular — BatchInfo / StructureResult / ckpt path.
@@ -281,6 +311,53 @@ class Orchestrator:
         self.pp_checkpoint = self.probes.add_point("Checkpoint")
         self.pp_degraded = self.probes.add_point("BackendDegraded")
         self._build_stats()
+
+    # --- chaos / elastic / preemption attachment ---
+
+    def attach_chaos(self, engine: chaosmod.ChaosEngine) -> None:
+        """Wire the deterministic fault-injection engine into every hook
+        point this orchestrator owns (watchdog wedges; the per-campaign
+        ladders pick the engine up lazily at construction)."""
+        self.chaos = engine
+        self.watchdog.chaos = engine
+
+    def attach_elastic(self, ctx) -> None:
+        """Join an elastic campaign: heartbeats start now (liveness must
+        be visible before the first lease claim)."""
+        self._elastic = ctx
+        # a chaos engine built from plan config predates the worker name;
+        # adopt it so worker-targeted faults (kill_worker) aim correctly
+        if self.chaos is not None and not self.chaos.worker:
+            self.chaos.worker = ctx.worker
+        ctx.start()
+
+    def request_drain(self) -> None:
+        """Ask the drive loop to stop at the next batch boundary, write a
+        resumable checkpoint and end the stream (the graceful-preemption
+        path; idempotent)."""
+        self._drain = True
+
+    def install_signal_handlers(self):
+        """SIGTERM/SIGINT → graceful drain (finish the in-flight batch,
+        checkpoint, exit resumable).  A second signal raises
+        KeyboardInterrupt — the operator's escape hatch.  Returns a
+        restore callable; no-op outside the main thread (signals cannot
+        be installed there)."""
+        import signal
+
+        def _handler(signum, frame):
+            if self._drain:
+                raise KeyboardInterrupt
+            self._drain = True
+            debug.dprintf("Campaign", "signal %s: draining to checkpoint",
+                          signum)
+
+        try:
+            prev = {s: signal.signal(s, _handler)
+                    for s in (signal.SIGTERM, signal.SIGINT)}
+        except ValueError:        # not the main thread
+            return lambda: None
+        return lambda: [signal.signal(s, h) for s, h in prev.items()]
 
     # --- stats tree (statistics::Group bound to the object tree) ---
 
@@ -324,6 +401,46 @@ class Orchestrator:
             "retries",
             lambda: sum(d.retries for d in self._dispatchers.values()),
             "re-dispatch attempts beyond each first try")
+        rg.leaked_threads = statsmod.Formula(
+            "leaked_threads", lambda: self.watchdog.leaked_threads,
+            "abandoned watchdog dispatch threads still alive")
+        # chaos accounting: what the deterministic failure plan injected
+        # and what the stack survived — a chaos run is self-describing
+        # from this group alone (empty dicts when no plan is attached)
+        cg = statsmod.Group("chaos")
+        self.stats.chaos = cg
+        cg.injected = statsmod.Formula(
+            "injected",
+            lambda: dict(self.chaos.injected) if self.chaos else {},
+            "faults injected per kind (chaos plan)")
+        cg.survived = statsmod.Formula(
+            "survived",
+            lambda: dict(self.chaos.survived) if self.chaos else {},
+            "injected faults the stack recovered from, per kind")
+        cg.dispatches = statsmod.Formula(
+            "dispatches",
+            lambda: self.chaos.dispatches if self.chaos else 0,
+            "batches this process computed under the chaos schedule")
+        # elastic accounting: membership/lease ledgers (zeros when the
+        # campaign is not elastic)
+        eg = statsmod.Group("elastic")
+        self.stats.elastic = eg
+        for name, desc in (
+                ("workers_lost", "peers declared lost (heartbeat stale)"),
+                ("leases_claimed", "batch leases this worker won"),
+                ("leases_adopted", "peer-computed batches adopted"),
+                ("leases_revoked", "lost workers' leases revoked"),
+                ("batches_reclaimed",
+                 "revoked batches this worker re-dispatched")):
+            setattr(eg, name, statsmod.Formula(
+                name,
+                lambda n=name: (self._elastic.counters()[n]
+                                if self._elastic else 0), desc))
+        eg.collective_timeouts = statsmod.Formula(
+            "collective_timeouts",
+            lambda: sum(c.collective_timeouts
+                        for c in self._campaigns.values()),
+            "sharded-step deadlines (possible lost-peer symptom)")
         # result-integrity accounting: the 'and the tallies were audited'
         # ledger (integrity.IntegrityMonitor) — canary outcomes, invariant
         # checks, differential-audit mismatches, quarantine/recovery
@@ -457,7 +574,7 @@ class Orchestrator:
         if key not in self._dispatchers:
             self._dispatchers[key] = resil.dispatcher_for_campaign(
                 self.campaign(sp_idx, structure), self.rcfg,
-                watchdog=self.watchdog)
+                watchdog=self.watchdog, chaos=self.chaos)
         return self._dispatchers[key]
 
     def checked_dispatcher(self, sp_idx: int, sp_name: str, structure: str
@@ -494,8 +611,8 @@ class Orchestrator:
                 if st.done:
                     continue
                 yield from self._run_structure(sp_idx, sp.name, structure, st)
-                if self.aborted:
-                    return    # escalation budget: no CAMPAIGN_COMPLETE
+                if self.aborted or self.preempted:
+                    return    # budget abort / drain: no CAMPAIGN_COMPLETE
             yield ExitEvent.SIMPOINT_COMPLETE, sp.name
         if self._plan_level:
             # coherence tiers (mesi:/noc:) measure plan-level synthetic
@@ -506,7 +623,7 @@ class Orchestrator:
                     continue
                 yield from self._run_structure(
                     _COHERENCE_SP_ID, COHERENCE_SP_NAME, structure, st)
-                if self.aborted:
+                if self.aborted or self.preempted:
                     return
             yield ExitEvent.SIMPOINT_COMPLETE, COHERENCE_SP_NAME
         yield ExitEvent.CAMPAIGN_COMPLETE, dict(self.results)
@@ -561,24 +678,35 @@ class Orchestrator:
                        else ExitEvent.MAX_TRIALS), result
                 return
 
-            keys = prng.trial_keys(prng.batch_key(sk, st.next_batch),
-                                   plan.batch_size)
-            # per-structure DELTAS of the kernel's shared running escape
-            # counters (one kernel serves every structure of a simpoint,
-            # and resume restores prior counts — assignment would clobber)
-            esc0 = int(getattr(camp.kernel, "escapes", 0))
-            tt0 = int(getattr(camp.kernel, "taint_trials", 0))
-            # dispatch through the integrity-checked resilience ladder:
-            # retries/backoff on the device tier, then CPU-JAX, then the
-            # host oracle — the same frozen keys on every tier, so the
-            # tally is bit-identical regardless of where it ran; canaries,
-            # tally invariants and the sampled differential audit run on
-            # every batch before its tally is believed
+            # graceful preemption: the drain flag is only ever honored at
+            # a batch boundary (the Drainable posture — no device work in
+            # flight), so the in-flight batch always completes first
+            if self._drain:
+                self.preempted = True
+                ckpt = self.checkpoint() if self.outdir else None
+                yield ExitEvent.PREEMPTED, ckpt
+                return
+            # obtain this batch's believed tally: locally through the
+            # integrity-checked resilience ladder, or — in an elastic
+            # campaign — through the lease board (compute it under a
+            # lease, or adopt a peer's published result; either way the
+            # tally is a pure function of the frozen keys, so the
+            # cumulative state is bit-identical to a single-worker run)
             try:
-                res = self.checked_dispatcher(
-                    sp_idx, sp_name, structure).tally_batch(
-                        keys, stratified=camp.stratify,
-                        batch_id=st.next_batch)
+                if self._elastic is not None:
+                    doc, adopted = self._elastic_obtain(
+                        sp_idx, sp_name, structure, st, camp)
+                else:
+                    doc = self._compute_batch(sp_idx, sp_name, structure,
+                                              camp, sk, st.next_batch)
+                    adopted = False
+            except elastic_mod.DrainRequested:
+                # SIGTERM while blocked on a peer's lease: drain NOW (the
+                # scheduler's kill grace is shorter than any claim wait)
+                self.preempted = True
+                ckpt = self.checkpoint() if self.outdir else None
+                yield ExitEvent.PREEMPTED, ckpt
+                return
             except integ.IntegrityError:
                 # unrecoverable corruption: every re-dispatch failed the
                 # checks.  The corrupt batch is NOT counted; leave the
@@ -592,11 +720,32 @@ class Orchestrator:
                 if self.outdir:
                     self.checkpoint()
                 return
-            if camp.stratify:
+            # elastic bit-identity guard: the effective batch size is
+            # rounded to the LOCAL mesh, so workers with different device
+            # counts would lease differently-sized (differently-KEYED)
+            # batches under the same batch_id — silently corrupting the
+            # trials accounting and the pure-function-of-coordinates
+            # contract.  Refuse loudly; the fix is a plan batch_size
+            # divisible by every worker's mesh.
+            if adopted and int(doc.get("batch_size",
+                                       self.batch_size)) != self.batch_size:
+                from shrewd_tpu.parallel.elastic import ElasticError
+                raise ElasticError(
+                    f"adopted batch {doc.get('batch_id')} of "
+                    f"{sp_name}/{structure} was computed with "
+                    f"batch_size={doc.get('batch_size')} by "
+                    f"{doc.get('worker')!r}, but this worker's effective "
+                    f"batch_size is {self.batch_size} (mesh size "
+                    f"{self.mesh.size}) — elastic workers must agree on "
+                    "the effective batch size; pick a plan batch_size "
+                    "divisible by every worker's mesh")
+            if camp.stratify and doc.get("strata") is not None:
+                sarr = np.asarray(doc["strata"], dtype=np.int64)
                 if st.strata is None:
-                    st.strata = np.zeros_like(res.strata)
-                st.strata += res.strata
-            tally = res.tally
+                    st.strata = np.zeros_like(sarr)
+                st.strata += sarr
+            tally = np.asarray(doc["tally"], dtype=np.int64)
+            tier = int(doc.get("tier", resil.TIER_DEVICE))
             # cumulative-monotonicity invariant: belt-and-braces over the
             # per-batch checks (a non-negative tally cannot regress the
             # cumulative counters, so a trip here means host-side state
@@ -624,24 +773,29 @@ class Orchestrator:
                     return
             st.tallies += tally
             st.next_batch += 1
-            st.escapes += int(getattr(camp.kernel, "escapes", 0)) - esc0
-            st.taint_trials += (int(getattr(camp.kernel, "taint_trials", 0))
-                                - tt0)
-            st.tier_trials[res.tier] += plan.batch_size
-            self.budget.record(res.tier, plan.batch_size)
-            sg.trials += plan.batch_size
+            st.escapes += int(doc.get("escapes", 0))
+            st.taint_trials += int(doc.get("taint_trials", 0))
+            st.tier_trials[tier] += self.batch_size
+            self.budget.record(tier, self.batch_size)
+            sg.trials += self.batch_size
             sg.outcomes += tally
-            sg.tiers.add(res.tier, plan.batch_size)
+            sg.tiers.add(tier, self.batch_size)
             avf_live = float(C.avf(st.tallies))
             debug.dprintf("Campaign", "%s/%s batch %d: trials=%d avf=%.4f"
-                          " tier=%s", sp_name, structure, st.next_batch,
-                          st.trials, avf_live, TIERS[res.tier])
+                          " tier=%s%s", sp_name, structure, st.next_batch,
+                          st.trials, avf_live, TIERS[tier],
+                          " (adopted)" if adopted else "")
+            # elastic membership changes observed while obtaining this
+            # batch surface as typed events (the re-mesh announcement)
+            if self._elastic is not None:
+                for lost in self._elastic.take_lost():
+                    yield ExitEvent.WORKER_LOST, lost
             info = BatchInfo(
                 sp_name, structure, st.next_batch - 1, st.trials,
-                st.tallies.copy(), avf_live, res.tier)
-            if res.tier != resil.TIER_DEVICE:
+                st.tallies.copy(), avf_live, tier)
+            if tier != resil.TIER_DEVICE and not adopted:
                 dinfo = DegradeInfo(sp_name, structure, st.next_batch - 1,
-                                    res.tier, res.attempts)
+                                    tier, int(doc.get("attempts", 1)))
                 self.pp_degraded.notify(dinfo)
                 yield ExitEvent.BACKEND_DEGRADED, dinfo
             self.pp_batch.notify(info)
@@ -701,6 +855,121 @@ class Orchestrator:
                 self.pp_checkpoint.notify(ckpt)
                 yield ExitEvent.CHECKPOINT, ckpt
 
+    def _compute_batch(self, sp_idx: int, sp_name: str, structure: str,
+                       camp, sk, batch_id: int) -> dict:
+        """Dispatch ONE batch through the integrity-checked resilience
+        ladder and return its believed result as a JSON-serializable
+        document (the lease board's publish format; the local path uses
+        the same shape so accumulation is one code path).
+
+        Chaos hook point: faults armed for this batch fire here — the
+        wedge inside the watchdog, per-tier BackendErrors inside the
+        ladder, tally corruption inside the checked dispatcher, and the
+        worker kill at the boundary before any work."""
+        if self.chaos is not None:
+            self.chaos.begin_batch(batch_id, sp_name, structure)
+            self.chaos.maybe_kill()
+            cspec = self.chaos.take_corrupt_tally()
+            if cspec is not None:
+                delta = int(cspec.get("delta", 1))
+                self.monitor.arm_corruption(
+                    lambda t, d=delta: t + d, times=1,
+                    note=lambda: self.chaos.note_fired("corrupt_tally"))
+        keys = prng.trial_keys(prng.batch_key(sk, batch_id),
+                               self.batch_size)
+        # per-structure DELTAS of the kernel's shared running escape
+        # counters (one kernel serves every structure of a simpoint, and
+        # resume restores prior counts — assignment would clobber)
+        esc0 = int(getattr(camp.kernel, "escapes", 0))
+        tt0 = int(getattr(camp.kernel, "taint_trials", 0))
+        res = self.checked_dispatcher(sp_idx, sp_name, structure
+                                      ).tally_batch(
+            keys, stratified=camp.stratify, batch_id=batch_id)
+        if self.chaos is not None:
+            # the tally was believed (checks passed, quarantine
+            # recovered): every fault that fired this batch was survived
+            self.chaos.end_batch()
+        return {
+            "batch_id": int(batch_id),
+            "batch_size": int(self.batch_size),
+            "tally": np.asarray(res.tally, dtype=np.int64).tolist(),
+            "strata": (None if res.strata is None
+                       else np.asarray(res.strata, np.int64).tolist()),
+            "tier": int(res.tier),
+            "attempts": int(res.attempts),
+            "escapes": int(getattr(camp.kernel, "escapes", 0)) - esc0,
+            "taint_trials": (int(getattr(camp.kernel, "taint_trials", 0))
+                             - tt0),
+        }
+
+    def _elastic_obtain(self, sp_idx: int, sp_name: str, structure: str,
+                        st: _State, camp) -> tuple[dict, bool]:
+        """One batch through the lease board: adopt the published result
+        for ``st.next_batch`` or claim and compute it; while blocked on a
+        live peer, speculate up to ``lookahead`` batches ahead (their
+        published results are adopted when accumulation reaches them).
+        Lost peers' leases are revoked en route (ElasticContext.obtain)."""
+        ctx = self._elastic
+        sk = self._structure_prng_key(sp_idx, structure)
+        target = st.next_batch
+        spec_state = {"next": target + 1}
+
+        def compute_for(batch_id):
+            return self._compute_batch(sp_idx, sp_name, structure, camp,
+                                       sk, batch_id)
+
+        # speculation never runs past the last batch the stopping rule
+        # could possibly consume (the max_trials ceiling) — batches past
+        # it would be fully computed and never accumulated by anyone
+        ceiling = -(-int(self.plan.max_trials) // self.batch_size)
+
+        def speculate() -> bool:
+            while spec_state["next"] < min(target + 1 + ctx.cfg.lookahead,
+                                           ceiling):
+                b = spec_state["next"]
+                spec_state["next"] += 1
+                k = ctx.key(sp_name, structure, b)
+                if ctx.board.done(k) is None and ctx.board.claim(k):
+                    ctx.claimed += 1
+                    d = compute_for(b)
+                    d["worker"] = ctx.worker
+                    ctx.board.publish(k, d)
+                    return True
+            return False
+
+        key = ctx.key(sp_name, structure, target)
+        for attempt in range(3):
+            doc, adopted = ctx.obtain(key, lambda: compute_for(target),
+                                      speculate,
+                                      should_abort=lambda: self._drain)
+            if not (adopted and self.icfg.invariants):
+                return doc, adopted
+            # an adopted result passes the same cheap host-side tally
+            # invariants every locally-computed batch passed before being
+            # believed (the computing peer checked them, but a stale or
+            # buggy peer build publishes with a valid checksum — validate
+            # at the trust boundary, not just at the producer)
+            viol = integ.tally_violations(
+                doc.get("tally"), int(doc.get("batch_size",
+                                              self.batch_size)),
+                doc.get("strata"))
+            if not viol:
+                return doc, adopted
+            self.monitor.invariant_violations += 1
+            self.monitor.record_quarantine({
+                "kind": "adopted", "simpoint": sp_name,
+                "structure": structure, "batch_id": int(target),
+                "worker": doc.get("worker"), "problems": [
+                    {"kind": "invariant", "violations": viol}],
+                "fatal": attempt >= 2})
+            debug.dprintf(
+                "Elastic", "adopted %s from %s failed invariants (%s) — "
+                "retracting and recomputing", key, doc.get("worker"), viol)
+            ctx.board.retract(key)
+        raise integ.IntegrityError(
+            f"{sp_name}/{structure} batch {target}: adopted result failed "
+            "invariants on every retract/recompute attempt")
+
     # --- outputs (the m5out contract) ---
 
     def write_outputs(self) -> None:
@@ -742,6 +1011,11 @@ class Orchestrator:
         doc = {
             "version": CKPT_VERSION,
             "plan": self.plan.to_dict(),
+            # the EFFECTIVE batch size (plan batch_size rounded up to the
+            # mesh): batch PRNG keys derive from it, so a resume on a
+            # mesh that rounds differently would silently mix two
+            # incompatible key streams — resume() validates this instead
+            "batch_size": int(self.batch_size),
             "state": state_doc,
             # v5: the integrity monitor (mismatch ledger, canary/invariant
             # counters, quarantine log) rides the checkpoint so the audit
@@ -751,8 +1025,41 @@ class Orchestrator:
         doc["checksum"] = resil.doc_checksum(doc)
         path = os.path.join(ckpt_dir, "campaign.json")
         if os.path.exists(path):
-            os.replace(path, os.path.join(ckpt_dir, "campaign.prev.json"))
+            # rotate only a VALID latest: rotating a torn campaign.json
+            # (crash or injected tear since the last write) over
+            # campaign.prev.json would destroy the one valid fallback and
+            # open a no-valid-checkpoint window until the write below
+            # lands — exactly the double-fault a chaos plan composes
+            try:
+                resil.load_json_verified(path)
+            except ValueError:
+                debug.dprintf("Campaign", "latest checkpoint is torn — "
+                              "overwriting in place, keeping prev")
+            else:
+                os.replace(path,
+                           os.path.join(ckpt_dir, "campaign.prev.json"))
+                # durability: the rotation rename is only crash-safe once
+                # the directory entry itself is on disk — without this a
+                # power loss could drop BOTH names (the new
+                # campaign.json's own write_json_atomic fsyncs the dir
+                # again after its rename)
+                resil.fsync_dir(ckpt_dir)
         resil.write_json_atomic(path, doc)
+        if self.chaos is not None:
+            spec = self.chaos.take_torn_checkpoint()
+            if spec is not None:
+                # chaos checkpoint hook: corrupt the freshly-written bytes
+                # the way a power loss would, then prove on the spot that
+                # the v5 fallback chain still yields a valid document
+                chaosmod.tear_file(path,
+                                   float(spec.get("keep_fraction", 0.5)))
+                try:
+                    self.load_checkpoint_doc(ckpt_dir)
+                    self.chaos.note_survived("torn_checkpoint")
+                except ValueError:
+                    debug.dprintf(
+                        "Chaos", "torn checkpoint NOT recoverable (no "
+                        "valid fallback in %s)", ckpt_dir)
         return ckpt_dir
 
     @staticmethod
@@ -788,6 +1095,15 @@ class Orchestrator:
         upgrade_checkpoint(doc)
         plan = CampaignPlan.from_dict(doc["plan"])
         orch = cls(plan, mesh=mesh, outdir=outdir)
+        want = doc.get("batch_size")
+        if want is not None and int(want) != orch.batch_size:
+            raise ValueError(
+                f"checkpoint ran with effective batch_size {want} but "
+                f"this {orch.mesh.size}-device mesh rounds the plan's "
+                f"{plan.batch_size} to {orch.batch_size} — batch PRNG "
+                "keys would diverge from the checkpointed history; "
+                "resume on a mesh size that divides the original batch "
+                "size (or keep plan batch_size a multiple of both)")
         for spn, per_structure in doc["state"].items():
             for s, st_doc in per_structure.items():
                 orch.state[(spn, s)] = _State.from_dict(st_doc)

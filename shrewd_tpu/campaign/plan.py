@@ -16,11 +16,13 @@ SimPoint sources polymorph over ``SimPointSpec``:
 
 from __future__ import annotations
 
+from shrewd_tpu.chaos import ChaosConfig
 from shrewd_tpu.integrity import IntegrityConfig
 from shrewd_tpu.models.mesi import MesiConfig
 from shrewd_tpu.models.noc import NocConfig
 from shrewd_tpu.models.o3 import O3Config, STRUCTURES
 from shrewd_tpu.models.ruby import CacheConfig
+from shrewd_tpu.parallel.elastic import ElasticConfig
 from shrewd_tpu.resilience import ResilienceConfig
 from shrewd_tpu.trace import synth
 from shrewd_tpu.trace.format import Trace
@@ -133,6 +135,15 @@ class CampaignPlan(ConfigObject):
     # resilience child, part of the plan so a campaign's self-validation
     # behavior is reproducible from its config dump
     integrity = Child(IntegrityConfig)
+    # elastic multi-host posture: heartbeat cadence/timeouts and the
+    # lease-board speculation window (parallel/elastic.py); the
+    # coordination directory and worker identity are runtime arguments
+    # (--elastic-dir/--worker), not plan state
+    elastic = Child(ElasticConfig)
+    # deterministic chaos schedule (shrewd_tpu/chaos.py): where this
+    # campaign's injected-failure plan comes from, so a chaos run is
+    # reproducible from its config dump like every other posture
+    chaos = Child(ChaosConfig)
     # non-O3 fault tiers (used only when a tier-qualified structure is in
     # ``structures``)
     cache = Child(CacheConfig)

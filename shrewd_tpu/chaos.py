@@ -1,0 +1,354 @@
+"""Deterministic chaos harness: a failure-plan DSL over the resilience stack.
+
+PRs 1–2 shipped a watchdog, a degradation ladder, an integrity quarantine
+and a crash-safe checkpoint chain — all exercised only by failures
+hand-constructed inside unit tests.  A fault-injection framework should be
+able to inject faults into *itself* on a reproducible schedule and prove
+the whole stack end to end; this module is that schedule.
+
+A **chaos plan** is a JSON document::
+
+    {"seed": 0, "faults": [
+      {"kind": "wedge",           "at_batch": 0, "times": 1},
+      {"kind": "backend_error",   "at_batch": 1, "tier": "device",
+       "permanent": true},
+      {"kind": "corrupt_tally",   "at_batch": 2, "delta": 1},
+      {"kind": "torn_checkpoint", "at_ckpt": 1},
+      {"kind": "kill_worker",     "after_dispatches": 3, "rc": 137}
+    ]}
+
+Triggers are pure functions of campaign coordinates — batch ids, checkpoint
+ordinals, per-process dispatch counts — never wall-clock randomness.  The
+seeded form ``{"sample": {"k": 2, "of": 20}}`` draws ``k`` batch ids from
+``range(of)`` with a PRNG derived from the plan seed and the fault's index,
+so the schedule is reproducible bit-for-bit across runs.
+
+Each fault kind lands on a hook point that already exists in the code:
+
+======================  ====================================================
+``wedge``               ``DeviceWatchdog.call`` (the dispatch sleeps past
+                        the deadline → ``DispatchTimeout`` → retry/ladder);
+                        requires ``resilience.dispatch_timeout > 0``
+``backend_error``       ``ResilientDispatcher.tally_batch`` (raises
+                        ``BackendError`` on the named tier; ``times`` bounds
+                        failed attempts, ``permanent`` fails the whole tier
+                        for that batch → ladder descends)
+``corrupt_tally``       ``IntegrityMonitor.arm_corruption`` (the integrity
+                        layer quarantines and re-dispatches on frozen keys)
+``torn_checkpoint``     checkpoint bytes truncated after the atomic write
+                        (the v5 ``campaign.prev.json`` fallback recovers)
+``kill_worker``         ``os._exit`` at a batch boundary (the elastic lease
+                        board revokes the dead worker's leases and
+                        survivors re-dispatch them on frozen keys)
+======================  ====================================================
+
+Every injected and survived fault is counted per kind; the orchestrator
+exposes the ledgers as the ``campaign.chaos.*`` stats group, so a chaos run
+is self-describing from its stats dump alone.
+
+Import discipline: like ``resilience.py``, importable WITHOUT jax (the
+engine is pure host-side bookkeeping; injections ride hooks in modules that
+already own the backend work).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+from shrewd_tpu.resilience import BackendError, TIERS
+from shrewd_tpu.utils import debug
+from shrewd_tpu.utils.config import ConfigObject, Param
+
+debug.register_flag("Chaos", "deterministic fault-injection harness")
+
+KINDS = ("wedge", "backend_error", "corrupt_tally", "torn_checkpoint",
+         "kill_worker")
+
+KILL_DEFAULT_RC = 137
+
+
+class ChaosPlanError(ValueError):
+    """A chaos plan failed validation."""
+
+
+class ChaosConfig(ConfigObject):
+    """The ``plan.chaos`` config child: where this campaign's failure
+    schedule comes from, so a chaos run is reproducible from its config
+    dump like every other campaign posture."""
+
+    plan_path = Param(str, "", "path to a chaos-plan JSON file "
+                               "(empty = no chaos)")
+    spec = Param(str, "", "inline chaos-plan JSON (overrides plan_path)")
+
+    def build(self, worker: str = "") -> "ChaosEngine | None":
+        if self.spec:
+            return ChaosEngine(json.loads(self.spec), worker=worker)
+        if self.plan_path:
+            return ChaosEngine.from_path(self.plan_path, worker=worker)
+        return None
+
+
+def _as_id_list(v) -> list[int]:
+    if isinstance(v, (int, np.integer)):
+        return [int(v)]
+    return [int(x) for x in v]
+
+
+def _normalize(plan: dict) -> list[dict]:
+    """Validate + expand the fault list (seeded samples → explicit ids)."""
+    seed = int(plan.get("seed", 0))
+    faults = plan.get("faults")
+    if not isinstance(faults, list):
+        raise ChaosPlanError("chaos plan needs a 'faults' list")
+    out: list[dict] = []
+    for i, spec in enumerate(faults):
+        kind = spec.get("kind")
+        if kind not in KINDS:
+            raise ChaosPlanError(
+                f"fault {i}: unknown kind {kind!r} (one of {KINDS})")
+        s = dict(spec)
+        if "sample" in s:
+            # the seeded schedule: k batch ids drawn from range(of) with
+            # a PRNG that is a pure function of (plan seed, fault index)
+            samp = s.pop("sample")
+            rng = np.random.default_rng((seed, i))
+            ids = rng.choice(int(samp["of"]), size=int(samp["k"]),
+                             replace=False)
+            s["at_batch"] = sorted(int(x) for x in ids)
+        for key in ("at_batch", "at_ckpt"):
+            if key in s:
+                s[key] = _as_id_list(s[key])
+        if kind == "torn_checkpoint" and "at_ckpt" not in s:
+            raise ChaosPlanError(f"fault {i}: torn_checkpoint needs at_ckpt")
+        if kind != "torn_checkpoint" and ("at_batch" not in s
+                                          and "after_dispatches" not in s):
+            raise ChaosPlanError(
+                f"fault {i}: {kind} needs at_batch / sample / "
+                "after_dispatches")
+        if "tier" in s and s["tier"] not in TIERS:
+            raise ChaosPlanError(
+                f"fault {i}: unknown tier {s['tier']!r} (one of {TIERS})")
+        s["_fires_left"] = len(s.get("at_batch", s.get("at_ckpt", [0]))) \
+            if "after_dispatches" not in s else 1
+        out.append(s)
+    return out
+
+
+class ChaosEngine:
+    """Armed per-batch injection state + the injected/survived ledgers.
+
+    The orchestrator calls ``begin_batch`` before each dispatch it computes
+    (elastic workers: each batch they *compute*, not adopt) and
+    ``end_batch`` after the batch's tally is believed; hook owners
+    (watchdog, ladder, monitor, checkpoint writer) consume armed faults via
+    the ``take_* / maybe_*`` methods.  Everything is deterministic given
+    the plan: no wall clock enters any trigger decision.
+    """
+
+    def __init__(self, plan: dict, worker: str = ""):
+        self.worker = worker
+        self.faults = _normalize(plan)
+        self.injected: dict[str, int] = {}
+        self.survived: dict[str, int] = {}
+        self.fires: list[dict] = []          # evidence: what fired where
+        self.dispatches = 0                  # batches this process computed
+        self.ckpts = 0                       # checkpoints this process wrote
+        # kind -> LIST of armed states (a plan may schedule several
+        # faults of the same kind onto one batch, e.g. backend_error on
+        # two tiers to force a double descent — none may be dropped)
+        self._armed: dict[str, list[dict]] = {}
+        self._batch: tuple = ()              # (batch_id, simpoint, structure)
+        self._wedge_warned = False
+
+    @classmethod
+    def from_path(cls, path: str, worker: str = "") -> "ChaosEngine":
+        with open(path) as f:
+            return cls(json.load(f), worker=worker)
+
+    # --- ledger helpers -------------------------------------------------
+
+    def _fire(self, kind: str, detail: dict | None = None) -> None:
+        self.injected[kind] = self.injected.get(kind, 0) + 1
+        ev = {"kind": kind, "batch": self._batch}
+        if detail:
+            ev.update(detail)
+        self.fires.append(ev)
+        debug.dprintf("Chaos", "injected %s at %s", kind, self._batch)
+
+    def note_fired(self, kind: str) -> None:
+        """External hooks (monitor corruption note) report a fire."""
+        for st in self._armed.get(kind, ()):
+            if not st["fired"]:
+                st["fired"] = True
+                self._fire(kind)
+                return
+
+    def note_survived(self, kind: str) -> None:
+        self.survived[kind] = self.survived.get(kind, 0) + 1
+        debug.dprintf("Chaos", "survived %s", kind)
+
+    # --- batch lifecycle ------------------------------------------------
+
+    def begin_batch(self, batch_id: int, simpoint: str = "",
+                    structure: str = "") -> None:
+        """Arm the faults matching this batch.  Called once per batch this
+        process computes (the per-process ``after_dispatches`` counter and
+        the campaign-coordinate ``at_batch`` trigger both advance here)."""
+        self.dispatches += 1
+        self._armed = {}
+        self._batch = (int(batch_id), simpoint, structure)
+        for s in self.faults:
+            if s["kind"] == "torn_checkpoint" or s["_fires_left"] <= 0:
+                continue
+            if s.get("simpoint") and simpoint and s["simpoint"] != simpoint:
+                continue
+            if s.get("structure") and structure \
+                    and s["structure"] != structure:
+                continue
+            hit = (batch_id in s.get("at_batch", ())
+                   or s.get("after_dispatches") == self.dispatches)
+            if not hit:
+                continue
+            s["_fires_left"] -= 1
+            times = int(s.get("times", 1))
+            if s.get("permanent"):
+                times = 1 << 30      # within-batch permanent: tier descends
+            self._armed.setdefault(s["kind"], []).append(
+                {"spec": s, "left": times, "fired": False})
+
+    def end_batch(self) -> None:
+        """The batch's tally was believed (invariants/canaries passed,
+        quarantine recovered): every fault that fired during it was
+        survived."""
+        for kind, states in self._armed.items():
+            for st in states:
+                if st["fired"]:
+                    self.note_survived(kind)
+            if any(st["fired"] for st in states):
+                continue
+            if kind == "wedge" and not self._wedge_warned:
+                # armed but no deadline-bearing dispatch ever consumed it
+                # — the watchdog path was NOT proven; say so rather than
+                # letting the operator read silence as success
+                self._wedge_warned = True
+                import warnings
+
+                warnings.warn(
+                    "chaos plan armed a 'wedge' fault but no dispatch "
+                    "ran under a positive watchdog deadline "
+                    "(resilience.dispatch_timeout) — the wedge never "
+                    "fired and the watchdog path is NOT being proven",
+                    RuntimeWarning, stacklevel=2)
+        self._armed = {}
+
+    # --- hook points ----------------------------------------------------
+
+    def maybe_kill(self) -> None:
+        """The elastic hook: hard process death at a batch boundary (the
+        preempted-without-warning case the lease board must survive)."""
+        for st in self._armed.get("kill_worker", ()):
+            spec = st["spec"]
+            # a worker-TARGETED kill fires only on the named worker — an
+            # engine with no worker identity (e.g. built from plan config
+            # before attach_elastic names it) must NOT match a filter
+            # meant for someone else, or every process dies instead of one
+            if spec.get("worker") and spec["worker"] != self.worker:
+                continue
+            st["fired"] = True
+            self._fire("kill_worker", {"worker": self.worker})
+            debug.dprintf("Chaos", "kill_worker %s: os._exit(%s)",
+                          self.worker, spec.get("rc", KILL_DEFAULT_RC))
+            os._exit(int(spec.get("rc", KILL_DEFAULT_RC)))
+
+    def take_wedge(self, timeout: float) -> dict | None:
+        """Watchdog hook: ``{"fn": wedged, "deadline": s}`` (consumed once
+        per armed count), or None.  Only meaningful under a positive
+        watchdog deadline — with no deadline a wedge would hang the run,
+        which is the disease, not the test.
+
+        The injected dispatch carries its own (short) deadline, bounded by
+        the real one: the campaign's deadline must stay generous enough
+        for first-compile dispatches, but the injected wedge should prove
+        the timeout machinery in test-scale time.  The wedged fn never
+        touches the backend and exits shortly after abandonment, so the
+        orphaned thread cannot poison in-flight collectives the way an
+        abandoned *real* dispatch would."""
+        if timeout <= 0:
+            return None
+        for st in self._armed.get("wedge", ()):
+            if st["left"] <= 0:
+                continue
+            st["left"] -= 1
+            if not st["fired"]:
+                st["fired"] = True
+                self._fire("wedge")
+            deadline = min(timeout,
+                           float(st["spec"].get("deadline", 0.25)))
+
+            def wedged():
+                time.sleep(deadline * 3)
+                raise BackendError("chaos wedge released after deadline")
+            return {"fn": wedged, "deadline": deadline}
+        return None
+
+    def maybe_backend_error(self, tier: int) -> None:
+        """Ladder hook: raise ``BackendError`` on the named tier while the
+        armed attempt budget lasts."""
+        for st in self._armed.get("backend_error", ()):
+            if st["left"] <= 0:
+                continue
+            want = st["spec"].get("tier", TIERS[0])
+            if TIERS[tier] != want:
+                continue
+            st["left"] -= 1
+            if not st["fired"]:
+                st["fired"] = True
+                self._fire("backend_error", {"tier": want})
+            raise BackendError(
+                f"chaos: injected {want}-tier failure "
+                f"(batch {self._batch[0]})")
+
+    def take_corrupt_tally(self) -> dict | None:
+        """Integrity hook: the armed corruption spec (the orchestrator arms
+        ``IntegrityMonitor.arm_corruption`` with it), or None.  The fire is
+        reported back via ``note_fired`` when the corruption is actually
+        applied to a dispatched tally."""
+        for st in self._armed.get("corrupt_tally", ()):
+            if st["left"] > 0:
+                st["left"] -= 1
+                return st["spec"]
+        return None
+
+    def take_torn_checkpoint(self) -> dict | None:
+        """Checkpoint hook: called once per checkpoint written; returns the
+        spec when this checkpoint ordinal is scheduled to tear."""
+        ordinal = self.ckpts
+        self.ckpts += 1
+        for s in self.faults:
+            if s["kind"] != "torn_checkpoint" or s["_fires_left"] <= 0:
+                continue
+            if ordinal in s.get("at_ckpt", ()):
+                s["_fires_left"] -= 1
+                self._batch = (ordinal, "ckpt", "")
+                self._fire("torn_checkpoint", {"ckpt": ordinal})
+                return s
+        return None
+
+    # --- reporting ------------------------------------------------------
+
+    def to_dict(self) -> dict:
+        return {"injected": dict(self.injected),
+                "survived": dict(self.survived),
+                "fires": list(self.fires)}
+
+
+def tear_file(path: str, keep_fraction: float = 0.5) -> None:
+    """Corrupt a file the way a power loss mid-write would: keep a prefix,
+    drop the tail (the checksum/JSON-truncation detectors must catch it)."""
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        f.truncate(max(int(size * keep_fraction), 1))

@@ -476,17 +476,23 @@ class IntegrityMonitor:
 
     # --- test hook ------------------------------------------------------
 
-    def arm_corruption(self, fn, times: int = 1) -> None:
-        """TEST HOOK: apply ``fn(tally) -> tally`` to the next ``times``
-        dispatched batch tallies — the injected-corruption harness the
-        acceptance criterion requires (a bit-flipped tally on a degraded
-        tier is otherwise unobtainable on a healthy backend)."""
-        self._corruptions.extend([fn] * times)
+    def arm_corruption(self, fn, times: int = 1, note=None) -> None:
+        """INJECTION HOOK: apply ``fn(tally) -> tally`` to the next
+        ``times`` dispatched batch tallies — the injected-corruption
+        harness the acceptance criterion requires (a bit-flipped tally on
+        a degraded tier is otherwise unobtainable on a healthy backend).
+        Used by tests directly and by the chaos harness
+        (``chaos.ChaosEngine``), whose ``note`` callback is invoked at
+        apply time so the chaos ledger counts the fault when it actually
+        lands, not when it is scheduled."""
+        self._corruptions.extend([(fn, note)] * times)
 
     def apply_corruption(self, res: DispatchResult) -> DispatchResult:
         if not self._corruptions:
             return res
-        fn = self._corruptions.pop(0)
+        fn, note = self._corruptions.pop(0)
+        if note is not None:
+            note()
         return res._replace(tally=np.asarray(fn(np.asarray(res.tally))))
 
     # --- evidence -------------------------------------------------------

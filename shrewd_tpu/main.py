@@ -61,17 +61,58 @@ def _apply_resilience_overrides(orch, args) -> None:
         icfg.canary_trials = args.canary_trials
 
 
+def _apply_chaos_elastic(orch, args) -> None:
+    """--chaos-plan attaches the deterministic failure schedule;
+    --elastic-dir joins (or starts) an elastic multi-host campaign over a
+    shared coordination directory."""
+    worker = getattr(args, "worker", "") or f"w{os.getpid()}"
+    if getattr(args, "chaos_plan", None):
+        from shrewd_tpu.chaos import ChaosEngine
+
+        orch.plan.chaos.plan_path = args.chaos_plan   # reproducible dump
+        orch.attach_chaos(ChaosEngine.from_path(args.chaos_plan,
+                                                worker=worker))
+    if getattr(args, "elastic_dir", None):
+        from shrewd_tpu.parallel.elastic import ElasticContext
+
+        orch.attach_elastic(ElasticContext(args.elastic_dir, worker,
+                                           orch.plan.elastic))
+
+
 def _drive(orch, args) -> int:
     """Drive the orchestrator's event loop to completion (the stdlib
     Simulator.run analog: typed exit events → handlers,
     ``python/gem5/simulate/simulator.py:530``)."""
+    _apply_resilience_overrides(orch, args)
+    _apply_chaos_elastic(orch, args)
+    # graceful preemption: SIGTERM/SIGINT finish the in-flight batch,
+    # write a resumable checkpoint, and exit rc 4 (distinct from the
+    # budget-abort rc 3 so schedulers can tell drain from distrust)
+    restore_signals = orch.install_signal_handlers()
+    t0 = time.monotonic()
+    ckpt_every = orch.plan.checkpoint_every
+    try:
+        n_batches = _drive_events(orch, ckpt_every)
+    finally:
+        # the second-signal KeyboardInterrupt escape hatch (and any
+        # ladder/elastic error) must still restore handlers and leave the
+        # elastic membership gracefully — a stale heartbeat file would
+        # make peers burn a full timeout declaring us lost and pollute
+        # the shared coordination dir for later campaigns
+        restore_signals()
+        if orch._elastic is not None:
+            orch._elastic.stop()      # graceful leave: peers see it
+    orch.write_outputs()
+    return _drive_outputs(orch, args, t0, n_batches)
+
+
+def _drive_events(orch, ckpt_every: int) -> int:
+    """Consume the orchestrator's event stream, logging each typed event;
+    returns the number of completed batches."""
     from shrewd_tpu.resilience import TIERS
     from shrewd_tpu.sim.exit_event import ExitEvent
 
-    _apply_resilience_overrides(orch, args)
-    t0 = time.monotonic()
     n_batches = 0
-    ckpt_every = orch.plan.checkpoint_every
     for event, payload in orch.events():
         if event == ExitEvent.BATCH_COMPLETE:
             n_batches += 1
@@ -102,11 +143,23 @@ def _drive(orch, args) -> int:
             _log(f"ESCALATION BUDGET EXCEEDED: {e.rate:.1%} of trials ran "
                  f"below the device tier (threshold {e.threshold:.1%}, "
                  f"action={e.action}) — tiers {e.tier_trials}")
+        elif event == ExitEvent.PREEMPTED:
+            _log(f"PREEMPTED: drained to checkpoint "
+                 f"{payload or '(no outdir — progress lost)'}")
+        elif event == ExitEvent.WORKER_LOST:
+            _log(f"WORKER LOST: {payload.worker} (lease {payload.batch_key}"
+                 f" revoked; survivors: "
+                 f"{', '.join(payload.survivors) or 'this worker'})")
         elif event == ExitEvent.SIMPOINT_COMPLETE:
             _log(f"simpoint {payload}: done")
         elif event == ExitEvent.CAMPAIGN_COMPLETE:
             break
-    orch.write_outputs()
+    return n_batches
+
+
+def _drive_outputs(orch, args, t0, n_batches) -> int:
+    from shrewd_tpu.resilience import TIERS
+
     if orch.outdir:
         orch.checkpoint()
     esc = orch.budget
@@ -121,6 +174,18 @@ def _drive(orch, args) -> int:
              f"audited ({mon.ledger.mismatched} mismatched), "
              f"{mon.quarantined} batches quarantined "
              f"({mon.recovered} recovered)")
+    chaos = orch.chaos
+    if chaos is not None and chaos.injected:
+        _log(f"chaos: injected {dict(chaos.injected)}, "
+             f"survived {dict(chaos.survived)}")
+    el = orch._elastic
+    if el is not None:
+        _log(f"elastic ({el.worker}): {el.counters()}")
+    if orch.preempted:
+        _log(f"campaign PREEMPTED after {n_batches} batches in "
+             f"{time.monotonic() - t0:.1f}s"
+             + (f" → {orch.outdir} (resumable)" if orch.outdir else ""))
+        return 4
     if orch.aborted:
         _log(f"campaign ABORTED by "
              f"{orch.abort_reason or 'escalation budget'} after "
@@ -265,6 +330,21 @@ def main(argv: list[str] | None = None) -> int:
     resil.add_argument("--canary-trials", type=int, default=None,
                        help="seed-canary trials salted per batch "
                             "(0 disables canaries)")
+    resil.add_argument("--chaos-plan", default=None,
+                       help="chaos-plan JSON file: a deterministic "
+                            "failure schedule injected at the watchdog/"
+                            "ladder/integrity/checkpoint hook points "
+                            "(shrewd_tpu/chaos.py)")
+    resil.add_argument("--elastic-dir", default=None,
+                       help="shared coordination directory for an elastic "
+                            "multi-host campaign (heartbeats + batch "
+                            "leases; parallel/elastic.py).  Start N "
+                            "processes with the same plan and dir; lost "
+                            "workers' batches are re-dispatched by "
+                            "survivors bit-identically")
+    resil.add_argument("--worker", default=None,
+                       help="worker name for elastic/chaos runs "
+                            "(default: w<pid>)")
 
     p = sub.add_parser("run", help="run a campaign plan to completion",
                        parents=[common, resil])

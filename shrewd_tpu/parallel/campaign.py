@@ -21,7 +21,7 @@ from jax.sharding import PartitionSpec as P
 from shrewd_tpu.ops import classify as C
 from shrewd_tpu.parallel import stopping
 from shrewd_tpu.parallel.mesh import TRIAL_AXIS, shard_keys, shard_map
-from shrewd_tpu.resilience import DeviceWatchdog, TIERS
+from shrewd_tpu.resilience import DeviceWatchdog, DispatchTimeout, TIERS
 from shrewd_tpu.utils import debug, prng
 
 debug.register_flag("CampaignStep", "per-batch sharded campaign steps")
@@ -77,6 +77,10 @@ class ShardedCampaign:
         self.integrity_check = integrity_check
         self.shard_checks = 0        # shard-vs-psum verifications run
         self.shard_mismatches = 0    # ... that failed (each also raises)
+        # collective-timeout detection (elastic layer): in a multi-host
+        # mesh a deadline on the psum step is the first observable symptom
+        # of a lost peer — the count feeds worker-loss diagnosis upstream
+        self.collective_timeouts = 0
         self.mode = getattr(getattr(kernel, "cfg", None),
                             "replay_kernel", "dense")
         may_latch = structure == "latch"
@@ -145,8 +149,15 @@ class ShardedCampaign:
         deadline."""
         if self.watchdog is None:
             return step(*args)
-        return self.watchdog.call(
-            lambda: jax.block_until_ready(step(*args)))
+        try:
+            return self.watchdog.call(
+                lambda: jax.block_until_ready(step(*args)))
+        except DispatchTimeout:
+            # in a multi-process mesh this step IS a collective: a
+            # deadline here may mean a lost peer, not a wedged backend —
+            # count it so the elastic layer can fold it into membership
+            self.collective_timeouts += 1
+            raise
 
     def _verify_shards(self, local, total) -> None:
         """The shard-vs-psum invariant (integrity layer): the locals each

@@ -61,6 +61,20 @@ def make_mesh(devices=None) -> Mesh:
     return Mesh(np.asarray(devices), (TRIAL_AXIS,))
 
 
+def round_up_to_mesh(n: int, mesh_size: int) -> int:
+    """Smallest multiple of ``mesh_size`` that is >= ``n``.
+
+    The plan-level fix for the ``shard_keys`` divisibility requirement:
+    the orchestrator rounds its plan's batch_size up through this (with a
+    warning) instead of crashing mid-campaign — required once elastic
+    re-meshing can shrink the device count under a running plan.  The
+    hard raise in ``shard_keys`` stays: an explicit low-level call with a
+    non-divisible batch is a caller bug, not a plan to repair."""
+    if mesh_size <= 0:
+        raise ValueError(f"mesh size must be positive, got {mesh_size}")
+    return -(-int(n) // int(mesh_size)) * int(mesh_size)
+
+
 def shard_keys(mesh: Mesh, keys: jax.Array) -> jax.Array:
     """Place a per-trial key batch sharded across the trial axis.
 

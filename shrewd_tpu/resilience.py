@@ -37,9 +37,11 @@ from __future__ import annotations
 
 import hashlib
 import json
+import os
 import random
 import threading
 import time
+import warnings
 from typing import Callable, NamedTuple
 
 import numpy as np
@@ -146,12 +148,27 @@ class DeviceWatchdog:
     self-exiting tunnel probe, inverted to stay in-process.  ``timeout=0``
     disables the thread hop entirely (zero overhead on the hot path)."""
 
+    #: abandoned-thread count past which the watchdog warns: each wedged
+    #: dispatch orphans one daemon thread (plus whatever C-level state it
+    #: pins), so unbounded accumulation is a slow leak worth surfacing
+    leak_warn_cap = 8
+
     def __init__(self, timeout: float = 0.0, name: str = "device"):
         self.timeout = float(timeout)
         self.name = name
         self.healthy = True
         self.dispatches = 0
         self.timeouts = 0
+        self.chaos = None            # optional chaos.ChaosEngine (wedge hook)
+        self._abandoned: list[threading.Thread] = []
+        self._leak_warned = False
+
+    @property
+    def leaked_threads(self) -> int:
+        """Abandoned dispatch threads still alive (a wedged thread that
+        eventually finishes drops off; one that never does is a leak)."""
+        self._abandoned = [t for t in self._abandoned if t.is_alive()]
+        return len(self._abandoned)
 
     def call(self, fn: Callable, *args, timeout: float | None = None):
         """``fn(*args)`` bounded by ``timeout`` (default: the instance's).
@@ -160,6 +177,19 @@ class DeviceWatchdog:
         propagates unchanged (the retry loop decides what is retryable)."""
         tmo = self.timeout if timeout is None else float(timeout)
         self.dispatches += 1
+        if self.chaos is not None and tmo > 0:
+            # chaos wedge hook (only on deadline-bearing dispatches — the
+            # ladder also routes fallback tiers through here with tmo=0,
+            # which must neither consume nor misreport the wedge):
+            # substitute a dispatch that sleeps past the deadline, so the
+            # injected fault exercises the REAL timeout machinery (thread
+            # hop, abandonment, DispatchTimeout) rather than a synthetic
+            # raise.  The injected call runs under the spec's own short
+            # deadline so the campaign's real deadline can stay generous
+            # enough for first-compile dispatches.
+            wedged = self.chaos.take_wedge(tmo)
+            if wedged is not None:
+                fn, args, tmo = wedged["fn"], (), wedged["deadline"]
         if tmo <= 0:
             return fn(*args)
         # a plain daemon thread, NOT ThreadPoolExecutor: pool workers are
@@ -176,17 +206,32 @@ class DeviceWatchdog:
             finally:
                 done.set()
 
-        threading.Thread(
+        th = threading.Thread(
             target=_runner, daemon=True,
-            name=f"watchdog-{self.name}-{self.dispatches}").start()
+            name=f"watchdog-{self.name}-{self.dispatches}")
+        th.start()
         if not done.wait(tmo):
             self.timeouts += 1
             self.healthy = False
             # the dispatch thread is stuck in C; abandon it (daemon — it
-            # dies with the process) and let the caller's ladder decide
+            # dies with the process) and let the caller's ladder decide.
+            # Track the orphan: repeated wedges accumulate threads (and
+            # whatever backend state they pin), which is a leak worth a
+            # stat and, past the cap, a warning.
+            self._abandoned.append(th)
+            leaked = self.leaked_threads
+            if leaked > self.leak_warn_cap and not self._leak_warned:
+                self._leak_warned = True
+                warnings.warn(
+                    f"DeviceWatchdog {self.name}: {leaked} abandoned "
+                    f"dispatch threads still alive (cap "
+                    f"{self.leak_warn_cap}) — the backend is wedging "
+                    "repeatedly; each orphan pins backend state until it "
+                    "finishes or the process exits", RuntimeWarning,
+                    stacklevel=2)
             debug.dprintf("Resilience",
-                          "watchdog %s: dispatch wedged after %.1fs",
-                          self.name, tmo)
+                          "watchdog %s: dispatch wedged after %.1fs "
+                          "(%d threads leaked)", self.name, tmo, leaked)
             raise DispatchTimeout(
                 f"{self.name}: dispatch exceeded {tmo:.1f}s") from None
         if "err" in box:
@@ -356,11 +401,16 @@ class ResilientDispatcher:
     def __init__(self, tiers, config: ResilienceConfig | None = None,
                  watchdog: DeviceWatchdog | None = None,
                  backoff: BackoffPolicy | None = None,
-                 device_deadline: bool = True):
+                 device_deadline: bool = True, chaos=None):
         """``device_deadline=False`` when the campaign enforces its own
         per-step deadline (ShardedCampaign built with a watchdog): the
         dispatcher then calls the device tier directly instead of adding a
-        second thread hop + timer around the same work."""
+        second thread hop + timer around the same work.
+
+        ``chaos`` (chaos.ChaosEngine, optional): the deterministic
+        fault-injection harness — armed per-tier ``BackendError`` faults
+        fire here, exercising the retry/degradation machinery exactly as a
+        real backend failure would."""
         if not tiers:
             raise ValueError("need at least one tier")
         self.tiers = list(tiers)
@@ -370,6 +420,7 @@ class ResilientDispatcher:
         self.backoff = (backoff if backoff is not None
                         else BackoffPolicy.from_config(self.cfg))
         self.device_deadline = device_deadline
+        self.chaos = chaos
         self.retries = 0          # re-dispatches beyond each first attempt
         self.degradations = 0     # tier descents taken
 
@@ -385,7 +436,8 @@ class ResilientDispatcher:
             return None
         return ResilientDispatcher(
             self.tiers[pos + 1:], self.cfg, watchdog=self.watchdog,
-            backoff=self.backoff, device_deadline=self.device_deadline)
+            backoff=self.backoff, device_deadline=self.device_deadline,
+            chaos=self.chaos)
 
     def tally_batch(self, keys, stratified: bool = False) -> DispatchResult:
         attempts = 0
@@ -402,6 +454,10 @@ class ResilientDispatcher:
                     self.retries += 1
                     self.backoff.sleep(attempt - 1)
                 try:
+                    if self.chaos is not None:
+                        # chaos ladder hook: an armed per-tier fault raises
+                        # here, consuming one attempt like a real failure
+                        self.chaos.maybe_backend_error(tier)
                     tally, strata = self.watchdog.call(
                         fn, keys, stratified, timeout=tmo)
                     return DispatchResult(
@@ -521,8 +577,8 @@ def oracle_available(campaign) -> bool:
 
 
 def dispatcher_for_campaign(campaign, cfg: ResilienceConfig | None = None,
-                            watchdog: DeviceWatchdog | None = None
-                            ) -> ResilientDispatcher:
+                            watchdog: DeviceWatchdog | None = None,
+                            chaos=None) -> ResilientDispatcher:
     """Build the ladder for one ShardedCampaign: device, then CPU-JAX
     (skipped when the mesh already IS the cpu backend — re-dispatching to
     the same platform cannot help), then the host oracle where valid."""
@@ -539,7 +595,8 @@ def dispatcher_for_campaign(campaign, cfg: ResilienceConfig | None = None,
     # stack a second deadline around the same call
     return ResilientDispatcher(
         tiers, cfg, watchdog=watchdog,
-        device_deadline=getattr(campaign, "watchdog", None) is None)
+        device_deadline=getattr(campaign, "watchdog", None) is None,
+        chaos=chaos)
 
 
 # --------------------------------------------------------------------------
@@ -554,17 +611,30 @@ def doc_checksum(doc: dict) -> str:
     return hashlib.sha256(blob).hexdigest()
 
 
-def write_json_atomic(path: str, doc: dict) -> None:
-    """tmp + fsync + rename: a crash mid-write can truncate only the tmp
-    file, never the live document."""
-    import os
+def fsync_dir(path: str) -> None:
+    """fsync a DIRECTORY: ``os.replace`` makes a rename visible, but the
+    new directory entry itself lives in the directory's data blocks — on a
+    power loss before the directory syncs, the rename can vanish and the
+    file with it.  POSIX durability for a rename is file-fsync + rename +
+    directory-fsync; this is the third step."""
+    fd = os.open(path, os.O_RDONLY | getattr(os, "O_DIRECTORY", 0))
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
 
+
+def write_json_atomic(path: str, doc: dict) -> None:
+    """tmp + fsync + rename + dir-fsync: a crash mid-write can truncate
+    only the tmp file, never the live document, and a power loss after the
+    rename cannot drop the renamed entry."""
     tmp = path + ".tmp"
     with open(tmp, "w") as f:
         json.dump(doc, f, indent=1, default=str)
         f.flush()
         os.fsync(f.fileno())
     os.replace(tmp, path)
+    fsync_dir(os.path.dirname(os.path.abspath(path)))
 
 
 def load_json_verified(path: str) -> dict:

@@ -37,6 +37,14 @@ class ExitEvent(enum.Enum):
     # audit_action=abort breach ends the stream after a resumable
     # checkpoint (rc 3)
     INTEGRITY_VIOLATION = "integrity_violation"
+    # SIGTERM/SIGINT drain: the in-flight batch finished, a resumable
+    # checkpoint was written, and the event stream ends (payload: the
+    # checkpoint dir, or None without an outdir).  The CLI exits rc 4.
+    PREEMPTED = "preempted"
+    # an elastic peer stopped heartbeating and its batch lease was revoked
+    # (payload: elastic.WorkerLostInfo — who died, the reclaimed batch,
+    # the surviving membership); the campaign continues on the survivors
+    WORKER_LOST = "worker_lost"
     # one simpoint finished all structures (payload: simpoint name)
     SIMPOINT_COMPLETE = "simpoint_complete"
     # the whole plan finished (payload: {(simpoint, structure): result})

@@ -66,6 +66,12 @@ QUICK_MODULES = {
     # same rationale as test_resilience (the corruption-path smoke must
     # run on every push)
     "test_integrity",
+    # chaos harness + elastic layer: DSL/lease/heartbeat units plus the
+    # injected-fault campaign integrations (each fault class survived
+    # bit-identically) — the whole-resilience-stack smoke belongs in the
+    # on-every-push tier like its two predecessors; the multi-process
+    # kill/recover case stays slow-tier (tests/test_multihost.py)
+    "test_chaos",
 }
 QUICK_TESTS = {
     # one representative per subsystem (≈4-10 s each, compile-dominated)

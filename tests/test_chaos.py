@@ -1,0 +1,641 @@
+"""Deterministic chaos harness + elastic layer (shrewd_tpu/chaos.py,
+shrewd_tpu/parallel/elastic.py, orchestrator wiring).
+
+The contract under test is the ISSUE acceptance criterion: for each
+injected fault class — wedged dispatch, tier failure, torn checkpoint,
+corrupt tally, killed/lost worker — the campaign survives through the
+machinery that fault targets, and the final tally equals the undisturbed
+run of the same seed BIT-FOR-BIT.  Every injected and survived fault must
+land in the ``campaign.chaos.*`` / ``campaign.elastic.*`` stats groups.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from shrewd_tpu import stats as statsmod
+from shrewd_tpu.chaos import ChaosEngine, ChaosPlanError, tear_file
+from shrewd_tpu.parallel.elastic import (ElasticConfig, ElasticContext,
+                                         HeartbeatWriter, LeaseBoard,
+                                         Membership)
+from shrewd_tpu.resilience import TIER_DEVICE, TIER_ORACLE
+
+
+# --- the chaos-plan DSL ------------------------------------------------------
+
+def test_plan_validation_rejects_bad_specs():
+    with pytest.raises(ChaosPlanError):
+        ChaosEngine({"faults": [{"kind": "meteor", "at_batch": 0}]})
+    with pytest.raises(ChaosPlanError):
+        ChaosEngine({"faults": [{"kind": "wedge"}]})        # no trigger
+    with pytest.raises(ChaosPlanError):
+        ChaosEngine({"faults": [{"kind": "torn_checkpoint"}]})
+    with pytest.raises(ChaosPlanError):
+        ChaosEngine({"faults": [{"kind": "backend_error", "at_batch": 0,
+                                 "tier": "gpu"}]})
+    with pytest.raises(ChaosPlanError):
+        ChaosEngine({"not_faults": []})
+
+
+def test_seeded_schedule_is_deterministic_and_wall_clock_free():
+    plan = {"seed": 11, "faults": [
+        {"kind": "corrupt_tally", "sample": {"k": 3, "of": 50}}]}
+    a = ChaosEngine(plan).faults[0]["at_batch"]
+    b = ChaosEngine(plan).faults[0]["at_batch"]
+    assert a == b and len(a) == 3 and all(0 <= x < 50 for x in a)
+    # a different seed draws a different schedule (same mechanism)
+    c = ChaosEngine({"seed": 12, "faults": plan["faults"]}
+                    ).faults[0]["at_batch"]
+    assert c != a
+
+
+def test_each_hook_fires_exactly_per_plan():
+    eng = ChaosEngine({"faults": [
+        {"kind": "backend_error", "at_batch": [1, 3], "tier": "device"},
+        {"kind": "corrupt_tally", "at_batch": 2},
+    ]})
+    fired = []
+    for b in range(5):
+        eng.begin_batch(b, "w0", "regfile")
+        try:
+            eng.maybe_backend_error(TIER_DEVICE)
+        except Exception:
+            fired.append(("be", b))
+        if eng.take_corrupt_tally() is not None:
+            fired.append(("ct", b))
+        eng.end_batch()
+    assert fired == [("be", 1), ("ct", 2), ("be", 3)]
+    assert eng.injected == {"backend_error": 2}    # corruption counts at
+    # apply time (note_fired), which this loop never reaches
+
+
+def test_same_kind_faults_on_one_batch_all_arm():
+    # two backend_error faults on one batch (device AND cpu tier — the
+    # double-descent scenario) must BOTH arm; kind-keyed state that
+    # overwrites would silently drop one
+    eng = ChaosEngine({"faults": [
+        {"kind": "backend_error", "at_batch": 0, "tier": "device"},
+        {"kind": "backend_error", "at_batch": 0, "tier": "cpu"}]})
+    eng.begin_batch(0, "w0", "regfile")
+    raised = []
+    for tier in (0, 1, 0, 1):       # device, cpu, device, cpu
+        try:
+            eng.maybe_backend_error(tier)
+        except Exception:
+            raised.append(tier)
+    assert raised == [0, 1]         # each tier's fault fired exactly once
+    assert eng.injected == {"backend_error": 2}
+    eng.end_batch()
+    assert eng.survived == {"backend_error": 2}
+
+
+def test_structure_filter_and_times_budget():
+    eng = ChaosEngine({"faults": [
+        {"kind": "backend_error", "at_batch": 0, "structure": "fu",
+         "times": 2}]})
+    eng.begin_batch(0, "w0", "regfile")     # filtered out
+    eng.maybe_backend_error(TIER_DEVICE)    # no raise
+    eng2 = ChaosEngine({"faults": [
+        {"kind": "backend_error", "at_batch": 0, "times": 2}]})
+    eng2.begin_batch(0, "w0", "fu")
+    raises = 0
+    for _ in range(4):
+        try:
+            eng2.maybe_backend_error(TIER_DEVICE)
+        except Exception:
+            raises += 1
+    assert raises == 2                      # the attempt budget, exactly
+    assert eng2.injected == {"backend_error": 1}   # one FAULT, two raises
+
+
+def test_kill_worker_spec_and_worker_filter(monkeypatch):
+    exits = []
+    monkeypatch.setattr(os, "_exit", exits.append)
+    eng = ChaosEngine({"faults": [
+        {"kind": "kill_worker", "after_dispatches": 2, "worker": "w1",
+         "rc": 99}]}, worker="w0")
+    eng.begin_batch(0, "w0", "regfile")
+    eng.maybe_kill()                        # wrong worker: no exit
+    # an engine with NO worker identity must not match a targeted kill
+    # (a config-built engine predates attach_elastic naming it)
+    anon = ChaosEngine({"faults": [
+        {"kind": "kill_worker", "after_dispatches": 1, "worker": "w1"}]})
+    anon.begin_batch(0, "w0", "regfile")
+    anon.maybe_kill()
+    assert exits == []
+    eng = ChaosEngine({"faults": [
+        {"kind": "kill_worker", "after_dispatches": 2, "rc": 99}]},
+        worker="w1")
+    eng.begin_batch(0, "w0", "regfile")
+    eng.maybe_kill()                        # 1st dispatch: not yet
+    eng.begin_batch(1, "w0", "regfile")
+    eng.maybe_kill()
+    assert exits == [99]
+    assert eng.injected == {"kill_worker": 1}
+
+
+def test_wedge_warns_when_it_never_fires():
+    # no deadline-bearing dispatch ever consumed the armed wedge (e.g.
+    # resilience.dispatch_timeout left at 0): the batch ends with the
+    # wedge unfired and the engine says so instead of reading as success
+    eng = ChaosEngine({"faults": [{"kind": "wedge", "at_batch": 0}]})
+    eng.begin_batch(0, "w0", "regfile")
+    assert eng.take_wedge(0.0) is None      # tmo<=0: not consumed
+    with pytest.warns(RuntimeWarning, match="never fired"):
+        eng.end_batch()
+    assert eng.injected == {}
+    # a consumed wedge ends the batch silently (survived instead)
+    eng2 = ChaosEngine({"faults": [{"kind": "wedge", "at_batch": 0}]})
+    eng2.begin_batch(0, "w0", "regfile")
+    assert eng2.take_wedge(1.0) is not None
+    eng2.end_batch()
+    assert eng2.survived == {"wedge": 1}
+
+
+# --- campaign-level chaos: bit-identical survival ---------------------------
+
+def _tiny_plan(**kw):
+    from shrewd_tpu.campaign.plan import CampaignPlan, WorkloadSpec
+    from shrewd_tpu.trace.synth import WorkloadConfig
+
+    defaults = dict(structures=["regfile"], batch_size=64,
+                    target_halfwidth=0.2, confidence=0.95,
+                    max_trials=128, min_trials=128)
+    defaults.update(kw)
+    plan = CampaignPlan(
+        simpoints=[WorkloadSpec(
+            name="w0", workload=WorkloadConfig(n=96, nphys=32, mem_words=64,
+                                               working_set_words=32,
+                                               seed=7))],
+        **defaults)
+    # canaries/audit off: these tests target the chaos/elastic machinery;
+    # the per-campaign canary/audit compiles would only slow the smoke
+    # (tests/test_integrity.py owns that coverage; invariants stay on —
+    # they are the detector the corrupt-tally fault must trip)
+    plan.integrity.canary_trials = 0
+    plan.integrity.audit_rate = 0.0
+    return plan
+
+
+def _final_results(orch):
+    from shrewd_tpu.sim.exit_event import ExitEvent
+
+    events = list(orch.events())
+    return events, (dict(events[-1][1])
+                    if events[-1][0] is ExitEvent.CAMPAIGN_COMPLETE
+                    else None)
+
+
+@pytest.fixture(scope="module")
+def clean_results():
+    """The undisturbed run every chaos scenario must reproduce exactly
+    (two batches: min_trials == max_trials == 2 * batch_size)."""
+    from shrewd_tpu.campaign.orchestrator import Orchestrator
+
+    _, results = _final_results(Orchestrator(_tiny_plan()))
+    assert results is not None
+    return results
+
+
+def _assert_bit_identical(clean, results):
+    assert results is not None
+    for k in clean:
+        np.testing.assert_array_equal(clean[k].tallies, results[k].tallies)
+
+
+def test_injected_tier_failure_survives_via_ladder(clean_results):
+    from shrewd_tpu.campaign.orchestrator import Orchestrator
+    from shrewd_tpu.sim.exit_event import ExitEvent
+
+    plan = _tiny_plan()
+    plan.resilience.max_retries = 0
+    plan.resilience.backoff_base = 0.0
+    orch = Orchestrator(plan)
+    orch.attach_chaos(ChaosEngine({"faults": [
+        {"kind": "backend_error", "at_batch": 0, "tier": "device",
+         "permanent": True}]}))
+    events, results = _final_results(orch)
+    _assert_bit_identical(clean_results, results)
+    assert ExitEvent.BACKEND_DEGRADED in [e for e, _ in events]
+    assert orch.chaos.injected == {"backend_error": 1}
+    assert orch.chaos.survived == {"backend_error": 1}
+    # batch 0 escaped to the oracle tier, batch 1 stayed on device
+    st = orch.state[("w0", "regfile")]
+    assert int(st.tier_trials[TIER_ORACLE]) == 64
+    assert int(st.tier_trials[TIER_DEVICE]) == 64
+
+
+def test_injected_wedge_exercises_real_watchdog(clean_results):
+    from shrewd_tpu.campaign.orchestrator import Orchestrator
+
+    plan = _tiny_plan()
+    # generous real deadline (first-compile safe); the injected wedge
+    # carries its own short one, so the test still runs in seconds
+    plan.resilience.dispatch_timeout = 60.0
+    plan.resilience.backoff_base = 0.0
+    orch = Orchestrator(plan)
+    orch.attach_chaos(ChaosEngine({"faults": [
+        {"kind": "wedge", "at_batch": 0, "times": 1}]}))
+    _, results = _final_results(orch)
+    _assert_bit_identical(clean_results, results)
+    assert orch.watchdog.timeouts == 1          # the wedge, nothing else
+    assert orch.chaos.injected == {"wedge": 1}
+    assert orch.chaos.survived == {"wedge": 1}
+    # recovered by RETRY on the device tier (transient wedge, no descent)
+    st = orch.state[("w0", "regfile")]
+    assert int(st.tier_trials[TIER_DEVICE]) == st.trials
+
+
+def test_injected_tally_corruption_quarantined_and_recovered(clean_results):
+    from shrewd_tpu.campaign.orchestrator import Orchestrator
+
+    orch = Orchestrator(_tiny_plan())
+    orch.attach_chaos(ChaosEngine({"faults": [
+        {"kind": "corrupt_tally", "at_batch": 1, "delta": 3}]}))
+    _, results = _final_results(orch)
+    _assert_bit_identical(clean_results, results)
+    assert orch.monitor.quarantined == 1
+    assert orch.monitor.recovered == 1
+    assert orch.chaos.injected == {"corrupt_tally": 1}
+    assert orch.chaos.survived == {"corrupt_tally": 1}
+    # the chaos stats group is populated in the dumps
+    text = statsmod.dump_text(orch.stats)
+    assert "campaign.chaos.injected" in text and "corrupt_tally" in text
+
+
+def test_injected_torn_checkpoint_survives_via_fallback(tmp_path):
+    from shrewd_tpu.campaign.orchestrator import Orchestrator
+
+    orch = Orchestrator(_tiny_plan(checkpoint_every=1),
+                        outdir=str(tmp_path))
+    orch.attach_chaos(ChaosEngine({"faults": [
+        {"kind": "torn_checkpoint", "at_ckpt": 1}]}))
+    _, results = _final_results(orch)
+    assert results is not None
+    assert orch.chaos.injected == {"torn_checkpoint": 1}
+    assert orch.chaos.survived == {"torn_checkpoint": 1}
+    # and the torn latest is still resumable end to end (prev fallback)
+    ckpt = os.path.join(str(tmp_path), "campaign_ckpt")
+    doc = Orchestrator.load_checkpoint_doc(ckpt)
+    assert doc["version"] >= 5
+
+
+def test_chaos_config_rides_the_plan(tmp_path):
+    """plan.chaos is a config child: a plan dumped with an inline spec
+    rebuilds an armed engine (the reproducibility contract)."""
+    from shrewd_tpu.campaign.orchestrator import Orchestrator
+    from shrewd_tpu.campaign.plan import CampaignPlan
+
+    plan = _tiny_plan()
+    plan.chaos.spec = json.dumps({"faults": [
+        {"kind": "corrupt_tally", "at_batch": 0}]})
+    plan2 = CampaignPlan.from_dict(plan.to_dict())
+    orch = Orchestrator(plan2)
+    assert orch.chaos is not None
+    assert orch.chaos.faults[0]["kind"] == "corrupt_tally"
+    assert orch.watchdog.chaos is orch.chaos    # wedge hook wired
+
+
+# --- graceful preemption ----------------------------------------------------
+
+def test_sigterm_drain_checkpoints_and_resumes_bit_identical(
+        tmp_path, clean_results):
+    from shrewd_tpu.campaign.orchestrator import Orchestrator
+    from shrewd_tpu.sim.exit_event import ExitEvent
+
+    orch = Orchestrator(_tiny_plan(), outdir=str(tmp_path))
+    events = []
+    for ev, payload in orch.events():
+        events.append((ev, payload))
+        if ev == ExitEvent.BATCH_COMPLETE:
+            orch.request_drain()       # what the SIGTERM handler does
+    kinds = [e for e, _ in events]
+    assert ExitEvent.PREEMPTED in kinds
+    assert ExitEvent.CAMPAIGN_COMPLETE not in kinds
+    assert orch.preempted and not orch.aborted
+    # the drain landed a checkpoint after exactly one batch
+    ckpt = events[-1][1]
+    assert ckpt and os.path.isdir(ckpt)
+    orch2 = Orchestrator.resume(ckpt)
+    assert orch2.state[("w0", "regfile")].trials == 64
+    _, results = _final_results(orch2)
+    _assert_bit_identical(clean_results, results)
+
+
+def test_signal_handler_requests_drain_then_escalates():
+    import signal
+
+    from shrewd_tpu.campaign.orchestrator import Orchestrator
+
+    orch = Orchestrator(_tiny_plan())
+    restore = orch.install_signal_handlers()
+    try:
+        handler = signal.getsignal(signal.SIGTERM)
+        handler(signal.SIGTERM, None)
+        assert orch._drain and not orch.preempted
+        with pytest.raises(KeyboardInterrupt):
+            handler(signal.SIGTERM, None)      # second signal: escape
+    finally:
+        restore()
+
+
+# --- elastic layer ----------------------------------------------------------
+
+def test_lease_board_claim_is_atomic_and_publish_roundtrips(tmp_path):
+    a = LeaseBoard(str(tmp_path), "a")
+    b = LeaseBoard(str(tmp_path), "b")
+    assert a.claim("w0.regfile.0")
+    assert not b.claim("w0.regfile.0")          # exactly one winner
+    assert a.owner("w0.regfile.0") == "a"
+    assert b.done("w0.regfile.0") is None
+    b.publish("w0.regfile.0", {"tally": [1, 2], "worker": "b"})
+    assert a.done("w0.regfile.0")["tally"] == [1, 2]
+    assert a.revoke("w0.regfile.0")
+    assert not a.revoke("w0.regfile.0")         # one winner among revokers
+    assert b.claim("w0.regfile.0")              # reclaimable after revoke
+
+
+def test_membership_sees_graceful_leave_and_staleness(tmp_path):
+    hb = HeartbeatWriter(str(tmp_path), "alpha", interval=0.05)
+    m = Membership(str(tmp_path), timeout=5.0)
+    assert not m.alive("alpha")
+    hb.beat()
+    assert m.alive("alpha") and m.workers() == ["alpha"]
+    old = time.time() - 100
+    os.utime(hb.path, (old, old))
+    assert not m.alive("alpha")                 # stale = lost
+    hb.beat()
+    hb.stop()
+    assert not m.alive("alpha")                 # graceful leave = gone
+
+
+def test_elastic_single_worker_matches_plain_run(tmp_path, clean_results):
+    from shrewd_tpu.campaign.orchestrator import Orchestrator
+
+    ctx = ElasticContext(str(tmp_path), "solo")
+    orch = Orchestrator(_tiny_plan())
+    orch.attach_elastic(ctx)
+    _, results = _final_results(orch)
+    ctx.stop()
+    _assert_bit_identical(clean_results, results)
+    assert ctx.claimed == 2 and ctx.adopted == 0
+    # published documents carry everything adoption needs
+    doc = ctx.board.done(ctx.key("w0", "regfile", 0))
+    assert doc["worker"] == "solo" and sum(doc["tally"]) == 64
+    assert "tier" in doc and "escapes" in doc
+
+
+def test_elastic_adopts_peer_results_bit_identically(tmp_path,
+                                                     clean_results):
+    """Worker B joins after worker A published everything: B adopts every
+    batch (compute-free) and still lands the identical cumulative state —
+    the agreement-without-a-barrier property."""
+    from shrewd_tpu.campaign.orchestrator import Orchestrator
+
+    a = ElasticContext(str(tmp_path), "a")
+    oa = Orchestrator(_tiny_plan())
+    oa.attach_elastic(a)
+    _, ra = _final_results(oa)
+    a.stop()
+    b = ElasticContext(str(tmp_path), "b")
+    ob = Orchestrator(_tiny_plan())
+    ob.attach_elastic(b)
+    _, rb = _final_results(ob)
+    b.stop()
+    _assert_bit_identical(clean_results, ra)
+    _assert_bit_identical(clean_results, rb)
+    assert b.adopted == 2 and b.claimed == 0
+
+
+def test_elastic_revokes_lost_workers_lease_and_recovers(
+        tmp_path, clean_results):
+    from shrewd_tpu.campaign.orchestrator import Orchestrator
+    from shrewd_tpu.sim.exit_event import ExitEvent
+
+    # a ghost worker claims batch 0, heartbeats once, then "dies"
+    ghost = ElasticContext(str(tmp_path), "ghost")
+    ghost.heartbeat.beat()
+    assert ghost.board.claim(ghost.key("w0", "regfile", 0))
+    old = time.time() - 100
+    os.utime(ghost.heartbeat.path, (old, old))
+
+    plan = _tiny_plan()
+    plan.elastic.heartbeat_timeout = 1.0
+    ctx = ElasticContext(str(tmp_path), "survivor", plan.elastic)
+    orch = Orchestrator(plan)
+    orch.attach_elastic(ctx)
+    events, results = _final_results(orch)
+    ctx.stop()
+    _assert_bit_identical(clean_results, results)
+    lost = [p for e, p in events if e == ExitEvent.WORKER_LOST]
+    assert len(lost) == 1 and lost[0].worker == "ghost"
+    assert "survivor" in lost[0].survivors
+    assert ctx.revoked == 1 and ctx.reclaimed == 1
+    text = statsmod.dump_text(orch.stats)
+    assert "campaign.elastic.leases_revoked" in text
+    assert ctx.counters()["workers_lost"] == 1
+
+
+def test_elastic_refuses_heterogeneous_batch_size_adoption(tmp_path):
+    """Workers whose local meshes imply different effective batch sizes
+    would lease differently-KEYED batches under the same batch_id —
+    adoption must fail loudly, not corrupt the trials accounting."""
+    from shrewd_tpu.campaign.orchestrator import Orchestrator
+    from shrewd_tpu.parallel.elastic import ElasticError
+
+    ctx = ElasticContext(str(tmp_path), "b")
+    # a peer published batch 0 computed under a different batch size
+    ctx.board.publish(ctx.key("w0", "regfile", 0), {
+        "worker": "a", "batch_id": 0, "batch_size": 72,
+        "tally": [72, 0, 0, 0], "strata": None, "tier": 0, "attempts": 1,
+        "escapes": 0, "taint_trials": 0})
+    orch = Orchestrator(_tiny_plan())
+    orch.attach_elastic(ctx)
+    with pytest.raises(ElasticError, match="batch_size"):
+        list(orch.events())
+    ctx.stop()
+
+
+def test_elastic_retracts_invalid_adopted_result_and_recomputes(
+        tmp_path, clean_results):
+    """A peer's published result with a VALID checksum but an invalid
+    tally (stale/buggy peer build) must be caught at the adoption trust
+    boundary, retracted, and recomputed — not absorbed into the AVF."""
+    from shrewd_tpu.campaign.orchestrator import Orchestrator
+
+    ctx = ElasticContext(str(tmp_path), "b")
+    orch = Orchestrator(_tiny_plan())
+    ctx.board.publish(ctx.key("w0", "regfile", 0), {
+        "worker": "evil", "batch_id": 0,
+        "batch_size": orch.batch_size,
+        "tally": [orch.batch_size, 0, 1, 0],   # sum != batch_size
+        "strata": None, "tier": 0, "attempts": 1,
+        "escapes": 0, "taint_trials": 0})
+    orch.attach_elastic(ctx)
+    _, results = _final_results(orch)
+    ctx.stop()
+    _assert_bit_identical(clean_results, results)
+    assert orch.monitor.quarantined == 1
+    assert orch.monitor.quarantine_log[0]["kind"] == "adopted"
+    # ...and a torn done-doc on disk reads as absent (checksum guard)
+    k2 = ctx.key("w0", "regfile", 1)
+    path = ctx.board._done(k2)
+    assert ctx.board.done(k2) is not None
+    tear_file(path)
+    assert ctx.board.done(k2) is None
+
+
+def test_elastic_gives_up_on_live_holders_claim_wait(tmp_path):
+    cfg = ElasticConfig(poll_interval=0.01, claim_wait=0.1, lookahead=0)
+    holder = ElasticContext(str(tmp_path), "holder", cfg)
+    holder.heartbeat.beat()                     # stays "alive"
+    assert holder.board.claim("k")
+    ctx = ElasticContext(str(tmp_path), "waiter", cfg)
+    from shrewd_tpu.parallel.elastic import DrainRequested, ElasticError
+    with pytest.raises(ElasticError):
+        ctx.obtain("k", lambda: {"tally": []})
+    # a drain request while blocked must NOT wait out claim_wait
+    with pytest.raises(DrainRequested):
+        ctx.obtain("k", lambda: {"tally": []},
+                   should_abort=lambda: True)
+
+
+def test_resume_refuses_mismatched_effective_batch_size(tmp_path):
+    """The effective batch size (plan rounded to the mesh) derives the
+    batch PRNG keys: resuming on a mesh that rounds differently would
+    mix incompatible key streams — resume must refuse, not diverge."""
+    import json as jsonmod
+
+    from shrewd_tpu import resilience as resil
+    from shrewd_tpu.campaign.orchestrator import Orchestrator
+
+    orch = Orchestrator(_tiny_plan(), outdir=str(tmp_path))
+    ckpt = orch.checkpoint()
+    path = os.path.join(ckpt, "campaign.json")
+    doc = jsonmod.load(open(path))
+    assert doc["batch_size"] == orch.batch_size
+    doc["batch_size"] = orch.batch_size + 8     # a different mesh's view
+    doc["checksum"] = resil.doc_checksum(doc)
+    resil.write_json_atomic(path, doc)
+    with pytest.raises(ValueError, match="PRNG keys would diverge"):
+        Orchestrator.resume(ckpt)
+
+
+# --- satellite: batch_size auto-round vs mesh size --------------------------
+
+def test_plan_batch_size_rounds_up_to_mesh_multiple():
+    import jax
+
+    from shrewd_tpu.campaign.orchestrator import Orchestrator
+    from shrewd_tpu.parallel.mesh import (make_mesh, round_up_to_mesh,
+                                          shard_keys)
+    from shrewd_tpu.utils import prng
+
+    assert round_up_to_mesh(60, 8) == 64
+    assert round_up_to_mesh(64, 8) == 64
+    assert round_up_to_mesh(1, 8) == 8
+    with pytest.raises(ValueError):
+        round_up_to_mesh(4, 0)
+    with pytest.warns(RuntimeWarning, match="rounded up"):
+        orch = Orchestrator(_tiny_plan(batch_size=60, min_trials=64,
+                                       max_trials=64))
+    assert orch.batch_size == 64
+    _, results = _final_results(orch)
+    assert results is not None
+    assert results[("w0", "regfile")].trials % 64 == 0
+    # the explicit low-level contract keeps the hard raise
+    mesh = make_mesh(jax.devices())
+    with pytest.raises(ValueError, match="not divisible"):
+        shard_keys(mesh, prng.trial_keys(prng.campaign_key(0),
+                                         mesh.size + 1))
+
+
+# --- satellite: checkpoint-directory fsync durability -----------------------
+
+def test_write_json_atomic_fsyncs_directory_after_rename(tmp_path,
+                                                         monkeypatch):
+    import stat as statmod
+
+    from shrewd_tpu import resilience as resil
+
+    calls = []
+    real_replace, real_fsync = os.replace, os.fsync
+
+    def spy_replace(src, dst):
+        calls.append(("replace", dst))
+        return real_replace(src, dst)
+
+    def spy_fsync(fd):
+        is_dir = statmod.S_ISDIR(os.fstat(fd).st_mode)
+        calls.append(("fsync_dir" if is_dir else "fsync_file", fd))
+        return real_fsync(fd)
+
+    monkeypatch.setattr(os, "replace", spy_replace)
+    monkeypatch.setattr(os, "fsync", spy_fsync)
+    path = str(tmp_path / "doc.json")
+    resil.write_json_atomic(path, {"x": 1})
+    kinds = [k for k, _ in calls]
+    # file fsync BEFORE the rename, directory fsync AFTER it
+    assert kinds == ["fsync_file", "replace", "fsync_dir"]
+
+
+def test_checkpoint_rotation_fsyncs_dir_between_renames(tmp_path,
+                                                        monkeypatch):
+    from shrewd_tpu import resilience as resil
+    from shrewd_tpu.campaign.orchestrator import Orchestrator
+
+    orch = Orchestrator(_tiny_plan(min_trials=64, max_trials=64),
+                        outdir=str(tmp_path))
+    ckpt = orch.checkpoint()               # first write: no rotation yet
+    seq = []
+    monkeypatch.setattr(os, "replace",
+                        lambda s, d, _r=os.replace: (seq.append("replace"),
+                                                     _r(s, d))[1])
+    monkeypatch.setattr(resil, "fsync_dir",
+                        lambda p, _f=resil.fsync_dir: (seq.append("fsync"),
+                                                       _f(p))[1])
+    orch.checkpoint()                      # rotation + fresh write
+    # rotation rename → dir fsync → tmp rename → dir fsync
+    assert seq == ["replace", "fsync", "replace", "fsync"]
+    assert os.path.exists(os.path.join(ckpt, "campaign.prev.json"))
+
+
+# --- satellite: watchdog leaked-thread accounting ---------------------------
+
+def test_watchdog_tracks_and_prunes_leaked_threads():
+    import threading
+
+    from shrewd_tpu.resilience import DeviceWatchdog, DispatchTimeout
+
+    w = DeviceWatchdog(timeout=0.05)
+    release = threading.Event()
+    for _ in range(3):
+        with pytest.raises(DispatchTimeout):
+            w.call(release.wait, 5.0)
+    assert w.leaked_threads == 3 and w.timeouts == 3
+    release.set()                          # the wedge "heals"
+    deadline = time.monotonic() + 5.0
+    while w.leaked_threads and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert w.leaked_threads == 0           # accounting prunes dead orphans
+
+
+def test_watchdog_warns_past_leak_cap():
+    import threading
+
+    from shrewd_tpu.resilience import DeviceWatchdog, DispatchTimeout
+
+    w = DeviceWatchdog(timeout=0.02)
+    w.leak_warn_cap = 1
+    release = threading.Event()
+    try:
+        with pytest.warns(RuntimeWarning, match="abandoned"):
+            for _ in range(3):
+                with pytest.raises(DispatchTimeout):
+                    w.call(release.wait, 5.0)
+    finally:
+        release.set()
+    assert w.leaked_threads >= 2

@@ -32,3 +32,29 @@ def test_two_process_campaign_matches_single_process():
     assert res["workers_agree"] and res["matches_single_process"], res
     assert res["global_devices"] == 4
     assert sum(res["tally"]) == 128
+
+
+def test_killed_worker_no_longer_wedges_survivor_elastic():
+    """The ISSUE acceptance criterion: a hard-killed worker in a
+    2-process CPU launch must not wedge the survivor.  In elastic mode
+    the survivor revokes the dead worker's batch lease (stale heartbeat),
+    re-dispatches it on the frozen PRNG keys, and finishes with a tally
+    bit-identical to an undisturbed single-process run — where the
+    collective mode (and dist-gem5's TCP barrier) would hang forever."""
+    env = dict(os.environ, PYTHONPATH=REPO)
+    env.pop("PYTEST_CURRENT_TEST", None)
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "dist_launch.py"),
+         "--mode", "elastic", "--num-processes", "2",
+         "--local-devices", "2", "--batch", "64", "--uops", "64",
+         "--num-batches", "4", "--kill-worker", "1", "--at-batch", "2",
+         "--timeout", "300"],
+        capture_output=True, text=True, env=env, timeout=420, cwd=REPO)
+    assert r.returncode == 0, r.stdout[-800:] + r.stderr[-800:]
+    line = next(ln for ln in r.stdout.splitlines() if ln.startswith("{"))
+    res = json.loads(line)
+    assert res["ok"], res
+    assert res["matches_single_process"], res
+    assert res["survivors"] == [0]
+    assert res["batches_reclaimed"] >= 1, res
+    assert res["elastic"]["w0"]["workers_lost"] == 1

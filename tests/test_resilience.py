@@ -307,7 +307,7 @@ def _wedge_device_tier(monkeypatch, fail=lambda calls: True):
     real_builder = resil.dispatcher_for_campaign
     calls = [0]
 
-    def patched(campaign, cfg=None, watchdog=None):
+    def patched(campaign, cfg=None, watchdog=None, chaos=None):
         real_fn = resil._device_tier(campaign)
 
         def wedgy(keys, stratified):
@@ -319,7 +319,7 @@ def _wedge_device_tier(monkeypatch, fail=lambda calls: True):
         cfg = cfg if cfg is not None else ResilienceConfig()
         return ResilientDispatcher(
             [(TIER_DEVICE, wedgy), (TIER_CPU, real_fn)], cfg,
-            watchdog=watchdog)
+            watchdog=watchdog, chaos=chaos)
 
     monkeypatch.setattr(resil, "dispatcher_for_campaign", patched)
     return real_builder

@@ -16,6 +16,20 @@ prints its replicated tally; the supervisor checks all workers agree and
 that the tally equals a single-process run of the same batch bit-for-bit
 (placement must not change outcomes — every trial's fate is a pure
 function of its PRNG key).
+
+``--mode elastic`` exercises the failure story the collective mode cannot
+have: N *independent* orchestrator processes share one campaign through
+the lease board (``shrewd_tpu/parallel/elastic.py`` — per-process meshes,
+no cross-process collective to wedge), and ``--kill-worker N --at-batch
+B`` hard-kills worker N at its B-th dispatched batch via the chaos
+harness (``shrewd_tpu/chaos.py``).  The supervisor asserts that the
+survivors revoke the dead worker's lease, finish the campaign, and that
+the post-recovery tally equals an undisturbed single-process run of the
+same plan BIT-FOR-BIT — where dist-gem5 would hang its TCP barrier
+forever on the first dead node:
+
+    python tools/dist_launch.py --mode elastic --num-processes 2 \
+        --kill-worker 1 --at-batch 2
 """
 
 from __future__ import annotations
@@ -189,10 +203,194 @@ def reference(args) -> int:
     return 0
 
 
+# --------------------------------------------------------------------------
+# elastic mode: lease-board campaign + chaos kill + bit-identity assertion
+# --------------------------------------------------------------------------
+
+def _elastic_plan(args):
+    """The shared campaign every elastic role runs: min_trials==max_trials
+    pins the batch count, so the undisturbed reference and the
+    kill/recover run must converge on exactly the same batch set."""
+    from shrewd_tpu.campaign.plan import CampaignPlan, WorkloadSpec
+    from shrewd_tpu.trace.synth import WorkloadConfig
+
+    trials = args.batch * args.num_batches
+    plan = CampaignPlan(
+        simpoints=[WorkloadSpec(name="w0", workload=WorkloadConfig(
+            n=args.uops, nphys=32, mem_words=64, working_set_words=32,
+            seed=args.seed))],
+        structures=["regfile"], batch_size=args.batch,
+        target_halfwidth=0.5, max_trials=trials, min_trials=trials,
+        seed=args.seed)
+    plan.machine.replay_kernel = "dense"
+    plan.integrity.canary_trials = 0
+    plan.integrity.audit_rate = 0.0
+    plan.elastic.heartbeat_interval = 0.2
+    plan.elastic.heartbeat_timeout = 2.0
+    return plan
+
+
+def _final_tallies(orch) -> dict:
+    from shrewd_tpu.sim.exit_event import ExitEvent
+
+    events = list(orch.events())
+    ev, payload = events[-1]
+    assert ev is ExitEvent.CAMPAIGN_COMPLETE, ev
+    return {f"{sp}/{st}": r.tallies.tolist()
+            for (sp, st), r in payload.items()}
+
+
+def elastic_worker(args) -> int:
+    import time
+
+    from shrewd_tpu.campaign.orchestrator import Orchestrator
+    from shrewd_tpu.parallel.elastic import ElasticContext
+
+    plan = _elastic_plan(args)
+    orch = Orchestrator(plan)
+    if args.kill_at_batch >= 0:
+        from shrewd_tpu.chaos import ChaosEngine
+
+        orch.attach_chaos(ChaosEngine(
+            {"faults": [{"kind": "kill_worker",
+                         "after_dispatches": args.kill_at_batch}]},
+            worker=args.worker))
+    ctx = ElasticContext(args.coord_dir, args.worker, plan.elastic)
+    if args.wait_for_lost:
+        # deterministic kill/recover scenario: hold all claims until the
+        # target worker has JOINED (first heartbeat seen) and then DIED
+        # (heartbeat stale) — the survivor then demonstrably recovers the
+        # dead worker's leased batches rather than winning a startup race
+        hb = ctx.membership._hb_path(args.wait_for_lost)
+        deadline = time.monotonic() + args.timeout / 2
+        while time.monotonic() < deadline:
+            if os.path.exists(hb) \
+                    and not ctx.membership.alive(args.wait_for_lost):
+                break
+            time.sleep(0.1)
+        else:
+            print(f"timed out waiting for {args.wait_for_lost} to die",
+                  file=sys.stderr)
+            return 1
+    orch.attach_elastic(ctx)
+    tallies = _final_tallies(orch)
+    ctx.stop()
+    print(json.dumps({"worker": args.worker, "tallies": tallies,
+                      "elastic": ctx.counters()}), flush=True)
+    return 0
+
+
+def elastic_reference(args) -> int:
+    from shrewd_tpu.campaign.orchestrator import Orchestrator
+
+    print(json.dumps({"tallies": _final_tallies(
+        Orchestrator(_elastic_plan(args)))}), flush=True)
+    return 0
+
+
+def supervise_elastic(args) -> int:
+    import tempfile
+
+    env = _worker_env(args.local_devices)
+    with tempfile.TemporaryDirectory(prefix="shrewd_elastic_") as coord:
+        procs = []
+        for pid in range(args.num_processes):
+            argv = [sys.executable, os.path.abspath(__file__),
+                    "--role", "elastic-worker", "--coord-dir", coord,
+                    "--worker", f"w{pid}", "--batch", str(args.batch),
+                    "--uops", str(args.uops), "--seed", str(args.seed),
+                    "--num-batches", str(args.num_batches),
+                    "--timeout", str(args.timeout)]
+            if pid == args.kill_worker:
+                argv += ["--kill-at-batch", str(args.at_batch)]
+            elif args.kill_worker >= 0:
+                # survivors hold claims until the target has joined and
+                # died, so the run demonstrably RECOVERS leased batches
+                # instead of winning a startup race against the victim
+                argv += ["--wait-for-lost", f"w{args.kill_worker}"]
+            procs.append(subprocess.Popen(
+                argv, env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE, text=True))
+        results, failures = {}, {}
+        for pid, p in enumerate(procs):
+            try:
+                out, err = p.communicate(timeout=args.timeout)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                out, err = p.communicate()
+                failures[pid] = f"TIMEOUT\n{err[-500:]}"
+                continue
+            if pid == args.kill_worker:
+                # the chaos kill exits rc 137 by design; a killed worker
+                # that somehow "succeeded" means the kill never fired
+                if p.returncode == 0:
+                    failures[pid] = "kill target exited 0 (kill not fired)"
+                continue
+            if p.returncode != 0:
+                failures[pid] = f"rc={p.returncode}\n{err[-800:]}"
+                continue
+            line = next((ln for ln in out.splitlines()
+                         if ln.startswith("{")), None)
+            if line is None:
+                failures[pid] = f"no result line\n{err[-500:]}"
+                continue
+            results[pid] = json.loads(line)
+        for pid, why in failures.items():
+            print(f"worker {pid}: {why}", file=sys.stderr)
+
+    # undisturbed single-process reference of the same plan
+    ref_tallies = None
+    try:
+        ref = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--role",
+             "elastic-reference", "--batch", str(args.batch),
+             "--uops", str(args.uops), "--seed", str(args.seed),
+             "--num-batches", str(args.num_batches)],
+            env=env, capture_output=True, text=True, timeout=args.timeout)
+        if ref.returncode == 0:
+            line = next((ln for ln in ref.stdout.splitlines()
+                         if ln.startswith("{")), None)
+            if line is not None:
+                ref_tallies = json.loads(line)["tallies"]
+    except subprocess.TimeoutExpired:
+        print("reference run: TIMEOUT", file=sys.stderr)
+
+    survivor_tallies = [r["tallies"] for r in results.values()]
+    expect_survivors = args.num_processes - (
+        1 if 0 <= args.kill_worker < args.num_processes else 0)
+    agree = (len(survivor_tallies) == expect_survivors > 0
+             and all(t == survivor_tallies[0] for t in survivor_tallies))
+    reclaimed = sum(r["elastic"]["batches_reclaimed"]
+                    for r in results.values())
+    result = {
+        "ok": bool(not failures and agree and ref_tallies is not None
+                   and survivor_tallies[0] == ref_tallies
+                   and (args.kill_worker < 0 or reclaimed >= 1)),
+        "mode": "elastic",
+        "survivors": sorted(results),
+        "survivors_agree": agree,
+        "tallies": survivor_tallies[0] if survivor_tallies else None,
+        "single_process_tallies": ref_tallies,
+        "matches_single_process": (bool(survivor_tallies)
+                                   and survivor_tallies[0] == ref_tallies),
+        "batches_reclaimed": reclaimed,
+        "elastic": {f"w{pid}": r["elastic"] for pid, r in results.items()},
+    }
+    print(json.dumps(result))
+    return 0 if result["ok"] else 1
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--role", default="supervisor",
-                    choices=("supervisor", "worker", "reference"))
+                    choices=("supervisor", "worker", "reference",
+                             "elastic-worker", "elastic-reference"))
+    ap.add_argument("--mode", default="collective",
+                    choices=("collective", "elastic"),
+                    help="collective: one jax.distributed mesh (a dead "
+                         "worker wedges the psum); elastic: independent "
+                         "per-process meshes over a shared lease board "
+                         "(a dead worker's batches are reclaimed)")
     ap.add_argument("--num-processes", type=int, default=2)
     ap.add_argument("--process-id", type=int, default=0)
     ap.add_argument("--local-devices", type=int, default=4)
@@ -205,11 +403,36 @@ def main() -> int:
                     help="skip the pre-flight backend health probe")
     ap.add_argument("--probe-timeout", type=float, default=55.0,
                     help="backend_probe.py self-exit watchdog seconds")
+    # elastic-mode arguments
+    ap.add_argument("--num-batches", type=int, default=4,
+                    help="elastic: batches in the shared campaign")
+    ap.add_argument("--coord-dir", default="",
+                    help="elastic worker: shared coordination directory")
+    ap.add_argument("--worker", default="w0",
+                    help="elastic worker: worker name")
+    ap.add_argument("--kill-worker", type=int, default=-1,
+                    help="elastic supervisor: worker index to hard-kill "
+                         "(-1 = none)")
+    ap.add_argument("--at-batch", type=int, default=2,
+                    help="elastic supervisor: kill the worker at its Nth "
+                         "dispatched batch (1-based)")
+    ap.add_argument("--kill-at-batch", type=int, default=-1,
+                    help="elastic worker (internal): self-kill at the Nth "
+                         "dispatched batch")
+    ap.add_argument("--wait-for-lost", default="",
+                    help="elastic worker (internal): hold claims until "
+                         "this worker has joined and died")
     args = ap.parse_args()
     if args.role == "worker":
         return worker(args)
     if args.role == "reference":
         return reference(args)
+    if args.role == "elastic-worker":
+        return elastic_worker(args)
+    if args.role == "elastic-reference":
+        return elastic_reference(args)
+    if args.mode == "elastic":
+        return supervise_elastic(args)
     return supervise(args)
 
 
