@@ -393,6 +393,98 @@ def _load_pinned_baseline(n_uops: int) -> float | None:
 
 
 # --------------------------------------------------------------------------
+# pipelined-campaign microbenchmark: serial loop vs pipelined engine
+# --------------------------------------------------------------------------
+
+def _pipeline_microcampaign(quick: bool) -> dict:
+    """Serial-vs-pipelined wall-clock on the REAL campaign loop (the
+    orchestrator, with the default integrity posture — canaries, tally
+    invariants, differential audit — as the host-side work the pipeline
+    overlaps).  Warm runs first compile every executable into the shared
+    cache (parallel/exec_cache.py), so the timed pair measures loop
+    mechanics (dispatch, transfers, host work), not XLA compile time.
+    Also asserts the two timed runs' tallies are bit-identical — a perf
+    number from diverging tallies is not a perf number."""
+    import numpy as np
+
+    from shrewd_tpu import stats as statsmod
+    from shrewd_tpu.campaign.orchestrator import Orchestrator
+    from shrewd_tpu.campaign.plan import CampaignPlan, WorkloadSpec
+    from shrewd_tpu.trace.synth import WorkloadConfig
+
+    # small batches on purpose: the pipeline's win is amortizing
+    # per-batch overhead (dispatch, transfer, canary, python bookkeeping)
+    # across a sync interval — the regime a real TPU campaign lives in,
+    # where host-side seconds per batch rival device microseconds
+    n_batches = 48 if quick else 96
+    batch = 32
+    sync_every, depth = 8, 2
+
+    def make_plan(sync: int) -> CampaignPlan:
+        p = CampaignPlan(
+            simpoints=[WorkloadSpec(name="w0", workload=WorkloadConfig(
+                n=96, nphys=64, mem_words=256, working_set_words=64,
+                seed=11))],
+            structures=["regfile"], batch_size=batch,
+            target_halfwidth=0.5, max_trials=batch * n_batches,
+            min_trials=batch * n_batches)
+        # audit off for the TIMED pair: the differential audit is pure
+        # jax compute, identical in both arms, and on a CPU backend it
+        # contends with the campaign step for the same cores (nothing to
+        # overlap) — it would only dilute the loop-mechanics ratio this
+        # stage exists to measure.  Canaries stay at the default posture:
+        # their amortization to interval boundaries is part of the
+        # pipelined design under test.
+        p.integrity.audit_rate = 0.0
+        p.pipeline.sync_every = sync
+        p.pipeline.depth = depth
+        return p
+
+    def run(sync: int):
+        orch = Orchestrator(make_plan(sync))
+        t0 = time.monotonic()
+        for _event, _payload in orch.events():
+            pass
+        return time.monotonic() - t0, orch
+
+    run(1)                       # warm: serial per-batch executables
+    run(sync_every)              # warm: interval executables (AOT)
+    s1, orch_s = run(1)
+    p1, orch_p = run(sync_every)
+    s2, _ = run(1)               # best-of-2 per arm: a 2-core box is
+    p2, _ = run(sync_every)      # noisy at sub-second loop times
+    serial_s, piped_s = min(s1, s2), min(p1, p2)
+    t_s = next(iter(orch_s.results.values())).tallies
+    t_p = next(iter(orch_p.results.values())).tallies
+    identical = bool(np.array_equal(t_s, t_p))
+    if not identical:
+        # a perf number from diverging tallies is not a perf number: fail
+        # the stage loudly (the bench line then ships WITHOUT pipeline
+        # fields — an observable absence — and tier-1 pins bit-identity
+        # fatally in tests/test_pipeline.py)
+        raise RuntimeError(
+            f"pipelined tallies diverged from serial: {t_s.tolist()} != "
+            f"{t_p.tolist()}")
+    perf = statsmod.to_dict(orch_p.stats)["perf"]
+    out = {
+        "campaign_serial_s": round(serial_s, 3),
+        "campaign_pipelined_s": round(piped_s, 3),
+        "pipeline_speedup": round(serial_s / piped_s, 3),
+        "pipeline_sync_every": sync_every,
+        "pipeline_depth": depth,
+        "pipeline_bit_identical": identical,
+        "campaign_perf": {k: (round(v, 4) if isinstance(v, float) else v)
+                          for k, v in perf.items()},
+    }
+    log(f"campaign loop ({n_batches} batches x {batch} trials): serial "
+        f"{serial_s:.2f}s, pipelined(sync={sync_every},depth={depth}) "
+        f"{piped_s:.2f}s -> x{out['pipeline_speedup']:.2f} "
+        f"(bit-identical={identical}, overlap "
+        f"{out['campaign_perf'].get('overlap_fraction')})")
+    return out
+
+
+# --------------------------------------------------------------------------
 # worker: one platform, real measurement
 # --------------------------------------------------------------------------
 
@@ -551,6 +643,16 @@ def run_worker(args) -> None:
     else:
         extra["vs_baseline"] = extra["vs_baseline_fresh"]
     emit(device_rate, extra)
+
+    # pipelined campaign engine vs the serial loop on the REAL
+    # orchestrator (runs in --quick too: it is the ci_tier1 smoke's
+    # subject and the acceptance gate for the pipelined-engine PR)
+    try:
+        if budget_left("pipeline microcampaign"):
+            extra.update(_pipeline_microcampaign(args.quick))
+    except Exception as e:  # noqa: BLE001 — optional stage
+        log(f"pipeline microcampaign skipped: {type(e).__name__}: "
+            f"{str(e)[:300]}")
 
     # Pallas on/off delta (the fast pass is auto-enabled on TPU backends;
     # force-off comparison quantifies its win on the same device)

@@ -34,6 +34,8 @@ from shrewd_tpu.models.o3 import STRUCTURES
 from shrewd_tpu.ops import classify as C
 from shrewd_tpu.ops.trial import TrialKernel
 from shrewd_tpu.parallel import elastic as elastic_mod
+from shrewd_tpu.parallel import exec_cache
+from shrewd_tpu.parallel import pipeline as pipeline_mod
 from shrewd_tpu.parallel import stopping
 from shrewd_tpu.parallel.campaign import ShardedCampaign
 from shrewd_tpu.parallel.mesh import make_mesh, round_up_to_mesh
@@ -302,6 +304,16 @@ class Orchestrator:
         # batches are leased from the shared board instead of computed
         # unconditionally, and peer results are adopted bit-identically
         self._elastic = None
+        # pipelined engine (parallel/pipeline.py): sync_every > 1 overlaps
+        # device compute with the host-side integrity/stats/checkpoint
+        # work; sync_every = 1 (the default) is exactly the serial loop
+        self.pcfg = plan.pipeline
+        self._perf = pipeline_mod.PerfStats()
+        self._engines: dict[tuple[int, str],
+                            pipeline_mod.PipelinedEngine] = {}
+        if self.pcfg.compilation_cache_dir:
+            exec_cache.enable_persistent_cache(
+                self.pcfg.compilation_cache_dir)
         # probe points (utils/probes; gem5 ProbePoint pattern): listeners
         # attach without the orchestrator knowing who observes.  Payloads
         # are batch-granular — BatchInfo / StructureResult / ckpt path.
@@ -478,6 +490,40 @@ class Orchestrator:
         ig.recovered_batches = statsmod.Formula(
             "recovered_batches", lambda: mon.recovered,
             "quarantined batches recovered with a clean tally")
+        # pipeline performance accounting: the perf_opt contract is that
+        # the speedup is OBSERVABLE — device/host seconds, the overlap
+        # fraction, and the executable-cache hit ledger are first-class
+        # stats, reported by bench.py alongside the headline rate
+        perf = self._perf
+        pg = statsmod.Group("perf")
+        self.stats.perf = pg
+        pg.device_step_seconds = statsmod.Formula(
+            "device_step_seconds", lambda: perf.device_step_seconds,
+            "dispatch-to-materialization latency summed over intervals")
+        pg.device_wait_seconds = statsmod.Formula(
+            "device_wait_seconds", lambda: perf.device_wait_seconds,
+            "host time BLOCKED waiting on device results")
+        pg.host_seconds = statsmod.Formula(
+            "host_seconds", lambda: perf.host_seconds,
+            "host-side work time while intervals were in flight")
+        pg.overlap_fraction = statsmod.Formula(
+            "overlap_fraction", lambda: perf.overlap_fraction(),
+            "fraction of device latency hidden behind host work")
+        pg.dispatch_depth = statsmod.Formula(
+            "dispatch_depth", lambda: perf.depth_hwm,
+            "in-flight interval high-water mark")
+        pg.intervals = statsmod.Formula(
+            "intervals", lambda: perf.intervals,
+            "sync intervals believed through the pipelined path")
+        pg.serial_fallbacks = statsmod.Formula(
+            "serial_fallbacks", lambda: perf.serial_fallbacks,
+            "intervals recovered through the serial per-batch ladder")
+        pg.executables_compiled = statsmod.Formula(
+            "executables_compiled", lambda: exec_cache.cache().compiled,
+            "campaign-step executables compiled (process-wide cache)")
+        pg.executables_reused = statsmod.Formula(
+            "executables_reused", lambda: exec_cache.cache().reused,
+            "campaign-step executables reused from the cache")
         # refresh from restored state (resume path)
         for (spn, s), st in self.state.items():
             sg = getattr(getattr(self.stats, f"sp_{spn}"), f"st_{s}")
@@ -591,6 +637,50 @@ class Orchestrator:
                 sp_name, structure, structure_key=sk)
         return self._checked[key]
 
+    def engine(self, sp_idx: int, sp_name: str, structure: str
+               ) -> pipeline_mod.PipelinedEngine:
+        """The pipelined engine for one campaign (parallel/pipeline.py):
+        shares the orchestrator's integrity monitor, chaos engine and perf
+        ledger; recovery routes through the same checked dispatcher the
+        serial loop uses, so failure semantics are identical."""
+        key = (sp_idx, structure)
+        if key not in self._engines:
+            self._engines[key] = pipeline_mod.PipelinedEngine(
+                self.campaign(sp_idx, structure),
+                self.checked_dispatcher(sp_idx, sp_name, structure),
+                self._structure_prng_key(sp_idx, structure),
+                self.batch_size, self._ceiling_batches,
+                sync_every=self.pcfg.sync_every, depth=self.pcfg.depth,
+                monitor=self.monitor, chaos=self.chaos, perf=self._perf,
+                sp_name=sp_name, structure=structure)
+        return self._engines[key]
+
+    @property
+    def _ceiling_batches(self) -> int:
+        """Batches the stopping rule could possibly consume (the
+        ``max_trials`` ceiling) — the ONE definition the pipelined
+        engine's dispatch-ahead bound and ``_interval_len``'s ragged
+        final interval must share (a divergence would make ``_fill``
+        raise its past-the-ceiling error)."""
+        return -(-int(self.plan.max_trials) // self.batch_size)
+
+    def _interval_len(self, st: _State, camp: ShardedCampaign) -> int:
+        """Effective sync-interval length for one campaign's next
+        dispatch: the plan's ``sync_every`` bounded by the remaining
+        batch budget (the ragged final interval before ``max_trials``),
+        or 0 — the serial per-batch loop — where pipelining cannot
+        apply: elastic campaigns lease individual batches, and
+        host-resolution / multi-process campaigns have no
+        device-accumulable step.  A 1-batch ragged TAIL of a pipelined
+        campaign still returns 1 (not 0): the engine may already hold
+        that batch in flight from dispatch-ahead, and consuming it there
+        avoids recomputing it serially."""
+        k = int(self.pcfg.sync_every)
+        if (k <= 1 or self._elastic is not None
+                or not camp.supports_intervals):
+            return 0
+        return max(1, min(k, self._ceiling_batches - st.next_batch))
+
     def _structure_prng_key(self, sp_idx: int, structure: str):
         """The frozen PRNG key every batch of one (simpoint, structure)
         campaign derives from — the single source both the drive loop and
@@ -696,6 +786,11 @@ class Orchestrator:
                 if self._elastic is not None:
                     doc, adopted = self._elastic_obtain(
                         sp_idx, sp_name, structure, st, camp)
+                elif (k_int := self._interval_len(st, camp)) >= 1:
+                    doc = self._compute_interval(
+                        sp_idx, sp_name, structure, camp,
+                        st.next_batch, k_int)
+                    adopted = False
                 else:
                     doc = self._compute_batch(sp_idx, sp_name, structure,
                                               camp, sk, st.next_batch)
@@ -746,6 +841,12 @@ class Orchestrator:
                 st.strata += sarr
             tally = np.asarray(doc["tally"], dtype=np.int64)
             tier = int(doc.get("tier", resil.TIER_DEVICE))
+            # a pipelined doc covers a whole sync interval: n_batches > 1,
+            # optionally with per-batch tier provenance from a recovery
+            n_batches = int(doc.get("n_batches", 1))
+            n_new = self.batch_size * n_batches
+            tiers_list = [int(t) for t in
+                          (doc.get("tiers") or [tier] * n_batches)]
             # cumulative-monotonicity invariant: belt-and-braces over the
             # per-batch checks (a non-negative tally cannot regress the
             # cumulative counters, so a trip here means host-side state
@@ -772,14 +873,16 @@ class Orchestrator:
                         self.checkpoint()
                     return
             st.tallies += tally
-            st.next_batch += 1
+            prev_nb = st.next_batch
+            st.next_batch += n_batches
             st.escapes += int(doc.get("escapes", 0))
             st.taint_trials += int(doc.get("taint_trials", 0))
-            st.tier_trials[tier] += self.batch_size
-            self.budget.record(tier, self.batch_size)
-            sg.trials += self.batch_size
+            for t in tiers_list:
+                st.tier_trials[t] += self.batch_size
+                self.budget.record(t, self.batch_size)
+                sg.tiers.add(t, self.batch_size)
+            sg.trials += n_new
             sg.outcomes += tally
-            sg.tiers.add(tier, self.batch_size)
             avf_live = float(C.avf(st.tallies))
             debug.dprintf("Campaign", "%s/%s batch %d: trials=%d avf=%.4f"
                           " tier=%s%s", sp_name, structure, st.next_batch,
@@ -849,11 +952,33 @@ class Orchestrator:
                         self.checkpoint()
                     return
 
+            # interval-aware cadence: a sync interval may jump next_batch
+            # past the exact multiple, so checkpoint on every CROSSING of
+            # a checkpoint_every boundary (identical to % == 0 when
+            # n_batches == 1)
             if (plan.checkpoint_every and self.outdir and
-                    st.next_batch % plan.checkpoint_every == 0):
+                    st.next_batch // plan.checkpoint_every
+                    > prev_nb // plan.checkpoint_every):
                 ckpt = self.checkpoint()
                 self.pp_checkpoint.notify(ckpt)
                 yield ExitEvent.CHECKPOINT, ckpt
+
+    def _arm_chaos(self, batch_ids, sp_name: str, structure: str) -> None:
+        """Arm the deterministic chaos schedule for the batches about to
+        be obtained (one id = the serial loop, several = one sync
+        interval — the armed set is the union either way): worker kills
+        fire here at the boundary before any work, and an armed tally
+        corruption lands on the result's believed tally."""
+        if self.chaos is None:
+            return
+        self.chaos.begin_batches(batch_ids, sp_name, structure)
+        self.chaos.maybe_kill()
+        cspec = self.chaos.take_corrupt_tally()
+        if cspec is not None:
+            delta = int(cspec.get("delta", 1))
+            self.monitor.arm_corruption(
+                lambda t, d=delta: t + d, times=1,
+                note=lambda: self.chaos.note_fired("corrupt_tally"))
 
     def _compute_batch(self, sp_idx: int, sp_name: str, structure: str,
                        camp, sk, batch_id: int) -> dict:
@@ -866,15 +991,7 @@ class Orchestrator:
         wedge inside the watchdog, per-tier BackendErrors inside the
         ladder, tally corruption inside the checked dispatcher, and the
         worker kill at the boundary before any work."""
-        if self.chaos is not None:
-            self.chaos.begin_batch(batch_id, sp_name, structure)
-            self.chaos.maybe_kill()
-            cspec = self.chaos.take_corrupt_tally()
-            if cspec is not None:
-                delta = int(cspec.get("delta", 1))
-                self.monitor.arm_corruption(
-                    lambda t, d=delta: t + d, times=1,
-                    note=lambda: self.chaos.note_fired("corrupt_tally"))
+        self._arm_chaos([batch_id], sp_name, structure)
         keys = prng.trial_keys(prng.batch_key(sk, batch_id),
                                self.batch_size)
         # per-structure DELTAS of the kernel's shared running escape
@@ -901,6 +1018,32 @@ class Orchestrator:
             "taint_trials": (int(getattr(camp.kernel, "taint_trials", 0))
                              - tt0),
         }
+
+    def _compute_interval(self, sp_idx: int, sp_name: str, structure: str,
+                          camp, b0: int, k: int) -> dict:
+        """Obtain ONE sync interval (k batches) through the pipelined
+        engine.  Same believed-result document shape as ``_compute_batch``
+        plus ``n_batches``/``tiers``; integrity checks run on the interval
+        deltas, and any failure recovers through the serial per-batch
+        ladder on frozen keys (parallel/pipeline.py).
+
+        Chaos hook point: batch-granular faults scheduled on ANY of the
+        interval's batch ids arm here and fire at the pipelined
+        equivalents of their serial hook points (the wedge at
+        materialization under the armed deadline, tier errors at consume
+        time, tally corruption on the interval result, the worker kill at
+        the interval boundary before any work)."""
+        self._arm_chaos(range(b0, b0 + k), sp_name, structure)
+        esc0 = int(getattr(camp.kernel, "escapes", 0))
+        tt0 = int(getattr(camp.kernel, "taint_trials", 0))
+        doc = self.engine(sp_idx, sp_name, structure).obtain(
+            b0, k, stratified=camp.stratify)
+        if self.chaos is not None:
+            self.chaos.end_batch()
+        doc["escapes"] = int(getattr(camp.kernel, "escapes", 0)) - esc0
+        doc["taint_trials"] = (int(getattr(camp.kernel, "taint_trials", 0))
+                               - tt0)
+        return doc
 
     def _elastic_obtain(self, sp_idx: int, sp_name: str, structure: str,
                         st: _State, camp) -> tuple[dict, bool]:

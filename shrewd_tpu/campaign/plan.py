@@ -23,6 +23,7 @@ from shrewd_tpu.models.noc import NocConfig
 from shrewd_tpu.models.o3 import O3Config, STRUCTURES
 from shrewd_tpu.models.ruby import CacheConfig
 from shrewd_tpu.parallel.elastic import ElasticConfig
+from shrewd_tpu.parallel.pipeline import PipelineConfig
 from shrewd_tpu.resilience import ResilienceConfig
 from shrewd_tpu.trace import synth
 from shrewd_tpu.trace.format import Trace
@@ -144,6 +145,12 @@ class CampaignPlan(ConfigObject):
     # campaign's injected-failure plan comes from, so a chaos run is
     # reproducible from its config dump like every other posture
     chaos = Child(ChaosConfig)
+    # pipelined-engine posture (parallel/pipeline.py): sync-interval
+    # length, in-flight depth, and the opt-in persistent compilation
+    # cache — sync_every = 1 (the default) is exactly the serial loop,
+    # and pipelined tallies are bit-identical at any sync_every because
+    # per-batch tallies are pure functions of their frozen PRNG keys
+    pipeline = Child(PipelineConfig)
     # non-O3 fault tiers (used only when a tier-qualified structure is in
     # ``structures``)
     cache = Child(CacheConfig)

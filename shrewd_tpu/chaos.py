@@ -220,6 +220,22 @@ class ChaosEngine:
             self._armed.setdefault(s["kind"], []).append(
                 {"spec": s, "left": times, "fired": False})
 
+    def begin_batches(self, batch_ids, simpoint: str = "",
+                      structure: str = "") -> None:
+        """Interval-scoped arming (the pipelined engine consumes one sync
+        interval at a time): arm the UNION of faults triggered by any id
+        in ``batch_ids``, advancing the per-process dispatch counter once
+        per batch — a batch-granular plan keeps firing at the same
+        campaign coordinates whether the loop is serial or pipelined."""
+        ids = [int(b) for b in batch_ids]
+        armed: dict[str, list[dict]] = {}
+        for b in ids:
+            self.begin_batch(b, simpoint, structure)
+            for kind, states in self._armed.items():
+                armed.setdefault(kind, []).extend(states)
+        self._armed = armed
+        self._batch = (ids[0] if ids else -1, simpoint, structure)
+
     def end_batch(self) -> None:
         """The batch's tally was believed (invariants/canaries passed,
         quarantine recovered): every fault that fired during it was

@@ -660,6 +660,34 @@ class CheckedDispatcher:
                           "batch %d", len(mismatches), idx.size,
                           self.sp_name, self.structure, batch_id)
 
+    # --- interval-granular surface (the pipelined engine) ---------------
+    #
+    # The pipelined engine (parallel/pipeline.py) materializes one sync
+    # interval at a time and runs the SAME defenses at interval
+    # boundaries on the cumulative deltas: the canary battery still runs
+    # on the batch's dispatch tier, the invariants still require
+    # sum == trials (now the interval's trial count), and the audit still
+    # samples each batch with its own deterministic per-batch draw — so
+    # the mismatch ledger is identical whichever loop ran.
+
+    def check_result(self, res: DispatchResult,
+                     n_trials: int) -> list[dict]:
+        """Invariants + canaries for a believed-result candidate covering
+        ``n_trials`` trials (a batch or a whole sync interval); returns
+        failure evidence (empty = believed)."""
+        return self._check(res, n_trials)
+
+    def audit_batch(self, keys, batch_id: int) -> None:
+        """Differential-audit one batch's keys under its own
+        deterministic sample (resume and pipelined runs re-audit the
+        same trials)."""
+        self._audit(keys, batch_id)
+
+    def sync_shard_counters(self, batch_id: int) -> None:
+        """Fold the campaign's shard-vs-psum counters into the shared
+        monitor (evidence attributed to ``batch_id``)."""
+        self._sync_shard_counters(batch_id)
+
     # --- the checked dispatch ------------------------------------------
 
     def tally_batch(self, keys, stratified: bool = False,

@@ -59,6 +59,16 @@ def _apply_resilience_overrides(orch, args) -> None:
         icfg.audit_action = args.audit_action
     if getattr(args, "canary_trials", None) is not None:
         icfg.canary_trials = args.canary_trials
+    pcfg = orch.pcfg
+    if getattr(args, "sync_every", None) is not None:
+        pcfg.sync_every = args.sync_every
+    if getattr(args, "pipeline_depth", None) is not None:
+        pcfg.depth = args.pipeline_depth
+    if getattr(args, "compilation_cache_dir", None):
+        from shrewd_tpu.parallel.exec_cache import enable_persistent_cache
+
+        pcfg.compilation_cache_dir = args.compilation_cache_dir
+        enable_persistent_cache(args.compilation_cache_dir)
 
 
 def _apply_chaos_elastic(orch, args) -> None:
@@ -345,6 +355,20 @@ def main(argv: list[str] | None = None) -> int:
     resil.add_argument("--worker", default=None,
                        help="worker name for elastic/chaos runs "
                             "(default: w<pid>)")
+    resil.add_argument("--sync-every", type=int, default=None,
+                       help="batches accumulated on device per host "
+                            "transfer (plan.pipeline.sync_every; 1 = the "
+                            "serial loop, >1 enables the pipelined "
+                            "engine — bit-identical tallies either way)")
+    resil.add_argument("--pipeline-depth", type=int, default=None,
+                       help="max sync intervals in flight "
+                            "(plan.pipeline.depth, default 2 = double "
+                            "buffering)")
+    resil.add_argument("--compilation-cache-dir", default=None,
+                       help="opt-in persistent jax compilation cache "
+                            "directory: re-runs and resumes skip "
+                            "retrace/recompile of unchanged campaign "
+                            "steps (plan.pipeline.compilation_cache_dir)")
 
     p = sub.add_parser("run", help="run a campaign plan to completion",
                        parents=[common, resil])

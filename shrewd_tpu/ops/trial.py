@@ -17,8 +17,6 @@ in-framework oracle (the CheckerCPU pattern) and the shard_map-traceable
 
 from __future__ import annotations
 
-from functools import partial
-
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -88,6 +86,7 @@ class TrialKernel:
         self._golden_rec = None         # taint-kernel streams, lazy
         self._samplers: dict = {}
         self._sample_jits: dict = {}
+        self._shared_jits: dict = {}    # instance fast path over exec_cache
         # taint observability: escape counts feed campaign stats
         self.escapes = 0
         self.taint_trials = 0
@@ -134,11 +133,32 @@ class TrialKernel:
         return jax.vmap(
             lambda r: C.classify(r, self.golden, self.cfg.compare_regs))(results)
 
-    @partial(jax.jit, static_argnums=0)
+    def _shared_jit(self, kind: str, build, **flags):
+        """Kernel-level jits through the process-wide executable cache
+        (parallel/exec_cache.py), keyed by trace content + config: the
+        old ``partial(jax.jit, static_argnums=0)`` methods were keyed by
+        *instance*, so every TrialKernel over the same trace — the CPU
+        fallback tier, the canary battery and audit oracle of each new
+        orchestrator, bench warm-up/timed pairs — re-traced and
+        re-compiled identical programs."""
+        k = (kind, tuple(sorted(flags.items())))
+        fn = self._shared_jits.get(k)
+        if fn is None:
+            from shrewd_tpu.parallel import exec_cache
+
+            structure = flags.pop("structure", "")
+            fn = exec_cache.cache().get(
+                exec_cache.step_key(self, None, structure, kind=kind,
+                                    **flags),
+                owner=self, build=build)
+            self._shared_jits[k] = fn
+        return fn
+
     def run_batch(self, faults: Fault) -> jax.Array:
         """Fault batch (vmapped leaves) → outcome classes int32[B], dense
         kernel (the in-framework oracle path)."""
-        return self._outcomes(faults)
+        return self._shared_jit(
+            "run_batch", lambda: jax.jit(self._outcomes))(faults)
 
     def sampler(self, structure: str):
         if structure not in self._samplers:
@@ -217,11 +237,16 @@ class TrialKernel:
         _ = self.golden_rec      # materialize outside the jit trace
         return self._taint_batch_jit(faults, use_row)
 
-    @partial(jax.jit, static_argnums=(0, 2))
     def _taint_batch_jit(self, faults: Fault, use_row: bool):
-        setup = self._setup_batch(faults)
-        return jax.vmap(
-            lambda f, s: self._taint_one(f, use_row, setup=s))(faults, setup)
+        def build():
+            def fn(faults):
+                setup = self._setup_batch(faults)
+                return jax.vmap(lambda f, s: self._taint_one(
+                    f, use_row, setup=s))(faults, setup)
+            return jax.jit(fn)
+
+        return self._shared_jit("taint_batch", build,
+                                use_row=bool(use_row))(faults)
 
     def _pallas_enabled(self) -> bool:
         mode = self.cfg.pallas
@@ -249,10 +274,19 @@ class TrialKernel:
             u_steps=self.cfg.pallas_u_steps, interpret=interp)
 
     def sample_batch(self, keys: jax.Array, structure: str) -> Fault:
-        """Jitted fault sampling (cached per structure)."""
+        """Jitted fault sampling — cached per structure through the
+        process-wide executable cache (parallel/exec_cache.py), so a
+        second kernel over the same trace/config (the CPU fallback tier,
+        a re-built orchestrator, bench warm-up/timed pairs) reuses the
+        compiled sampler instead of re-tracing it."""
         if structure not in self._sample_jits:
-            self._sample_jits[structure] = jax.jit(
-                self.sampler(structure).sample_batch)
+            from shrewd_tpu.parallel import exec_cache
+
+            samp = self.sampler(structure)
+            self._sample_jits[structure] = exec_cache.cache().get(
+                exec_cache.step_key(self, None, structure, kind="sample"),
+                owner=self,
+                build=lambda: jax.jit(samp.sample_batch))
         return self._sample_jits[structure](keys)
 
     @staticmethod
@@ -277,9 +311,12 @@ class TrialKernel:
             # taint kernels' validity test would disagree on mem faults
             return np.asarray(self.run_batch(faults))
         res = self.taint_fast(faults, may_latch=may_latch)
-        return self.resolve_escapes(faults, np.asarray(res.outcome).copy(),
-                                    np.asarray(res.escaped),
-                                    np.asarray(res.overflow))
+        # ONE host transfer of all three outputs (separate np.asarray
+        # pulls each paid their own device sync + copy)
+        out, esc, ovf = jax.device_get((res.outcome, res.escaped,
+                                        res.overflow))
+        # device_get may return read-only views; resolve_escapes writes
+        return self.resolve_escapes(faults, np.array(out), esc, ovf)
 
     def oracle_outcomes(self, faults: Fault) -> np.ndarray:
         """Per-trial outcomes from the host oracle — the serial C++ golden
@@ -334,9 +371,12 @@ class TrialKernel:
 
     # --- the campaign unit -------------------------------------------------
 
-    @partial(jax.jit, static_argnums=(0, 2))
     def _run_keys_dense(self, keys: jax.Array, structure: str) -> jax.Array:
-        return C.tally(self.outcomes_from_keys(keys, structure))
+        return self._shared_jit(
+            "run_keys_dense",
+            lambda: jax.jit(
+                lambda k: C.tally(self.outcomes_from_keys(k, structure))),
+            structure=structure)(keys)
 
     def _outcomes_device(self, keys: jax.Array, structure: str):
         """Keys → (outcomes int32[B], faults, n_unresolved): the traceable

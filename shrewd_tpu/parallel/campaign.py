@@ -19,8 +19,9 @@ import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from shrewd_tpu.ops import classify as C
-from shrewd_tpu.parallel import stopping
-from shrewd_tpu.parallel.mesh import TRIAL_AXIS, shard_keys, shard_map
+from shrewd_tpu.parallel import exec_cache, stopping
+from shrewd_tpu.parallel.mesh import (TRIAL_AXIS, shard_batch_stack,
+                                      shard_keys, shard_map)
 from shrewd_tpu.resilience import DeviceWatchdog, DispatchTimeout, TIERS
 from shrewd_tpu.utils import debug, prng
 
@@ -85,61 +86,90 @@ class ShardedCampaign:
                             "replay_kernel", "dense")
         may_latch = structure == "latch"
 
-        def local_step(keys):
-            # the traceable campaign protocol (ops.trial.TrialKernel,
-            # models.ruby.CacheKernel): keys → per-trial outcome classes
-            outs = kernel.outcomes_from_keys(keys, structure)
-            t = C.tally(outs)
-            if integrity_check:
-                return jax.lax.psum(t, TRIAL_AXIS), t[None, :]
-            return jax.lax.psum(t, TRIAL_AXIS)
+        def build_step():
+            def local_step(keys):
+                # the traceable campaign protocol (ops.trial.TrialKernel,
+                # models.ruby.CacheKernel): keys → per-trial outcome classes
+                outs = kernel.outcomes_from_keys(keys, structure)
+                t = C.tally(outs)
+                if integrity_check:
+                    return jax.lax.psum(t, TRIAL_AXIS), t[None, :]
+                return jax.lax.psum(t, TRIAL_AXIS)
 
-        self._step = jax.jit(shard_map(
-            local_step, mesh=mesh, in_specs=P(TRIAL_AXIS),
-            out_specs=((P(), P(TRIAL_AXIS)) if integrity_check else P())))
+            return jax.jit(shard_map(
+                local_step, mesh=mesh, in_specs=P(TRIAL_AXIS),
+                out_specs=((P(), P(TRIAL_AXIS)) if integrity_check
+                           else P())))
+
+        # every jitted step goes through the process-wide executable cache
+        # (parallel/exec_cache.py): two campaigns computing the same pure
+        # function — the CPU fallback tier, a re-built orchestrator, the
+        # canary battery's tier fns — share ONE compiled callable instead
+        # of re-tracing per ShardedCampaign instance
+        self._step = self._cached("step", build_step)
 
         self._taint_step = None
         self._device_step = None
         self._strat_step = None
         if stratify:
-            def strat_step(keys):
-                tally_h, n_unres = kernel.run_keys_stratified(keys,
-                                                              structure)
-                out = (jax.lax.psum(tally_h, TRIAL_AXIS),
-                       jax.lax.psum(n_unres, TRIAL_AXIS))
-                if integrity_check:
-                    return out + (tally_h[None],)
-                return out
+            def build_strat():
+                def strat_step(keys):
+                    tally_h, n_unres = kernel.run_keys_stratified(keys,
+                                                                  structure)
+                    out = (jax.lax.psum(tally_h, TRIAL_AXIS),
+                           jax.lax.psum(n_unres, TRIAL_AXIS))
+                    if integrity_check:
+                        return out + (tally_h[None],)
+                    return out
 
-            self._strat_step = jax.jit(shard_map(
-                strat_step, mesh=mesh, in_specs=P(TRIAL_AXIS),
-                out_specs=((P(), P(), P(TRIAL_AXIS)) if integrity_check
-                           else (P(), P()))))
+                return jax.jit(shard_map(
+                    strat_step, mesh=mesh, in_specs=P(TRIAL_AXIS),
+                    out_specs=((P(), P(), P(TRIAL_AXIS)) if integrity_check
+                               else (P(), P()))))
+
+            self._strat_step = self._cached("strat", build_strat)
         if self.mode != "dense":
             _ = kernel.golden_rec     # materialize before tracing
             if resolution == "device":
-                def device_step(keys):
-                    tally, n_unres = kernel.run_keys_device(keys, structure)
-                    out = (jax.lax.psum(tally, TRIAL_AXIS),
-                           jax.lax.psum(n_unres, TRIAL_AXIS))
-                    if integrity_check:
-                        return out + (tally[None],)
-                    return out
+                def build_device():
+                    def device_step(keys):
+                        tally, n_unres = kernel.run_keys_device(keys,
+                                                                structure)
+                        out = (jax.lax.psum(tally, TRIAL_AXIS),
+                               jax.lax.psum(n_unres, TRIAL_AXIS))
+                        if integrity_check:
+                            return out + (tally[None],)
+                        return out
 
-                self._device_step = jax.jit(shard_map(
-                    device_step, mesh=mesh, in_specs=P(TRIAL_AXIS),
-                    out_specs=((P(), P(), P(TRIAL_AXIS)) if integrity_check
-                               else (P(), P()))))
+                    return jax.jit(shard_map(
+                        device_step, mesh=mesh, in_specs=P(TRIAL_AXIS),
+                        out_specs=((P(), P(), P(TRIAL_AXIS))
+                                   if integrity_check else (P(), P()))))
+
+                self._device_step = self._cached("device", build_device)
             else:
-                def taint_step(keys):
-                    faults = kernel.sampler(structure).sample_batch(keys)
-                    res = kernel.taint_fast(faults, may_latch=may_latch)
-                    return res.outcome, res.escaped, res.overflow
+                def build_taint():
+                    def taint_step(keys):
+                        faults = kernel.sampler(structure).sample_batch(keys)
+                        res = kernel.taint_fast(faults, may_latch=may_latch)
+                        return res.outcome, res.escaped, res.overflow
 
-                self._taint_step = jax.jit(shard_map(
-                    taint_step, mesh=mesh,
-                    in_specs=P(TRIAL_AXIS),
-                    out_specs=(P(TRIAL_AXIS),) * 3))
+                    return jax.jit(shard_map(
+                        taint_step, mesh=mesh,
+                        in_specs=P(TRIAL_AXIS),
+                        out_specs=(P(TRIAL_AXIS),) * 3))
+
+                self._taint_step = self._cached("taint", build_taint)
+
+    def _cached(self, kind: str, build, **extra):
+        """One campaign-step executable via the shared cache, keyed by the
+        kernel's content fingerprint + mesh + structure + step kind."""
+        return exec_cache.cache().get(
+            exec_cache.step_key(self.kernel, self.mesh, self.structure,
+                                kind=kind, mode=self.mode,
+                                resolution=self.resolution,
+                                integrity=self.integrity_check, **extra),
+            owner=self.kernel, build=build)
 
     def _dispatch(self, step, *args):
         """One jitted device step, through the watchdog when configured.
@@ -180,9 +210,12 @@ class ShardedCampaign:
         if self._strat_step is None:
             raise ValueError("campaign built without stratify=True")
         out = self._dispatch(self._strat_step, shard_keys(self.mesh, keys))
-        tally_h, n_unres = out[0], out[1]
+        # ONE host transfer of the whole result tuple (the per-output
+        # np.asarray pulls each paid their own sync + copy)
+        host = jax.device_get(out)
+        tally_h, n_unres = host[0], host[1]
         if self.integrity_check:
-            self._verify_shards(out[2], tally_h)
+            self._verify_shards(host[2], tally_h)
         if self.mode != "dense":    # dense replay has no escape machinery
             self.kernel.escapes += int(n_unres)
             self.kernel.taint_trials += int(keys.shape[0])
@@ -193,24 +226,25 @@ class ShardedCampaign:
         if self._device_step is not None:
             out = self._dispatch(self._device_step,
                                  shard_keys(self.mesh, keys))
-            tally, n_unres = out[0], out[1]
+            host = jax.device_get(out)      # one transfer for the tuple
+            tally, n_unres = host[0], host[1]
             if self.integrity_check:
-                self._verify_shards(out[2], tally)
+                self._verify_shards(host[2], tally)
             self.kernel.escapes += int(n_unres)
             self.kernel.taint_trials += int(keys.shape[0])
             return tally
         if self._taint_step is None:
             out = self._dispatch(self._step, shard_keys(self.mesh, keys))
             if self.integrity_check:
-                tally, local = out
+                tally, local = jax.device_get(out)
                 self._verify_shards(local, tally)
                 return tally
             return out
         keys_sh = shard_keys(self.mesh, keys)
-        out, esc, ovf = self._dispatch(self._taint_step, keys_sh)
-        out = np.asarray(out).copy()
-        esc = np.asarray(esc)
-        ovf = np.asarray(ovf)
+        res = self._dispatch(self._taint_step, keys_sh)
+        out, esc, ovf = jax.device_get(res)  # one transfer for all three
+        out = np.array(out)    # device_get may return a read-only view;
+        # the escape-resolution passes below write into ``out``
         if self.mode == "taint":    # conservative, no host re-runs
             out[esc | ovf] = C.OUTCOME_SDC
             self.kernel.escapes += int((esc | ovf).sum())
@@ -224,6 +258,196 @@ class ShardedCampaign:
             self.kernel.taint_trials += out.size
         return jnp.asarray(
             np.bincount(out, minlength=C.N_OUTCOMES).astype(np.int32))
+
+    # --- sync-interval machinery (pipelined engine, parallel/pipeline.py)
+
+    @property
+    def supports_intervals(self) -> bool:
+        """Whether the multi-batch jitted interval step applies: the
+        host-resolution taint path does per-batch host re-runs (nothing to
+        accumulate on device), and a multi-process mesh would need the
+        distributed key-data transport ``shard_batch_stack`` doesn't do."""
+        return self._taint_step is None and jax.process_count() == 1
+
+    def _build_interval_step(self, S: int):
+        """Jitted S-batch step: raw key data (S, B, ...) sharded on B →
+        cumulative interval tallies, accumulated ON DEVICE with one psum
+        at the end.  Integer per-batch tallies commute, so the result is
+        bit-identical to S serial ``tally_batch`` calls summed on the
+        host.  Keys travel as raw data and re-wrap per batch inside the
+        scan — extended-dtype arrays through scan/stack are version-
+        fragile, uint32 data is not."""
+        kernel, structure = self.kernel, self.structure
+        integrity = self.integrity_check
+
+        if self.stratify:
+            from shrewd_tpu.ops.trial import N_STRATA
+
+            def local(kd):
+                def body(acc, kd_b):
+                    keys = jax.random.wrap_key_data(kd_b)
+                    th, nu = kernel.run_keys_stratified(keys, structure)
+                    return (acc[0] + th, acc[1] + nu), None
+                acc0 = (jnp.zeros((N_STRATA, C.N_OUTCOMES), jnp.int32),
+                        jnp.int32(0))
+                (th, nu), _ = jax.lax.scan(body, acc0, kd)
+                out = (jax.lax.psum(th, TRIAL_AXIS),
+                       jax.lax.psum(nu, TRIAL_AXIS))
+                if integrity:
+                    out = out + (th[None],)
+                return out
+
+            out_specs = ((P(), P(), P(TRIAL_AXIS)) if integrity
+                         else (P(), P()))
+        elif self._device_step is not None:
+            def local(kd):
+                def body(acc, kd_b):
+                    keys = jax.random.wrap_key_data(kd_b)
+                    tally, nu = kernel.run_keys_device(keys, structure)
+                    return (acc[0] + tally, acc[1] + nu), None
+                acc0 = (jnp.zeros(C.N_OUTCOMES, jnp.int32), jnp.int32(0))
+                (t, nu), _ = jax.lax.scan(body, acc0, kd)
+                out = (jax.lax.psum(t, TRIAL_AXIS),
+                       jax.lax.psum(nu, TRIAL_AXIS))
+                if integrity:
+                    out = out + (t[None],)
+                return out
+
+            out_specs = ((P(), P(), P(TRIAL_AXIS)) if integrity
+                         else (P(), P()))
+        else:
+            def local(kd):
+                def body(acc, kd_b):
+                    keys = jax.random.wrap_key_data(kd_b)
+                    outs = kernel.outcomes_from_keys(keys, structure)
+                    return acc + C.tally(outs), None
+                t, _ = jax.lax.scan(
+                    body, jnp.zeros(C.N_OUTCOMES, jnp.int32), kd)
+                out = jax.lax.psum(t, TRIAL_AXIS)
+                if integrity:
+                    out = (out, t[None])
+                return out
+
+            out_specs = ((P(), P(TRIAL_AXIS)) if integrity else P())
+        return jax.jit(shard_map(
+            local, mesh=self.mesh, in_specs=P(None, TRIAL_AXIS),
+            out_specs=out_specs))
+
+    def dispatch_interval(self, keys_list) -> "InflightInterval":
+        """Async-dispatch one sync interval (len(keys_list) batches) and
+        return WITHOUT blocking — jax dispatch is asynchronous, so the
+        host is free to consume the previous interval while the device
+        computes this one.  The watchdog deadline is armed NOW and
+        enforced at ``materialize_interval``.  The interval step is
+        AOT-compiled through the shared executable cache (keyed by kernel
+        content, mesh, structure, S)."""
+        if not self.supports_intervals:
+            raise ValueError(f"{self.structure}: campaign does not support "
+                             "sync-interval accumulation")
+        S = len(keys_list)
+        B = int(keys_list[0].shape[0])
+        armed_at = (self.watchdog.arm() if self.watchdog is not None
+                    else time.monotonic())
+
+        def dispatch_work():
+            kd = jnp.stack([jax.random.key_data(k) for k in keys_list])
+            kd_sh = shard_batch_stack(self.mesh, kd)
+            # B is part of the key: the AOT path caches a SHAPE-
+            # SPECIALIZED executable, so a second campaign over the same
+            # trace at a different batch size must compile its own
+            step = exec_cache.cache().get_aot(
+                exec_cache.step_key(self.kernel, self.mesh,
+                                    self.structure, kind="interval",
+                                    S=S, B=B, mode=self.mode,
+                                    resolution=self.resolution,
+                                    stratify=self.stratify,
+                                    integrity=self.integrity_check),
+                owner=self.kernel,
+                build=lambda: self._build_interval_step(S),
+                example_args=(kd_sh,))
+            return step(kd_sh)
+
+        # the dispatch side is deadline-guarded too: a backend that
+        # wedges at enqueue/compile time (buffer allocation, device_put,
+        # the first AOT compile) must surface as DispatchTimeout in
+        # bounded time, exactly like the serial loop's guarded dispatch —
+        # arm() above starts the clock, so materialization only gets what
+        # the dispatch didn't spend
+        if self.watchdog is not None and self.watchdog.timeout > 0:
+            try:
+                out = self.watchdog.call(dispatch_work,
+                                         timeout=self.watchdog.timeout * S)
+            except DispatchTimeout:
+                self.collective_timeouts += 1
+                raise
+        else:
+            out = dispatch_work()
+        return InflightInterval(out, armed_at, S, S * B)
+
+    def materialize_interval(self, handle: "InflightInterval",
+                             timeout: float | None = None):
+        """Block for / transfer an in-flight interval — ONE host transfer
+        per sync interval.  Enforces the deadline armed at dispatch,
+        verifies the shard-vs-psum invariant on the interval accumulators,
+        and updates the kernel's escape counters exactly as the serial
+        per-batch loop would.  → (tally int64 (N_OUTCOMES,),
+        strata int64 | None).
+
+        ``timeout``: total deadline measured from the arm time.  Default
+        scales the watchdog's PER-BATCH deadline by the interval's batch
+        count; the pipelined engine passes a depth-scaled value on top,
+        since a prefetched interval legitimately queues behind the
+        intervals dispatched ahead of it."""
+        def fetch():
+            return jax.device_get(handle.out)
+
+        if self.watchdog is None:
+            host = fetch()
+        else:
+            if timeout is None and self.watchdog.timeout > 0:
+                timeout = self.watchdog.timeout * handle.n_batches
+            try:
+                host = self.watchdog.call_armed(fetch, handle.armed_at,
+                                                timeout=timeout)
+            except DispatchTimeout:
+                self.collective_timeouts += 1
+                raise
+        strata = None
+        n_unres = None
+        if self.stratify:
+            strata = np.asarray(host[0], dtype=np.int64)
+            n_unres = int(host[1])
+            if self.integrity_check:
+                self._verify_shards(host[2], host[0])
+            tally = strata.sum(axis=0)
+        elif self._device_step is not None:
+            tally = np.asarray(host[0], dtype=np.int64)
+            n_unres = int(host[1])
+            if self.integrity_check:
+                self._verify_shards(host[2], host[0])
+        elif self.integrity_check:
+            tally = np.asarray(host[0], dtype=np.int64)
+            self._verify_shards(host[1], host[0])
+        else:
+            tally = np.asarray(host, dtype=np.int64)
+        if self.mode != "dense" and n_unres is not None:
+            self.kernel.escapes += n_unres
+            self.kernel.taint_trials += handle.n_trials
+        return tally, strata
+
+    def tally_interval(self, keys_list):
+        """Blocking convenience: dispatch + materialize one interval (the
+        serial-equivalence surface the bit-identity tests pin)."""
+        return self.materialize_interval(self.dispatch_interval(keys_list))
+
+
+class InflightInterval(NamedTuple):
+    """An async-dispatched sync interval: device outputs not yet awaited,
+    the watchdog arm time, and the interval's shape."""
+    out: object              # device arrays (pytree), still in flight
+    armed_at: float          # watchdog deadline epoch (resilience.arm)
+    n_batches: int
+    n_trials: int
 
 
 class CampaignResult(NamedTuple):

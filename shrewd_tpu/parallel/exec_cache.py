@@ -1,0 +1,252 @@
+"""Process-wide executable cache: compile each campaign step once.
+
+Every ``ShardedCampaign`` used to build its *own* ``jax.jit(shard_map(...))``
+closures, so jax's function-identity jit cache never matched across
+instances: the CPU fallback tier, a resumed orchestrator in the same
+process, the canary battery's tier functions, and bench's warm-up/timed
+pairs each re-traced and re-compiled an identical program over the same
+trace.  This module is the shared registry those builders route through:
+executables are keyed by *content* — a digest of the trace arrays plus the
+kernel config, the structure, the mesh fingerprint, and the step kind — so
+any two campaigns computing the same pure function share one compiled
+callable, whichever kernel instance built it first.
+
+Two cache surfaces:
+
+- ``get(key, owner, build)`` — memoize a jitted callable.  ``owner`` is the
+  object whose lifetime the entry's correctness depends on (the kernel): a
+  weak reference guards against ``id()`` reuse after garbage collection.
+- ``get_aot(key, owner, build, example_args)`` — the AOT variant for the
+  pipelined interval steps: ``build()``'s jitted callable is
+  ``lower(...).compile()``d eagerly at build time, so the whole compile cost
+  lands before the campaign loop starts (and is skipped entirely on re-runs
+  when the persistent compilation cache below is enabled).  Falls back to
+  the plain jitted callable when AOT lowering is unavailable.
+
+``enable_persistent_cache(dir)`` opts into jax's on-disk compilation cache
+(``jax_compilation_cache_dir``) so *re-runs and resumes in new processes*
+skip retrace/recompile too.
+
+Import discipline: jax-free at module import (the cache is pure host-side
+bookkeeping; jax enters only inside ``enable_persistent_cache`` and the
+callers' build functions).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import weakref
+from collections import OrderedDict
+from typing import Callable
+
+import numpy as np
+
+from shrewd_tpu.utils import debug
+
+debug.register_flag("ExecCache", "shared executable cache hits/misses")
+
+#: entries kept before least-recently-used eviction — each entry pins its
+#: builder kernel (trace constants) through the jit closure, so an
+#: unbounded cache would leak every trace a long session ever touched
+MAX_ENTRIES = 64
+
+
+class ExecutableCache:
+    """LRU registry of compiled campaign steps (see module docstring)."""
+
+    def __init__(self, max_entries: int = MAX_ENTRIES):
+        self.max_entries = int(max_entries)
+        # key -> (owner weakref | None, callable)
+        self._entries: OrderedDict = OrderedDict()
+        self.compiled = 0       # cache misses that built a new executable
+        self.reused = 0         # cache hits
+        self.aot = 0            # ... of the compiled ones, AOT-lowered
+        self.evicted = 0
+
+    def _hit(self, key, owner):
+        ent = self._entries.get(key)
+        if ent is None:
+            return None
+        ref, fn = ent
+        if ref is not None and ref() is None:
+            # the owner died and its id() may since have been reused by a
+            # different object — the digest alone can no longer prove the
+            # entry matches, so treat as a miss and rebuild
+            del self._entries[key]
+            return None
+        self._entries.move_to_end(key)
+        self.reused += 1
+        debug.dprintf("ExecCache", "reuse %s", key[0] if key else key)
+        return fn
+
+    def _store(self, key, owner, fn):
+        ref = None
+        if owner is not None:
+            try:
+                ref = weakref.ref(owner)
+            except TypeError:       # unweakrefable owner: entry unguarded
+                ref = None
+        self._entries[key] = (ref, fn)
+        self._entries.move_to_end(key)
+        while len(self._entries) > self.max_entries:
+            self._entries.popitem(last=False)
+            self.evicted += 1
+        return fn
+
+    def get(self, key, owner, build: Callable[[], Callable]):
+        """The memoized callable for ``key`` (built via ``build()`` on
+        miss).  ``owner``: the object whose ``id()`` participates in the
+        key's digest chain (weakly held; a dead owner invalidates)."""
+        fn = self._hit(key, owner)
+        if fn is not None:
+            return fn
+        self.compiled += 1
+        debug.dprintf("ExecCache", "compile %s", key[0] if key else key)
+        return self._store(key, owner, build())
+
+    def get_aot(self, key, owner, build: Callable[[], Callable],
+                example_args: tuple):
+        """Like ``get`` but the built callable is AOT lower/compile'd
+        against ``example_args`` so the compile happens NOW (before the
+        campaign loop), not inside the first timed dispatch.  Lowering
+        failures degrade to the plain jitted callable — AOT is a latency
+        optimization, never a correctness dependency."""
+        fn = self._hit(key, owner)
+        if fn is not None:
+            return fn
+        self.compiled += 1
+        jit_fn = build()
+        try:
+            compiled = jit_fn.lower(*example_args).compile()
+            self.aot += 1
+            debug.dprintf("ExecCache", "AOT compile %s",
+                          key[0] if key else key)
+        except Exception as e:  # noqa: BLE001 — no AOT on this path/version
+            debug.dprintf("ExecCache", "AOT lowering unavailable (%s) — "
+                          "falling back to jit for %s", e, key)
+            return self._store(key, owner, jit_fn)
+        return self._store(key, owner, compiled)
+
+    def stats(self) -> dict:
+        return {"compiled": self.compiled, "reused": self.reused,
+                "aot": self.aot, "evicted": self.evicted,
+                "entries": len(self._entries)}
+
+    def clear(self) -> None:
+        self._entries.clear()
+
+
+_GLOBAL: ExecutableCache | None = None
+
+
+def cache() -> ExecutableCache:
+    """The per-process shared cache (campaigns, tiers, bench all route
+    through the same one — that is the whole point)."""
+    global _GLOBAL
+    if _GLOBAL is None:
+        _GLOBAL = ExecutableCache()
+    return _GLOBAL
+
+
+# --------------------------------------------------------------------------
+# key fingerprints
+# --------------------------------------------------------------------------
+
+_TRACE_FIELDS = ("opcode", "dst", "src1", "src2", "imm", "taken",
+                 "init_reg", "init_mem")
+
+
+def trace_digest(trace) -> str:
+    """Content digest of a trace's arrays — the part of an executable's
+    identity that ``id()`` cannot provide (two ``build_trace()`` calls on
+    the same spec yield distinct objects with identical content, and their
+    compiled steps are interchangeable).  Cached on the trace object."""
+    got = getattr(trace, "_exec_cache_digest", None)
+    if got is not None:
+        return got
+    h = hashlib.sha1()
+    for name in _TRACE_FIELDS:
+        arr = getattr(trace, name, None)
+        if arr is None:
+            continue
+        a = np.asarray(arr)
+        h.update(name.encode())
+        h.update(str(a.shape).encode())
+        h.update(str(a.dtype).encode())
+        h.update(np.ascontiguousarray(a).tobytes())
+    for name in ("n", "nphys", "mem_words"):
+        h.update(f"{name}={getattr(trace, name, None)}".encode())
+    digest = h.hexdigest()
+    try:
+        trace._exec_cache_digest = digest
+    except Exception:  # noqa: BLE001 — unsettable attr: recompute next time
+        pass
+    return digest
+
+
+def kernel_fingerprint(kernel) -> tuple:
+    """Stable identity of the pure computation a kernel performs: trace
+    content + full config.  Kernels with equal fingerprints compute
+    identical outcome functions, so their compiled steps interchange."""
+    cfgs = []
+    for attr in ("cfg", "minor_cfg"):
+        c = getattr(kernel, attr, None)
+        if c is None:
+            cfgs.append(None)
+        else:
+            try:
+                cfgs.append(json.dumps(c.to_dict(), sort_keys=True,
+                                       default=str))
+            except Exception:  # noqa: BLE001 — config without to_dict:
+                cfgs.append(repr(c))
+    trace = getattr(kernel, "trace", None)
+    tdig = trace_digest(trace) if trace is not None else f"id{id(kernel)}"
+    # a memmap'd kernel classifies mem faults differently (VA-trap model);
+    # no digest covers the memmap, so fall back to instance identity there
+    if getattr(kernel, "memmap", None) is not None:
+        tdig += f"+memmap{id(kernel.memmap)}"
+    return (type(kernel).__name__, tdig, tuple(cfgs))
+
+
+def mesh_fingerprint(mesh) -> tuple | None:
+    if mesh is None:               # mesh-free executables (sampler jits)
+        return None
+    devs = np.asarray(mesh.devices).reshape(-1)
+    return (np.asarray(mesh.devices).shape,
+            tuple(getattr(d, "id", i) for i, d in enumerate(devs)),
+            tuple(mesh.axis_names))
+
+
+def step_key(kernel, mesh, structure: str, kind: str, **flags) -> tuple:
+    """The full cache key for one campaign step executable."""
+    return (kind, kernel_fingerprint(kernel), mesh_fingerprint(mesh),
+            str(structure), tuple(sorted(flags.items())))
+
+
+# --------------------------------------------------------------------------
+# persistent (on-disk) compilation cache
+# --------------------------------------------------------------------------
+
+def enable_persistent_cache(path: str) -> bool:
+    """Opt into jax's on-disk compilation cache at ``path`` so re-runs and
+    resumes in NEW processes skip retrace/recompile of unchanged steps.
+    Returns True when the backend accepted the setting; best-effort —
+    an old jax without the knobs degrades to in-process caching only."""
+    import jax
+
+    try:
+        jax.config.update("jax_compilation_cache_dir", str(path))
+    except Exception as e:  # noqa: BLE001 — no persistent cache support
+        debug.dprintf("ExecCache",
+                      "persistent compilation cache unavailable: %s", e)
+        return False
+    # default thresholds skip sub-second compiles — campaign steps on CPU
+    # test shapes are exactly those, so lower both floors where supported
+    for knob, val in (("jax_persistent_cache_min_compile_time_secs", 0.0),
+                      ("jax_persistent_cache_min_entry_size_bytes", -1)):
+        try:
+            jax.config.update(knob, val)
+        except Exception:  # noqa: BLE001 — older jax: keep its defaults
+            pass
+    return True

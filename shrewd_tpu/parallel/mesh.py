@@ -95,5 +95,18 @@ def shard_keys(mesh: Mesh, keys: jax.Array) -> jax.Array:
     return jax.device_put(keys, NamedSharding(mesh, P(TRIAL_AXIS)))
 
 
+def shard_batch_stack(mesh: Mesh, arr) -> jax.Array:
+    """Place a stacked per-batch array (S, B, ...) sharded on the B axis —
+    the sync-interval analog of ``shard_keys`` (raw arrays only: the
+    pipelined engine ships PRNG key *data* and re-wraps on device, which
+    sidesteps extended-dtype transport entirely).  Single-process only;
+    the pipelined engine gates on ``jax.process_count() == 1``."""
+    n = arr.shape[1]
+    if n % mesh.size:
+        raise ValueError(
+            f"batch size {n} not divisible by mesh size {mesh.size}")
+    return jax.device_put(arr, NamedSharding(mesh, P(None, TRIAL_AXIS)))
+
+
 def replicated(mesh: Mesh, x) -> jax.Array:
     return jax.device_put(x, NamedSharding(mesh, P()))

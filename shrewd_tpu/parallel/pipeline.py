@@ -1,0 +1,307 @@
+"""Pipelined campaign engine: overlap device compute with host-side work.
+
+The serial loop (``campaign/orchestrator.py`` → ``parallel/campaign.py``)
+dispatches one batch, blocks until it completes, materializes the tally,
+then runs every host-side consumer — canary salting, tally invariants,
+audit sampling, stats, checkpoint decisions — before the next dispatch.
+The device idles during all host work and the host idles during all device
+work.  JAX's dispatch is asynchronous by design; this engine exploits it:
+
+- **async double-buffered dispatch** — while the host consumes interval N,
+  intervals N+1..N+depth-1 are already dispatched (``depth`` bounds the
+  in-flight window).  The ``DeviceWatchdog`` deadline is *armed at
+  dispatch* and *enforced at materialization* (``resilience.call_armed``),
+  so the wedge-detection guarantee survives without per-batch blocking.
+- **sync-interval accumulation** — one jitted multi-batch step
+  (``ShardedCampaign.dispatch_interval``) accumulates ``sync_every``
+  batches' tallies (and strata) on device and transfers to host ONCE per
+  interval.  Stopping-rule checks, integrity invariants and canary
+  verification run at interval boundaries on the cumulative deltas.
+  Per-batch tallies are pure functions of their frozen PRNG keys and
+  integer sums commute, so the accumulated interval tally is
+  **bit-identical** to the serial loop's — ``sync_every=1`` reproduces
+  today's semantics exactly and stays the default for chaos/elastic modes.
+- **serial recovery** — any failure at materialization (wedge, backend
+  error, shard mismatch) or any interval-boundary integrity problem
+  (invariant/canary/corruption) drops the whole in-flight window and
+  re-dispatches the interval's batches one-by-one through the existing
+  integrity-checked resilience ladder on the same frozen keys: recovery is
+  bit-identical because the serial path is.
+
+Import discipline: jax-free at module import (``PipelineConfig`` rides the
+``CampaignPlan``, which bench's jax-free supervisor deserializes); jax
+enters only through the campaign/dispatcher objects the engine drives.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import NamedTuple
+
+import numpy as np
+
+from shrewd_tpu import resilience as resil
+from shrewd_tpu.utils import debug
+from shrewd_tpu.utils.config import ConfigObject, Param
+
+debug.register_flag("Pipeline", "pipelined campaign engine")
+
+
+class PipelineConfig(ConfigObject):
+    """Knobs for the pipelined engine (a ``CampaignPlan`` child, so a
+    campaign's pipelining posture is reproducible from its config dump)."""
+
+    sync_every = Param(int, 1,
+                       "batches accumulated on device per host transfer "
+                       "(1 = serial semantics, exactly today's loop; keep "
+                       "1 for chaos/elastic runs unless testing them "
+                       "pipelined)", check=lambda v: v >= 1)
+    depth = Param(int, 2,
+                  "max sync intervals in flight (2 = double buffering)",
+                  check=lambda v: v >= 1)
+    compilation_cache_dir = Param(str, "",
+                                  "opt-in persistent jax compilation "
+                                  "cache directory: re-runs and resumes "
+                                  "in new processes skip retrace/"
+                                  "recompile (empty = in-process "
+                                  "executable cache only)")
+
+
+class PerfStats:
+    """Host-side perf ledger for the ``campaign.perf.*`` stats group —
+    the speedup must be observable, not asserted.  Jax-free."""
+
+    def __init__(self):
+        self.device_step_seconds = 0.0   # dispatch → materialized, summed
+        # per interval (includes device queue time at depth > 1)
+        self.device_wait_seconds = 0.0   # host BLOCKED in materialization
+        # (the non-overlapped remainder of device_step_seconds)
+        self.host_seconds = 0.0          # host-side work while intervals
+        # were in flight (checks, stats, checkpoints, audit)
+        self.dispatches = 0              # intervals dispatched
+        self.intervals = 0               # intervals believed pipelined
+        self.serial_fallbacks = 0        # intervals recovered serially
+        self.depth_hwm = 0               # in-flight high-water mark
+
+    def overlap_fraction(self) -> float:
+        """Fraction of device latency hidden behind host work: 1.0 means
+        the host never blocked (compute fully overlapped), 0.0 means the
+        serial posture (every device second was a host wait second)."""
+        if self.device_step_seconds <= 0:
+            return 0.0
+        return max(0.0, 1.0 - self.device_wait_seconds
+                   / self.device_step_seconds)
+
+    def to_dict(self) -> dict:
+        return {
+            "device_step_seconds": round(self.device_step_seconds, 4),
+            "device_wait_seconds": round(self.device_wait_seconds, 4),
+            "host_seconds": round(self.host_seconds, 4),
+            "overlap_fraction": round(self.overlap_fraction(), 4),
+            "dispatches": self.dispatches,
+            "intervals": self.intervals,
+            "serial_fallbacks": self.serial_fallbacks,
+            "depth_hwm": self.depth_hwm,
+        }
+
+
+class _Pending(NamedTuple):
+    b0: int                 # first batch id of the interval
+    k: int                  # batches in the interval
+    keys: list              # per-batch key arrays (audit / serial recovery)
+    handle: object          # ShardedCampaign in-flight interval handle
+
+
+class PipelinedEngine:
+    """Per-(simpoint, structure) pipelined dispatch over one campaign.
+
+    ``obtain(b0, k, stratified)`` returns the interval's believed result
+    document (the ``_compute_batch`` doc shape plus ``n_batches`` /
+    ``tiers``): materialize the head interval, keep ``depth`` intervals in
+    flight behind it, run the interval-boundary integrity checks, and fall
+    back to the serial per-batch checked ladder on any failure."""
+
+    def __init__(self, campaign, checked, structure_key, batch_size: int,
+                 ceiling_batches: int, sync_every: int, depth: int,
+                 monitor, chaos=None, perf: PerfStats | None = None,
+                 sp_name: str = "", structure: str = ""):
+        self.campaign = campaign
+        self.checked = checked            # integrity.CheckedDispatcher
+        self.sk = structure_key
+        self.batch_size = int(batch_size)
+        self.ceiling = int(ceiling_batches)
+        self.sync_every = max(int(sync_every), 1)
+        self.depth = max(int(depth), 1)
+        self.monitor = monitor
+        self.chaos = chaos
+        self.perf = perf if perf is not None else PerfStats()
+        self.sp_name = sp_name
+        self.structure = structure
+        self._q: deque[_Pending] = deque()
+        self._last_return: float | None = None
+
+    # --- keys -----------------------------------------------------------
+
+    def _keys(self, batch_id: int):
+        from shrewd_tpu.utils import prng
+
+        return prng.trial_keys(prng.batch_key(self.sk, batch_id),
+                               self.batch_size)
+
+    # --- dispatch-ahead -------------------------------------------------
+
+    def _fill(self, b0: int, k: int) -> None:
+        q = self._q
+        if q and (q[0].b0 != b0 or q[0].k != k):
+            # realignment (resume, recovery, interval-length change):
+            # in-flight results are pure device work with no host side
+            # effects — dropping them costs compute, never correctness
+            debug.dprintf("Pipeline", "%s/%s: dropping %d stale in-flight "
+                          "intervals (head %d!=%d)", self.sp_name,
+                          self.structure, len(q), q[0].b0, b0)
+            q.clear()
+        while len(q) < self.depth:
+            nb = (q[-1].b0 + q[-1].k) if q else b0
+            if nb >= self.ceiling:
+                break
+            kk = min(self.sync_every, self.ceiling - nb)
+            if not q:
+                kk = k            # the head must match the caller's ask
+            keys = [self._keys(b) for b in range(nb, nb + kk)]
+            handle = self.campaign.dispatch_interval(keys)
+            q.append(_Pending(nb, kk, keys, handle))
+            self.perf.dispatches += 1
+            self.perf.depth_hwm = max(self.perf.depth_hwm, len(q))
+        if not q or q[0].b0 != b0:
+            raise RuntimeError(
+                f"{self.sp_name}/{self.structure}: interval at batch {b0} "
+                f"is beyond the campaign ceiling ({self.ceiling} batches)")
+
+    # --- the believed-interval protocol ---------------------------------
+
+    def obtain(self, b0: int, k: int, stratified: bool = False) -> dict:
+        now = time.monotonic()
+        if self._last_return is not None:
+            # host-side time since the last interval was handed over:
+            # stats/stopping/checkpoint work that ran while the next
+            # intervals computed — the overlapped half of the ledger
+            self.perf.host_seconds += now - self._last_return
+        try:
+            return self._obtain(b0, k, stratified)
+        finally:
+            self._last_return = time.monotonic()
+
+    def _obtain(self, b0: int, k: int, stratified: bool) -> dict:
+        try:
+            # dispatch failures (an interval-step compile the backend
+            # rejects, an enqueue-time crash) must degrade like any other
+            # device failure — the serial ladder is the recovery path for
+            # the whole interval, exactly as for a materialization wedge
+            self._fill(b0, k)
+            head = self._q.popleft()
+            if self.chaos is not None:
+                # armed device-tier chaos faults fire at consume time, the
+                # pipelined analog of the ladder's per-dispatch hook
+                self.chaos.maybe_backend_error(resil.TIER_DEVICE)
+            # the per-batch watchdog deadline scales by interval length x
+            # in-flight depth: a prefetched interval legitimately queues
+            # behind everything dispatched ahead of it
+            wd = self.campaign.watchdog
+            tmo = (wd.timeout * k * self.depth
+                   if wd is not None and wd.timeout > 0 else None)
+            # snapshot the kernel's escape counters: materialization bumps
+            # them, but a quarantined interval's bump must be rolled back
+            # before serial recovery re-adds the believed values (the
+            # _CounterGuard discipline of the serial checked dispatch)
+            kernel = self.campaign.kernel
+            esc0 = getattr(kernel, "escapes", None)
+            tt0 = getattr(kernel, "taint_trials", None)
+            t0 = time.monotonic()
+            tally, strata = self.campaign.materialize_interval(
+                head.handle, timeout=tmo)
+            t1 = time.monotonic()
+            self.perf.device_wait_seconds += t1 - t0
+            self.perf.device_step_seconds += t1 - head.handle.armed_at
+        except Exception as e:  # noqa: BLE001 — wedge, backend crash,
+            # shard-sum mismatch: every dispatch/materialization failure
+            # recovers through the serial per-batch ladder on frozen keys
+            debug.dprintf("Pipeline", "%s/%s interval [%d,%d): "
+                          "pipelined dispatch failed (%s) — serial "
+                          "recovery", self.sp_name, self.structure,
+                          b0, b0 + k, e)
+            return self._recover(b0, k, stratified)
+        res = resil.DispatchResult(np.asarray(tally, dtype=np.int64),
+                                   None if strata is None
+                                   else np.asarray(strata, dtype=np.int64),
+                                   resil.TIER_DEVICE, 1)
+        res = self.monitor.apply_corruption(res)
+        problems = self.checked.check_result(res, k * self.batch_size)
+        self.checked.sync_shard_counters(b0)
+        if problems:
+            if esc0 is not None:
+                kernel.escapes = esc0
+            if tt0 is not None:
+                kernel.taint_trials = tt0
+            self.monitor.record_quarantine({
+                "kind": problems[0]["kind"], "simpoint": self.sp_name,
+                "structure": self.structure, "batch_id": int(b0),
+                "interval": int(k), "tier": resil.TIERS[resil.TIER_DEVICE],
+                "problems": problems, "fatal": False})
+            self.monitor.requeues += 1
+            doc = self._recover(b0, k, stratified)
+            self.monitor.recovered += 1
+            return doc
+        for i, b in enumerate(range(b0, b0 + k)):
+            # audit each batch with the SAME deterministic per-batch
+            # sample as the serial loop: the mismatch ledger is identical
+            # whichever loop ran (and the re-runs overlap the next
+            # interval's device compute)
+            self.checked.audit_batch(head.keys[i], b)
+        self.perf.intervals += 1
+        return {
+            "batch_id": int(b0),
+            "n_batches": int(k),
+            "batch_size": int(self.batch_size),
+            "tally": res.tally.tolist(),
+            "strata": (None if res.strata is None
+                       else res.strata.tolist()),
+            "tier": int(res.tier),
+            "tiers": [int(res.tier)] * int(k),
+            "attempts": 1,
+        }
+
+    def _recover(self, b0: int, k: int, stratified: bool) -> dict:
+        """Serial per-batch recovery on the frozen keys: the in-flight
+        window is untrusted (a wedged backend may poison everything
+        dispatched to it), so drop it and route each batch through the
+        integrity-checked resilience ladder — the exact serial path, so
+        recovery is bit-identical by the ladder's own contract."""
+        from shrewd_tpu.ops import classify as C
+
+        self._q.clear()
+        self.perf.serial_fallbacks += 1
+        tally = np.zeros(C.N_OUTCOMES, dtype=np.int64)
+        strata_sum = None
+        tiers: list[int] = []
+        attempts = 0
+        for b in range(b0, b0 + k):
+            res = self.checked.tally_batch(self._keys(b),
+                                           stratified=stratified,
+                                           batch_id=b)
+            tally += np.asarray(res.tally, dtype=np.int64)
+            if res.strata is not None:
+                s = np.asarray(res.strata, dtype=np.int64)
+                strata_sum = s if strata_sum is None else strata_sum + s
+            tiers.append(int(res.tier))
+            attempts += int(res.attempts)
+        return {
+            "batch_id": int(b0),
+            "n_batches": int(k),
+            "batch_size": int(self.batch_size),
+            "tally": tally.tolist(),
+            "strata": (None if strata_sum is None else strata_sum.tolist()),
+            "tier": int(max(tiers)),
+            "tiers": tiers,
+            "attempts": int(attempts),
+        }

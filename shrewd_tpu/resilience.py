@@ -239,6 +239,39 @@ class DeviceWatchdog:
         self.healthy = True
         return box["out"]
 
+    # --- future-based mode (pipelined async dispatch) -------------------
+    #
+    # The serial loop wraps `block_until_ready(step(...))` in `call`, so
+    # the deadline covers dispatch AND completion of one batch.  The
+    # pipelined engine dispatches WITHOUT blocking (jax dispatch is async)
+    # and only blocks later, when the host is ready to consume the result
+    # — so the deadline must be armed at dispatch time and enforced at
+    # materialization, or a wedged backend would hide inside the
+    # never-awaited in-flight window.
+
+    def arm(self) -> float:
+        """Future mode, dispatch side: stamp the moment a dispatch was
+        enqueued.  Pass the token to ``call_armed`` at materialization."""
+        return time.monotonic()
+
+    #: minimum materialization grace even when the armed deadline has
+    #: fully elapsed while the host did other work: an already-complete
+    #: result returns instantly, and a genuinely wedged one still
+    #: surfaces as DispatchTimeout in bounded (small) time
+    armed_floor = 0.05
+
+    def call_armed(self, fn: Callable, armed_at: float,
+                   timeout: float | None = None):
+        """Future mode, materialization side: run ``fn()`` (the blocking
+        device_get / block_until_ready) under the REMAINING deadline,
+        measured from ``armed_at`` — the wedge-detection guarantee of the
+        serial loop, preserved without per-batch blocking."""
+        tmo = self.timeout if timeout is None else float(timeout)
+        if tmo <= 0:
+            return self.call(fn, timeout=0.0)
+        remaining = tmo - (time.monotonic() - armed_at)
+        return self.call(fn, timeout=max(remaining, self.armed_floor))
+
     def probe(self, fn: Callable, timeout: float | None = None) -> bool:
         """Health probe: True iff ``fn()`` completes in time without
         raising.  Updates ``healthy``."""

@@ -72,6 +72,11 @@ QUICK_MODULES = {
     # on-every-push tier like its two predecessors; the multi-process
     # kill/recover case stays slow-tier (tests/test_multihost.py)
     "test_chaos",
+    # pipelined engine + executable cache: cache/watchdog units plus the
+    # serial-vs-pipelined bit-identity integrations (ragged intervals,
+    # chaos mid-interval, mid-grid checkpoint resume) — the perf-path
+    # correctness smoke runs on every push like the layers it rides on
+    "test_pipeline",
 }
 QUICK_TESTS = {
     # one representative per subsystem (≈4-10 s each, compile-dominated)

@@ -1,0 +1,334 @@
+"""Pipelined campaign engine (parallel/pipeline.py, exec_cache.py,
+ShardedCampaign interval steps, orchestrator wiring).
+
+The contract under test is the ISSUE acceptance criterion: pipelined
+tallies are BIT-IDENTICAL to the serial loop — per-batch tallies are pure
+functions of their frozen PRNG keys and integer sums commute — for
+sync_every ∈ {1, >1, ragged final interval}, on the dense, hybrid
+(device-resolution) and stratified paths, under injected chaos
+(wedge / corrupt tally / worker kill mid-interval), and across a
+mid-interval checkpoint/resume.  The watchdog's future-based mode must
+preserve the wedge-detection guarantee (deadline armed at dispatch,
+enforced at materialization), and the ``campaign.perf.*`` group must make
+the pipelining observable.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from shrewd_tpu import resilience as resil
+from shrewd_tpu.parallel import exec_cache
+
+
+# --- executable cache (unit) ------------------------------------------------
+
+def test_exec_cache_reuse_and_owner_guard():
+    cache = exec_cache.ExecutableCache(max_entries=2)
+
+    class Owner:
+        pass
+
+    o1 = Owner()
+    built = []
+
+    def build():
+        built.append(1)
+        return lambda x: x + 1
+
+    fn = cache.get(("k1",), o1, build)
+    assert fn(1) == 2 and cache.compiled == 1
+    assert cache.get(("k1",), o1, build) is fn
+    assert cache.reused == 1 and len(built) == 1
+    # dead owner invalidates the entry (id() reuse guard)
+    del o1
+    cache.get(("k1",), Owner(), build)
+    assert cache.compiled == 2
+    # LRU eviction keeps the cache bounded
+    keep = Owner()
+    cache.get(("k2",), keep, build)
+    cache.get(("k3",), keep, build)
+    assert len(cache._entries) == 2 and cache.evicted >= 1
+
+
+def test_trace_digest_is_content_keyed():
+    from shrewd_tpu.trace.synth import WorkloadConfig, generate
+
+    cfg = dict(n=64, nphys=32, mem_words=64, working_set_words=32, seed=3)
+    a = generate(WorkloadConfig(**cfg))
+    b = generate(WorkloadConfig(**cfg))          # distinct object, same content
+    c = generate(WorkloadConfig(**{**cfg, "seed": 4}))
+    assert a is not b
+    assert exec_cache.trace_digest(a) == exec_cache.trace_digest(b)
+    assert exec_cache.trace_digest(a) != exec_cache.trace_digest(c)
+
+
+# --- watchdog future mode (unit) -------------------------------------------
+
+def test_watchdog_armed_deadline_enforced_at_materialization():
+    wd = resil.DeviceWatchdog(0.3)
+    # a result that is already complete materializes instantly even when
+    # the armed deadline has fully elapsed (the floor grace)
+    armed = wd.arm() - 10.0
+    assert wd.call_armed(lambda: 42, armed) == 42
+    # a wedged materialization surfaces within the REMAINING deadline,
+    # measured from dispatch — not a fresh full deadline
+    armed = wd.arm()
+    t0 = time.monotonic()
+    with pytest.raises(resil.DispatchTimeout):
+        wd.call_armed(lambda: time.sleep(30), armed)
+    assert time.monotonic() - t0 < 5.0
+    assert wd.timeouts == 1
+    # timeout 0 disables the deadline entirely (serial parity)
+    wd0 = resil.DeviceWatchdog(0.0)
+    assert wd0.call_armed(lambda: 7, wd0.arm() - 99) == 7
+
+
+# --- chaos interval arming (unit) -------------------------------------------
+
+def test_chaos_begin_batches_arms_union():
+    from shrewd_tpu.chaos import ChaosEngine
+
+    eng = ChaosEngine({"faults": [
+        {"kind": "backend_error", "at_batch": 1, "tier": "device"},
+        {"kind": "corrupt_tally", "at_batch": 3},
+    ]})
+    eng.begin_batches(range(0, 4), "w0", "regfile")
+    # both faults (on different batches of the interval) are armed at once
+    assert set(eng._armed) == {"backend_error", "corrupt_tally"}
+    assert eng.dispatches == 4          # per-batch counter still advances
+
+
+# --- campaign + plan fixtures ----------------------------------------------
+
+def _tiny_plan(sync_every=1, depth=2, n_batches=6, batch_size=32,
+               canaries=0, **kw):
+    from shrewd_tpu.campaign.plan import CampaignPlan, WorkloadSpec
+    from shrewd_tpu.trace.synth import WorkloadConfig
+
+    defaults = dict(structures=["regfile"], batch_size=batch_size,
+                    target_halfwidth=0.2, confidence=0.95,
+                    max_trials=batch_size * n_batches,
+                    min_trials=batch_size * n_batches)
+    defaults.update(kw)
+    plan = CampaignPlan(
+        simpoints=[WorkloadSpec(
+            name="w0", workload=WorkloadConfig(n=96, nphys=32, mem_words=64,
+                                               working_set_words=32,
+                                               seed=7))],
+        **defaults)
+    # audit off (pure jax compute, identical either loop — test_integrity
+    # owns it); canaries per test — interval-boundary canaries are part of
+    # the pipelined design and get their own coverage below
+    plan.integrity.canary_trials = canaries
+    plan.integrity.audit_rate = 0.0
+    plan.resilience.backoff_base = 0.0
+    plan.pipeline.sync_every = sync_every
+    plan.pipeline.depth = depth
+    return plan
+
+
+def _run(plan, outdir=None):
+    from shrewd_tpu.campaign.orchestrator import Orchestrator
+    from shrewd_tpu.sim.exit_event import ExitEvent
+
+    orch = Orchestrator(plan, outdir=outdir)
+    events = list(orch.events())
+    results = (dict(events[-1][1])
+               if events[-1][0] is ExitEvent.CAMPAIGN_COMPLETE else None)
+    return orch, results
+
+
+# --- interval step bit-identity (campaign level) ----------------------------
+
+@pytest.mark.parametrize("mode,stratify", [
+    ("hybrid", False), ("dense", False), ("hybrid", True)])
+def test_interval_step_matches_serial_batches(mode, stratify):
+    from shrewd_tpu.models.o3 import O3Config
+    from shrewd_tpu.ops.trial import TrialKernel
+    from shrewd_tpu.parallel.campaign import ShardedCampaign
+    from shrewd_tpu.parallel.mesh import make_mesh
+    from shrewd_tpu.trace.synth import WorkloadConfig, generate
+    from shrewd_tpu.utils import prng
+
+    tr = generate(WorkloadConfig(n=96, nphys=32, mem_words=64,
+                                 working_set_words=32, seed=7))
+    kernel = TrialKernel(tr, O3Config(replay_kernel=mode))
+    camp = ShardedCampaign(kernel, make_mesh(), "regfile",
+                           stratify=stratify, integrity_check=True)
+    assert camp.supports_intervals
+    B = 32
+    sk = prng.structure_key(prng.simpoint_key(prng.campaign_key(0), 0), 0)
+
+    def keys(b):
+        return prng.trial_keys(prng.batch_key(sk, b), B)
+
+    serial = None
+    for b in range(6):
+        t = np.asarray(camp.tally_batch_stratified(keys(b)) if stratify
+                       else camp.tally_batch(keys(b)), dtype=np.int64)
+        serial = t if serial is None else serial + t
+    esc_serial = kernel.escapes
+    kernel.escapes = kernel.taint_trials = 0
+    acc = np.zeros_like(serial)
+    for b0, k in ((0, 4), (4, 2)):      # sync 4 + ragged final interval
+        tally, strata = camp.tally_interval(
+            [keys(b) for b in range(b0, b0 + k)])
+        acc += strata if stratify else tally
+    np.testing.assert_array_equal(acc, serial)
+    assert kernel.escapes == esc_serial   # counters match the serial loop
+
+
+# --- orchestrator bit-identity ----------------------------------------------
+
+def test_orchestrator_pipelined_bit_identical_with_ragged_interval():
+    # 9 batches, sync 4 → intervals of 4 + 4 + a 1-batch ragged TAIL
+    # (which must be consumed from the engine's in-flight queue, not
+    # recomputed serially); canaries ON so the interval-boundary canary
+    # path is exercised in the believed flow
+    _, serial = _run(_tiny_plan(sync_every=1, canaries=2, n_batches=9))
+    orch, piped = _run(_tiny_plan(sync_every=4, canaries=2, n_batches=9))
+    assert serial is not None and piped is not None
+    for key in serial:
+        np.testing.assert_array_equal(serial[key].tallies,
+                                      piped[key].tallies)
+        assert serial[key].trials == piped[key].trials
+    assert orch._perf.intervals == 3
+    # dispatch-ahead covered every batch exactly once: the 1-batch tail
+    # came out of the in-flight queue, not a duplicate serial compute
+    assert orch._perf.dispatches == 3
+    assert orch._perf.serial_fallbacks == 0
+    assert orch.monitor.canary_runs == 3     # per interval, not per batch
+    # the perf group is a first-class stats citizen
+    from shrewd_tpu import stats as statsmod
+    perf = statsmod.to_dict(orch.stats)["perf"]
+    assert perf["dispatch_depth"] >= 1
+    assert 0.0 <= perf["overlap_fraction"] <= 1.0
+    assert perf["executables_compiled"] > 0
+
+
+def test_orchestrator_pipelined_stratified_bit_identical():
+    _, serial = _run(_tiny_plan(sync_every=1, stratify=True))
+    _, piped = _run(_tiny_plan(sync_every=4, stratify=True))
+    for key in serial:
+        np.testing.assert_array_equal(serial[key].tallies,
+                                      piped[key].tallies)
+        # the post-stratified interval is a pure function of the strata,
+        # so it must agree too
+        assert serial[key].avf_interval == piped[key].avf_interval
+
+
+# --- chaos mid-interval ------------------------------------------------------
+
+def test_pipelined_corrupt_tally_mid_interval_recovers_bit_identical():
+    from shrewd_tpu.chaos import ChaosEngine
+
+    clean_orch, clean = _run(_tiny_plan(sync_every=1))
+    plan = _tiny_plan(sync_every=4)
+    from shrewd_tpu.campaign.orchestrator import Orchestrator
+    orch = Orchestrator(plan)
+    orch.attach_chaos(ChaosEngine({"faults": [
+        {"kind": "corrupt_tally", "at_batch": 5, "delta": 3}]}))
+    events = list(orch.events())
+    results = dict(events[-1][1])
+    for key in clean:
+        np.testing.assert_array_equal(clean[key].tallies,
+                                      results[key].tallies)
+    assert orch.chaos.injected == {"corrupt_tally": 1}
+    assert orch.chaos.survived == orch.chaos.injected
+    assert orch.monitor.quarantined >= 1       # interval quarantined ...
+    assert orch._perf.serial_fallbacks >= 1    # ... and recovered serially
+    assert orch.monitor.recovered >= 1
+    # escape-counter parity under quarantine: the untrusted interval's
+    # counter bump is rolled back before serial recovery re-adds the
+    # believed values (the hybrid path counts escapes on every dispatch)
+    key = ("w0", "regfile")
+    assert orch.state[key].escapes == clean_orch.state[key].escapes
+    assert (orch.state[key].taint_trials
+            == clean_orch.state[key].taint_trials)
+
+
+def test_pipelined_wedge_mid_interval_recovers_bit_identical():
+    from shrewd_tpu.chaos import ChaosEngine
+
+    _, clean = _run(_tiny_plan(sync_every=1))
+    plan = _tiny_plan(sync_every=4)
+    plan.resilience.dispatch_timeout = 30.0     # deadline-bearing dispatch
+    from shrewd_tpu.campaign.orchestrator import Orchestrator
+    orch = Orchestrator(plan)
+    orch.attach_chaos(ChaosEngine({"faults": [
+        {"kind": "wedge", "at_batch": 5, "deadline": 0.2}]}))
+    events = list(orch.events())
+    results = dict(events[-1][1])
+    for key in clean:
+        np.testing.assert_array_equal(clean[key].tallies,
+                                      results[key].tallies)
+    # the wedge fired through the REAL watchdog machinery at
+    # materialization (armed at dispatch) and the interval recovered
+    # through the serial ladder on frozen keys
+    assert orch.chaos.injected.get("wedge", 0) >= 1
+    assert orch.chaos.survived.get("wedge", 0) >= 1
+    assert orch.watchdog.timeouts >= 1
+    assert orch._perf.serial_fallbacks >= 1
+
+
+def test_pipelined_kill_worker_mid_interval_resumes_bit_identical(
+        tmp_path, monkeypatch):
+    import os as _os
+
+    from shrewd_tpu.campaign.orchestrator import Orchestrator
+    from shrewd_tpu.chaos import ChaosEngine
+
+    class _Killed(BaseException):
+        pass
+
+    _, clean = _run(_tiny_plan(sync_every=1, n_batches=8))
+    # the worker dies at the boundary of the interval containing batch 5
+    # (mid-sync-grid); checkpoint_every=2 leaves a resumable checkpoint
+    # at the previous interval boundary
+    plan = _tiny_plan(sync_every=4, n_batches=8, checkpoint_every=2)
+    orch = Orchestrator(plan, outdir=str(tmp_path / "out"))
+    orch.attach_chaos(ChaosEngine({"faults": [
+        {"kind": "kill_worker", "at_batch": 5}]}))
+    monkeypatch.setattr(_os, "_exit",
+                        lambda rc: (_ for _ in ()).throw(_Killed()))
+    with pytest.raises(_Killed):
+        for _ in orch.events():
+            pass
+    ckpt = str(tmp_path / "out" / "campaign_ckpt")
+    orch2 = Orchestrator.resume(ckpt, outdir=str(tmp_path / "out2"))
+    # the dead worker is not re-injected on resume (a real kill is once)
+    orch2.chaos = None
+    orch2.watchdog.chaos = None
+    events = list(orch2.events())
+    results = dict(events[-1][1])
+    for key in clean:
+        np.testing.assert_array_equal(clean[key].tallies,
+                                      results[key].tallies)
+        assert clean[key].trials == results[key].trials
+
+
+# --- mid-interval checkpoint / resume ---------------------------------------
+
+def test_resume_from_mid_grid_checkpoint_matches_undisturbed(tmp_path):
+    from shrewd_tpu.campaign.orchestrator import Orchestrator
+
+    _, clean = _run(_tiny_plan(sync_every=1))
+    # serial run leaves its LAST checkpoint at batch 4 — not a multiple
+    # of the resumed run's sync_every, so the resumed pipelined campaign
+    # starts mid-grid and its first interval is ragged
+    plan = _tiny_plan(sync_every=1, checkpoint_every=4)
+    orch, _ = _run(plan, outdir=str(tmp_path / "out"))
+    ckpt = str(tmp_path / "out" / "campaign_ckpt")
+    doc = Orchestrator.load_checkpoint_doc(ckpt)
+    st = doc["state"]["w0"]["regfile"]
+    assert st["next_batch"] == 4           # genuinely mid-run
+    orch2 = Orchestrator.resume(ckpt, outdir=str(tmp_path / "out2"))
+    orch2.pcfg.sync_every = 4              # resume PIPELINED
+    events = list(orch2.events())
+    results = dict(events[-1][1])
+    for key in clean:
+        np.testing.assert_array_equal(clean[key].tallies,
+                                      results[key].tallies)
+        assert clean[key].trials == results[key].trials
