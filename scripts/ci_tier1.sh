@@ -9,6 +9,19 @@
 
 cd "$(dirname "$0")/.." || exit 1
 
+# graftlint gate (FATAL): static determinism & replay-safety
+# certification (shrewd_tpu/analysis/, tools/graftlint.py).  AST passes
+# over the package (exec-cache jit routing, no wall clock in
+# deterministic regions, atomic checkpoint writes, PRNG hygiene) plus
+# the jaxpr/HLO audit of the standard campaign executables (frozen-key
+# RNG lineage, no host callbacks, ONE device->host transfer per sync
+# interval, donation consistency) — recorded as LINT_r06.json.  A NEW
+# violation fails the build; pre-existing findings are waived in-source
+# with "# graftlint: allow-<rule> -- <reason>" (re-run with
+# --baseline LINT_r06.json to gate only on regressions).
+timeout -k 10 420 env JAX_PLATFORMS=cpu python tools/graftlint.py --strict --json LINT_r06.json \
+  || { echo "FATAL: graftlint gate failed (static determinism/replay-safety violations)"; exit 1; }
+
 # Non-fatal backend-probe smoke: catches probe drift (import breakage,
 # verdict-format changes) in tier-1 without ever affecting the pass/fail
 # status — the probe is the first thing operators reach for when a

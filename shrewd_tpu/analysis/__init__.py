@@ -1,0 +1,57 @@
+"""graftlint: static determinism & replay-safety certification.
+
+Two layers over the campaign stack (CLI: ``tools/graftlint.py``; CI gate:
+``scripts/ci_tier1.sh`` → ``LINT_r06.json``):
+
+- **Layer 1** (``jaxpr_audit`` / ``certify``) — walk the jaxpr + lowered
+  HLO of every executable admitted to ``parallel/exec_cache.py`` and
+  certify the replay-safety rules (frozen-key RNG lineage, no host
+  callbacks, the ONE-device_get-per-sync-interval transfer budget,
+  donation consistency).  Strict mode refuses admission.
+- **Layer 2** (``ast_lint``) — repo-specific AST passes: exec-cache
+  routing for jits, no wall clock in deterministic chaos/elastic regions,
+  atomic checkpoint writes, PRNG key hygiene.
+
+Import discipline: jax-free at package import (the linter runs in
+accelerator-less tooling contexts; jax enters only inside the audit
+functions).
+"""
+
+from shrewd_tpu.analysis.ast_lint import (Finding, LintReport, lint_file,
+                                          lint_tree)
+from shrewd_tpu.analysis.config import (RULES, AnalysisConfig,
+                                        GraftlintConfig, load_config)
+from shrewd_tpu.analysis.jaxpr_audit import (ALLOWED_RNG, CALLBACK_PRIMS,
+                                             FORBIDDEN_RNG,
+                                             CertificationError,
+                                             StepAuditor, audit_callable,
+                                             primitive_census)
+
+__all__ = [
+    "ALLOWED_RNG", "CALLBACK_PRIMS", "FORBIDDEN_RNG", "RULES",
+    "AnalysisConfig", "CertificationError", "Finding", "GraftlintConfig",
+    "LintReport", "StepAuditor", "audit_callable", "install_step_auditor",
+    "lint_file", "lint_tree", "load_config", "primitive_census",
+]
+
+
+def install_step_auditor(mode: str, transfer_budget: int = 1):
+    """Orchestrator/CLI wiring: install the exec-cache auditor per the
+    ``plan.analysis.certify`` posture.  Certification is a process-wide
+    opt-in and one campaign must not silently DISARM or DOWNGRADE
+    another's: 'off' leaves any existing auditor in place, and 'warn'
+    keeps an already-installed strict auditor (the stricter posture
+    wins; an explicit disarm is the CLI's ``--certify off``).  Returns
+    the effective auditor or None."""
+    if mode == "off":
+        return None
+    from shrewd_tpu.analysis.jaxpr_audit import StepAuditor
+    from shrewd_tpu.parallel import exec_cache
+
+    existing = exec_cache.current_auditor()
+    if mode == "warn" and getattr(existing, "strict", False):
+        return existing
+    auditor = StepAuditor(transfer_budget=transfer_budget,
+                          strict=mode == "strict")
+    exec_cache.install_auditor(auditor)
+    return auditor

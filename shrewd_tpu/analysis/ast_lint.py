@@ -1,0 +1,415 @@
+"""Layer-2 static analysis: repo-specific AST lint passes.
+
+The dynamic layers (pinned tests, in-run canaries, the integrity
+quarantine) prove determinism *after* code runs; these passes prove the
+repo-specific preconditions *before* anything runs, the way the reference
+builds its CheckerCPU redundancy into the design rather than the test
+suite.  Five rules, each encoding a contract another subsystem already
+depends on:
+
+========  ============  =====================================================
+GL101     jit           in campaign-critical modules every ``jax.jit`` /
+                        ``partial(jax.jit, ...)`` must route through the
+                        process-wide executable cache
+                        (``parallel/exec_cache.py`` — content-keyed, so the
+                        fallback tier / canary battery / a re-built
+                        orchestrator reuse one compiled step); an
+                        instance-keyed jit silently recompiles per object
+GL102     wall-clock    no wall-clock *reads* (``time.time``,
+                        ``datetime.now``, ...) inside deterministic
+                        chaos/elastic regions — triggers are pure functions
+                        of campaign coordinates (the chaos DSL's
+                        no-wall-clock rule); ``time.sleep`` and
+                        ``time.monotonic`` perf ledgers are not reads of
+                        schedule-bearing state and are not flagged
+GL103     raw-write     persisted JSON documents in checkpoint-bearing
+                        modules must go through
+                        ``resilience.write_json_atomic`` (tmp + fsync +
+                        rename + dir-fsync); a bare ``json.dump`` can tear
+GL104     key-reuse     a PRNG key consumed by ``jax.random.split`` must
+                        not be passed to another ``jax.random`` call
+                        afterwards (key reuse makes two "independent"
+                        samples collide; ``fold_in`` with distinct
+                        coordinates is the sanctioned derivation idiom)
+GL105     key-genesis   ``jax.random.key`` / ``PRNGKey`` only in
+                        ``utils/prng.py`` — every key derives from the plan
+                        seed through the campaign-coordinate helpers, which
+                        is what makes re-dispatch on frozen keys possible
+========  ============  =====================================================
+
+**Waivers**: a finding is waived by a comment on the same line, the line
+above, or a decorator line of the flagged statement::
+
+    # graftlint: allow-<rule-name> -- <reason>
+
+The reason is mandatory — a reasonless waiver is itself reported (the
+waiver ledger is evidence, not an off switch).
+
+Import discipline: jax-free (pure ``ast`` work; the linter must run in
+environments with no accelerator stack at all).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+
+from shrewd_tpu.analysis.config import RULES, GraftlintConfig
+
+#: call-router attribute names that mark a jit as cache-routed (GL101):
+#: an enclosing def named build*/_build*, or an enclosing call to one of
+#: these (the exec-cache surfaces and the kernel/campaign helpers that
+#: wrap them)
+_ROUTERS = {"get", "get_aot", "_shared_jit", "_cached", "_chunk_jit"}
+
+#: wall-clock reads (GL102) — (module-ish qualifier, attr)
+_WALL_CLOCK = {
+    ("time", "time"), ("time", "time_ns"),
+    ("datetime", "now"), ("datetime", "utcnow"), ("datetime", "today"),
+    ("date", "today"),
+}
+
+_WAIVER_RE = re.compile(
+    r"#\s*graftlint:\s*allow-([a-z-]+)(?:\s*--\s*(\S.*))?")
+
+
+@dataclass
+class Finding:
+    rule: str                  # GLxxx
+    path: str                  # repo-relative file path
+    line: int
+    msg: str
+    waived: bool = False
+    waiver_reason: str = ""
+    severity: str = "error"
+
+    def to_dict(self) -> dict:
+        return {"rule": self.rule, "name": RULES.get(self.rule, self.rule),
+                "path": self.path, "line": self.line, "msg": self.msg,
+                "waived": self.waived, "waiver_reason": self.waiver_reason,
+                "severity": self.severity}
+
+    def __str__(self) -> str:
+        tag = " (waived: %s)" % self.waiver_reason if self.waived else ""
+        return f"{self.path}:{self.line}: {self.rule} {self.msg}{tag}"
+
+
+@dataclass
+class LintReport:
+    findings: list = field(default_factory=list)
+
+    @property
+    def violations(self) -> list:
+        return [f for f in self.findings
+                if not f.waived and f.severity == "error"]
+
+    @property
+    def warnings(self) -> list:
+        return [f for f in self.findings
+                if not f.waived and f.severity == "warn"]
+
+    @property
+    def waivers(self) -> list:
+        return [f for f in self.findings if f.waived]
+
+    def to_dict(self) -> dict:
+        return {"violations": [f.to_dict() for f in self.violations],
+                "warnings": [f.to_dict() for f in self.warnings],
+                "waivers": [f.to_dict() for f in self.waivers]}
+
+
+def _parents(tree: ast.AST) -> dict:
+    par = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            par[child] = node
+    return par
+
+
+def _ancestors(node, par):
+    while node in par:
+        node = par[node]
+        yield node
+
+
+def _dotted(node) -> str:
+    """``a.b.c`` for an Attribute/Name chain, '' otherwise."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _is_jax_jit(node) -> bool:
+    return isinstance(node, ast.Attribute) and node.attr == "jit" \
+        and _dotted(node) in ("jax.jit",)
+
+
+class _FileLint:
+    """All passes over one file (parse once, share parents/waivers)."""
+
+    def __init__(self, path: str, rel: str, cfg: GraftlintConfig):
+        self.rel = rel.replace(os.sep, "/")
+        self.cfg = cfg
+        with open(path) as f:
+            self.src = f.read()
+        self.tree = ast.parse(self.src, filename=path)
+        self.par = _parents(self.tree)
+        self.lines = self.src.splitlines()
+        # line -> (rule-name, reason|None); a reason may continue over
+        # following pure-comment lines (joined — the waiver ledger is
+        # evidence and should read whole)
+        self.waiver_lines: dict[int, tuple[str, str | None]] = {}
+        for i, line in enumerate(self.lines, 1):
+            m = _WAIVER_RE.search(line)
+            if not m:
+                continue
+            reason = m.group(2)
+            j = i
+            while reason is not None and j < len(self.lines):
+                nxt = self.lines[j].strip()
+                if not nxt.startswith("#") or _WAIVER_RE.search(nxt):
+                    break
+                reason = f"{reason} {nxt.lstrip('#').strip()}"
+                j += 1
+            self.waiver_lines[i] = (m.group(1), reason)
+        self.findings: list[Finding] = []
+
+    # --- waiver lookup --------------------------------------------------
+
+    def _scan_up(self, start: int, rule_name: str, depth: int = 8):
+        """A waiver on ``start``'s own line or in the contiguous comment/
+        blank block immediately above it (multi-line waiver prose keeps
+        its marker attached to the code it covers)."""
+        i = start
+        while i >= 1 and start - i <= depth:
+            got = self.waiver_lines.get(i)
+            if got and got[0] == rule_name:
+                return got
+            i -= 1
+            text = self.lines[i - 1].strip() if 0 < i <= len(self.lines) \
+                else ""
+            if i != start and text and not text.startswith("#"):
+                break                       # hit real code: stop climbing
+        return None
+
+    def _waiver_for(self, node, rule_name: str):
+        """The waiver covering ``node`` for ``rule_name``: its own line,
+        the comment block above it / its statement, or a decorator."""
+        starts = {node.lineno}
+        stmt = node
+        while stmt in self.par and not isinstance(stmt, (ast.stmt,)):
+            stmt = self.par[stmt]
+        if isinstance(stmt, ast.stmt):
+            starts.add(stmt.lineno)
+            for dec in getattr(stmt, "decorator_list", []):
+                starts.add(dec.lineno)
+        for ln in sorted(starts):
+            got = self._scan_up(ln, rule_name)
+            if got is not None:
+                return got
+        return None
+
+    def _report(self, rule: str, node, msg: str) -> None:
+        name = RULES[rule]
+        sev = self.cfg.rule_severity(rule)
+        if sev == "off":
+            return
+        waiver = self._waiver_for(node, name)
+        if waiver is not None and not waiver[1]:
+            self.findings.append(Finding(
+                rule, self.rel, node.lineno,
+                f"waiver 'allow-{name}' is missing its reason "
+                "(syntax: # graftlint: allow-%s -- <why>)" % name,
+                severity=sev))
+            return
+        self.findings.append(Finding(
+            rule, self.rel, node.lineno, msg,
+            waived=waiver is not None,
+            waiver_reason=waiver[1] if waiver else "",
+            severity=sev))
+
+    # --- GL101: bare jax.jit -------------------------------------------
+
+    def _routed(self, node) -> bool:
+        for anc in _ancestors(node, self.par):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and anc.name.lstrip("_").startswith("build"):
+                return True
+            if isinstance(anc, ast.Call):
+                fn = anc.func
+                name = fn.attr if isinstance(fn, ast.Attribute) else \
+                    (fn.id if isinstance(fn, ast.Name) else "")
+                if name in _ROUTERS:
+                    return True
+        return False
+
+    def check_bare_jit(self) -> None:
+        for node in ast.walk(self.tree):
+            jit = None
+            if isinstance(node, ast.Call) and _is_jax_jit(node.func):
+                jit = node
+            elif isinstance(node, ast.Call) and _dotted(node.func) in (
+                    "functools.partial", "partial") and node.args \
+                    and _is_jax_jit(node.args[0]):
+                jit = node
+            if jit is None or self._routed(jit):
+                continue
+            self._report(
+                "GL101", jit,
+                "bare jax.jit in a campaign-critical module — route it "
+                "through parallel/exec_cache (content-keyed, shared "
+                "across instances) or waive with a reason")
+
+    # --- GL102: wall clock in deterministic regions ---------------------
+
+    def check_wall_clock(self) -> None:
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            if not isinstance(fn, ast.Attribute):
+                continue
+            qual = _dotted(fn.value).rsplit(".", 1)[-1]
+            if (qual, fn.attr) in _WALL_CLOCK:
+                self._report(
+                    "GL102", node,
+                    f"wall-clock read {qual}.{fn.attr}() in a "
+                    "deterministic chaos/elastic module — triggers must "
+                    "be pure functions of campaign coordinates (batch "
+                    "ids, checkpoint ordinals, seeded samples)")
+
+    # --- GL103: raw persisted writes ------------------------------------
+
+    def check_raw_write(self) -> None:
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if _dotted(node.func) not in ("json.dump",):
+                continue
+            fn_name = ""
+            for anc in _ancestors(node, self.par):
+                if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    fn_name = anc.name
+                    break
+            if fn_name == "write_json_atomic":
+                continue                     # the sanctioned implementation
+            self._report(
+                "GL103", node,
+                "raw json.dump in a checkpoint-bearing module — persisted "
+                "documents go through resilience.write_json_atomic "
+                "(tmp + fsync + rename + dir-fsync) or carry a waiver "
+                "explaining why tearing is acceptable")
+
+    # --- GL104: key reuse after split -----------------------------------
+    #
+    # ``fold_in`` is NOT a consumer: deriving several children from one
+    # parent with distinct coordinates (simpoint_key/batch_key/...) is
+    # the framework's addressing scheme.  ``split`` is: its whole
+    # contract is that the parent key is dead afterwards.
+
+    _CONSUMERS = {"split"}
+
+    def check_key_reuse(self) -> None:
+        for func in ast.walk(self.tree):
+            if not isinstance(func, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef, ast.Lambda)):
+                continue
+            consumed: dict[str, int] = {}    # name -> lineno consumed
+            rebound: set[str] = set()
+            for node in ast.walk(func):
+                if not isinstance(node, ast.Call):
+                    continue
+                fn = node.func
+                if not isinstance(fn, ast.Attribute):
+                    continue
+                if not _dotted(fn).startswith("jax.random."):
+                    continue
+                # reuse check first: an already-consumed name as any arg
+                for arg in node.args:
+                    if isinstance(arg, ast.Name) \
+                            and arg.id in consumed \
+                            and arg.id not in rebound \
+                            and node.lineno > consumed[arg.id]:
+                        self._report(
+                            "GL104", node,
+                            f"PRNG key {arg.id!r} used after "
+                            f"jax.random.{self._consumer_of(arg.id)} "
+                            f"(line {consumed[arg.id]}) — a consumed key "
+                            "must not be reused (derive fresh keys from "
+                            "campaign coordinates instead)")
+                if fn.attr in self._CONSUMERS and node.args \
+                        and isinstance(node.args[0], ast.Name):
+                    name = node.args[0].id
+                    # rebinding the same name consumes-and-replaces
+                    stmt = self.par.get(node)
+                    targets = []
+                    if isinstance(stmt, ast.Assign):
+                        for t in stmt.targets:
+                            targets.extend(
+                                n.id for n in ast.walk(t)
+                                if isinstance(n, ast.Name))
+                    if name in targets:
+                        rebound.add(name)
+                    elif name not in consumed:
+                        consumed[name] = node.lineno
+                        self._last_consumer = getattr(
+                            self, "_last_consumer", {})
+                        self._last_consumer[name] = fn.attr
+
+    def _consumer_of(self, name: str) -> str:
+        return getattr(self, "_last_consumer", {}).get(name, "split")
+
+    # --- GL105: key genesis outside utils/prng --------------------------
+
+    def check_key_genesis(self) -> None:
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if _dotted(node.func) in ("jax.random.key",
+                                      "jax.random.PRNGKey"):
+                self._report(
+                    "GL105", node,
+                    "PRNG key genesis outside utils/prng.py — every key "
+                    "derives from the plan seed through the campaign-"
+                    "coordinate helpers (trial_key/batch_key/...), which "
+                    "is what makes frozen-key re-dispatch bit-identical")
+
+
+def lint_file(path: str, rel: str, cfg: GraftlintConfig) -> list:
+    """Every applicable pass over one file → findings."""
+    fl = _FileLint(path, rel, cfg)
+    rel_n = fl.rel
+    if rel_n in cfg.jit_modules:
+        fl.check_bare_jit()
+    if rel_n in cfg.deterministic_modules:
+        fl.check_wall_clock()
+    if rel_n in cfg.checkpoint_modules:
+        fl.check_raw_write()
+    fl.check_key_reuse()
+    if rel_n not in cfg.key_genesis_allow:
+        fl.check_key_genesis()
+    return fl.findings
+
+
+def lint_tree(root: str, cfg: GraftlintConfig | None = None,
+              package: str = "shrewd_tpu") -> LintReport:
+    """Lint every ``.py`` file under ``<root>/<package>`` → LintReport."""
+    cfg = cfg if cfg is not None else GraftlintConfig()
+    report = LintReport()
+    base = os.path.join(root, package)
+    for dirpath, _dirnames, filenames in os.walk(base):
+        for name in sorted(filenames):
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, name)
+            rel = os.path.relpath(path, root)
+            report.findings.extend(lint_file(path, rel, cfg))
+    report.findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return report

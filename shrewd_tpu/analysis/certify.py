@@ -1,0 +1,136 @@
+"""Certify the framework's standard campaign executables.
+
+The acceptance surface of the jaxpr auditor (``analysis/jaxpr_audit.py``):
+build the four step shapes every campaign actually dispatches — the dense
+per-batch step, the hybrid (device-resolution) step, the stratified step,
+and the pipelined multi-batch interval step — over a small synthetic
+window, trace them, and certify the replay-safety rules with the
+ONE-transfer budget.  Plus a deliberately *violating* interval step (a
+``jax.debug.print`` smuggled into the scan body) that the auditor must
+reject: a certifier that cannot fail is not evidence.
+
+Used by ``tools/graftlint.py`` (the CI gate records the certificates in
+``LINT_r06.json``) and by the unit tests.  Costs traces + lowerings, not
+XLA compiles — see ``audit_callable``.
+"""
+
+from __future__ import annotations
+
+from shrewd_tpu.analysis.jaxpr_audit import audit_callable
+
+#: (name, replay_kernel mode, stratify) for the standard per-batch steps
+STANDARD_STEPS = (
+    ("dense", "dense", False),
+    ("hybrid", "hybrid", False),
+    ("stratified", "hybrid", True),
+)
+
+
+def _probe_campaigns():
+    """One tiny-window campaign per standard step shape (the
+    tests/test_pipeline.py fixture geometry — small enough that the
+    golden pass is seconds, big enough to exercise every code path)."""
+    from shrewd_tpu.models.o3 import O3Config
+    from shrewd_tpu.ops.trial import TrialKernel
+    from shrewd_tpu.parallel.campaign import ShardedCampaign
+    from shrewd_tpu.parallel.mesh import make_mesh
+    from shrewd_tpu.trace.synth import WorkloadConfig, generate
+
+    tr = generate(WorkloadConfig(n=96, nphys=32, mem_words=64,
+                                 working_set_words=32, seed=7))
+    mesh = make_mesh()
+    out = []
+    for name, mode, stratify in STANDARD_STEPS:
+        kernel = TrialKernel(tr, O3Config(replay_kernel=mode))
+        out.append((name, ShardedCampaign(kernel, mesh, "regfile",
+                                          stratify=stratify)))
+    return out
+
+
+def _interval_args(camp, S: int, B: int):
+    import jax
+    import jax.numpy as jnp
+
+    from shrewd_tpu.parallel.mesh import shard_batch_stack
+    from shrewd_tpu.utils import prng
+
+    sk = prng.structure_key(prng.simpoint_key(prng.campaign_key(0), 0), 0)
+    kd = jnp.stack([jax.random.key_data(
+        prng.trial_keys(prng.batch_key(sk, b), B)) for b in range(S)])
+    return (shard_batch_stack(camp.mesh, kd),)
+
+
+def _batch_args(camp, B: int):
+    from shrewd_tpu.parallel.mesh import shard_keys
+    from shrewd_tpu.utils import prng
+
+    sk = prng.structure_key(prng.simpoint_key(prng.campaign_key(0), 0), 0)
+    return (shard_keys(camp.mesh, prng.trial_keys(prng.batch_key(sk, 0),
+                                                  B)),)
+
+
+def violating_interval_step(camp, S: int):
+    """The seeded-violation fixture: the interval step's scan body with a
+    ``jax.debug.print`` inside — one hidden host callback, so the static
+    transfer count is 2 > the 1-per-interval budget.  The auditor MUST
+    reject it."""
+    import jax
+    import jax.numpy as jnp
+
+    from shrewd_tpu.ops import classify as C
+
+    kernel, structure = camp.kernel, camp.structure
+
+    def broken(kd):
+        def body(acc, kd_b):
+            keys = jax.random.wrap_key_data(kd_b)
+            outs = kernel.outcomes_from_keys(keys, structure)
+            t = acc + C.tally(outs)
+            jax.debug.print("tally={t}", t=t)     # the smuggled side effect
+            return t, None
+
+        t, _ = jax.lax.scan(body, jnp.zeros(C.N_OUTCOMES, jnp.int32), kd)
+        return t
+
+    return broken
+
+
+def certify_standard_executables(transfer_budget: int = 1,
+                                 batch_size: int = 32,
+                                 sync_every: int = 4) -> dict:
+    """Certificates for every standard executable + the violation
+    fixture's verdict.  ``doc["ok"]`` means: all four standard steps
+    certified clean AND the broken fixture was rejected."""
+    certs: dict[str, dict] = {}
+    camps = _probe_campaigns()
+    for name, camp in camps:
+        certs[f"{name}/batch"] = audit_callable(
+            camp._strat_step if camp.stratify else
+            (camp._device_step if camp._device_step is not None
+             else camp._step),
+            _batch_args(camp, batch_size), kind=f"{name}/batch",
+            transfer_budget=transfer_budget)
+        certs[f"{name}/interval"] = audit_callable(
+            camp._build_interval_step(sync_every),
+            _interval_args(camp, sync_every, batch_size),
+            kind=f"{name}/interval", transfer_budget=transfer_budget)
+    # pipelined-interval is the hybrid interval step (the engine's hot
+    # path); alias it under the name the acceptance criteria use
+    certs["pipelined/interval"] = certs["hybrid/interval"]
+    # the fixture that must FAIL
+    _, dense_camp = camps[0]
+    broken_cert = audit_callable(
+        violating_interval_step(dense_camp, sync_every),
+        (_interval_args(dense_camp, sync_every, batch_size)[0],),
+        kind="fixture/broken-interval", transfer_budget=transfer_budget)
+    fixture_rejected = not broken_cert["ok"]
+    ok = fixture_rejected and all(
+        c["ok"] and c["transfers"] <= transfer_budget
+        for name, c in certs.items())
+    return {
+        "ok": ok,
+        "transfer_budget": transfer_budget,
+        "certificates": certs,
+        "violation_fixture": broken_cert,
+        "fixture_rejected": fixture_rejected,
+    }

@@ -1,0 +1,219 @@
+"""graftlint configuration: the ``[tool.graftlint]`` pyproject block and
+the ``plan.analysis`` campaign child.
+
+Two consumers, one source of truth:
+
+- ``tools/graftlint.py`` (and the CI gate) read rule scoping — which
+  modules each AST pass covers, per-rule severity, the device→host
+  transfer budget — from ``pyproject.toml`` so the lint posture is
+  versioned with the code it certifies;
+- the orchestrator reads the ``plan.analysis`` child to decide whether
+  compiled campaign steps are certified at admission time
+  (``parallel/exec_cache.py`` auditor hook), so a campaign's
+  verification posture is reproducible from its config dump like every
+  other posture.
+
+The container's Python is 3.10 (no ``tomllib``), so ``load_pyproject``
+carries a minimal TOML-subset reader for exactly the value shapes the
+``[tool.graftlint]`` block uses: strings, booleans, ints, floats, and
+(possibly multiline) arrays of strings.  Import discipline: jax-free
+(pure host-side configuration).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from dataclasses import dataclass, field
+
+from shrewd_tpu.utils.config import ConfigObject, Param
+
+#: rule ids → human names (the waiver comment uses the name:
+#: ``# graftlint: allow-<name> -- <reason>``)
+RULES = {
+    "GL101": "jit",
+    "GL102": "wall-clock",
+    "GL103": "raw-write",
+    "GL104": "key-reuse",
+    "GL105": "key-genesis",
+}
+
+SEVERITIES = ("error", "warn", "off")
+
+
+class AnalysisConfig(ConfigObject):
+    """The ``plan.analysis`` child: whether compiled campaign steps are
+    statically certified (jaxpr/HLO replay-safety audit) when they are
+    admitted to the executable cache."""
+
+    certify = Param(str, "off",
+                    "certify executables at cache admission: 'off' (no "
+                    "auditor), 'warn' (audit + report, never refuse), "
+                    "'strict' (a violating executable is refused — "
+                    "exec_cache.AdmissionError)",
+                    check=lambda v: v in ("off", "warn", "strict"))
+    transfer_budget = Param(int, 1,
+                            "max device→host transfers per executable "
+                            "invocation (1 = the ONE-device_get-per-sync-"
+                            "interval contract of parallel/pipeline.py)",
+                            check=lambda v: v >= 1)
+
+
+@dataclass
+class GraftlintConfig:
+    """Resolved lint configuration (pyproject block + defaults)."""
+
+    # GL101: modules where every jax.jit must route through the
+    # executable cache or carry an allow-jit waiver
+    jit_modules: list = field(default_factory=lambda: [
+        "shrewd_tpu/parallel/campaign.py",
+        "shrewd_tpu/parallel/pipeline.py",
+        "shrewd_tpu/parallel/elastic.py",
+        "shrewd_tpu/parallel/exec_cache.py",
+        "shrewd_tpu/ops/trial.py",
+        "shrewd_tpu/ops/chunked.py",
+        "shrewd_tpu/ops/pallas_taint.py",
+        "shrewd_tpu/integrity.py",
+        "shrewd_tpu/resilience.py",
+        "shrewd_tpu/chaos.py",
+        "shrewd_tpu/campaign/orchestrator.py",
+    ])
+    # GL102: modules whose trigger/replay logic must be wall-clock-free
+    deterministic_modules: list = field(default_factory=lambda: [
+        "shrewd_tpu/chaos.py",
+        "shrewd_tpu/parallel/elastic.py",
+    ])
+    # GL103: modules whose persisted JSON documents must go through
+    # resilience.write_json_atomic (+ dir fsync)
+    checkpoint_modules: list = field(default_factory=lambda: [
+        "shrewd_tpu/campaign/orchestrator.py",
+        "shrewd_tpu/resilience.py",
+        "shrewd_tpu/parallel/elastic.py",
+        "shrewd_tpu/integrity.py",
+        "shrewd_tpu/chaos.py",
+    ])
+    # GL104 applies package-wide; GL105 everywhere except these files
+    # (the one place key genesis is allowed — everything else derives
+    # from the plan seed through utils/prng.py)
+    key_genesis_allow: list = field(default_factory=lambda: [
+        "shrewd_tpu/utils/prng.py",
+    ])
+    severity: dict = field(default_factory=lambda: {
+        rid: "error" for rid in RULES})
+    transfer_budget: int = 1
+
+    def rule_severity(self, rule_id: str) -> str:
+        return self.severity.get(rule_id, "error")
+
+
+# --------------------------------------------------------------------------
+# pyproject [tool.graftlint] loading (TOML subset — Python 3.10, no tomllib)
+# --------------------------------------------------------------------------
+
+_STR = r'"((?:[^"\\]|\\.)*)"'
+
+
+def _strip_comment(line: str) -> str:
+    """Drop a trailing ``#`` comment, respecting double-quoted strings."""
+    out = []
+    in_str = False
+    i = 0
+    while i < len(line):
+        ch = line[i]
+        if ch == '"' and (i == 0 or line[i - 1] != "\\"):
+            in_str = not in_str
+        if ch == "#" and not in_str:
+            break
+        out.append(ch)
+        i += 1
+    return "".join(out)
+
+
+def _parse_value(text: str):
+    text = text.strip()
+    if text.startswith("["):
+        return [m.group(1) for m in re.finditer(_STR, text)]
+    m = re.fullmatch(_STR, text)
+    if m:
+        return m.group(1)
+    if text in ("true", "false"):
+        return text == "true"
+    try:
+        return int(text)
+    except ValueError:
+        pass
+    try:
+        return float(text)
+    except ValueError:
+        raise ValueError(f"graftlint config: unsupported TOML value {text!r}")
+
+
+def parse_graftlint_toml(text: str) -> dict:
+    """The ``[tool.graftlint]`` (+ ``[tool.graftlint.severity]``) tables
+    of a pyproject document, as a flat dict (severity nested)."""
+    out: dict = {}
+    section = None
+    pending_key = None
+    pending = ""
+    for raw in text.splitlines():
+        line = _strip_comment(raw).strip()
+        if not line:
+            continue
+        if pending_key is not None:
+            pending += " " + line
+            if pending.count("[") == pending.count("]"):
+                tgt = out.setdefault("severity", {}) \
+                    if section == "severity" else out
+                tgt[pending_key] = _parse_value(pending)
+                pending_key, pending = None, ""
+            continue
+        m = re.fullmatch(r"\[([^\]]+)\]", line)
+        if m:
+            name = m.group(1).strip()
+            if name == "tool.graftlint":
+                section = "root"
+            elif name == "tool.graftlint.severity":
+                section = "severity"
+            else:
+                section = None
+            continue
+        if section is None:
+            continue
+        if "=" not in line:
+            continue
+        key, _, val = line.partition("=")
+        key = key.strip().strip('"')
+        val = val.strip()
+        if val.startswith("[") and val.count("[") != val.count("]"):
+            pending_key, pending = key, val      # multiline array
+            continue
+        tgt = out.setdefault("severity", {}) if section == "severity" else out
+        tgt[key] = _parse_value(val)
+    return out
+
+
+def load_config(root: str) -> GraftlintConfig:
+    """GraftlintConfig from ``<root>/pyproject.toml`` (defaults when the
+    file or the ``[tool.graftlint]`` block is absent)."""
+    cfg = GraftlintConfig()
+    path = os.path.join(root, "pyproject.toml")
+    if not os.path.exists(path):
+        return cfg
+    with open(path) as f:
+        doc = parse_graftlint_toml(f.read())
+    for key in ("jit_modules", "deterministic_modules",
+                "checkpoint_modules", "key_genesis_allow"):
+        if key in doc:
+            setattr(cfg, key, list(doc[key]))
+    if "transfer_budget" in doc:
+        cfg.transfer_budget = int(doc["transfer_budget"])
+    sev = doc.get("severity", {})
+    name_to_id = {name: rid for rid, name in RULES.items()}
+    for name, level in sev.items():
+        rid = name_to_id.get(name, name)
+        if level not in SEVERITIES:
+            raise ValueError(
+                f"graftlint config: severity for {name!r} must be one of "
+                f"{SEVERITIES}, got {level!r}")
+        cfg.severity[rid] = level
+    return cfg

@@ -1,0 +1,209 @@
+"""Layer-1 static analysis: jaxpr/HLO replay-safety certification.
+
+Every compiled campaign step that passes through the executable cache
+(``parallel/exec_cache.py``) computes a tally that the framework promises
+is a *pure function of its frozen PRNG keys* — that promise is what makes
+recovery, degradation, elasticity and pipelining bit-identical.  The
+dynamic layers test the promise after the fact; this auditor proves the
+program-level preconditions ahead of time, from the traced jaxpr and the
+lowered HLO, before a single trial runs (the ahead-of-time analog of the
+reference's shadow-FU/CheckerCPU redundancy):
+
+- **RNG lineage** — the only randomness primitives allowed are the
+  counter-based threefry/random_bits family that frozen keys feed;
+  ``rng_bit_generator``/``rng_uniform`` (stateful XLA RNG, the
+  ``rbg``/``unsafe_rbg`` impls) would make outcomes depend on execution
+  order, which no frozen key can repair.
+- **No side-effecting callbacks** — ``io_callback`` / ``pure_callback`` /
+  ``jax.debug.print`` / infeed/outfeed inside a step punch hidden
+  device↔host channels: they break the ONE-transfer accounting, stall the
+  async dispatch pipeline, and (io_callback) order-couple the program to
+  the host.
+- **Transfer budget** — a step's device→host transfer count is
+  ``1`` (the single materialization of its result tuple) ``+`` one per
+  callback/outfeed primitive.  The pipelined engine's contract is ONE
+  ``device_get`` per sync interval (``parallel/pipeline.py``,
+  ``ShardedCampaign.materialize_interval``); an executable whose static
+  count exceeds the budget cannot honor it.
+- **Donation consistency** — input/output aliasing in the lowered HLO
+  (``tf.aliasing_output``) must match what the caller declared: an
+  undeclared donated buffer is exactly the stale-aliasing hazard the
+  shard-vs-psum invariant exists to catch at runtime.
+
+Certificates are plain dicts (JSON-able evidence, cached content-keyed
+alongside the executable by ``exec_cache``).  Import discipline: jax
+enters only inside functions — the module must import in jax-free
+tooling contexts.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+
+#: the frozen-key threefry lineage (jax 0.4.x primitive names): these are
+#: pure functions of their key operands — sanctioned
+ALLOWED_RNG = frozenset({
+    "threefry2x32", "random_seed", "random_wrap", "random_fold_in",
+    "random_bits", "random_split", "random_unwrap", "random_clone",
+})
+
+#: stateful / order-coupled RNG: forbidden in campaign steps
+FORBIDDEN_RNG = frozenset({"rng_bit_generator", "rng_uniform"})
+
+#: primitives that open a device↔host channel; each costs one transfer
+#: beyond the result materialization, and all are forbidden in steps
+CALLBACK_PRIMS = frozenset({
+    "pure_callback", "io_callback", "debug_callback", "callback",
+    "infeed", "outfeed",
+})
+
+
+class CertificationError(RuntimeError):
+    """A strict-mode audit found violations (see ``.certificate``)."""
+
+    def __init__(self, msg: str, certificate: dict):
+        super().__init__(msg)
+        self.certificate = certificate
+
+
+def _sub_jaxprs(params: dict):
+    import jax
+
+    closed, plain = jax.core.ClosedJaxpr, jax.core.Jaxpr
+    for v in params.values():
+        if isinstance(v, closed):
+            yield v.jaxpr
+        elif isinstance(v, plain):
+            yield v
+        elif isinstance(v, (list, tuple)):
+            for x in v:
+                if isinstance(x, closed):
+                    yield x.jaxpr
+                elif isinstance(x, plain):
+                    yield x
+
+
+def primitive_census(jaxpr) -> Counter:
+    """Recursive primitive-name counts over a (Closed)Jaxpr — the raw
+    material every rule below reads."""
+    import jax
+
+    if isinstance(jaxpr, jax.core.ClosedJaxpr):
+        jaxpr = jaxpr.jaxpr
+    census: Counter = Counter()
+    stack = [jaxpr]
+    while stack:
+        j = stack.pop()
+        for eqn in j.eqns:
+            census[eqn.primitive.name] += 1
+            stack.extend(_sub_jaxprs(eqn.params))
+    return census
+
+
+def hlo_donated_args(lowered_text: str) -> list[int]:
+    """Argument indices the lowered module aliases to outputs (donation),
+    parsed from the StableHLO text (``tf.aliasing_output`` arg attrs)."""
+    import re
+
+    out = []
+    for m in re.finditer(r"%arg(\d+)[^)]*?\{[^}]*tf\.aliasing_output",
+                         lowered_text):
+        out.append(int(m.group(1)))
+    return sorted(set(out))
+
+
+def audit_callable(fn, example_args: tuple, *, kind: str = "step",
+                   transfer_budget: int | None = 1,
+                   declared_donations: tuple = (),
+                   check_hlo: bool = True) -> dict:
+    """Trace ``fn`` on ``example_args`` and certify the replay-safety
+    rules.  Returns the certificate (``cert["ok"]`` is the verdict); the
+    caller decides whether a failed certificate refuses admission
+    (``exec_cache`` strict mode) or only reports (warn mode).
+
+    Tracing-only: ``jax.make_jaxpr`` + ``lower`` — no backend compile, so
+    certification cost is a trace, not an XLA compilation."""
+    import jax
+
+    violations: list[str] = []
+    census = primitive_census(jax.make_jaxpr(fn)(*example_args))
+    rng_used = {p: n for p, n in census.items()
+                if p in ALLOWED_RNG or p in FORBIDDEN_RNG}
+    for prim in sorted(set(census) & FORBIDDEN_RNG):
+        violations.append(
+            f"rng: forbidden primitive '{prim}' ({census[prim]}x) — "
+            "randomness outside the frozen-key threefry lineage makes "
+            "the step order-dependent")
+    callbacks = {p: census[p] for p in sorted(set(census) & CALLBACK_PRIMS)}
+    for prim, n in callbacks.items():
+        violations.append(
+            f"side-effect: '{prim}' ({n}x) — device↔host callbacks are "
+            "forbidden in campaign steps (hidden transfers, host "
+            "order-coupling)")
+    transfers = 1 + sum(callbacks.values())
+    if transfer_budget is not None and transfers > transfer_budget:
+        violations.append(
+            f"transfer budget: {transfers} device→host transfers per "
+            f"invocation > budget {transfer_budget} (the ONE-device_get-"
+            "per-sync-interval contract)")
+    donated: list[int] = []
+    if check_hlo:
+        try:
+            lowered = (fn.lower(*example_args) if hasattr(fn, "lower")
+                       else jax.jit(fn).lower(*example_args))
+            donated = hlo_donated_args(lowered.as_text())
+        except Exception as e:  # noqa: BLE001 — lowering unavailable on
+            # this path/version: the jaxpr rules above still certified
+            donated = []
+            census["_hlo_unavailable"] = 1
+            _ = e
+        undeclared = sorted(set(donated) - set(declared_donations))
+        if undeclared:
+            violations.append(
+                f"donation: arguments {undeclared} are aliased to outputs "
+                "in the lowered HLO but not declared by the caller — an "
+                "undeclared donated buffer is a stale-aliasing hazard")
+    return {
+        "kind": kind,
+        "ok": not violations,
+        "violations": violations,
+        "transfers": transfers,
+        "transfer_budget": transfer_budget,
+        "callbacks": callbacks,
+        "rng": rng_used,
+        "donated_args": donated,
+        "n_eqns": int(sum(census.values())),
+    }
+
+
+class StepAuditor:
+    """The ``exec_cache`` auditor hook: certify each executable at
+    admission (AOT path) or on its first eager call (jit path).
+
+    ``strict=True`` raises ``CertificationError`` on a failed
+    certificate — the cache then refuses to admit the executable.
+    ``on_cert`` (optional) observes every certificate (the CLI's
+    reporting path)."""
+
+    def __init__(self, transfer_budget: int = 1, strict: bool = False,
+                 on_cert=None):
+        self.transfer_budget = int(transfer_budget)
+        self.strict = bool(strict)
+        self.on_cert = on_cert
+        self.audited = 0
+        self.failed = 0
+
+    def __call__(self, fn, example_args: tuple, key) -> dict:
+        kind = key[0] if isinstance(key, tuple) and key else "step"
+        cert = audit_callable(fn, example_args, kind=str(kind),
+                              transfer_budget=self.transfer_budget)
+        self.audited += 1
+        if not cert["ok"]:
+            self.failed += 1
+        if self.on_cert is not None:
+            self.on_cert(key, cert)
+        if self.strict and not cert["ok"]:
+            raise CertificationError(
+                f"executable {kind!r} failed replay-safety "
+                f"certification: {'; '.join(cert['violations'])}", cert)
+        return cert

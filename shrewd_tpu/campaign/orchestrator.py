@@ -314,6 +314,17 @@ class Orchestrator:
         if self.pcfg.compilation_cache_dir:
             exec_cache.enable_persistent_cache(
                 self.pcfg.compilation_cache_dir)
+        # static replay-safety certification (shrewd_tpu/analysis/):
+        # audit every executable at cache admission; 'strict' refuses a
+        # violating step (exec_cache.AdmissionError) before any trial
+        # runs.  Installed process-wide — certification is a posture of
+        # the process's shared cache, like the persistent compile cache
+        self.auditor = None
+        if plan.analysis.certify != "off":
+            from shrewd_tpu import analysis as analysis_mod
+
+            self.auditor = analysis_mod.install_step_auditor(
+                plan.analysis.certify, plan.analysis.transfer_budget)
         # probe points (utils/probes; gem5 ProbePoint pattern): listeners
         # attach without the orchestrator knowing who observes.  Payloads
         # are batch-granular — BatchInfo / StructureResult / ckpt path.
@@ -524,6 +535,16 @@ class Orchestrator:
         pg.executables_reused = statsmod.Formula(
             "executables_reused", lambda: exec_cache.cache().reused,
             "campaign-step executables reused from the cache")
+        pg.executables_certified = statsmod.Formula(
+            "executables_certified",
+            lambda: sum(1 for c in exec_cache.cache().certificates.values()
+                        if c.get("ok")),
+            "executables carrying a PASSING replay-safety certificate "
+            "(plan.analysis.certify; failed/unauditable certificates "
+            "are in the ledger but do not count as certified)")
+        pg.executables_refused = statsmod.Formula(
+            "executables_refused", lambda: exec_cache.cache().refused,
+            "executables refused admission by the strict-mode audit")
         # refresh from restored state (resume path)
         for (spn, s), st in self.state.items():
             sg = getattr(getattr(self.stats, f"sp_{spn}"), f"st_{s}")

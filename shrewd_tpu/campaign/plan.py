@@ -16,6 +16,7 @@ SimPoint sources polymorph over ``SimPointSpec``:
 
 from __future__ import annotations
 
+from shrewd_tpu.analysis.config import AnalysisConfig
 from shrewd_tpu.chaos import ChaosConfig
 from shrewd_tpu.integrity import IntegrityConfig
 from shrewd_tpu.models.mesi import MesiConfig
@@ -151,6 +152,13 @@ class CampaignPlan(ConfigObject):
     # and pipelined tallies are bit-identical at any sync_every because
     # per-batch tallies are pure functions of their frozen PRNG keys
     pipeline = Child(PipelineConfig)
+    # static-certification posture (shrewd_tpu/analysis/): whether every
+    # compiled campaign step is jaxpr/HLO-audited for replay safety at
+    # executable-cache admission — 'strict' refuses a violating
+    # executable before a single trial runs (the ahead-of-time analog of
+    # the in-loop canaries), 'warn' audits and reports, 'off' (default)
+    # adds zero overhead
+    analysis = Child(AnalysisConfig)
     # non-O3 fault tiers (used only when a tier-qualified structure is in
     # ``structures``)
     cache = Child(CacheConfig)

@@ -265,11 +265,24 @@ class CanaryBattery:
             return np.asarray(kernel.oracle_outcomes(faults))
         # tier kernels: the unsharded campaign protocol is the
         # in-framework reference (the canary then proves the sharded
-        # psum path reproduces it)
+        # psum path reproduces it); shared through the executable cache
+        # so every fresh battery over the same kernel reuses one compile
         import jax
 
-        out = jax.jit(self.kernel.outcomes_from_keys,
-                      static_argnums=1)(self.seed_keys, self.structure)
+        from shrewd_tpu.parallel import exec_cache
+
+        # the structure is CLOSED OVER, not a static argument: it is
+        # already part of the cache key, and an array-only signature is
+        # what keeps the executable auditable (make_jaxpr cannot trace a
+        # call with a raw-string positional)
+        kernel, structure = self.kernel, self.structure
+        out = exec_cache.cache().get(
+            exec_cache.step_key(kernel, None, structure,
+                                kind="seed_reference"),
+            owner=kernel,
+            build=lambda: jax.jit(
+                lambda keys: kernel.outcomes_from_keys(keys, structure)),
+        )(self.seed_keys)
         return np.asarray(out)
 
     def seed_expected(self) -> np.ndarray | None:

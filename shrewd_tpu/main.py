@@ -59,6 +59,20 @@ def _apply_resilience_overrides(orch, args) -> None:
         icfg.audit_action = args.audit_action
     if getattr(args, "canary_trials", None) is not None:
         icfg.canary_trials = args.canary_trials
+    if getattr(args, "certify", None):
+        from shrewd_tpu import analysis as analysis_mod
+        from shrewd_tpu.parallel import exec_cache
+
+        orch.plan.analysis.certify = args.certify   # reproducible dump
+        if args.certify == "off":
+            # an EXPLICIT off must disarm a plan-installed auditor, or
+            # the dumped config ('off') and the run's behavior (strict)
+            # would disagree — the reproducibility contract
+            exec_cache.clear_auditor()
+            orch.auditor = None
+        else:
+            orch.auditor = analysis_mod.install_step_auditor(
+                args.certify, orch.plan.analysis.transfer_budget)
     pcfg = orch.pcfg
     if getattr(args, "sync_every", None) is not None:
         pcfg.sync_every = args.sync_every
@@ -369,6 +383,14 @@ def main(argv: list[str] | None = None) -> int:
                             "directory: re-runs and resumes skip "
                             "retrace/recompile of unchanged campaign "
                             "steps (plan.pipeline.compilation_cache_dir)")
+    resil.add_argument("--certify", default=None,
+                       choices=("off", "warn", "strict"),
+                       help="statically certify every compiled campaign "
+                            "step at executable-cache admission (jaxpr/"
+                            "HLO replay-safety audit, shrewd_tpu/"
+                            "analysis/): 'strict' refuses a violating "
+                            "executable before any trial runs "
+                            "(plan.analysis.certify)")
 
     p = sub.add_parser("run", help="run a campaign plan to completion",
                        parents=[common, resil])

@@ -485,6 +485,9 @@ def _make_kernel(n: int, k: int, nphys: int, mem_words: int, may_latch: bool,
     return kernel
 
 
+# graftlint: allow-jit -- module-level jit: its function identity is
+# already process-wide (one compile per static-arg combination), so
+# content keying through exec_cache would add nothing
 @functools.partial(jax.jit, static_argnames=("k", "compare_regs", "may_latch",
                                              "b_tile", "u_steps",
                                              "interpret"))

@@ -104,7 +104,8 @@ class TrialKernel:
             # empty — correct in every trace, concrete on first eager use.
             if not jax.core.trace_state_clean():
                 return self._replay_one(null_fault())
-            self._golden = jax.jit(self._replay_one)(null_fault())
+            self._golden = self._shared_jit(
+                "golden", lambda: jax.jit(self._replay_one))(null_fault())
         return self._golden
 
     def with_shrewd(self, enable: bool | None = None,

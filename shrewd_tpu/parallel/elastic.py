@@ -115,6 +115,8 @@ class HeartbeatWriter:
         self.beats += 1
         tmp = f"{self.path}.{os.getpid()}.tmp"
         with open(tmp, "w") as f:
+            # graftlint: allow-raw-write -- liveness beat: atomic rename,
+            # deliberately unsynced; its loss on crash IS the signal
             json.dump({"worker": self.worker, "beats": self.beats}, f)
         os.replace(tmp, self.path)
 
@@ -159,6 +161,9 @@ class Membership:
 
     def alive(self, worker: str) -> bool:
         try:
+            # graftlint: allow-wall-clock -- heartbeat staleness is
+            # wall-clock liveness, not a trigger decision: tallies stay
+            # bit-identical under any membership (frozen-key re-dispatch)
             age = time.time() - os.stat(self._hb_path(worker)).st_mtime
         except OSError:
             return False                 # left gracefully or never joined
@@ -203,6 +208,9 @@ class LeaseBoard:
         tmp = f"{path}.{os.getpid()}.claim"
         with open(tmp, "w") as f:
             import json
+            # graftlint: allow-raw-write -- lease claim: fsync'd tmp +
+            # atomic os.link is the commit point; no torn lease is
+            # observable and the board path must stay write_json-free
             json.dump({"worker": self.worker, "key": key}, f)
             f.flush()
             os.fsync(f.fileno())

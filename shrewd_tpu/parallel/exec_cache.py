@@ -52,6 +52,57 @@ debug.register_flag("ExecCache", "shared executable cache hits/misses")
 MAX_ENTRIES = 64
 
 
+class AdmissionError(RuntimeError):
+    """A strict-mode replay-safety audit refused this executable (see
+    ``.certificate`` for the evidence)."""
+
+    def __init__(self, msg: str, certificate: dict | None = None):
+        super().__init__(msg)
+        self.certificate = certificate or {}
+
+
+# the installed auditor (analysis/jaxpr_audit.StepAuditor or compatible):
+# ``auditor(fn, example_args, key) -> certificate dict`` — raises to
+# refuse admission.  None (the default) = zero-overhead pass-through.
+_AUDITOR = None
+
+
+def install_auditor(auditor) -> None:
+    """Certify every executable admitted from now on: the AOT path audits
+    at admission (example args in hand), the plain-jit path on its first
+    eager call.  Certificates are cached content-keyed alongside the
+    entries (``cache().certificates``)."""
+    global _AUDITOR
+    _AUDITOR = auditor
+
+
+def clear_auditor() -> None:
+    global _AUDITOR
+    _AUDITOR = None
+
+
+def current_auditor():
+    return _AUDITOR
+
+
+class _LowerMemo:
+    """A jitted callable with its ``lower(*args)`` memoized — the AOT
+    admission path audits (jaxpr + HLO) and then compiles, and both want
+    the same lowering."""
+
+    def __init__(self, fn):
+        self._fn = fn
+        self._lowered = None
+
+    def __call__(self, *args, **kwargs):
+        return self._fn(*args, **kwargs)
+
+    def lower(self, *args):
+        if self._lowered is None:
+            self._lowered = self._fn.lower(*args)
+        return self._lowered
+
+
 class ExecutableCache:
     """LRU registry of compiled campaign steps (see module docstring)."""
 
@@ -63,6 +114,11 @@ class ExecutableCache:
         self.reused = 0         # cache hits
         self.aot = 0            # ... of the compiled ones, AOT-lowered
         self.evicted = 0
+        # content key digest -> replay-safety certificate (when an
+        # auditor is installed) — the ahead-of-time evidence that the
+        # executable honors the frozen-key/one-transfer contracts
+        self.certificates: dict[str, dict] = {}
+        self.refused = 0        # strict-mode admission refusals
 
     def _hit(self, key, owner):
         ent = self._entries.get(key)
@@ -72,8 +128,11 @@ class ExecutableCache:
         if ref is not None and ref() is None:
             # the owner died and its id() may since have been reused by a
             # different object — the digest alone can no longer prove the
-            # entry matches, so treat as a miss and rebuild
+            # entry matches, so treat as a miss and rebuild (and drop the
+            # certificate with the entry: evidence about a dead
+            # executable must not count toward the rebuilt one)
             del self._entries[key]
+            self.certificates.pop(key_digest(key), None)
             return None
         self._entries.move_to_end(key)
         self.reused += 1
@@ -90,9 +149,87 @@ class ExecutableCache:
         self._entries[key] = (ref, fn)
         self._entries.move_to_end(key)
         while len(self._entries) > self.max_entries:
-            self._entries.popitem(last=False)
+            old_key, _ = self._entries.popitem(last=False)
+            # the certificate is evidence ABOUT a cached executable: it
+            # leaves with its entry (the count must track live entries)
+            self.certificates.pop(key_digest(old_key), None)
             self.evicted += 1
         return fn
+
+    def _audit(self, key, fn, example_args) -> None:
+        """Run the installed auditor and cache its certificate.  Only a
+        deliberate REFUSAL (the auditor's own error type, carrying its
+        certificate) becomes ``AdmissionError``; an auditor that merely
+        crashed proves nothing, so infrastructure failures are recorded
+        and the executable admits — a warn-mode run must never abort
+        because the auditor couldn't analyze something."""
+        auditor = _AUDITOR
+        if auditor is None:
+            return
+        try:
+            cert = auditor(fn, example_args, key)
+        except AdmissionError:
+            self.refused += 1
+            raise
+        except Exception as e:  # noqa: BLE001
+            if hasattr(e, "certificate"):
+                # the auditor's own refusal type (CertificationError):
+                # normalize so callers see one refusal type
+                self.refused += 1
+                raise AdmissionError(
+                    f"executable refused by replay-safety audit: {e}",
+                    e.certificate) from e
+            debug.dprintf("ExecCache", "audit of %s errored (%s) — "
+                          "admitting unaudited", key[0] if key else key, e)
+            self.certificates[key_digest(key)] = {
+                "kind": str(key[0]) if key else "step", "ok": False,
+                "audit_error": str(e), "violations": []}
+            return
+        self.certificates[key_digest(key)] = cert
+
+    def _audited_on_first_call(self, key, fn):
+        """Wrap a plain jitted callable so its FIRST eager call (no
+        ambient trace — auditing mid-trace would trace a trace) runs the
+        replay-safety audit on the real arguments.  Zero wrapping when no
+        auditor is installed."""
+        if _AUDITOR is None:
+            return fn
+        state = {"done": False, "refusal": None}
+
+        def audited(*args, **kwargs):
+            if state["refusal"] is not None:
+                # a refused executable STAYS refused: holders that cached
+                # this wrapper (kernel._shared_jits, chunk fns) must not
+                # execute it just because the first caller caught the
+                # error — e.g. a resilience ladder retrying the "failed"
+                # dispatch
+                raise state["refusal"]
+            if not state["done"]:
+                import jax
+
+                if jax.core.trace_state_clean():
+                    state["done"] = True
+                    if kwargs:
+                        # make_jaxpr takes positional args only — don't
+                        # silently skip: an unauditable call shape is
+                        # recorded as evidence, never as certified
+                        self.certificates[key_digest(key)] = {
+                            "kind": str(key[0]) if key else "step",
+                            "ok": False, "violations": [],
+                            "audit_error": "called with keyword "
+                            "arguments — unauditable"}
+                        return fn(*args, **kwargs)
+                    try:
+                        self._audit(key, fn, args)
+                    except AdmissionError as e:
+                        # refusal evicts the entry: the executable is not
+                        # admitted, and a later get() re-refuses afresh
+                        state["refusal"] = e
+                        self._entries.pop(key, None)
+                        raise
+            return fn(*args, **kwargs)
+
+        return audited
 
     def get(self, key, owner, build: Callable[[], Callable]):
         """The memoized callable for ``key`` (built via ``build()`` on
@@ -103,7 +240,8 @@ class ExecutableCache:
             return fn
         self.compiled += 1
         debug.dprintf("ExecCache", "compile %s", key[0] if key else key)
-        return self._store(key, owner, build())
+        return self._store(key, owner,
+                           self._audited_on_first_call(key, build()))
 
     def get_aot(self, key, owner, build: Callable[[], Callable],
                 example_args: tuple):
@@ -117,8 +255,17 @@ class ExecutableCache:
             return fn
         self.compiled += 1
         jit_fn = build()
+        # the AOT path has example args in hand: certify at ADMISSION —
+        # a strict-mode violation refuses the executable before the
+        # compile is even attempted (and before any trial runs).  The
+        # lowering is memoized so the auditor's HLO check and the AOT
+        # compile below share ONE lower() instead of paying the biggest
+        # executables' trace cost twice
+        lowerable = (_LowerMemo(jit_fn) if hasattr(jit_fn, "lower")
+                     else jit_fn)
+        self._audit(key, lowerable, example_args)
         try:
-            compiled = jit_fn.lower(*example_args).compile()
+            compiled = lowerable.lower(*example_args).compile()
             self.aot += 1
             debug.dprintf("ExecCache", "AOT compile %s",
                           key[0] if key else key)
@@ -131,10 +278,13 @@ class ExecutableCache:
     def stats(self) -> dict:
         return {"compiled": self.compiled, "reused": self.reused,
                 "aot": self.aot, "evicted": self.evicted,
-                "entries": len(self._entries)}
+                "entries": len(self._entries),
+                "certified": len(self.certificates),
+                "refused": self.refused}
 
     def clear(self) -> None:
         self._entries.clear()
+        self.certificates.clear()
 
 
 _GLOBAL: ExecutableCache | None = None
@@ -222,6 +372,13 @@ def step_key(kernel, mesh, structure: str, kind: str, **flags) -> tuple:
     """The full cache key for one campaign step executable."""
     return (kind, kernel_fingerprint(kernel), mesh_fingerprint(mesh),
             str(structure), tuple(sorted(flags.items())))
+
+
+def key_digest(key) -> str:
+    """Stable short digest of a cache key — how certificates are content-
+    keyed alongside their executables (the key already IS the content
+    identity; the digest just makes it a JSON-able handle)."""
+    return hashlib.sha1(repr(key).encode()).hexdigest()[:16]
 
 
 # --------------------------------------------------------------------------

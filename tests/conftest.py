@@ -77,6 +77,12 @@ QUICK_MODULES = {
     # chaos mid-interval, mid-grid checkpoint resume) — the perf-path
     # correctness smoke runs on every push like the layers it rides on
     "test_pipeline",
+    # graftlint static analysis: AST-rule fixtures are sub-second; the
+    # jaxpr-auditor certifications are trace-only (no XLA compile), and
+    # the strict-admission integration rides the shared tiny-kernel
+    # compiles — the lint gate's own correctness belongs in the tier
+    # that runs the gate
+    "test_graftlint",
 }
 QUICK_TESTS = {
     # one representative per subsystem (≈4-10 s each, compile-dominated)

@@ -1,0 +1,143 @@
+#!/usr/bin/env python
+"""graftlint — static determinism & replay-safety certification CLI.
+
+Two layers (shrewd_tpu/analysis/):
+
+- **AST lint** (always): repo-specific passes over ``shrewd_tpu/`` —
+  exec-cache routing for jits (GL101), no wall clock in deterministic
+  chaos/elastic regions (GL102), atomic checkpoint writes (GL103), PRNG
+  key hygiene (GL104/GL105).  Rule scoping and severity come from the
+  ``[tool.graftlint]`` block in ``pyproject.toml``; findings are waived
+  in-source with ``# graftlint: allow-<rule> -- <reason>``.
+- **jaxpr/HLO audit** (skippable with ``--no-jaxpr``): build the
+  standard campaign executables (dense / hybrid / stratified per-batch
+  steps + the pipelined interval steps) over a probe window and certify
+  the replay-safety rules — frozen-key RNG lineage, no host callbacks,
+  ONE device→host transfer per invocation, donation consistency — and
+  prove the auditor has teeth by rejecting a seeded-violation fixture.
+
+Exit status: 0 = clean (or only waived/baseline findings), 1 = new
+violations (or a standard executable failed certification / the
+violation fixture was NOT rejected), 2 = usage/environment error.
+
+Usage::
+
+    python tools/graftlint.py --strict --json LINT_r06.json   # the CI gate
+    python tools/graftlint.py --no-jaxpr                      # fast, AST only
+    python tools/graftlint.py --baseline LINT_r06.json        # only NEW
+                                                              # violations fail
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+
+def _violation_key(v: dict) -> tuple:
+    # path + rule + message identifies a finding across runs; LINE does
+    # not participate (pre-existing findings must not become "new" when
+    # unrelated edits shift them)
+    return (v["path"], v["rule"], v["msg"])
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="graftlint", description=__doc__.splitlines()[0])
+    ap.add_argument("--strict", action="store_true",
+                    help="record the strict posture in the JSON artifact "
+                         "(violations always gate the exit code; "
+                         "--baseline is the one escape hatch)")
+    ap.add_argument("--baseline", default=None, metavar="LINT.json",
+                    help="previous lint artifact: only violations NOT in "
+                         "it are fatal (pre-existing findings report but "
+                         "don't gate)")
+    ap.add_argument("--json", default=None, metavar="OUT",
+                    help="write the machine-readable lint artifact "
+                         "(the LINT_r06.json the CI gate records)")
+    ap.add_argument("--no-jaxpr", action="store_true",
+                    help="skip the jaxpr/HLO executable audit (fast "
+                         "AST-only mode; no jax import)")
+    ap.add_argument("--root", default=REPO_ROOT,
+                    help="repo root (default: the checkout this script "
+                         "lives in)")
+    args = ap.parse_args(argv)
+
+    from shrewd_tpu.analysis import lint_tree, load_config
+
+    try:
+        cfg = load_config(args.root)
+    except ValueError as e:
+        print(f"graftlint: {e}", file=sys.stderr)
+        return 2
+    report = lint_tree(args.root, cfg)
+
+    doc = {
+        "tool": "graftlint",
+        "strict": bool(args.strict),
+        "transfer_budget": cfg.transfer_budget,
+        **report.to_dict(),
+    }
+
+    certify_ok = True
+    if not args.no_jaxpr:
+        from shrewd_tpu.analysis.certify import certify_standard_executables
+
+        cert_doc = certify_standard_executables(
+            transfer_budget=cfg.transfer_budget)
+        doc["executables"] = cert_doc
+        certify_ok = cert_doc["ok"]
+
+    new_violations = [f.to_dict() for f in report.violations]
+    if args.baseline and os.path.exists(args.baseline):
+        with open(args.baseline) as f:
+            base = {_violation_key(v)
+                    for v in json.load(f).get("violations", [])}
+        new_violations = [v for v in new_violations
+                         if _violation_key(v) not in base]
+    doc["new_violations"] = new_violations
+    doc["ok"] = certify_ok and not new_violations
+
+    # --- human-readable report ---
+    for f in report.violations:
+        print(f"VIOLATION {f}")
+    for f in report.warnings:
+        print(f"warning   {f}")
+    for f in report.waivers:
+        print(f"waived    {f.path}:{f.line} {f.rule} -- {f.waiver_reason}")
+    if not args.no_jaxpr:
+        ex = doc["executables"]
+        for name, c in sorted(ex["certificates"].items()):
+            verdict = "certified" if c["ok"] else "REJECTED"
+            print(f"executable {name}: {verdict} "
+                  f"(transfers={c['transfers']}/"
+                  f"{ex['transfer_budget']})")
+        print("violation fixture: "
+              + ("rejected (auditor has teeth)" if ex["fixture_rejected"]
+                 else "NOT REJECTED — the auditor is blind"))
+    n_v, n_w = len(report.violations), len(report.waivers)
+    print(f"graftlint: {n_v} violation(s) "
+          f"({len(new_violations)} new), {len(report.warnings)} "
+          f"warning(s), {n_w} waiver(s)"
+          + ("" if args.no_jaxpr else
+             f", executables {'ok' if certify_ok else 'FAILED'}"))
+
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(doc, f, indent=1)
+            f.write("\n")
+        print(f"wrote {args.json}")
+
+    # violations gate unconditionally; --baseline is the one escape hatch
+    # (it already filtered new_violations above) and --strict only names
+    # the posture in the artifact
+    return 1 if (new_violations or not certify_ok) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
