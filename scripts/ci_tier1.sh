@@ -70,6 +70,52 @@ print(f"chaos smoke: injected {orch.chaos.injected} -> survived, "
       "tally bit-identical")
 CHAOS_SMOKE
 
+# Non-fatal fleet smoke: a 2-tenant multi-tenant fleet on one mesh
+# (shrewd_tpu/service/), both tenants over the SAME window — each
+# tenant's tally must be bit-identical to its solo serial run, and the
+# second tenant must compile ZERO new steps (cross-tenant dedupe through
+# the content-keyed executable cache).  Never affects pass/fail status.
+timeout -k 10 240 env JAX_PLATFORMS=cpu python - <<'FLEET_SMOKE' \
+  || echo "WARNING: fleet smoke failed (non-fatal)"
+import numpy as np
+from shrewd_tpu.campaign.orchestrator import Orchestrator
+from shrewd_tpu.campaign.plan import CampaignPlan, WorkloadSpec
+from shrewd_tpu.parallel import exec_cache
+from shrewd_tpu.service import CampaignScheduler, TenantSpec
+from shrewd_tpu.trace.synth import WorkloadConfig
+
+def plan(seed):
+    p = CampaignPlan(
+        simpoints=[WorkloadSpec(name="w0", workload=WorkloadConfig(
+            n=64, nphys=32, mem_words=64, working_set_words=32, seed=3))],
+        structures=["regfile"], batch_size=32, target_halfwidth=0.5,
+        max_trials=128, min_trials=128, seed=seed)
+    p.integrity.canary_trials = 0
+    p.integrity.audit_rate = 0.0
+    p.resilience.backoff_base = 0.0
+    return p
+
+solos = {}
+warm = []
+for seed in (0, 9):
+    orch = Orchestrator(plan(seed))
+    warm.append(orch)       # keep kernels alive: cache entries are owner-guarded
+    solos[seed] = {k: v.tallies for k, v in dict(list(orch.events())[-1][1]).items()}
+before = exec_cache.cache().compiled
+sched = CampaignScheduler()
+sched.admit(TenantSpec(name="t0", plan=plan(0).to_dict()))
+sched.admit(TenantSpec(name="t9", plan=plan(9).to_dict()))
+assert sched.run() == 0, "fleet did not complete"
+for name, seed in (("t0", 0), ("t9", 9)):
+    got = sched.tenant_tallies(name)
+    for k, t in solos[seed].items():
+        np.testing.assert_array_equal(got[k], np.asarray(t))
+compiled = exec_cache.cache().compiled - before
+assert compiled == 0, f"shared-window fleet compiled {compiled} new steps"
+print(f"fleet smoke: 2 tenants bit-identical to solo, 0 new compiles "
+      f"(fairness {sched.fairness_index():.3f})")
+FLEET_SMOKE
+
 # Non-fatal pipelined-bench smoke: bench.py --quick includes the
 # serial-vs-pipelined campaign-loop microbenchmark (warm executable cache,
 # best-of-2 per arm, bit-identity asserted) — the recorded BENCH_r06.json

@@ -77,11 +77,17 @@ class GraftlintConfig:
         "shrewd_tpu/resilience.py",
         "shrewd_tpu/chaos.py",
         "shrewd_tpu/campaign/orchestrator.py",
+        "shrewd_tpu/service/scheduler.py",
+        "shrewd_tpu/service/queue.py",
     ])
     # GL102: modules whose trigger/replay logic must be wall-clock-free
+    # (the fleet scheduler qualifies by design: scheduling reads only
+    # admission order, trial counts and weights)
     deterministic_modules: list = field(default_factory=lambda: [
         "shrewd_tpu/chaos.py",
         "shrewd_tpu/parallel/elastic.py",
+        "shrewd_tpu/service/scheduler.py",
+        "shrewd_tpu/service/queue.py",
     ])
     # GL103: modules whose persisted JSON documents must go through
     # resilience.write_json_atomic (+ dir fsync)
@@ -91,6 +97,8 @@ class GraftlintConfig:
         "shrewd_tpu/parallel/elastic.py",
         "shrewd_tpu/integrity.py",
         "shrewd_tpu/chaos.py",
+        "shrewd_tpu/service/scheduler.py",
+        "shrewd_tpu/service/queue.py",
     ])
     # GL104 applies package-wide; GL105 everywhere except these files
     # (the one place key genesis is allowed — everything else derives

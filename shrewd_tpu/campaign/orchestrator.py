@@ -545,6 +545,12 @@ class Orchestrator:
         pg.executables_refused = statsmod.Formula(
             "executables_refused", lambda: exec_cache.cache().refused,
             "executables refused admission by the strict-mode audit")
+        pg.exec_cache_keys = statsmod.Formula(
+            "exec_cache_keys",
+            lambda: exec_cache.cache().per_key_stats(),
+            "per-content-key hit/miss/evict counters (cross-tenant "
+            "compile dedupe observability: a co-scheduled tenant on a "
+            "shared window shows hits and zero new misses)")
         # refresh from restored state (resume path)
         for (spn, s), st in self.state.items():
             sg = getattr(getattr(self.stats, f"sp_{spn}"), f"st_{s}")
@@ -563,8 +569,20 @@ class Orchestrator:
 
     def kernel(self, sp_idx: int) -> TrialKernel:
         if sp_idx not in self._kernels:
-            self._kernels[sp_idx] = TrialKernel(self.trace(sp_idx),
-                                                self.plan.machine)
+            # content-keyed kernel sharing (exec_cache.shared_kernel):
+            # co-scheduled tenants over the same window and machine
+            # config — and a re-built orchestrator in the same process —
+            # reuse one TrialKernel instead of re-materializing goldens
+            # per instance.  Escape counters are consumed as deltas, so
+            # sharing cannot leak state across campaigns.
+            import json as _json
+
+            trace = self.trace(sp_idx)
+            cfg_fp = _json.dumps(self.plan.machine.to_dict(),
+                                 sort_keys=True, default=str)
+            self._kernels[sp_idx] = exec_cache.shared_kernel(
+                trace, cfg_fp,
+                lambda: TrialKernel(trace, self.plan.machine))
         return self._kernels[sp_idx]
 
     def kernel_for(self, sp_idx: int, structure: str):
@@ -685,22 +703,78 @@ class Orchestrator:
         raise its past-the-ceiling error)."""
         return -(-int(self.plan.max_trials) // self.batch_size)
 
-    def _interval_len(self, st: _State, camp: ShardedCampaign) -> int:
+    def _interval_len(self, st: _State, camp: ShardedCampaign,
+                      key: tuple | None = None) -> int:
         """Effective sync-interval length for one campaign's next
         dispatch: the plan's ``sync_every`` bounded by the remaining
-        batch budget (the ragged final interval before ``max_trials``),
-        or 0 — the serial per-batch loop — where pipelining cannot
-        apply: elastic campaigns lease individual batches, and
-        host-resolution / multi-process campaigns have no
-        device-accumulable step.  A 1-batch ragged TAIL of a pipelined
-        campaign still returns 1 (not 0): the engine may already hold
-        that batch in flight from dispatch-ahead, and consuming it there
-        avoids recomputing it serially."""
+        batch budget (the ragged final interval before ``max_trials``)
+        AND by the half-width trajectory (below), or 0 — the serial
+        per-batch loop — where pipelining cannot apply: elastic
+        campaigns lease individual batches, and host-resolution /
+        multi-process campaigns have no device-accumulable step.  A
+        1-batch ragged TAIL of a pipelined campaign still returns 1
+        (not 0): the engine may already hold that batch in flight from
+        dispatch-ahead, and consuming it there avoids recomputing it
+        serially.
+
+        **Adaptive shrink**: a fixed interval checks the stopping rule
+        every ``sync_every`` batches, so a campaign that would converge
+        mid-interval overruns by up to ``sync_every - 1`` batches — on
+        small/fast campaigns (the NORTHSTAR regime: ~2-3 batches each)
+        that overshoot eats the whole pipelining win.  The Wilson
+        half-width is ~∝ 1/√n at a stable p̂, so the trials still
+        needed are ~ n·((hw/target)² − 1); the interval is clamped to
+        that distance.  Far from convergence the full ``sync_every``
+        throughput applies; near it the check cadence degenerates to
+        the serial per-batch loop exactly — overshoot goes to ~zero
+        while tallies stay bit-identical (grouping never changes the
+        frozen keys).  Two shape rules keep the adaptivity from eating
+        its own win: interval lengths are quantized DOWN to powers of
+        two (the AOT interval step is shape-specialized per S, so a
+        free-running S ∈ {1..sync_every} would compile log-many more
+        executables than it amortizes; rounding down only adds checks,
+        never overshoot), and a 1-batch ask routes through the plain
+        serial batch step (shared with the canary/recovery paths)
+        unless the engine already holds that batch in flight from
+        dispatch-ahead."""
         k = int(self.pcfg.sync_every)
         if (k <= 1 or self._elastic is not None
                 or not camp.supports_intervals):
             return 0
-        return max(1, min(k, self._ceiling_batches - st.next_batch))
+        k = max(1, min(k, self._ceiling_batches - st.next_batch))
+        need = float(self.plan.min_trials - st.trials)
+        if st.trials > 0:
+            vulnerable = int(st.tallies[C.OUTCOME_SDC] +
+                             st.tallies[C.OUTCOME_DUE])
+            strata_ok = camp.stratify and stopping.strata_cover_trials(
+                st.strata, st.trials)
+            hw = (stopping.post_stratified(
+                stopping.pairs_from_strata(st.strata),
+                self.plan.confidence).halfwidth if strata_ok
+                else stopping.wilson(vulnerable, st.trials,
+                                     self.plan.confidence).halfwidth)
+            target = float(self.plan.target_halfwidth)
+            if hw > target > 0:
+                need = max(need,
+                           st.trials * ((hw / target) ** 2 - 1.0))
+        k = max(1, min(k, -(-int(max(need, 1)) // self.batch_size)))
+        k = 1 << (k.bit_length() - 1)          # power-of-two quantization
+        if k == 1 and not self._engine_holds(key, st):
+            return 0
+        return k
+
+    def _engine_holds(self, key: tuple | None, st: _State) -> bool:
+        """Whether THIS campaign's engine dispatch-ahead queue already
+        holds ``st.next_batch`` as a 1-BATCH in-flight interval (the
+        ragged-tail case: consuming it from the queue beats recomputing
+        it through the serial step).  The length must match too — a
+        1-batch ask against a held LONGER interval would make ``_fill``
+        drop the whole in-flight window and re-dispatch, which is
+        strictly worse than the serial route."""
+        eng = self._engines.get(key) if key is not None else None
+        return bool(eng is not None and eng._q
+                    and eng._q[0].b0 == st.next_batch
+                    and eng._q[0].k == 1)
 
     def _structure_prng_key(self, sp_idx: int, structure: str):
         """The frozen PRNG key every batch of one (simpoint, structure)
@@ -712,6 +786,15 @@ class Orchestrator:
             _structure_id(structure))
 
     # --- the drive loop ---
+
+    def stepper(self) -> "StepDriver":
+        """The step-wise view of this campaign (service/scheduler.py): a
+        ``StepDriver`` whose ``tick()`` advances exactly one scheduling
+        quantum — one obtained batch (serial) or one sync interval
+        (pipelined) — and hands control back.  The run-to-completion
+        loop is ``for ev in orch.events()``; a multi-tenant scheduler
+        instead interleaves many campaigns' ticks over one mesh."""
+        return StepDriver(self)
 
     def events(self) -> Iterator[tuple[ExitEvent, object]]:
         """Advance the whole plan, yielding control at every typed event."""
@@ -807,7 +890,8 @@ class Orchestrator:
                 if self._elastic is not None:
                     doc, adopted = self._elastic_obtain(
                         sp_idx, sp_name, structure, st, camp)
-                elif (k_int := self._interval_len(st, camp)) >= 1:
+                elif (k_int := self._interval_len(
+                        st, camp, (sp_idx, structure))) >= 1:
                     doc = self._compute_interval(
                         sp_idx, sp_name, structure, camp,
                         st.next_batch, k_int)
@@ -1280,6 +1364,13 @@ class Orchestrator:
         orch._build_stats()   # rebind formulas/counters to restored state
         return orch
 
+    # step-wise terminal codes (StepDriver.rc / the fleet CLI contract):
+    # mirror the run-to-completion CLI — 0 complete, 3 budget/integrity
+    # abort (resumable), 4 graceful preemption (resumable)
+    RC_COMPLETE = 0
+    RC_ABORTED = 3
+    RC_PREEMPTED = 4
+
     def _persist_evidence(self) -> None:
         """Persist the integrity evidence record
         (``outdir/integrity_evidence.json``, atomic): quarantine log +
@@ -1292,3 +1383,73 @@ class Orchestrator:
             os.path.join(self.outdir, "integrity_evidence.json"),
             {"quarantine": list(self.monitor.quarantine_log),
              "ledger": self.monitor.ledger.to_dict()})
+
+
+class StepDriver:
+    """Step-wise, resumable driver over one campaign's event stream — the
+    per-tenant surface the multi-tenant scheduler ticks
+    (``shrewd_tpu/service/scheduler.py``).
+
+    ``events()`` is already batch-granular (it yields at every typed
+    event), so the step-wise refactor is a protocol, not a rewrite: each
+    ``tick()`` advances the underlying generator until ONE batch or sync
+    interval has been obtained and believed (``BATCH_COMPLETE``) or the
+    campaign reaches a terminal state, then returns the events produced
+    en route.  All host-side follow-up work of a batch (budget gates,
+    checkpoint-crossing, integrity evidence) that the generator performs
+    lazily after its yield lands at the START of the next tick — which
+    may be scheduled arbitrarily later, interleaved with other tenants'
+    ticks.  That is safe by construction: every orchestrator's state is
+    self-contained, and per-batch tallies are pure functions of their
+    frozen PRNG keys, so tick interleaving cannot perturb any tenant's
+    cumulative state (the fleet bit-identity invariant).
+    """
+
+    def __init__(self, orch: Orchestrator):
+        self.orch = orch
+        self._gen = orch.events()
+        self.done = False
+        self.rc = Orchestrator.RC_COMPLETE
+        self.results: dict | None = None    # CAMPAIGN_COMPLETE payload
+
+    def request_drain(self) -> None:
+        """Graceful per-tenant preemption: the next tick finishes its
+        in-flight batch, checkpoints (when the orchestrator has an
+        outdir) and terminates with rc 4 (resumable)."""
+        self.orch.request_drain()
+
+    def tick(self) -> list[tuple[ExitEvent, object]]:
+        """Advance one scheduling quantum.  Returns the typed events
+        produced (possibly several: a batch may be followed by
+        checkpoint/degradation/integrity events, and structure/simpoint
+        completions ride between batches).  After a terminal event the
+        driver is ``done`` with the campaign's CLI return code in
+        ``rc``; further ticks return []."""
+        if self.done:
+            return []
+        out: list[tuple[ExitEvent, object]] = []
+        while True:
+            try:
+                event, payload = next(self._gen)
+            except StopIteration:
+                # the stream ended without CAMPAIGN_COMPLETE: an abort
+                # path (escalation/audit budget, integrity violation)
+                # or a preemption whose terminal event we consumed on a
+                # previous iteration of this very tick
+                self.done = True
+                if self.orch.preempted:
+                    self.rc = Orchestrator.RC_PREEMPTED
+                elif self.orch.aborted:
+                    self.rc = Orchestrator.RC_ABORTED
+                return out
+            out.append((event, payload))
+            if event is ExitEvent.CAMPAIGN_COMPLETE:
+                self.done = True
+                self.results = dict(payload)
+                return out
+            if event is ExitEvent.PREEMPTED:
+                self.done = True
+                self.rc = Orchestrator.RC_PREEMPTED
+                return out
+            if event is ExitEvent.BATCH_COMPLETE:
+                return out

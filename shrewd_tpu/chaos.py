@@ -163,6 +163,17 @@ class ChaosEngine:
         self._armed: dict[str, list[dict]] = {}
         self._batch: tuple = ()              # (batch_id, simpoint, structure)
         self._wedge_warned = False
+        # what a fired kill_worker actually DOES.  None = hard process
+        # death via os._exit, resolved LATE at fire time (the elastic/
+        # multi-host posture — the lease board must survive a worker that
+        # vanishes without warning; late binding keeps monkeypatched
+        # os._exit test harnesses working).  A multi-tenant fleet
+        # rescopes it (service/scheduler.py): there the "worker" is one
+        # tenant's step driver, not the host process, so the scheduler
+        # installs an action that kills only the afflicted tenant — the
+        # others must keep running, which is exactly the isolation the
+        # fleet chaos test pins.
+        self.kill_action = None
 
     @classmethod
     def from_path(cls, path: str, worker: str = "") -> "ChaosEngine":
@@ -276,9 +287,11 @@ class ChaosEngine:
                 continue
             st["fired"] = True
             self._fire("kill_worker", {"worker": self.worker})
-            debug.dprintf("Chaos", "kill_worker %s: os._exit(%s)",
+            debug.dprintf("Chaos", "kill_worker %s: kill_action(%s)",
                           self.worker, spec.get("rc", KILL_DEFAULT_RC))
-            os._exit(int(spec.get("rc", KILL_DEFAULT_RC)))
+            kill = self.kill_action if self.kill_action is not None \
+                else os._exit
+            kill(int(spec.get("rc", KILL_DEFAULT_RC)))
 
     def take_wedge(self, timeout: float) -> dict | None:
         """Watchdog hook: ``{"fn": wedged, "deadline": s}`` (consumed once

@@ -119,6 +119,20 @@ class ExecutableCache:
         # executable honors the frozen-key/one-transfer contracts
         self.certificates: dict[str, dict] = {}
         self.refused = 0        # strict-mode admission refusals
+        # content key digest -> {"kind", "hits", "misses", "evictions"}:
+        # the per-key half of the hit ledger (campaign.perf.exec_cache
+        # stats).  Cross-tenant compile dedupe must be OBSERVABLE — a
+        # second tenant admitted over a shared window should show pure
+        # hits on the window's step keys, and the fleet test asserts it.
+        # Survives eviction deliberately: an evicted-then-recompiled key
+        # is a churn signal, not a fresh key.
+        self.key_stats: dict[str, dict] = {}
+
+    def _key_stat(self, key) -> dict:
+        return self.key_stats.setdefault(
+            key_digest(key),
+            {"kind": str(key[0]) if key else "step",
+             "hits": 0, "misses": 0, "evictions": 0})
 
     def _hit(self, key, owner):
         ent = self._entries.get(key)
@@ -136,6 +150,7 @@ class ExecutableCache:
             return None
         self._entries.move_to_end(key)
         self.reused += 1
+        self._key_stat(key)["hits"] += 1
         debug.dprintf("ExecCache", "reuse %s", key[0] if key else key)
         return fn
 
@@ -154,6 +169,7 @@ class ExecutableCache:
             # leaves with its entry (the count must track live entries)
             self.certificates.pop(key_digest(old_key), None)
             self.evicted += 1
+            self._key_stat(old_key)["evictions"] += 1
         return fn
 
     def _audit(self, key, fn, example_args) -> None:
@@ -239,6 +255,7 @@ class ExecutableCache:
         if fn is not None:
             return fn
         self.compiled += 1
+        self._key_stat(key)["misses"] += 1
         debug.dprintf("ExecCache", "compile %s", key[0] if key else key)
         return self._store(key, owner,
                            self._audited_on_first_call(key, build()))
@@ -254,6 +271,7 @@ class ExecutableCache:
         if fn is not None:
             return fn
         self.compiled += 1
+        self._key_stat(key)["misses"] += 1
         jit_fn = build()
         # the AOT path has example args in hand: certify at ADMISSION —
         # a strict-mode violation refuses the executable before the
@@ -282,9 +300,18 @@ class ExecutableCache:
                 "certified": len(self.certificates),
                 "refused": self.refused}
 
+    def per_key_stats(self) -> dict:
+        """Per-content-key hit/miss/evict counters keyed by the short key
+        digest (``campaign.perf.exec_cache_keys``): the observable form
+        of cross-tenant compile dedupe — a tenant co-scheduled over a
+        window another tenant already compiled shows hits and ZERO new
+        misses on that window's step keys."""
+        return {d: dict(s) for d, s in self.key_stats.items()}
+
     def clear(self) -> None:
         self._entries.clear()
         self.certificates.clear()
+        self.key_stats.clear()
 
 
 _GLOBAL: ExecutableCache | None = None
@@ -297,6 +324,46 @@ def cache() -> ExecutableCache:
     if _GLOBAL is None:
         _GLOBAL = ExecutableCache()
     return _GLOBAL
+
+
+# --------------------------------------------------------------------------
+# shared kernel registry (heavyweight host objects, not executables)
+# --------------------------------------------------------------------------
+
+#: kernels kept before LRU eviction — each pins its trace arrays and its
+#: materialized goldens, so the bound is deliberately small
+KERNEL_CACHE_MAX = 8
+
+_KERNELS: OrderedDict = OrderedDict()
+
+
+def shared_kernel(trace, cfg_fp: str, build: Callable[[], object]):
+    """Content-keyed registry of *kernel objects* (TrialKernel & co) —
+    the object-level complement of the executable cache.  Two campaigns
+    over the same window content and machine config (co-scheduled
+    tenants of the multi-tenant fleet, a re-built orchestrator, bench's
+    paired arms) share ONE kernel instance: construction cost (golden
+    materialization, scoreboard timing) is paid once, and the shared
+    instance keeps the executable cache's owner-weakrefs alive across
+    tenants.  Safe because a kernel's mutable state is only the running
+    escape counters, which every consumer reads as per-dispatch DELTAS
+    (orchestrator `_compute_batch`/`_compute_interval`), and dispatch is
+    single-threaded per process."""
+    key = (trace_digest(trace), cfg_fp)
+    kern = _KERNELS.get(key)
+    if kern is not None:
+        _KERNELS.move_to_end(key)
+        debug.dprintf("ExecCache", "shared kernel reuse %s", key[0][:12])
+        return kern
+    kern = build()
+    _KERNELS[key] = kern
+    while len(_KERNELS) > KERNEL_CACHE_MAX:
+        _KERNELS.popitem(last=False)
+    return kern
+
+
+def clear_kernels() -> None:
+    _KERNELS.clear()
 
 
 # --------------------------------------------------------------------------

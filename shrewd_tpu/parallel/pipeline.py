@@ -165,7 +165,13 @@ class PipelinedEngine:
             nb = (q[-1].b0 + q[-1].k) if q else b0
             if nb >= self.ceiling:
                 break
-            kk = min(self.sync_every, self.ceiling - nb)
+            # prefetch length follows the caller's CURRENT ask, not the
+            # plan ceiling: when the orchestrator's half-width-adaptive
+            # interval shrinks toward convergence (k → 1), speculative
+            # dispatch-ahead shrinks with it — batches past the stopping
+            # point are wasted device work, and near convergence is
+            # exactly where the next ask will be short
+            kk = min(k, self.sync_every, self.ceiling - nb)
             if not q:
                 kk = k            # the head must match the caller's ask
             keys = [self._keys(b) for b in range(nb, nb + kk)]

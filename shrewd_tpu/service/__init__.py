@@ -1,0 +1,33 @@
+"""Multi-tenant campaign service: a resident scheduler over one mesh.
+
+The reference's only answer to "many experiments" is ``multisim`` — a
+process-per-config fan-out where each gem5 instance owns the machine and
+campaigns run embarrassingly serial.  This package is the TPU-native
+alternative: ONE resident process owns the mesh and interleaves many
+concurrent campaigns (*tenants*) through the pipelined engine
+(``parallel/pipeline.py``), under a global dispatch-depth budget, with
+weighted fair-share + strict-priority scheduling, per-tenant stopping,
+checkpoints, integrity/chaos state, and admission-time certification.
+
+- ``queue.py``     — ``TenantSpec`` + the durable submission spool
+  (atomic claims over a shared directory, the elastic coord-dir idiom),
+  so tenants can be submitted while the fleet runs;
+- ``scheduler.py`` — ``CampaignScheduler``, the resident scheduler that
+  ticks each tenant's ``StepDriver`` one batch/interval at a time.
+
+The invariant is non-negotiable and pinned in ``tests/test_fleet.py``:
+each tenant's final tallies are bit-identical to its solo serial run
+(frozen per-batch PRNG keys), including under preemption, mid-fleet
+chaos, and drain/resume — co-scheduling changes wall-clock, never
+results.
+
+Import discipline: jax-free at package import (specs and the spool are
+pure host-side work; jax enters only when the scheduler elaborates a
+tenant's orchestrator).
+"""
+
+from shrewd_tpu.service.queue import SubmissionQueue, TenantSpec
+from shrewd_tpu.service.scheduler import CampaignScheduler, TenantKilled
+
+__all__ = ["CampaignScheduler", "SubmissionQueue", "TenantKilled",
+           "TenantSpec"]

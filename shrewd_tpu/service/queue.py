@@ -1,0 +1,188 @@
+"""Tenant specs + the durable submission queue (atomic spool directory).
+
+A **tenant** is one campaign plan plus its scheduling identity: a name,
+a strict-priority class, a fair-share weight, and an optional batch
+quota.  ``TenantSpec`` is the JSON-round-trippable submission unit — the
+whole tenant is reproducible from its spec document alone, exactly like
+a campaign from its ``config.json`` (the plan rides inside the spec).
+
+The **submission queue** is a spool directory with the same durability
+discipline as the elastic lease board (``parallel/elastic.py``): every
+document is written via ``resilience.write_json_atomic`` (tmp + fsync +
+rename + dir-fsync) and carries a content checksum, so a torn submission
+reads as absent, never as a half-tenant.  Claims are atomic renames
+(``pending/`` → ``claimed/``), so two servers racing a spool cannot both
+admit one tenant, and tenants can be submitted while the fleet runs —
+the scheduler polls ``pending/`` between ticks.
+
+Layout::
+
+    <root>/pending/   NNNNNN_<name>.json   submitted, unclaimed
+    <root>/claimed/   NNNNNN_<name>.json   admitted by a scheduler
+    <root>/done/      NNNNNN_<name>.json   final per-tenant result doc
+
+Import discipline: jax-free (pure host-side file coordination; the plan
+inside a spec is elaborated only by the scheduler).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import time
+
+from shrewd_tpu.resilience import load_json_verified, write_json_atomic
+from shrewd_tpu.utils import debug
+
+debug.register_flag("Fleet", "multi-tenant scheduler / submission queue")
+
+_TICKET_RE = re.compile(r"^(\d{6})_.*\.json$")
+
+
+def sanitize(name: str) -> str:
+    """Filesystem-safe tenant name (the elastic ``_sanitize`` discipline;
+    one definition here so spool tickets and per-tenant output
+    directories cannot disagree)."""
+    return re.sub(r"[^A-Za-z0-9_.+-]", "+", name)
+
+
+class TenantSpec:
+    """One tenant's submission: plan + scheduling identity.
+
+    ``plan`` is the ``CampaignPlan.to_dict()`` document (kept as a dict
+    so the spec round-trips without jax); ``priority`` is a strict class
+    (higher preempts lower entirely), ``weight`` the fair-share stride
+    within a class, and ``quota_batches`` an optional scheduler-level
+    resource cap — a tenant at quota is drained to a resumable
+    checkpoint (status ``quota``), never silently truncated."""
+
+    def __init__(self, name: str, plan: dict, priority: int = 0,
+                 weight: float = 1.0, quota_batches: int = 0,
+                 submitted_at: float = 0.0):
+        if not name:
+            raise ValueError("tenant needs a non-empty name")
+        if not float(weight) > 0:
+            raise ValueError(f"tenant {name!r}: weight must be > 0 "
+                             f"(got {weight})")
+        if int(quota_batches) < 0:
+            raise ValueError(f"tenant {name!r}: quota_batches must be >= 0")
+        self.name = str(name)
+        self.plan = dict(plan)
+        self.priority = int(priority)
+        self.weight = float(weight)
+        self.quota_batches = int(quota_batches)
+        self.submitted_at = float(submitted_at)
+
+    def build_plan(self):
+        from shrewd_tpu.campaign.plan import CampaignPlan
+
+        return CampaignPlan.from_dict(self.plan)
+
+    def to_dict(self) -> dict:
+        return {"name": self.name, "plan": dict(self.plan),
+                "priority": self.priority, "weight": self.weight,
+                "quota_batches": self.quota_batches,
+                "submitted_at": self.submitted_at}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "TenantSpec":
+        return cls(name=d["name"], plan=d["plan"],
+                   priority=d.get("priority", 0),
+                   weight=d.get("weight", 1.0),
+                   quota_batches=d.get("quota_batches", 0),
+                   submitted_at=d.get("submitted_at", 0.0))
+
+
+class SubmissionQueue:
+    """The durable spool (see module docstring)."""
+
+    def __init__(self, root: str):
+        self.root = root
+        self.pending_dir = os.path.join(root, "pending")
+        self.claimed_dir = os.path.join(root, "claimed")
+        self.done_dir = os.path.join(root, "done")
+        for d in (self.pending_dir, self.claimed_dir, self.done_dir):
+            os.makedirs(d, exist_ok=True)
+
+    # --- submission ------------------------------------------------------
+
+    def _next_seq(self) -> int:
+        seq = 0
+        for d in (self.pending_dir, self.claimed_dir, self.done_dir):
+            for name in os.listdir(d):
+                m = _TICKET_RE.match(name)
+                if m:
+                    seq = max(seq, int(m.group(1)) + 1)
+        return seq
+
+    def submit(self, spec: TenantSpec) -> str:
+        """Spool one tenant; returns the ticket name.  The sequence
+        number is reserved with an O_EXCL placeholder (two racing
+        submitters cannot share a ticket), then the real document
+        atomically replaces it — a poll between the two sees an invalid
+        document and skips it, never a half-spec."""
+        doc = spec.to_dict()
+        if not doc.get("submitted_at"):
+            # graftlint: allow-wall-clock -- submission timestamp feeds
+            # the queue-latency observability stat only; scheduling
+            # decisions are pure functions of admission order and batch
+            # counts, and tallies are frozen-key pure either way
+            doc["submitted_at"] = time.time()
+        seq = self._next_seq()
+        while True:
+            ticket = f"{seq:06d}_{sanitize(spec.name)}.json"
+            path = os.path.join(self.pending_dir, ticket)
+            try:
+                os.close(os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY))
+                break
+            except FileExistsError:
+                seq += 1
+        write_json_atomic(path, doc)
+        debug.dprintf("Fleet", "submitted %s (priority=%d weight=%g)",
+                      ticket, spec.priority, spec.weight)
+        return ticket
+
+    # --- the scheduler side ----------------------------------------------
+
+    def pending(self) -> list[str]:
+        return sorted(n for n in os.listdir(self.pending_dir)
+                      if _TICKET_RE.match(n))
+
+    def claim(self) -> list[tuple[str, TenantSpec]]:
+        """Claim every currently-valid pending submission, in ticket
+        order.  The claim is an atomic rename into ``claimed/`` — a
+        racing second server loses with OSError and skips.  Invalid
+        documents (in-flight placeholder, torn write) stay pending for a
+        later poll; they become claimable once their atomic replace
+        lands."""
+        out = []
+        for ticket in self.pending():
+            src = os.path.join(self.pending_dir, ticket)
+            try:
+                doc = load_json_verified(src)
+                spec = TenantSpec.from_dict(doc)
+            except (OSError, ValueError, KeyError):
+                continue             # placeholder / torn / malformed: skip
+            dst = os.path.join(self.claimed_dir, ticket)
+            try:
+                os.rename(src, dst)
+            except OSError:
+                continue             # lost the claim race
+            out.append((ticket, spec))
+            debug.dprintf("Fleet", "claimed %s", ticket)
+        return out
+
+    def mark_done(self, ticket: str, result: dict) -> None:
+        """Publish the tenant's final result document (atomic, like every
+        persisted artifact) and retire the claimed ticket."""
+        write_json_atomic(os.path.join(self.done_dir, ticket), dict(result))
+        try:
+            os.unlink(os.path.join(self.claimed_dir, ticket))
+        except OSError:
+            pass
+
+    def done(self, ticket: str) -> dict | None:
+        try:
+            return load_json_verified(os.path.join(self.done_dir, ticket))
+        except (OSError, ValueError):
+            return None

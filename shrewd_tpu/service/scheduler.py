@@ -1,0 +1,665 @@
+"""The resident multi-tenant campaign scheduler (see package docstring).
+
+One ``CampaignScheduler`` owns one mesh for its whole life and ticks many
+campaigns through it: each admitted ``TenantSpec`` becomes an
+``Orchestrator`` + ``StepDriver`` pair, and every scheduler tick advances
+exactly one tenant by one batch (serial) or one sync interval
+(pipelined).  Interleaving is where the throughput comes from: while
+tenant A's tick runs host-side work (stopping rule, invariants, stats,
+checkpoints), tenant B's in-flight intervals keep computing on the
+device, and the content-keyed executable cache (``parallel/exec_cache``)
+dedupes compiles across tenants sharing a window — the second tenant on
+a shared window compiles zero new steps (asserted in the fleet test).
+
+Scheduling is deterministic by construction: policies consume only
+admission order, per-tenant trial counts and weights — never wall clock
+— so a fleet's schedule log is reproducible, and each tenant's tallies
+are bit-identical to its solo serial run regardless of interleaving
+(frozen per-batch PRNG keys; the invariant every layer of this codebase
+preserves).
+
+Policies (``policy=``):
+
+- ``"fair"`` (default) — strict priority classes; within the runnable
+  class with the highest priority, weighted fair-share stride
+  scheduling: pick the tenant with the smallest virtual time
+  ``trials / weight`` (ties break on admission order).
+- ``"priority"`` — strict priority, FIFO within a class (admission
+  order), for drain-one-tenant-first operation.
+
+The **global dispatch-depth budget** bounds how much device work the
+whole fleet keeps in flight: each running tenant's pipelined engine
+depth is clamped to ``max(1, depth_budget // n_running)`` (re-balanced
+as tenants come and go), with the per-tenant plan depth as ceiling and a
+floor of 1 — the fleet cannot over-subscribe the mesh the way N
+independent processes would.
+
+Failure isolation: every tenant owns its watchdog, ladder, integrity
+monitor and chaos engine, so a wedge or corrupt tally quarantines and
+recovers INSIDE the afflicted tenant.  A chaos ``kill_worker`` is
+rescoped at admission (``ChaosEngine.kill_action``): in a fleet the
+"worker" is the tenant's step driver, so the kill tears down only that
+tenant's orchestrator — the scheduler rebuilds it from its last
+per-tenant checkpoint (or from scratch; frozen keys make both
+bit-identical) while every other tenant keeps running.
+
+Import discipline: jax-free at module import (the scheduler is pure
+host-side control; jax enters when a tenant's orchestrator is built).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import numpy as np
+
+from shrewd_tpu import resilience as resil
+from shrewd_tpu import stats as statsmod
+from shrewd_tpu.service.queue import SubmissionQueue, TenantSpec, sanitize
+from shrewd_tpu.utils import debug
+
+FLEET_CKPT_VERSION = 1
+
+POLICIES = ("fair", "priority")
+
+#: certify escalation order (the fleet's admission-time certification
+#: posture can tighten a tenant's plan, never loosen it)
+_CERTIFY_ORDER = {"off": 0, "warn": 1, "strict": 2}
+
+#: tenant terminal statuses a fleet resume re-admits (a resumable tenant
+#: continues from its namespaced checkpoint; ``quota`` stays parked until
+#: the operator resubmits with a bigger quota)
+_RESUMABLE = ("queued", "running", "preempted")
+
+
+class TenantKilled(RuntimeError):
+    """A chaos ``kill_worker`` fired inside a tenant's tick (the
+    fleet-scoped analog of ``os._exit``): the tenant's orchestrator is
+    dead; the scheduler rebuilds and resumes it."""
+
+    def __init__(self, tenant: str, rc: int):
+        super().__init__(f"tenant {tenant!r} killed by chaos (rc {rc})")
+        self.tenant = tenant
+        self.rc = rc
+
+
+class TenantState:
+    """One tenant's life in the fleet: spec + driver + ledgers."""
+
+    def __init__(self, spec: TenantSpec, order: int, ticket: str = ""):
+        self.spec = spec
+        self.order = order           # admission order (the FIFO tiebreak)
+        self.ticket = ticket         # spool ticket ("" = direct admit)
+        self.status = "queued"
+        self.orch = None
+        self.driver = None
+        self.trials = 0              # trials served (the fair-share unit)
+        self.batches = 0             # trials // effective batch size
+        self.ticks = 0               # scheduling quanta consumed
+        self.kills = 0               # chaos kill_worker fires survived
+        self.rc: int | None = None
+        self.queue_latency_s = 0.0   # submit → admission
+        self.wall_s = 0.0            # admission → terminal
+        self._t_admit: float | None = None
+        self._plan_depth = 1         # the plan's own depth (budget ceiling)
+        self.results: dict | None = None   # JSON-able per-structure summary
+
+    @property
+    def vtime(self) -> float:
+        return self.trials / self.spec.weight
+
+    def to_dict(self) -> dict:
+        return {"spec": self.spec.to_dict(), "order": self.order,
+                "ticket": self.ticket, "status": self.status,
+                "trials": self.trials, "batches": self.batches,
+                "ticks": self.ticks, "kills": self.kills, "rc": self.rc,
+                "queue_latency_s": round(self.queue_latency_s, 3),
+                "wall_s": round(self.wall_s, 3), "results": self.results}
+
+
+class CampaignScheduler:
+    """The resident scheduler (see module docstring).
+
+    ``outdir`` namespaces everything per tenant:
+    ``outdir/tenants/<name>/`` holds each tenant's m5out artifacts and
+    its ``campaign_ckpt`` (the per-tenant checkpoint namespace), and
+    ``outdir/fleet_ckpt/fleet.json`` + ``outdir/fleet_stats.json`` hold
+    the fleet's own resumable state and stats dump."""
+
+    def __init__(self, outdir: str | None = None, mesh=None,
+                 depth_budget: int = 4, policy: str = "fair",
+                 queue: SubmissionQueue | None = None, certify: str = "",
+                 idle_exit: bool = True, poll_interval: float = 0.2,
+                 on_tick=None):
+        if policy not in POLICIES:
+            raise ValueError(f"unknown policy {policy!r} (one of {POLICIES})")
+        if certify and certify not in _CERTIFY_ORDER:
+            raise ValueError(f"unknown certify mode {certify!r}")
+        self.outdir = outdir
+        self._mesh = mesh
+        self.depth_budget = max(1, int(depth_budget))
+        self.policy = policy
+        self.queue = queue
+        self.certify = certify
+        self.idle_exit = idle_exit
+        self.poll_interval = float(poll_interval)
+        self.on_tick = on_tick
+        self.tenants: dict[str, TenantState] = {}
+        self.schedule_log: list[str] = []    # tenant name per tick
+        self.ticks = 0
+        self._drain = False
+        self.preempted = False
+        self._t0 = time.monotonic()
+        self._build_stats()
+
+    # --- mesh / stats -----------------------------------------------------
+
+    @property
+    def mesh(self):
+        """The fleet's ONE mesh, built lazily (jax enters here): every
+        tenant's campaigns shard over the same devices, which is what
+        makes their executables cache-interchangeable."""
+        if self._mesh is None:
+            from shrewd_tpu.parallel.mesh import make_mesh
+
+            self._mesh = make_mesh()
+        return self._mesh
+
+    def _build_stats(self) -> None:
+        """``campaign.fleet.*`` — the multi-tenant ledger: who ran, how
+        fairly, how fast, and how much compile work co-scheduling
+        deduped.  Formulas read live scheduler state, like every other
+        stats group in the tree."""
+        from shrewd_tpu.parallel import exec_cache
+
+        self.stats = statsmod.Group("campaign")
+        fg = statsmod.Group("fleet")
+        self.stats.fleet = fg
+        fg.tenants_admitted = statsmod.Formula(
+            "tenants_admitted", lambda: len(self.tenants),
+            "tenants admitted to the fleet")
+        fg.tenants_by_status = statsmod.Formula(
+            "tenants_by_status", lambda: self._by_status(),
+            "tenant count per terminal/live status")
+        fg.ticks = statsmod.Formula(
+            "ticks", lambda: self.ticks,
+            "scheduling quanta dispatched fleet-wide")
+        fg.depth_budget = statsmod.Formula(
+            "depth_budget", lambda: self.depth_budget,
+            "global dispatch-depth budget shared by running tenants")
+        fg.tenant_trials = statsmod.Formula(
+            "tenant_trials",
+            lambda: {n: t.trials for n, t in self.tenants.items()},
+            "trials served per tenant")
+        fg.tenant_throughput = statsmod.Formula(
+            "tenant_throughput",
+            lambda: {n: round(t.trials / t.wall_s, 1)
+                     for n, t in self.tenants.items() if t.wall_s > 0},
+            "per-tenant trials/second (admission to terminal)")
+        fg.queue_latency_s = statsmod.Formula(
+            "queue_latency_s",
+            lambda: {n: round(t.queue_latency_s, 3)
+                     for n, t in self.tenants.items() if t.ticket},
+            "spool-submit to admission latency per queued tenant")
+        fg.fairness_index = statsmod.Formula(
+            "fairness_index", lambda: self.fairness_index(),
+            "Jain index over weight-normalized trials served "
+            "(1.0 = perfectly weighted-fair)")
+        fg.cache_hit_rate = statsmod.Formula(
+            "cache_hit_rate",
+            lambda: (lambda s: round(s["reused"]
+                                     / max(s["reused"] + s["compiled"], 1),
+                                     4))(exec_cache.cache().stats()),
+            "process-wide executable-cache hit rate (cross-tenant "
+            "compile dedupe)")
+        fg.schedule_ticks = statsmod.Formula(
+            "schedule_ticks",
+            lambda: {n: t.ticks for n, t in self.tenants.items()},
+            "scheduling quanta per tenant")
+
+    def _by_status(self) -> dict:
+        out: dict[str, int] = {}
+        for t in self.tenants.values():
+            out[t.status] = out.get(t.status, 0) + 1
+        return out
+
+    def fairness_index(self) -> float:
+        """Jain's fairness index over ``trials / weight`` of every tenant
+        that ran: (Σx)² / (n·Σx²) ∈ (0, 1], 1.0 = perfectly weighted-fair
+        allocation."""
+        x = [t.trials / t.spec.weight for t in self.tenants.values()
+             if t.trials > 0]
+        if not x:
+            return 1.0
+        return float(sum(x) ** 2 / (len(x) * sum(v * v for v in x)))
+
+    # --- admission --------------------------------------------------------
+
+    def admit(self, spec: TenantSpec, ticket: str = "") -> TenantState:
+        """Admit one tenant (direct or from the spool).  Names are the
+        tenant identity — checkpoint namespace, stats key, chaos worker —
+        so a duplicate is refused loudly rather than silently merging
+        two tenants' state."""
+        if spec.name in self.tenants:
+            raise ValueError(f"tenant {spec.name!r} already admitted")
+        t = TenantState(spec, order=len(self.tenants), ticket=ticket)
+        if spec.submitted_at:
+            # graftlint: allow-wall-clock -- queue latency is
+            # observability (submit → admission seconds across
+            # processes); every scheduling decision reads only admission
+            # order, trial counts and weights
+            t.queue_latency_s = max(0.0, time.time() - spec.submitted_at)
+        self.tenants[spec.name] = t
+        debug.dprintf("Fleet", "admitted %s (priority=%d weight=%g%s)",
+                      spec.name, spec.priority, spec.weight,
+                      f" ticket={ticket}" if ticket else "")
+        return t
+
+    def tenant_outdir(self, name: str) -> str | None:
+        if not self.outdir:
+            return None
+        return os.path.join(self.outdir, "tenants", sanitize(name))
+
+    def _start(self, t: TenantState) -> None:
+        """Elaborate one queued tenant: plan → orchestrator (resuming
+        from its namespaced checkpoint when one exists) → step driver,
+        with the fleet's certification posture applied and chaos kills
+        rescoped to the tenant."""
+        from shrewd_tpu.campaign.orchestrator import Orchestrator
+
+        plan = t.spec.build_plan()
+        if self.certify and (_CERTIFY_ORDER[self.certify]
+                             > _CERTIFY_ORDER.get(plan.analysis.certify, 0)):
+            # admission-time certification: the fleet's posture tightens
+            # the tenant's — its executables are jaxpr/HLO-audited at
+            # executable-cache admission before any trial runs
+            plan.analysis.certify = self.certify
+        outdir = self.tenant_outdir(t.spec.name)
+        ckpt_dir = (os.path.join(outdir, "campaign_ckpt") if outdir
+                    else None)
+        resumable = False
+        if ckpt_dir is not None:
+            try:
+                Orchestrator.load_checkpoint_doc(ckpt_dir)
+                resumable = True
+            except ValueError:
+                resumable = False
+        if resumable:
+            t.orch = Orchestrator.resume(ckpt_dir, mesh=self.mesh,
+                                         outdir=outdir)
+            # the fleet posture must hold on resume too (resume rebuilds
+            # the plan from the checkpoint document)
+            if self.certify:
+                t.orch.plan.analysis.certify = max(
+                    (t.orch.plan.analysis.certify, self.certify),
+                    key=lambda m: _CERTIFY_ORDER.get(m, 0))
+        else:
+            t.orch = Orchestrator(plan, mesh=self.mesh, outdir=outdir)
+        self._scope_chaos(t)
+        # the depth-budget ceiling is the SUBMITTED plan's depth, read
+        # from the spec document: _rebalance mutates pcfg.depth in
+        # place and the clamped value rides the tenant checkpoint, so
+        # reading it back from a resumed/rebuilt orchestrator would
+        # ratchet the tenant's depth down monotonically across resumes
+        spec_depth = (t.spec.plan.get("pipeline") or {}).get(
+            "depth", t.orch.pcfg.depth)
+        t._plan_depth = max(1, int(spec_depth))
+        t.driver = t.orch.stepper()
+        t.status = "running"
+        if t._t_admit is None:
+            t._t_admit = time.monotonic()
+        self._rebalance()
+
+    def _scope_chaos(self, t: TenantState, engine=None) -> None:
+        """Rescope a tenant's chaos engine to the fleet: the engine's
+        "worker" is the tenant, and a kill_worker tears down the tenant's
+        driver (``TenantKilled``), not the host process."""
+        if engine is not None:
+            t.orch.attach_chaos(engine)
+        eng = t.orch.chaos
+        if eng is None:
+            return
+        if not eng.worker:
+            eng.worker = t.spec.name
+        name = t.spec.name
+
+        def _kill(rc: int):
+            raise TenantKilled(name, rc)
+
+        eng.kill_action = _kill
+
+    def _rebalance(self) -> None:
+        """Re-divide the global dispatch-depth budget over running
+        tenants (floor 1, ceiling = each tenant's own plan depth) —
+        engines read their depth live, so in-flight windows shrink/grow
+        at the next fill."""
+        running = [t for t in self.tenants.values()
+                   if t.status == "running"]
+        if not running:
+            return
+        share = max(1, self.depth_budget // len(running))
+        for t in running:
+            depth = max(1, min(t._plan_depth, share))
+            t.orch.pcfg.depth = depth
+            for eng in t.orch._engines.values():
+                eng.depth = depth
+
+    # --- the scheduling loop ---------------------------------------------
+
+    def request_drain(self) -> None:
+        """Graceful fleet preemption (idempotent): every running tenant
+        finishes its in-flight batch, checkpoints into its namespace,
+        and the fleet state is persisted resumable (rc 4)."""
+        self._drain = True
+
+    def install_signal_handlers(self):
+        """SIGTERM/SIGINT → graceful fleet drain; a second signal raises
+        KeyboardInterrupt (the operator escape hatch) — the
+        ``Orchestrator.install_signal_handlers`` discipline extended to
+        the whole fleet.  Returns a restore callable; no-op off the main
+        thread."""
+        import signal
+
+        def _handler(signum, frame):
+            if self._drain:
+                raise KeyboardInterrupt
+            self._drain = True
+            debug.dprintf("Fleet", "signal %s: draining fleet to "
+                          "checkpoints", signum)
+
+        try:
+            prev = {s: signal.signal(s, _handler)
+                    for s in (signal.SIGTERM, signal.SIGINT)}
+        except ValueError:            # not the main thread
+            return lambda: None
+        return lambda: [signal.signal(s, h) for s, h in prev.items()]
+
+    def _poll_queue(self) -> None:
+        if self.queue is None:
+            return
+        for ticket, spec in self.queue.claim():
+            try:
+                self.admit(spec, ticket=ticket)
+            except ValueError as e:
+                # duplicate name etc: publish the refusal as the ticket's
+                # result instead of wedging the spool
+                debug.dprintf("Fleet", "refused %s: %s", ticket, e)
+                self.queue.mark_done(ticket, {"tenant": spec.name,
+                                              "status": "refused",
+                                              "error": str(e)})
+
+    def _candidates(self) -> list[TenantState]:
+        out = []
+        for t in self.tenants.values():
+            if t.status == "queued":
+                try:
+                    self._start(t)
+                except Exception as e:  # noqa: BLE001 — tenant isolation:
+                    # a plan that fails to elaborate (malformed dict,
+                    # missing trace file, bad config) is THAT tenant's
+                    # failure — park it as failed with the evidence and
+                    # keep serving everyone else; a resident scheduler
+                    # must never die on one bad submission
+                    self._fail(t, e)
+            if t.status == "running":
+                out.append(t)
+        return out
+
+    def _fail(self, t: TenantState, err: Exception) -> None:
+        t.status = "failed"
+        t.results = {"error": f"{type(err).__name__}: {err}"}
+        debug.dprintf("Fleet", "%s: failed to elaborate (%s)",
+                      t.spec.name, err)
+        if self.queue is not None and t.ticket:
+            self.queue.mark_done(t.ticket, {
+                "tenant": t.spec.name, "status": "failed",
+                "error": str(err)})
+        self._rebalance()
+
+    def _pick(self, cands: list[TenantState]) -> TenantState:
+        top = max(t.spec.priority for t in cands)
+        cls = [t for t in cands if t.spec.priority == top]
+        if self.policy == "priority":
+            return min(cls, key=lambda t: t.order)
+        return min(cls, key=lambda t: (t.vtime, t.order))
+
+    def _handle_kill(self, t: TenantState, e: TenantKilled) -> None:
+        """The fleet-scoped worker death: only THIS tenant's
+        orchestrator died.  Rebuild it — from its namespaced checkpoint
+        when one exists, else from scratch — carrying the SAME chaos
+        engine (its schedule state, including the consumed kill, must
+        survive the rebuild or the kill would re-fire forever), and
+        keep running.  Frozen keys make the recovered tallies
+        bit-identical either way."""
+        t.kills += 1
+        debug.dprintf("Fleet", "%s: %s — rebuilding tenant", t.spec.name, e)
+        engine = t.orch.chaos
+        t.status = "queued"
+        t.orch = t.driver = None
+        self._start(t)
+        self._scope_chaos(t, engine=engine)
+
+    def _tick_tenant(self, t: TenantState) -> None:
+        try:
+            t.driver.tick()
+        except TenantKilled as e:
+            self._handle_kill(t, e)
+            return
+        except Exception as e:  # noqa: BLE001 — tenant isolation: an
+            # exception escaping the event stream is unrecoverable FOR
+            # THIS TENANT (lazy elaboration of a bad plan at first tick,
+            # a missing trace file, a config the models reject — the
+            # ladder/integrity layers already absorbed everything
+            # transient inside the generator).  Park the tenant as
+            # failed with the evidence; the fleet keeps serving.
+            self._fail(t, e)
+            return
+        t.ticks += 1
+        trials = sum(st.trials for st in t.orch.state.values())
+        t.trials = trials
+        t.batches = trials // max(t.orch.batch_size, 1)
+        if t.driver.done:
+            self._finalize(t)
+            return
+        if (t.spec.quota_batches
+                and t.batches >= t.spec.quota_batches):
+            # quota exhausted: drain THIS tenant to a resumable
+            # checkpoint (status "quota") — the next tick finishes its
+            # in-flight batch and preempts it
+            debug.dprintf("Fleet", "%s: quota %d batches reached — "
+                          "draining", t.spec.name, t.spec.quota_batches)
+            t.driver.request_drain()
+
+    def _finalize(self, t: TenantState) -> None:
+        t.rc = t.driver.rc
+        from shrewd_tpu.campaign.orchestrator import Orchestrator
+
+        if t.rc == Orchestrator.RC_PREEMPTED:
+            t.status = ("quota" if t.spec.quota_batches
+                        and t.batches >= t.spec.quota_batches
+                        else "preempted")
+        elif t.rc == Orchestrator.RC_ABORTED:
+            t.status = "aborted"
+        else:
+            t.status = "complete"
+            if t.kills and t.orch.chaos is not None:
+                # the killed tenant finished with believed tallies: the
+                # injected kill was survived (the ledger the chaos stats
+                # group reports)
+                for _ in range(t.kills):
+                    t.orch.chaos.note_survived("kill_worker")
+        t.wall_s = (time.monotonic() - t._t_admit) if t._t_admit else 0.0
+        t.results = self._summarize(t)
+        t.orch.write_outputs()
+        if t.orch.outdir and t.status == "complete":
+            t.orch.checkpoint()          # the final-state dump _drive writes
+        if self.queue is not None and t.ticket:
+            self.queue.mark_done(t.ticket, {
+                "tenant": t.spec.name, "status": t.status, "rc": t.rc,
+                "trials": t.trials, "batches": t.batches,
+                "wall_s": round(t.wall_s, 3), "results": t.results})
+        debug.dprintf("Fleet", "%s: %s (rc=%s, %d trials, %d ticks)",
+                      t.spec.name, t.status, t.rc, t.trials, t.ticks)
+        self._rebalance()
+        if self.outdir:
+            self.checkpoint()
+
+    def _summarize(self, t: TenantState) -> dict:
+        """JSON-able per-(simpoint, structure) final state: completed
+        tenants summarize their StructureResults; preempted/aborted ones
+        summarize their partial cumulative state (what the checkpoint
+        holds)."""
+        out = {}
+        if t.driver.results is not None:
+            for (sp, st), r in t.driver.results.items():
+                out[f"{sp}/{st}"] = {
+                    "tallies": np.asarray(r.tallies).tolist(),
+                    "trials": int(r.trials), "avf": float(r.avf),
+                    "converged": bool(r.converged)}
+        else:
+            for (sp, st), s in t.orch.state.items():
+                out[f"{sp}/{st}"] = {
+                    "tallies": s.tallies.tolist(),
+                    "trials": int(s.trials), "avf": None,
+                    "converged": bool(s.converged)}
+        return out
+
+    def run(self) -> int:
+        """Drive the fleet: poll the spool, pick, tick, finalize — until
+        every tenant is terminal and (with ``idle_exit``) the spool is
+        empty, or a drain is requested.  Returns the fleet rc: 0 all
+        served, 3 when any tenant aborted (budget/integrity), 4 when the
+        fleet was drained (resumable)."""
+        while True:
+            if self._drain:
+                return self._drain_all()
+            self._poll_queue()
+            cands = self._candidates()
+            if not cands:
+                if self.queue is not None and not self.idle_exit:
+                    time.sleep(self.poll_interval)
+                    continue
+                break
+            t = self._pick(cands)
+            self.schedule_log.append(t.spec.name)
+            self.ticks += 1
+            self._tick_tenant(t)
+            if self.on_tick is not None:
+                self.on_tick(self)
+        self.write_outputs()
+        if self.outdir:
+            self.checkpoint()
+        if any(t.status == "aborted" for t in self.tenants.values()):
+            return 3
+        return 0
+
+    def _drain_all(self) -> int:
+        """Graceful fleet preemption: every running tenant drains to a
+        namespaced resumable checkpoint; queued tenants stay queued in
+        the fleet checkpoint.  rc 4, resumable via ``resume()``."""
+        self.preempted = True
+        for t in self.tenants.values():
+            if t.status == "running":
+                t.driver.request_drain()
+                while not t.driver.done:
+                    self.ticks += 1
+                    t.ticks += 1
+                    try:
+                        t.driver.tick()
+                    except TenantKilled as e:
+                        # belt-and-braces: the drain flag preempts at
+                        # the next batch boundary before any compute,
+                        # so a kill should not be reachable here — but
+                        # if one ever is, it must not break the drain
+                        # contract (every tenant checkpoints, fleet
+                        # exits resumable): rebuild and re-drain
+                        self._handle_kill(t, e)
+                        t.driver.request_drain()
+                    except Exception as e:  # noqa: BLE001 — isolation,
+                        # as in _tick_tenant: a dead tenant must not
+                        # stop the rest of the fleet from draining
+                        self._fail(t, e)
+                        break
+                if t.status == "running":
+                    self._finalize(t)
+        self.write_outputs()
+        if self.outdir:
+            self.checkpoint()
+        debug.dprintf("Fleet", "fleet drained: %s", self._by_status())
+        return 4
+
+    # --- fleet state persistence / outputs --------------------------------
+
+    def results(self) -> dict:
+        return {n: t.results for n, t in self.tenants.items()}
+
+    def tenant_tallies(self, name: str) -> dict:
+        """{(simpoint, structure): int64 tallies} for one tenant — the
+        bit-identity comparison surface the fleet tests pin against each
+        tenant's solo serial run."""
+        t = self.tenants[name]
+        out = {}
+        for key, row in (t.results or {}).items():
+            sp, st = key.split("/", 1)
+            out[(sp, st)] = np.asarray(row["tallies"], dtype=np.int64)
+        return out
+
+    def write_outputs(self) -> None:
+        if not self.outdir:
+            return
+        os.makedirs(self.outdir, exist_ok=True)
+        with open(os.path.join(self.outdir, "fleet_stats.txt"), "w") as f:
+            statsmod.dump_text(self.stats, f)
+        with open(os.path.join(self.outdir, "fleet_stats.json"), "w") as f:
+            statsmod.dump_json(self.stats, f)
+
+    def checkpoint(self) -> str:
+        """Persist the fleet's own resumable state (atomic, checksummed —
+        the campaign-checkpoint discipline): tenant specs, statuses,
+        fair-share ledgers and result summaries.  Per-tenant campaign
+        state lives in each tenant's namespaced checkpoint; this document
+        only has to say who exists and where they stand."""
+        ckpt_dir = os.path.join(self.outdir, "fleet_ckpt")
+        os.makedirs(ckpt_dir, exist_ok=True)
+        doc = {"version": FLEET_CKPT_VERSION, "policy": self.policy,
+               "depth_budget": self.depth_budget, "ticks": self.ticks,
+               "tenants": [t.to_dict() for t in self.tenants.values()]}
+        doc["checksum"] = resil.doc_checksum(doc)
+        resil.write_json_atomic(os.path.join(ckpt_dir, "fleet.json"), doc)
+        return ckpt_dir
+
+    @classmethod
+    def resume(cls, outdir: str, mesh=None,
+               queue: SubmissionQueue | None = None,
+               **kw) -> "CampaignScheduler":
+        """Rebuild a drained fleet from ``outdir/fleet_ckpt/fleet.json``:
+        terminal tenants keep their recorded results; resumable ones
+        (queued/running/preempted) are re-admitted and continue from
+        their namespaced checkpoints on the next ``run()``."""
+        doc = resil.load_json_verified(
+            os.path.join(outdir, "fleet_ckpt", "fleet.json"))
+        if doc.get("version") != FLEET_CKPT_VERSION:
+            raise ValueError(
+                f"fleet checkpoint version {doc.get('version')} != "
+                f"{FLEET_CKPT_VERSION}")
+        sched = cls(outdir=outdir, mesh=mesh, queue=queue,
+                    depth_budget=kw.pop("depth_budget",
+                                        doc["depth_budget"]),
+                    policy=kw.pop("policy", doc["policy"]), **kw)
+        for td in sorted(doc["tenants"], key=lambda d: d["order"]):
+            spec = TenantSpec.from_dict(td["spec"])
+            t = sched.admit(spec, ticket=td.get("ticket", ""))
+            t.trials = int(td.get("trials", 0))
+            t.batches = int(td.get("batches", 0))
+            t.kills = int(td.get("kills", 0))
+            t.queue_latency_s = float(td.get("queue_latency_s", 0.0))
+            status = td.get("status", "queued")
+            if status in _RESUMABLE:
+                t.status = "queued"      # _start resumes from its ckpt
+            else:
+                t.status = status
+                t.rc = td.get("rc")
+                t.results = td.get("results")
+                t.wall_s = float(td.get("wall_s", 0.0))
+        return sched

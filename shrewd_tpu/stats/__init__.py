@@ -400,6 +400,19 @@ def dump_hdf5(group: Group, path: str) -> None:
     moment attributes.  One dump per call (overwrite semantics)."""
     import h5py
 
+    def write_dict(h5g, d: dict) -> None:
+        """Dict-valued Formula payloads, possibly nested (e.g. the
+        per-content-key executable-cache ledger) and possibly carrying
+        string leaves — strings land as variable-length string scalars,
+        numbers as float64."""
+        for key, val in d.items():
+            if isinstance(val, dict):
+                write_dict(h5g.require_group(str(key)), val)
+            elif isinstance(val, str):
+                h5g.create_dataset(str(key), data=val)
+            else:
+                h5g.create_dataset(str(key), data=float(val))
+
     def write_group(h5g, g: Group) -> None:
         for s in g._stats.values():
             if isinstance(s, Distribution):      # includes Histogram
@@ -417,9 +430,7 @@ def dump_hdf5(group: Group, path: str) -> None:
             else:                                 # Scalar / Formula
                 v = s.to_value()
                 if isinstance(v, dict):           # dict-valued Formula
-                    sub = h5g.require_group(s.name)
-                    for key, val in v.items():
-                        sub.create_dataset(str(key), data=float(val))
+                    write_dict(h5g.require_group(s.name), v)
                 else:
                     h5g.create_dataset(s.name, data=float(v))
             h5g[s.name].attrs["description"] = s.desc

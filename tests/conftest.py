@@ -83,6 +83,12 @@ QUICK_MODULES = {
     # compiles — the lint gate's own correctness belongs in the tier
     # that runs the gate
     "test_graftlint",
+    # multi-tenant fleet: queue/policy units plus the fleet-vs-solo
+    # bit-identity integrations (chaos mid-fleet, drain/resume, shared-
+    # window compile dedupe) — all fleets ride the same tiny-kernel
+    # compiles through the shared executable cache, so the module is
+    # compile-dominated once like its predecessors
+    "test_fleet",
 }
 QUICK_TESTS = {
     # one representative per subsystem (≈4-10 s each, compile-dominated)

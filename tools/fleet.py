@@ -1,0 +1,153 @@
+"""Multi-tenant fleet driver — the CLI over ``shrewd_tpu/service/``.
+
+Three modes:
+
+- **serve** — run the resident scheduler over a submission spool; tenants
+  can be submitted while the fleet runs, and SIGTERM drains every tenant
+  to a namespaced resumable checkpoint (rc 4)::
+
+      python tools/fleet.py --serve --queue /spool --outdir fleet_out
+
+- **submit** — spool one tenant (a plan JSON + scheduling identity) from
+  any process; returns the ticket name::
+
+      python tools/fleet.py --submit plan.json --queue /spool \\
+          --name exp42 --priority 1 --weight 2 --quota-batches 100
+
+- **direct** — admit plan files straight into a fleet and run it to
+  completion (the embarrassingly-simple mode benchmarks and the
+  northstar fleet sweep use)::
+
+      python tools/fleet.py --plans a.json b.json --outdir fleet_out
+
+``--resume fleet_out`` rebuilds a drained fleet from its checkpoint and
+continues every resumable tenant.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+
+def _log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def cmd_submit(a) -> int:
+    from shrewd_tpu.service import SubmissionQueue, TenantSpec
+
+    if not a.queue:
+        _log("--submit needs --queue")
+        return 2
+    with open(a.submit) as f:
+        plan = json.load(f)
+    name = a.name or os.path.splitext(os.path.basename(a.submit))[0]
+    ticket = SubmissionQueue(a.queue).submit(TenantSpec(
+        name=name, plan=plan, priority=a.priority, weight=a.weight,
+        quota_batches=a.quota_batches))
+    print(json.dumps({"ticket": ticket, "tenant": name}))
+    return 0
+
+
+def _report(sched) -> None:
+    for name, t in sched.tenants.items():
+        _log(f"  {name}: {t.status} (rc={t.rc}, {t.trials} trials, "
+             f"{t.ticks} ticks, {t.wall_s:.1f}s"
+             + (f", {t.kills} kills survived" if t.kills else "") + ")")
+    _log(f"fleet: {sched.ticks} ticks, fairness "
+         f"{sched.fairness_index():.3f}, statuses {sched._by_status()}")
+
+
+def cmd_serve(a) -> int:
+    from shrewd_tpu.service import (CampaignScheduler, SubmissionQueue,
+                                    TenantSpec)
+
+    queue = SubmissionQueue(a.queue) if a.queue else None
+    if a.resume:
+        sched = CampaignScheduler.resume(
+            a.resume, queue=queue, certify=a.certify,
+            idle_exit=not a.stay_resident)
+    else:
+        sched = CampaignScheduler(
+            outdir=a.outdir, queue=queue, depth_budget=a.depth_budget,
+            policy=a.policy, certify=a.certify,
+            idle_exit=not a.stay_resident)
+    for i, path in enumerate(a.plans):
+        with open(path) as f:
+            plan = json.load(f)
+        name = f"t{i}_{os.path.splitext(os.path.basename(path))[0]}"
+        sched.admit(TenantSpec(name=name, plan=plan))
+    restore = sched.install_signal_handlers()
+    try:
+        rc = sched.run()
+    finally:
+        restore()
+    _report(sched)
+    return rc
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="multi-tenant campaign fleet (shrewd_tpu/service/)")
+    ap.add_argument("--serve", action="store_true",
+                    help="run the resident scheduler")
+    ap.add_argument("--submit", metavar="PLAN_JSON", default="",
+                    help="spool one tenant into --queue and exit")
+    ap.add_argument("--plans", nargs="*", default=[],
+                    help="plan JSONs admitted directly (no spool needed)")
+    ap.add_argument("--queue", default="",
+                    help="submission spool directory (service/queue.py)")
+    ap.add_argument("--outdir", default="fleet_out",
+                    help="fleet artifact root (per-tenant namespaces under "
+                         "tenants/, fleet checkpoint under fleet_ckpt/)")
+    ap.add_argument("--resume", default="",
+                    help="resume a drained fleet from this outdir")
+    ap.add_argument("--depth-budget", type=int, default=4,
+                    help="global dispatch-depth budget shared by running "
+                         "tenants")
+    ap.add_argument("--policy", default="fair",
+                    choices=("fair", "priority"),
+                    help="fair = strict priority classes + weighted "
+                         "fair-share stride within a class; priority = "
+                         "strict priority, FIFO within a class")
+    ap.add_argument("--certify", default="",
+                    choices=("", "off", "warn", "strict"),
+                    help="admission-time graftlint certification floor "
+                         "applied to every tenant's executables")
+    ap.add_argument("--stay-resident", action="store_true",
+                    help="keep serving an empty queue (SIGTERM drains); "
+                         "default exits when all tenants are terminal "
+                         "and the spool is empty")
+    ap.add_argument("--name", default="", help="[submit] tenant name")
+    ap.add_argument("--priority", type=int, default=0,
+                    help="[submit] strict-priority class (higher first)")
+    ap.add_argument("--weight", type=float, default=1.0,
+                    help="[submit] fair-share weight within the class")
+    ap.add_argument("--quota-batches", type=int, default=0,
+                    help="[submit] scheduler-level batch quota "
+                         "(0 = none; at quota the tenant drains to a "
+                         "resumable checkpoint)")
+    ap.add_argument("--platform", default=None,
+                    help="jax platform override (cpu/tpu/axon)")
+    a = ap.parse_args(argv)
+
+    if a.platform:
+        import jax
+        jax.config.update("jax_platforms", a.platform)
+    if a.submit:
+        return cmd_submit(a)
+    if a.serve or a.plans or a.resume:
+        return cmd_serve(a)
+    ap.print_help()
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

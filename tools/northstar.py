@@ -12,14 +12,26 @@ population-weighted metric, ``src/cpu/simple/probes/simpoint.hh:82``),
 and the summed wall-clock.  The grand total is the headline: wall-clock
 to ±1% CI across all structures × all workloads × SimPoints on one chip.
 
+``--fleet`` re-runs the same sweep as ONE interleaved multi-tenant fleet
+(``shrewd_tpu/service/``): every (workload, SimPoint, structure)
+campaign becomes a tenant on one shared mesh, batches interleaved
+through the pipelined engine under a global dispatch-depth budget.  The
+reference's ``multisim`` answer to this sweep is process-per-config;
+here one resident process serves all campaigns.  With ``--also-serial``
+both arms run back-to-back at the same scale and the measured speedup
+lands in ``--bench-out`` (BENCH_r07.json).
+
 Usage: python tools/northstar.py [--k 3] [--interval 4000] [--out FILE]
+       python tools/northstar.py --fleet --also-serial
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
+import tempfile
 import time
 from pathlib import Path
 
@@ -33,29 +45,32 @@ WORKLOADS = ["workloads/sort.c", "workloads/intmm.c", "workloads/divmix.c",
 STRUCTURES = ["regfile", "rob", "iq", "lsq", "fu", "latch"]
 
 
-def main() -> int:
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--workloads", nargs="*", default=WORKLOADS)
-    ap.add_argument("--structures", nargs="*", default=STRUCTURES)
-    ap.add_argument("--k", type=int, default=3, help="SimPoints/workload")
-    ap.add_argument("--interval", type=int, default=4000)
-    ap.add_argument("--halfwidth", type=float, default=0.01)
-    ap.add_argument("--batch", type=int, default=4096)
-    ap.add_argument("--max-trials", type=int, default=200_000)
-    ap.add_argument("--seed", type=int, default=0)
-    ap.add_argument("--out", default=str(REPO / "NORTHSTAR_r05.json"))
-    a = ap.parse_args()
-
-    import jax
-    import numpy as np
-
+def _build_windows(a) -> dict:
+    """Ingest phase, shared by both arms: {workload: [(trace, meta)]}."""
     from shrewd_tpu.ingest import hostdiff as hd
     from shrewd_tpu.ingest.simpoint import simpoint_windows
+
+    out = {}
+    for wl in a.workloads:
+        paths = hd.build_tools(wl)
+        windows, _sps, _profile = simpoint_windows(
+            paths, interval=a.interval, k=a.k, seed=a.seed)
+        out[wl] = windows
+        print(f"{wl}: {len(windows)} SimPoint windows", file=sys.stderr,
+              flush=True)
+    return out
+
+
+def _run_serial(a, windows_by_wl: dict) -> dict:
+    """The serial sweep: one ``run_until_ci`` campaign at a time (the
+    reference's posture — campaigns queue behind each other)."""
+    import jax
+
     from shrewd_tpu.models.minor import MinorConfig
     from shrewd_tpu.models.o3 import O3Config
+    from shrewd_tpu.ops.trial import TrialKernel
     from shrewd_tpu.parallel.campaign import ShardedCampaign, run_until_ci
     from shrewd_tpu.parallel.mesh import make_mesh
-    from shrewd_tpu.ops.trial import TrialKernel
 
     dev = jax.devices()[0]
     mesh = make_mesh(jax.devices()[:1])       # one chip — the metric's unit
@@ -65,25 +80,22 @@ def main() -> int:
            "halfwidth_target": a.halfwidth,
            "simpoint_interval_macro_ops": a.interval,
            "k_per_workload": a.k,
+           "max_trials": a.max_trials,
+           "batch": a.batch,
            "workloads": {}}
     grand_trials = 0
-    for wl in a.workloads:
+    for wl, windows in windows_by_wl.items():
         t_wl = time.time()
-        paths = hd.build_tools(wl)
-        windows, sps, _profile = simpoint_windows(
-            paths, interval=a.interval, k=a.k, seed=a.seed)
         row = {"n_simpoints": len(windows), "structures": {}}
-        kernels = []
-        for trace, meta in windows:
-            kernels.append((TrialKernel(trace, O3Config(), MinorConfig()),
-                            meta))
+        kernels = [(TrialKernel(trace, O3Config(), MinorConfig()), meta)
+                   for trace, meta in windows]
         for structure in a.structures:
             t_s = time.time()
             weighted = 0.0
             s_trials = 0
             sp_rows = []
             converged_all = True
-            for sp_id, (kernel, meta) in enumerate(kernels):
+            for kernel, meta in kernels:
                 camp = ShardedCampaign(kernel, mesh, structure)
                 res = run_until_ci(
                     camp, seed=a.seed,
@@ -121,13 +133,227 @@ def main() -> int:
     doc["total_trials"] = grand_trials
     doc["campaigns"] = sum(len(r["structures"]) * r["n_simpoints"]
                            for r in doc["workloads"].values())
-    with open(a.out, "w") as f:
-        json.dump(doc, f, indent=1)
+    return doc
+
+
+def _run_fleet(a, windows_by_wl: dict) -> dict:
+    """The same sweep as ONE interleaved fleet: each (workload, SimPoint,
+    structure) campaign is a tenant; one mesh, one resident scheduler,
+    batches interleaved through the pipelined engine.  Windows are
+    spilled to .npz once so every tenant's plan round-trips (the service
+    contract: a tenant is reproducible from its spec document)."""
+    import jax
+
+    from shrewd_tpu.campaign.plan import CampaignPlan, TraceFileSpec
+    from shrewd_tpu.parallel.mesh import make_mesh
+    from shrewd_tpu.service import CampaignScheduler, TenantSpec
+    from shrewd_tpu.trace import format as tf
+
+    dev = jax.devices()[0]
+    mesh = make_mesh(jax.devices()[:1])       # the same one-chip unit
+    spool = tempfile.mkdtemp(prefix="northstar_fleet_")
+    tenants = []          # (name, wl, structure, weight)
+    for wl, windows in windows_by_wl.items():
+        base = os.path.splitext(os.path.basename(wl))[0]
+        for trace, meta in windows:
+            npz = os.path.join(
+                spool, f"{base}_sp{meta['simpoint_interval']}.npz")
+            if not os.path.exists(npz):
+                tf.save(npz, trace, meta)
+            for structure in a.structures:
+                sp_name = f"{base}.sp{meta['simpoint_interval']}"
+                # the fleet's batch may be SMALLER than the serial arm's:
+                # the interval machinery decouples stopping granularity
+                # (one batch) from device-call granularity (sync_every
+                # batches accumulated in one jitted scan), so the fleet
+                # checks convergence at fleet_batch-granularity while
+                # keeping the serial posture's per-call device efficiency
+                # — the over-sampling a coarse serial batch pays on
+                # small campaigns is the fleet's structural win.
+                # min_trials floors at one full interval so the first
+                # convergence check matches the serial arm's (its first
+                # check is at one serial batch = one fleet interval).
+                fb = a.fleet_batch or a.batch
+                plan = CampaignPlan(
+                    simpoints=[TraceFileSpec(name=sp_name, path=npz)],
+                    structures=[structure], batch_size=fb,
+                    target_halfwidth=a.halfwidth,
+                    max_trials=a.max_trials, seed=a.seed,
+                    min_trials=max(1000, fb * a.sync_every))
+                # parity with the serial arm's BARE run_until_ci loop:
+                # no canaries/audit/invariants and no watchdog in either
+                # arm (the integrity and resilience layers have their own
+                # benchmarks) — interleaving + interval accumulation is
+                # the variable under measurement, nothing else
+                plan.integrity.canary_trials = 0
+                plan.integrity.audit_rate = 0.0
+                plan.integrity.invariants = False
+                plan.resilience.backoff_base = 0.0
+                plan.resilience.dispatch_timeout = 0.0
+                plan.pipeline.sync_every = a.sync_every
+                tenants.append((f"{base}.sp{meta['simpoint_interval']}"
+                                f".{structure}", plan,
+                                meta["simpoint_weight"], wl, structure))
+    sched = CampaignScheduler(outdir=None, mesh=mesh,
+                              depth_budget=a.depth_budget)
+    for name, plan, _w, _wl, _s in tenants:
+        sched.admit(TenantSpec(name=name, plan=plan.to_dict()))
+    t0 = time.time()
+    rc = sched.run()
+    fleet_s = time.time() - t0
+    doc = {"metric": "wall-clock to AVF ±1% CI (95%), one chip, "
+                     "interleaved multi-tenant fleet",
+           "platform": dev.platform,
+           "halfwidth_target": a.halfwidth,
+           "simpoint_interval_macro_ops": a.interval,
+           "k_per_workload": a.k,
+           "max_trials": a.max_trials,
+           "batch": a.fleet_batch or a.batch,
+           "serial_arm_batch": a.batch,
+           "sync_every": a.sync_every,
+           "depth_budget": a.depth_budget,
+           "policy": "fair",
+           "rc": rc,
+           "tenants": len(tenants),
+           "fleet_ticks": sched.ticks,
+           "fairness_index": round(sched.fairness_index(), 4),
+           "workloads": {}}
+    grand_trials = 0
+    for name, _plan, weight, wl, structure in tenants:
+        t = sched.tenants[name]
+        row = doc["workloads"].setdefault(
+            wl, {"structures": {}})["structures"].setdefault(
+            structure, {"weighted_avf": 0.0, "trials": 0,
+                        "converged": True, "tenants": []})
+        summary = list((t.results or {}).values())
+        avf = summary[0]["avf"] if summary else 0.0
+        conv = summary[0]["converged"] if summary else False
+        row["weighted_avf"] = round(row["weighted_avf"]
+                                    + weight * (avf or 0.0), 4)
+        row["trials"] += t.trials
+        row["converged"] = bool(row["converged"] and conv)
+        row["tenants"].append({
+            "tenant": name, "avf": round(avf or 0.0, 4),
+            "trials": t.trials, "ticks": t.ticks,
+            "status": t.status})
+        grand_trials += t.trials
+    doc["total_wall_clock_s"] = round(fleet_s, 1)
+    doc["total_trials"] = grand_trials
+    doc["campaigns"] = len(tenants)
+    from shrewd_tpu.parallel import exec_cache
+    doc["exec_cache"] = exec_cache.cache().stats()
+    return doc
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--workloads", nargs="*", default=WORKLOADS)
+    ap.add_argument("--structures", nargs="*", default=STRUCTURES)
+    ap.add_argument("--k", type=int, default=3, help="SimPoints/workload")
+    ap.add_argument("--interval", type=int, default=4000)
+    ap.add_argument("--halfwidth", type=float, default=0.01)
+    ap.add_argument("--batch", type=int, default=4096)
+    ap.add_argument("--max-trials", type=int, default=200_000)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--out", default=str(REPO / "NORTHSTAR_r05.json"))
+    ap.add_argument("--fleet", action="store_true",
+                    help="run the sweep as ONE interleaved multi-tenant "
+                         "fleet (shrewd_tpu/service/) instead of the "
+                         "serial campaign-after-campaign loop")
+    ap.add_argument("--also-serial", action="store_true",
+                    help="[fleet] run the serial sweep too (same scale, "
+                         "same process) and record the measured speedup")
+    ap.add_argument("--sync-every", type=int, default=4,
+                    help="[fleet] batches per device sync interval")
+    ap.add_argument("--fleet-batch", type=int, default=0,
+                    help="[fleet] per-tenant batch size (default: --batch)."
+                         "  A smaller fleet batch with sync-every scan "
+                         "accumulation keeps the serial arm's per-device-"
+                         "call trial count while stopping at finer "
+                         "granularity — less over-sampling per campaign")
+    ap.add_argument("--depth-budget", type=int, default=4,
+                    help="[fleet] global dispatch-depth budget")
+    ap.add_argument("--fleet-out",
+                    default=str(REPO / "NORTHSTAR_FLEET_r07.json"))
+    ap.add_argument("--bench-out", default=str(REPO / "BENCH_r07.json"))
+    ap.add_argument("--serial-baseline",
+                    default=str(REPO / "NORTHSTAR_r05.json"),
+                    help="[fleet] serial artifact to compare against when "
+                         "--also-serial is not given (scales must match "
+                         "for the comparison to mean anything)")
+    a = ap.parse_args()
+
+    windows_by_wl = _build_windows(a)
+
+    if not a.fleet:
+        doc = _run_serial(a, windows_by_wl)
+        with open(a.out, "w") as f:
+            json.dump(doc, f, indent=1)
+            f.write("\n")
+        print(json.dumps({"total_wall_clock_s": doc["total_wall_clock_s"],
+                          "total_trials": doc["total_trials"],
+                          "campaigns": doc["campaigns"],
+                          "platform": doc["platform"]}))
+        return 0
+
+    serial_doc = None
+    if a.also_serial:
+        serial_doc = _run_serial(a, windows_by_wl)
+        # cold-start parity: both arms must pay their own compiles — the
+        # process-wide executable cache and XLA's jit caches would
+        # otherwise hand the second arm the first arm's warm steps
+        import jax
+
+        from shrewd_tpu.parallel import exec_cache
+        exec_cache.cache().clear()
+        exec_cache.clear_kernels()
+        jax.clear_caches()
+    fleet_doc = _run_fleet(a, windows_by_wl)
+    if serial_doc is not None:
+        serial_s = serial_doc["total_wall_clock_s"]
+        serial_src = "measured (--also-serial, same scale/process)"
+        serial_trials = serial_doc["total_trials"]
+    else:
+        with open(a.serial_baseline) as f:
+            base = json.load(f)
+        serial_s = base["total_wall_clock_s"]
+        serial_src = a.serial_baseline
+        serial_trials = base.get("total_trials")
+    fleet_doc["serial_wall_clock_s"] = serial_s
+    fleet_doc["serial_source"] = serial_src
+    fleet_doc["speedup_vs_serial"] = round(
+        serial_s / max(fleet_doc["total_wall_clock_s"], 1e-9), 3)
+    with open(a.fleet_out, "w") as f:
+        json.dump(fleet_doc, f, indent=1)
         f.write("\n")
-    print(json.dumps({"total_wall_clock_s": doc["total_wall_clock_s"],
-                      "total_trials": grand_trials,
-                      "campaigns": doc["campaigns"],
-                      "platform": dev.platform}))
+    bench = {
+        "benchmark": "NORTHSTAR sweep: interleaved multi-tenant fleet "
+                     "vs serial campaign-after-campaign",
+        "platform": fleet_doc["platform"],
+        "campaigns": fleet_doc["campaigns"],
+        "config": {"workloads": a.workloads, "structures": a.structures,
+                   "k": a.k, "interval": a.interval,
+                   "halfwidth": a.halfwidth, "batch": a.batch,
+                   "fleet_batch": a.fleet_batch or a.batch,
+                   "max_trials": a.max_trials,
+                   "sync_every": a.sync_every,
+                   "depth_budget": a.depth_budget},
+        "serial_wall_clock_s": serial_s,
+        "serial_source": serial_src,
+        "serial_trials": serial_trials,
+        "fleet_wall_clock_s": fleet_doc["total_wall_clock_s"],
+        "fleet_trials": fleet_doc["total_trials"],
+        "speedup": fleet_doc["speedup_vs_serial"],
+        "fairness_index": fleet_doc["fairness_index"],
+        "exec_cache": fleet_doc["exec_cache"],
+    }
+    with open(a.bench_out, "w") as f:
+        json.dump(bench, f, indent=1)
+        f.write("\n")
+    print(json.dumps({"serial_s": serial_s,
+                      "fleet_s": fleet_doc["total_wall_clock_s"],
+                      "speedup": fleet_doc["speedup_vs_serial"],
+                      "campaigns": fleet_doc["campaigns"]}))
     return 0
 
 
