@@ -116,6 +116,73 @@ print(f"fleet smoke: 2 tenants bit-identical to solo, 0 new compiles "
       f"(fairness {sched.fairness_index():.3f})")
 FLEET_SMOKE
 
+# Non-fatal fleet-survivability smoke: a 3-tenant fleet served through
+# the real CLI dies a HARD death (kill_fleet chaos -> os._exit 137 at a
+# deterministic tick: no drain, no snapshot — the write-ahead journal
+# and per-tenant checkpoints are the only survivors), then
+# `fleet.py --recover` replays snapshot+journal (reaping the dead
+# server's stale pid lock on the way) and every tenant must finish with
+# tallies bit-identical to its solo serial run.  Never affects the
+# pass/fail status.
+timeout -k 10 420 env JAX_PLATFORMS=cpu python - <<'SURVIVE_SMOKE' \
+  || echo "WARNING: fleet survive smoke failed (non-fatal)"
+import json, os, subprocess, sys, tempfile
+import numpy as np
+from shrewd_tpu.campaign.orchestrator import Orchestrator
+from shrewd_tpu.campaign.plan import CampaignPlan, WorkloadSpec
+from shrewd_tpu.trace.synth import WorkloadConfig
+
+def plan(seed):
+    p = CampaignPlan(
+        simpoints=[WorkloadSpec(name="w0", workload=WorkloadConfig(
+            n=64, nphys=32, mem_words=64, working_set_words=32, seed=3))],
+        structures=["regfile"], batch_size=32, target_halfwidth=0.5,
+        max_trials=128, min_trials=128, seed=seed)
+    p.integrity.canary_trials = 0
+    p.integrity.audit_rate = 0.0
+    p.resilience.backoff_base = 0.0
+    return p
+
+seeds = (0, 9, 17)
+solos = {}
+warm = []
+for seed in seeds:
+    orch = Orchestrator(plan(seed))
+    warm.append(orch)     # keep kernels alive: cache entries are owner-guarded
+    solos[seed] = {k: np.asarray(v.tallies)
+                   for k, v in dict(list(orch.events())[-1][1]).items()}
+td = tempfile.mkdtemp(prefix="fleet_survive_")
+outdir = os.path.join(td, "out")
+paths = []
+for i, seed in enumerate(seeds):
+    pth = os.path.join(td, f"p{i}.json")
+    with open(pth, "w") as f:
+        json.dump(plan(seed).to_dict(), f)
+    paths.append(pth)
+cpath = os.path.join(td, "chaos.json")
+with open(cpath, "w") as f:
+    json.dump({"faults": [{"kind": "kill_fleet", "at_tick": 5}]}, f)
+env = dict(os.environ, JAX_PLATFORMS="cpu")
+r = subprocess.run([sys.executable, "tools/fleet.py", "--plans", *paths,
+                    "--outdir", outdir, "--chaos-plan", cpath], env=env)
+assert r.returncode == 137, f"expected hard-kill rc 137, got {r.returncode}"
+r = subprocess.run([sys.executable, "tools/fleet.py", "--recover", outdir],
+                   env=env)
+assert r.returncode == 0, f"recover rc {r.returncode}"
+with open(os.path.join(outdir, "fleet_ckpt", "fleet.json")) as f:
+    snap = json.load(f)
+assert snap["recoveries"] == 1, snap
+by_name = {d["spec"]["name"]: d for d in snap["tenants"]}
+for i, seed in enumerate(seeds):
+    doc = by_name[f"t{i}_p{i}"]
+    assert doc["status"] == "complete", (doc["spec"]["name"], doc["status"])
+    for k, t in solos[seed].items():
+        got = np.asarray(doc["results"][f"{k[0]}/{k[1]}"]["tallies"])
+        np.testing.assert_array_equal(got, t)
+print("fleet survive smoke: hard kill at tick 5 -> --recover -> "
+      "3 tenants complete, tallies bit-identical to solo")
+SURVIVE_SMOKE
+
 # Non-fatal pipelined-bench smoke: bench.py --quick includes the
 # serial-vs-pipelined campaign-loop microbenchmark (warm executable cache,
 # best-of-2 per arm, bit-identity asserted) — the recorded BENCH_r06.json

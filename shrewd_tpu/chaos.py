@@ -42,6 +42,27 @@ Each fault kind lands on a hook point that already exists in the code:
                         survivors re-dispatch them on frozen keys)
 ======================  ====================================================
 
+**Service-level kinds** target the multi-tenant fleet itself
+(``shrewd_tpu/service/``) rather than one campaign — the checker must
+survive the faults it studies, and PR 7 made the resident scheduler the
+weakest link:
+
+======================  ====================================================
+``kill_fleet``          hard process death (``kill_action`` seam, default
+                        ``os._exit``) at a fleet tick (``at_tick``) or right
+                        after a write-ahead-journal record lands
+                        (``at_journal``) — ``CampaignScheduler.recover()``
+                        must replay snapshot+journal bit-identically
+``torn_journal``        the journal append at ``at_journal`` persists only a
+                        prefix (fsync'd) and the process dies — exactly a
+                        power loss mid-append; replay must drop the torn
+                        tail and lose nothing acknowledged before it
+``corrupt_submission``  the ``at_submission``-th pending spool document the
+                        scheduler inspects is corrupted in place (parses,
+                        checksum fails) — the claim path must quarantine it
+                        to ``spool/bad/`` instead of raising out of the loop
+======================  ====================================================
+
 Every injected and survived fault is counted per kind; the orchestrator
 exposes the ledgers as the ``campaign.chaos.*`` stats group, so a chaos run
 is self-describing from its stats dump alone.
@@ -66,7 +87,24 @@ from shrewd_tpu.utils.config import ConfigObject, Param
 debug.register_flag("Chaos", "deterministic fault-injection harness")
 
 KINDS = ("wedge", "backend_error", "corrupt_tally", "torn_checkpoint",
-         "kill_worker")
+         "kill_worker", "kill_fleet", "torn_journal", "corrupt_submission")
+
+#: kinds whose triggers are NOT batch coordinates (never armed by
+#: ``begin_batch``): checkpoint ordinals and the fleet-level seams
+_NON_BATCH_KINDS = ("torn_checkpoint", "kill_fleet", "torn_journal",
+                    "corrupt_submission")
+
+#: trigger keys carrying id lists, by kind (fleet kinds + checkpoint);
+#: batch kinds use at_batch / sample / after_dispatches
+_KIND_TRIGGERS = {
+    "torn_checkpoint": ("at_ckpt",),
+    "kill_fleet": ("at_tick", "at_journal"),
+    "torn_journal": ("at_journal",),
+    "corrupt_submission": ("at_submission",),
+}
+
+_ID_KEYS = ("at_batch", "at_ckpt", "at_tick", "at_journal",
+            "at_submission")
 
 KILL_DEFAULT_RC = 137
 
@@ -119,21 +157,26 @@ def _normalize(plan: dict) -> list[dict]:
             ids = rng.choice(int(samp["of"]), size=int(samp["k"]),
                              replace=False)
             s["at_batch"] = sorted(int(x) for x in ids)
-        for key in ("at_batch", "at_ckpt"):
+        for key in _ID_KEYS:
             if key in s:
                 s[key] = _as_id_list(s[key])
-        if kind == "torn_checkpoint" and "at_ckpt" not in s:
-            raise ChaosPlanError(f"fault {i}: torn_checkpoint needs at_ckpt")
-        if kind != "torn_checkpoint" and ("at_batch" not in s
-                                          and "after_dispatches" not in s):
+        if kind in _KIND_TRIGGERS:
+            keys = _KIND_TRIGGERS[kind]
+            if not any(k in s for k in keys):
+                raise ChaosPlanError(
+                    f"fault {i}: {kind} needs " + " / ".join(keys))
+        elif "at_batch" not in s and "after_dispatches" not in s:
             raise ChaosPlanError(
                 f"fault {i}: {kind} needs at_batch / sample / "
                 "after_dispatches")
         if "tier" in s and s["tier"] not in TIERS:
             raise ChaosPlanError(
                 f"fault {i}: unknown tier {s['tier']!r} (one of {TIERS})")
-        s["_fires_left"] = len(s.get("at_batch", s.get("at_ckpt", [0]))) \
-            if "after_dispatches" not in s else 1
+        if "after_dispatches" in s:
+            s["_fires_left"] = 1
+        else:
+            s["_fires_left"] = sum(len(s[k]) for k in _ID_KEYS
+                                   if k in s) or 1
         out.append(s)
     return out
 
@@ -157,6 +200,7 @@ class ChaosEngine:
         self.fires: list[dict] = []          # evidence: what fired where
         self.dispatches = 0                  # batches this process computed
         self.ckpts = 0                       # checkpoints this process wrote
+        self.submissions = 0                 # spool docs inspected (fleet)
         # kind -> LIST of armed states (a plan may schedule several
         # faults of the same kind onto one batch, e.g. backend_error on
         # two tiers to force a double descent — none may be dropped)
@@ -213,7 +257,7 @@ class ChaosEngine:
         self._armed = {}
         self._batch = (int(batch_id), simpoint, structure)
         for s in self.faults:
-            if s["kind"] == "torn_checkpoint" or s["_fires_left"] <= 0:
+            if s["kind"] in _NON_BATCH_KINDS or s["_fires_left"] <= 0:
                 continue
             if s.get("simpoint") and simpoint and s["simpoint"] != simpoint:
                 continue
@@ -289,9 +333,71 @@ class ChaosEngine:
             self._fire("kill_worker", {"worker": self.worker})
             debug.dprintf("Chaos", "kill_worker %s: kill_action(%s)",
                           self.worker, spec.get("rc", KILL_DEFAULT_RC))
-            kill = self.kill_action if self.kill_action is not None \
-                else os._exit
-            kill(int(spec.get("rc", KILL_DEFAULT_RC)))
+            self.kill_now(spec.get("rc"))
+
+    def kill_now(self, rc=None) -> None:
+        """Fire the kill seam: the configured ``kill_action`` (a fleet
+        rescopes it; tests install a raising action) or a true hard
+        ``os._exit`` — no atexit, no flush, no drain."""
+        kill = self.kill_action if self.kill_action is not None \
+            else os._exit
+        kill(int(KILL_DEFAULT_RC if rc is None else rc))
+
+    # --- service-level hook points (the fleet scheduler/journal/spool) --
+
+    def maybe_kill_fleet(self, tick: int | None = None,
+                         journal_seq: int | None = None) -> None:
+        """The fleet's hard-kill seam: ``kill_fleet`` fires at a fleet
+        tick boundary (``at_tick``, consulted by the scheduler loop) or
+        right after a journal record lands (``at_journal``, consulted by
+        ``FleetJournal.append``) — both deterministic fleet coordinates,
+        never a clock."""
+        for s in self.faults:
+            if s["kind"] != "kill_fleet" or s["_fires_left"] <= 0:
+                continue
+            hit = (tick is not None and tick in s.get("at_tick", ())) \
+                or (journal_seq is not None
+                    and journal_seq in s.get("at_journal", ()))
+            if not hit:
+                continue
+            s["_fires_left"] -= 1
+            self._batch = (tick if tick is not None else journal_seq,
+                           "fleet", "")
+            self._fire("kill_fleet",
+                       {"tick": tick, "journal_seq": journal_seq})
+            debug.dprintf("Chaos", "kill_fleet (tick=%s journal=%s)",
+                          tick, journal_seq)
+            self.kill_now(s.get("rc"))
+
+    def take_torn_journal(self, seq: int) -> dict | None:
+        """Journal hook: the spec when journal record ``seq`` is
+        scheduled to tear (the append persists a prefix and the process
+        dies — see ``FleetJournal.append``), or None."""
+        for s in self.faults:
+            if s["kind"] != "torn_journal" or s["_fires_left"] <= 0:
+                continue
+            if seq in s.get("at_journal", ()):
+                s["_fires_left"] -= 1
+                self._batch = (seq, "journal", "")
+                self._fire("torn_journal", {"journal_seq": seq})
+                return s
+        return None
+
+    def take_corrupt_submission(self) -> dict | None:
+        """Spool hook: called once per pending submission document the
+        scheduler inspects; returns the spec when this inspection
+        ordinal is scheduled to corrupt the document in place."""
+        ordinal = self.submissions
+        self.submissions += 1
+        for s in self.faults:
+            if s["kind"] != "corrupt_submission" or s["_fires_left"] <= 0:
+                continue
+            if ordinal in s.get("at_submission", ()):
+                s["_fires_left"] -= 1
+                self._batch = (ordinal, "submission", "")
+                self._fire("corrupt_submission", {"submission": ordinal})
+                return s
+        return None
 
     def take_wedge(self, timeout: float) -> dict | None:
         """Watchdog hook: ``{"fn": wedged, "deadline": s}`` (consumed once
@@ -381,3 +487,17 @@ def tear_file(path: str, keep_fraction: float = 0.5) -> None:
     size = os.path.getsize(path)
     with open(path, "r+b") as f:
         f.truncate(max(int(size * keep_fraction), 1))
+
+
+def corrupt_json_checksum(path: str) -> None:
+    """Corrupt a COMPLETE checksummed document the way bit-rot (not a
+    torn write) would: the JSON still parses, the checksum no longer
+    verifies — the reader's quarantine path, not its in-flight-skip
+    path, must catch it."""
+    with open(path) as f:
+        doc = json.load(f)
+    doc["checksum"] = "0" * 64
+    with open(path, "w") as f:
+        # graftlint: allow-raw-write -- chaos corruption: producing a
+        # definitively-bad persisted document IS the injected fault
+        json.dump(doc, f)

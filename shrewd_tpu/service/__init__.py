@@ -11,9 +11,17 @@ checkpoints, integrity/chaos state, and admission-time certification.
 
 - ``queue.py``     — ``TenantSpec`` + the durable submission spool
   (atomic claims over a shared directory, the elastic coord-dir idiom),
-  so tenants can be submitted while the fleet runs;
+  so tenants can be submitted while the fleet runs, plus the
+  ``ServerLock`` single-server guard and the ``bad/`` quarantine for
+  poisoned submissions;
 - ``scheduler.py`` — ``CampaignScheduler``, the resident scheduler that
-  ticks each tenant's ``StepDriver`` one batch/interval at a time.
+  ticks each tenant's ``StepDriver`` one batch/interval at a time, with
+  the poison-tenant retry/quarantine ladder and the per-tenant tick
+  watchdog;
+- ``journal.py``   — the fleet's write-ahead journal: fsync'd
+  checksummed records for every scheduler state transition, compacted
+  into ``fleet.json``, so ``CampaignScheduler.recover()`` survives a
+  hard kill (SIGKILL/OOM) at any instruction boundary.
 
 The invariant is non-negotiable and pinned in ``tests/test_fleet.py``:
 each tenant's final tallies are bit-identical to its solo serial run
@@ -26,8 +34,12 @@ pure host-side work; jax enters only when the scheduler elaborates a
 tenant's orchestrator).
 """
 
-from shrewd_tpu.service.queue import SubmissionQueue, TenantSpec
-from shrewd_tpu.service.scheduler import CampaignScheduler, TenantKilled
+from shrewd_tpu.service.journal import FleetJournal, is_dirty, journal_path
+from shrewd_tpu.service.queue import (LockHeld, ServerLock,
+                                      SubmissionQueue, TenantSpec)
+from shrewd_tpu.service.scheduler import (CampaignScheduler, FleetKilled,
+                                          TenantKilled)
 
-__all__ = ["CampaignScheduler", "SubmissionQueue", "TenantKilled",
-           "TenantSpec"]
+__all__ = ["CampaignScheduler", "FleetJournal", "FleetKilled", "LockHeld",
+           "ServerLock", "SubmissionQueue", "TenantKilled", "TenantSpec",
+           "is_dirty", "journal_path"]

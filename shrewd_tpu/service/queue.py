@@ -20,6 +20,16 @@ Layout::
     <root>/pending/   NNNNNN_<name>.json   submitted, unclaimed
     <root>/claimed/   NNNNNN_<name>.json   admitted by a scheduler
     <root>/done/      NNNNNN_<name>.json   final per-tenant result doc
+    <root>/bad/       NNNNNN_<name>.json   poisoned submission + .reason
+    <root>/server.lock                     O_EXCL+pid single-server guard
+
+A **poisoned** submission — complete JSON whose checksum fails, or a
+document the spec validator rejects — is deterministically bad (an
+atomic replace can never heal it), so ``claim`` moves it to ``bad/``
+with a ``.reason`` doc and counts it, instead of raising out of the
+scheduler's poll loop or skipping it forever.  Only documents that do
+not PARSE stay pending: that is the in-flight signature of the atomic
+submit (O_EXCL placeholder → atomic replace).
 
 Import discipline: jax-free (pure host-side file coordination; the plan
 inside a spec is elaborated only by the scheduler).
@@ -27,11 +37,13 @@ inside a spec is elaborated only by the scheduler).
 
 from __future__ import annotations
 
+import json
 import os
 import re
 import time
 
-from shrewd_tpu.resilience import load_json_verified, write_json_atomic
+from shrewd_tpu.resilience import (doc_checksum, load_json_verified,
+                                   write_json_atomic)
 from shrewd_tpu.utils import debug
 
 debug.register_flag("Fleet", "multi-tenant scheduler / submission queue")
@@ -101,14 +113,17 @@ class SubmissionQueue:
         self.pending_dir = os.path.join(root, "pending")
         self.claimed_dir = os.path.join(root, "claimed")
         self.done_dir = os.path.join(root, "done")
-        for d in (self.pending_dir, self.claimed_dir, self.done_dir):
+        self.bad_dir = os.path.join(root, "bad")
+        for d in (self.pending_dir, self.claimed_dir, self.done_dir,
+                  self.bad_dir):
             os.makedirs(d, exist_ok=True)
 
     # --- submission ------------------------------------------------------
 
     def _next_seq(self) -> int:
         seq = 0
-        for d in (self.pending_dir, self.claimed_dir, self.done_dir):
+        for d in (self.pending_dir, self.claimed_dir, self.done_dir,
+                  self.bad_dir):
             for name in os.listdir(d):
                 m = _TICKET_RE.match(name)
                 if m:
@@ -128,6 +143,10 @@ class SubmissionQueue:
             # decisions are pure functions of admission order and batch
             # counts, and tallies are frozen-key pure either way
             doc["submitted_at"] = time.time()
+        # content checksum: a claimed doc that PARSES but fails this is
+        # definitively poisoned (bit-rot, tampering) and takes the bad/
+        # quarantine path, never the in-flight-skip path
+        doc["checksum"] = doc_checksum(doc)
         seq = self._next_seq()
         while True:
             ticket = f"{seq:06d}_{sanitize(spec.name)}.json"
@@ -151,18 +170,35 @@ class SubmissionQueue:
     def claim(self) -> list[tuple[str, TenantSpec]]:
         """Claim every currently-valid pending submission, in ticket
         order.  The claim is an atomic rename into ``claimed/`` — a
-        racing second server loses with OSError and skips.  Invalid
-        documents (in-flight placeholder, torn write) stay pending for a
-        later poll; they become claimable once their atomic replace
-        lands."""
+        racing second server loses with OSError and skips.
+
+        Documents that do not PARSE (in-flight placeholder, torn write)
+        stay pending for a later poll — they become claimable once
+        their atomic replace lands.  Documents that parse but are
+        poisoned (checksum mismatch, spec the validator rejects) can
+        never heal: they move to ``bad/`` with a reason doc instead of
+        wedging the poll loop forever or raising out of the scheduler."""
         out = []
         for ticket in self.pending():
             src = os.path.join(self.pending_dir, ticket)
             try:
-                doc = load_json_verified(src)
+                with open(src) as f:
+                    doc = json.load(f)
+            except (OSError, json.JSONDecodeError):
+                continue             # placeholder / in-flight: not ours yet
+            try:
+                if not isinstance(doc, dict):
+                    raise ValueError("submission is not a JSON object")
+                want = doc.get("checksum")
+                if want is not None and doc_checksum(doc) != want:
+                    raise ValueError("checksum mismatch "
+                                     "(corrupt submission)")
                 spec = TenantSpec.from_dict(doc)
-            except (OSError, ValueError, KeyError):
-                continue             # placeholder / torn / malformed: skip
+            except Exception as e:  # noqa: BLE001 — a complete-but-
+                # poisoned document is deterministically bad; quarantine
+                # it so the spool keeps serving
+                self.quarantine_bad(ticket, e)
+                continue
             dst = os.path.join(self.claimed_dir, ticket)
             try:
                 os.rename(src, dst)
@@ -171,6 +207,27 @@ class SubmissionQueue:
             out.append((ticket, spec))
             debug.dprintf("Fleet", "claimed %s", ticket)
         return out
+
+    def quarantine_bad(self, ticket: str, err: Exception) -> None:
+        """Move a poisoned pending submission to ``bad/`` (atomic
+        rename — a racing server loses and skips) and publish the
+        refusal evidence next to it as ``<ticket>.reason``."""
+        src = os.path.join(self.pending_dir, ticket)
+        dst = os.path.join(self.bad_dir, ticket)
+        try:
+            os.rename(src, dst)
+        except OSError:
+            return                   # raced away (claimed or re-quarantined)
+        write_json_atomic(dst + ".reason", {
+            "ticket": ticket, "error": f"{type(err).__name__}: {err}"})
+        debug.dprintf("Fleet", "quarantined bad submission %s: %s",
+                      ticket, err)
+
+    def bad_count(self) -> int:
+        """Poisoned submissions quarantined in ``bad/`` (the
+        ``campaign.fleet.submissions_bad`` stat)."""
+        return len([n for n in os.listdir(self.bad_dir)
+                    if _TICKET_RE.match(n)])
 
     def mark_done(self, ticket: str, result: dict) -> None:
         """Publish the tenant's final result document (atomic, like every
@@ -186,3 +243,137 @@ class SubmissionQueue:
             return load_json_verified(os.path.join(self.done_dir, ticket))
         except (OSError, ValueError):
             return None
+
+
+# --- single-server guard ----------------------------------------------------
+
+class LockHeld(RuntimeError):
+    """Another live server owns the fleet's lock file."""
+
+
+def _pid_alive(pid: int) -> bool:
+    if pid <= 0:
+        return False            # never signal pgid 0 / invalid pids
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True             # exists, owned by someone else
+    except OSError:
+        return False
+    return True
+
+
+class ServerLock:
+    """O_EXCL + pid lock file: one server per spool/fleet directory.
+
+    Two ``fleet.py --serve`` processes racing one spool would each win
+    half the atomic claims and split the fleet's tenants across two
+    schedulers with two journals — silently.  The lock makes the race
+    loud: the file is created with ``O_CREAT|O_EXCL`` (atomic on POSIX)
+    and records the holder's pid; a second server fails with
+    ``LockHeld``.
+
+    A **stale** lock — the recorded pid is not alive (the previous
+    server was SIGKILLed, which is exactly the hard-kill scenario the
+    journal exists for), or the content is unreadable (torn pid write)
+    — is reaped and re-raced through the same O_EXCL create, so crash
+    recovery never needs a human to rm a lock file.  Same-host pid
+    liveness only: a multi-host spool needs the elastic heartbeat
+    membership instead, and says so in README.
+    """
+
+    def __init__(self, root: str, name: str = "server.lock"):
+        os.makedirs(root, exist_ok=True)
+        self.path = os.path.join(root, name)
+        self._owned = False
+
+    def _holder(self) -> int | None:
+        try:
+            with open(self.path) as f:
+                return int(f.read().strip() or "0")
+        except (OSError, ValueError):
+            return None
+
+    def _reap_stale(self) -> None:
+        """Remove a stale lock under a reap MUTEX (its own O_EXCL file):
+        the holder re-reads the lock content before unlinking, so a
+        reaper acting on an old read can never unlink a lock another
+        server just validly acquired (the naive read-then-unlink TOCTOU
+        would split the fleet across two owners — the exact hazard the
+        lock exists to prevent)."""
+        reap = self.path + ".reap"
+        try:
+            fd = os.open(reap, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        except FileExistsError:
+            # another reaper holds the mutex; if ITS holder died between
+            # unlink(lock) and unlink(reap), clear the orphan
+            try:
+                with open(reap) as f:
+                    rpid = int(f.read().strip() or "0")
+            except (OSError, ValueError):
+                rpid = 0
+            if not _pid_alive(rpid):
+                try:
+                    os.unlink(reap)
+                except OSError:
+                    pass
+            return
+        try:
+            os.write(fd, f"{os.getpid()}\n".encode())
+        finally:
+            os.close(fd)
+        try:
+            # re-read under the mutex: only unlink if STILL stale
+            pid = self._holder()
+            if pid is None or not _pid_alive(pid):
+                try:
+                    os.unlink(self.path)
+                except OSError:
+                    pass
+        finally:
+            try:
+                os.unlink(reap)
+            except OSError:
+                pass
+
+    def acquire(self) -> "ServerLock":
+        for _ in range(8):
+            try:
+                fd = os.open(self.path,
+                             os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            except FileExistsError:
+                pid = self._holder()
+                if pid is not None and _pid_alive(pid):
+                    raise LockHeld(
+                        f"{self.path}: held by live pid {pid}")
+                # stale (dead pid / unreadable content): reap under the
+                # reap mutex, then re-race the O_EXCL create
+                self._reap_stale()
+                continue
+            try:
+                os.write(fd, f"{os.getpid()}\n".encode())
+                os.fsync(fd)
+            finally:
+                os.close(fd)
+            self._owned = True
+            debug.dprintf("Fleet", "server lock %s (pid %d)",
+                          self.path, os.getpid())
+            return self
+        raise LockHeld(f"{self.path}: could not settle lock ownership")
+
+    def release(self) -> None:
+        if not self._owned:
+            return
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+        self._owned = False
+
+    def __enter__(self) -> "ServerLock":
+        return self.acquire()
+
+    def __exit__(self, *exc) -> None:
+        self.release()

@@ -43,23 +43,50 @@ tenant's orchestrator — the scheduler rebuilds it from its last
 per-tenant checkpoint (or from scratch; frozen keys make both
 bit-identical) while every other tenant keeps running.
 
+**Survivability** (the write-ahead layer): ``fleet.json`` alone is only
+written at checkpoints, so every state transition — admit, tick-complete
+with its vtime/quota deltas, failure, quarantine, status change — is
+ALSO appended to a crash-safe journal (``service/journal.py``: fsync'd,
+checksummed, compacted into the snapshot) before the in-memory ledgers
+are trusted.  ``recover()`` replays snapshot+journal after a hard kill
+(SIGKILL/OOM) at any instruction boundary and resumes every tenant from
+its namespaced checkpoint bit-identically.  A **poison tenant** whose
+tick raises repeatedly gets a deterministic retry budget (tick-counted
+exponential backoff — no wall clock) and then a durable ``quarantined``
+status with its exception ledger persisted, never stalling the fleet or
+burning its fair share; a **livelocked** tenant is preempted by the
+per-tenant tick watchdog (``resilience.DeviceWatchdog`` deadlines) and
+routed down the same quarantine path.  All of it is provable on a
+reproducible schedule through the service-level chaos kinds
+(``kill_fleet`` / ``torn_journal`` / ``corrupt_submission``).
+
 Import discipline: jax-free at module import (the scheduler is pure
 host-side control; jax enters when a tenant's orchestrator is built).
 """
 
 from __future__ import annotations
 
+import json
 import os
 import time
 
 import numpy as np
 
+from shrewd_tpu import chaos as chaos_mod
 from shrewd_tpu import resilience as resil
 from shrewd_tpu import stats as statsmod
+from shrewd_tpu.service.journal import FleetJournal, is_dirty, journal_path
 from shrewd_tpu.service.queue import SubmissionQueue, TenantSpec, sanitize
 from shrewd_tpu.utils import debug
 
-FLEET_CKPT_VERSION = 1
+FLEET_CKPT_VERSION = 2
+
+#: snapshot versions ``recover``/``resume`` accept (v1 = pre-journal;
+#: its documents simply lack the survivability fields)
+_CKPT_VERSIONS = (1, FLEET_CKPT_VERSION)
+
+#: exception-ledger cap per tenant (the snapshot/journal carry it)
+_MAX_ERRORS = 32
 
 POLICIES = ("fair", "priority")
 
@@ -84,6 +111,21 @@ class TenantKilled(RuntimeError):
         self.rc = rc
 
 
+class FleetKilled(RuntimeError):
+    """The in-process stand-in for a fleet hard kill.
+
+    The DEFAULT action of the ``kill_fleet``/``torn_journal`` chaos
+    kinds is a true hard death (``os._exit`` — no drain, no checkpoint,
+    no atexit), which is what the CI round-trip exercises in a
+    subprocess.  Tests install ``engine.kill_action = raise FleetKilled``
+    instead, so the "dead" fleet's process survives to run
+    ``CampaignScheduler.recover()`` and assert bit-identity."""
+
+    def __init__(self, rc: int = 137):
+        super().__init__(f"fleet killed by chaos (rc {rc})")
+        self.rc = rc
+
+
 class TenantState:
     """One tenant's life in the fleet: spec + driver + ledgers."""
 
@@ -98,6 +140,9 @@ class TenantState:
         self.batches = 0             # trials // effective batch size
         self.ticks = 0               # scheduling quanta consumed
         self.kills = 0               # chaos kill_worker fires survived
+        self.failures = 0            # tick/elaboration exceptions (lifetime)
+        self.retry_at = 0            # fleet tick gating the next retry
+        self.errors: list[dict] = []  # exception ledger {tick, error}
         self.rc: int | None = None
         self.queue_latency_s = 0.0   # submit → admission
         self.wall_s = 0.0            # admission → terminal
@@ -113,7 +158,9 @@ class TenantState:
         return {"spec": self.spec.to_dict(), "order": self.order,
                 "ticket": self.ticket, "status": self.status,
                 "trials": self.trials, "batches": self.batches,
-                "ticks": self.ticks, "kills": self.kills, "rc": self.rc,
+                "ticks": self.ticks, "kills": self.kills,
+                "failures": self.failures, "errors": list(self.errors),
+                "rc": self.rc,
                 "queue_latency_s": round(self.queue_latency_s, 3),
                 "wall_s": round(self.wall_s, 3), "results": self.results}
 
@@ -131,7 +178,9 @@ class CampaignScheduler:
                  depth_budget: int = 4, policy: str = "fair",
                  queue: SubmissionQueue | None = None, certify: str = "",
                  idle_exit: bool = True, poll_interval: float = 0.2,
-                 on_tick=None):
+                 on_tick=None, chaos=None, retry_budget: int = 3,
+                 backoff_ticks: int = 2, tick_timeout: float = 0.0,
+                 compact_every: int = 64):
         if policy not in POLICIES:
             raise ValueError(f"unknown policy {policy!r} (one of {POLICIES})")
         if certify and certify not in _CERTIFY_ORDER:
@@ -145,11 +194,34 @@ class CampaignScheduler:
         self.idle_exit = idle_exit
         self.poll_interval = float(poll_interval)
         self.on_tick = on_tick
+        #: the FLEET-level chaos engine (kill_fleet / torn_journal /
+        #: corrupt_submission) — distinct from each tenant's own engine,
+        #: whose kills are rescoped to that tenant
+        self.chaos = chaos
+        #: tick-exception retries before durable quarantine; the i-th
+        #: retry waits ``backoff_ticks * 2**(i-1)`` FLEET TICKS —
+        #: deterministic, tick-counted, no wall clock in any decision
+        self.retry_budget = max(0, int(retry_budget))
+        self.backoff_ticks = max(1, int(backoff_ticks))
+        #: per-tenant tick deadline (seconds; 0 = no watchdog): a
+        #: livelocked tick is abandoned (DeviceWatchdog posture) and the
+        #: tenant takes the failure/quarantine path instead of wedging
+        #: the whole scheduler loop
+        self.tick_timeout = float(tick_timeout)
+        self.compact_every = max(1, int(compact_every))
+        self.recoveries = 0           # hard-kill recoveries survived
+        self.journal_torn = 0         # torn journal records dropped
         self.tenants: dict[str, TenantState] = {}
         self.schedule_log: list[str] = []    # tenant name per tick
         self.ticks = 0
         self._drain = False
         self.preempted = False
+        self._journal: FleetJournal | None = None
+        self._journal_floor = 0       # next_seq floor (snapshot journal_seq+1)
+        self._explicit_params: frozenset = frozenset()  # caller-pinned knobs
+        self._watchdog = (resil.DeviceWatchdog(timeout=self.tick_timeout,
+                                               name="fleet-tick")
+                          if self.tick_timeout > 0 else None)
         self._t0 = time.monotonic()
         self._build_stats()
 
@@ -217,6 +289,36 @@ class CampaignScheduler:
             "schedule_ticks",
             lambda: {n: t.ticks for n, t in self.tenants.items()},
             "scheduling quanta per tenant")
+        fg.recoveries = statsmod.Formula(
+            "recoveries", lambda: self.recoveries,
+            "hard-kill recoveries this fleet has survived "
+            "(snapshot + write-ahead-journal replay)")
+        fg.quarantined = statsmod.Formula(
+            "quarantined",
+            lambda: sum(1 for t in self.tenants.values()
+                        if t.status == "quarantined"),
+            "poison tenants parked in durable quarantine")
+        fg.tenant_failures = statsmod.Formula(
+            "tenant_failures",
+            lambda: {n: t.failures for n, t in self.tenants.items()
+                     if t.failures},
+            "tick/elaboration exceptions per tenant (the retry-budget "
+            "ledger)")
+        fg.journal_records = statsmod.Formula(
+            "journal_records",
+            lambda: self._journal.appended if self._journal else 0,
+            "write-ahead journal records fsync'd this process")
+        fg.journal_compactions = statsmod.Formula(
+            "journal_compactions",
+            lambda: self._journal.compactions if self._journal else 0,
+            "journal compactions into the fleet snapshot this process")
+        fg.journal_torn_dropped = statsmod.Formula(
+            "journal_torn_dropped", lambda: self.journal_torn,
+            "torn journal tail records dropped at the last recovery")
+        fg.submissions_bad = statsmod.Formula(
+            "submissions_bad",
+            lambda: self.queue.bad_count() if self.queue else 0,
+            "poisoned spool submissions quarantined to bad/")
 
     def _by_status(self) -> dict:
         out: dict[str, int] = {}
@@ -233,6 +335,46 @@ class CampaignScheduler:
         if not x:
             return 1.0
         return float(sum(x) ** 2 / (len(x) * sum(v * v for v in x)))
+
+    # --- the write-ahead journal ------------------------------------------
+
+    def _open_journal(self) -> FleetJournal | None:
+        """The fleet's WAL, opened lazily (no outdir → no journal, zero
+        overhead).  The seq floor comes from the snapshot so sequence
+        numbers stay monotonic across compactions and restarts."""
+        if self._journal is None and self.outdir:
+            floor = self._journal_floor
+            if floor == 0:
+                try:
+                    snap = resil.load_json_verified(os.path.join(
+                        self.outdir, "fleet_ckpt", "fleet.json"))
+                    floor = int(snap.get("journal_seq", -1)) + 1
+                except (OSError, ValueError):
+                    pass
+            self._journal = FleetJournal(journal_path(self.outdir),
+                                         next_seq=floor, chaos=self.chaos)
+            # the scheduler's own knobs must survive a kill BEFORE the
+            # first snapshot exists, so each process journals its config
+            # once at open (replay restores it; later records win)
+            self._journal.append("config", {
+                "policy": self.policy,
+                "depth_budget": self.depth_budget,
+                "retry_budget": self.retry_budget,
+                "backoff_ticks": self.backoff_ticks,
+                "tick_timeout": self.tick_timeout,
+                "compact_every": self.compact_every})
+        return self._journal
+
+    def _jlog(self, kind: str, data: dict | None = None) -> None:
+        """Durably journal one state transition BEFORE the in-memory
+        ledgers are trusted (the WAL contract), compacting into the
+        snapshot every ``compact_every`` records."""
+        j = self._open_journal()
+        if j is None:
+            return
+        j.append(kind, data)
+        if j.since_compact >= self.compact_every:
+            self.checkpoint()
 
     # --- admission --------------------------------------------------------
 
@@ -251,6 +393,8 @@ class CampaignScheduler:
             # order, trial counts and weights
             t.queue_latency_s = max(0.0, time.time() - spec.submitted_at)
         self.tenants[spec.name] = t
+        self._jlog("admit", {"tenant": spec.name, "spec": spec.to_dict(),
+                             "ticket": ticket, "order": t.order})
         debug.dprintf("Fleet", "admitted %s (priority=%d weight=%g%s)",
                       spec.name, spec.priority, spec.weight,
                       f" ticket={ticket}" if ticket else "")
@@ -307,6 +451,7 @@ class CampaignScheduler:
         t._plan_depth = max(1, int(spec_depth))
         t.driver = t.orch.stepper()
         t.status = "running"
+        self._jlog("status", {"tenant": t.spec.name, "status": "running"})
         if t._t_admit is None:
             t._t_admit = time.monotonic()
         self._rebalance()
@@ -378,6 +523,23 @@ class CampaignScheduler:
     def _poll_queue(self) -> None:
         if self.queue is None:
             return
+        if self.chaos is not None:
+            # corrupt_submission chaos: poison the scheduled pending doc
+            # in place (parses, checksum fails) so the claim path's
+            # bad-spool quarantine is provable on a schedule.  Documents
+            # that do not parse yet (in-flight submit placeholders) are
+            # not submissions: they neither consume the chaos ordinal
+            # nor crash the loop the harness exists to protect.
+            for ticket in self.queue.pending():
+                path = os.path.join(self.queue.pending_dir, ticket)
+                try:
+                    with open(path) as f:
+                        json.load(f)
+                except (OSError, ValueError):
+                    continue
+                spec = self.chaos.take_corrupt_submission()
+                if spec is not None:
+                    chaos_mod.corrupt_json_checksum(path)
         for ticket, spec in self.queue.claim():
             try:
                 self.admit(spec, ticket=ticket)
@@ -392,30 +554,81 @@ class CampaignScheduler:
     def _candidates(self) -> list[TenantState]:
         out = []
         for t in self.tenants.values():
-            if t.status == "queued":
+            if t.status == "queued" and t.retry_at <= self.ticks:
                 try:
                     self._start(t)
                 except Exception as e:  # noqa: BLE001 — tenant isolation:
                     # a plan that fails to elaborate (malformed dict,
                     # missing trace file, bad config) is THAT tenant's
-                    # failure — park it as failed with the evidence and
-                    # keep serving everyone else; a resident scheduler
-                    # must never die on one bad submission
-                    self._fail(t, e)
+                    # failure — it burns its retry budget and lands in
+                    # quarantine with the evidence while everyone else
+                    # keeps being served; a resident scheduler must
+                    # never die on one bad submission
+                    self._note_failure(t, e)
             if t.status == "running":
                 out.append(t)
         return out
 
-    def _fail(self, t: TenantState, err: Exception) -> None:
-        t.status = "failed"
-        t.results = {"error": f"{type(err).__name__}: {err}"}
-        debug.dprintf("Fleet", "%s: failed to elaborate (%s)",
-                      t.spec.name, err)
+    def _in_backoff(self) -> bool:
+        return any(t.status == "queued" and t.retry_at > self.ticks
+                   for t in self.tenants.values())
+
+    def _note_failure(self, t: TenantState, err: Exception) -> None:
+        """One tick/elaboration exception: ledger it, tear down the dead
+        driver, and either schedule a deterministic retry (exponential
+        backoff counted in FLEET TICKS — no wall clock enters any
+        decision) or quarantine the tenant for good."""
+        t.failures += 1
+        entry = {"tick": self.ticks,
+                 "error": f"{type(err).__name__}: {err}"}
+        t.errors.append(entry)
+        del t.errors[:-_MAX_ERRORS]
+        t.orch = t.driver = None
+        if t.failures > self.retry_budget:
+            self._quarantine(t)
+            return
+        delay = self.backoff_ticks * (2 ** (t.failures - 1))
+        t.retry_at = self.ticks + delay
+        t.status = "queued"
+        self._jlog("failure", {"tenant": t.spec.name,
+                               "failures": t.failures,
+                               "fleet_tick": self.ticks,
+                               "retry_at": t.retry_at,
+                               "error": entry["error"]})
+        debug.dprintf("Fleet", "%s: failure %d/%d (%s) — retry at tick "
+                      "%d", t.spec.name, t.failures, self.retry_budget,
+                      err, t.retry_at)
+        self._rebalance()
+
+    def _quarantine(self, t: TenantState) -> None:
+        """Retry budget exhausted: the tenant is poison.  Park it in a
+        DURABLE ``quarantined`` status — journal record, persisted
+        exception ledger in its namespace, done-doc for its ticket — so
+        it never stalls the fleet, never burns fair share, and never
+        silently retries across a resume/recover."""
+        t.status = "quarantined"
+        last = t.errors[-1]["error"] if t.errors else ""
+        t.results = {"error": last, "failures": t.failures}
+        t.wall_s = (time.monotonic() - t._t_admit) if t._t_admit else 0.0
+        outdir = self.tenant_outdir(t.spec.name)
+        if outdir:
+            os.makedirs(outdir, exist_ok=True)
+            resil.write_json_atomic(
+                os.path.join(outdir, "quarantine.json"),
+                {"tenant": t.spec.name, "failures": t.failures,
+                 "errors": list(t.errors)})
+        self._jlog("quarantine", {"tenant": t.spec.name,
+                                  "failures": t.failures,
+                                  "errors": list(t.errors)})
         if self.queue is not None and t.ticket:
             self.queue.mark_done(t.ticket, {
-                "tenant": t.spec.name, "status": "failed",
-                "error": str(err)})
+                "tenant": t.spec.name, "status": "quarantined",
+                "failures": t.failures, "error": last})
+        debug.dprintf("Fleet", "%s: QUARANTINED after %d failures (%s)",
+                      t.spec.name, t.failures, last)
         self._rebalance()
+        if self.outdir:
+            self.checkpoint()
 
     def _pick(self, cands: list[TenantState]) -> TenantState:
         top = max(t.spec.priority for t in cands)
@@ -434,6 +647,8 @@ class CampaignScheduler:
         bit-identical either way."""
         t.kills += 1
         debug.dprintf("Fleet", "%s: %s — rebuilding tenant", t.spec.name, e)
+        self._jlog("tenant_kill", {"tenant": t.spec.name,
+                                   "kills": t.kills})
         engine = t.orch.chaos
         t.status = "queued"
         t.orch = t.driver = None
@@ -442,23 +657,37 @@ class CampaignScheduler:
 
     def _tick_tenant(self, t: TenantState) -> None:
         try:
-            t.driver.tick()
+            if self._watchdog is not None:
+                # per-tenant tick watchdog: a livelocked tick (wedged
+                # host loop, runaway elaboration) is abandoned at the
+                # deadline (DispatchTimeout) instead of wedging the
+                # whole scheduler; the failure path below quarantines
+                # repeat offenders
+                self._watchdog.call(t.driver.tick)
+            else:
+                t.driver.tick()
         except TenantKilled as e:
             self._handle_kill(t, e)
             return
         except Exception as e:  # noqa: BLE001 — tenant isolation: an
             # exception escaping the event stream is unrecoverable FOR
-            # THIS TENANT (lazy elaboration of a bad plan at first tick,
-            # a missing trace file, a config the models reject — the
-            # ladder/integrity layers already absorbed everything
-            # transient inside the generator).  Park the tenant as
-            # failed with the evidence; the fleet keeps serving.
-            self._fail(t, e)
+            # THIS TENANT'S DRIVER (lazy elaboration of a bad plan at
+            # first tick, a missing trace file, a config the models
+            # reject, a livelock deadline — the ladder/integrity layers
+            # already absorbed everything transient inside the
+            # generator).  Ledger it; retry on a tick-counted backoff;
+            # quarantine when the budget is gone.  The fleet keeps
+            # serving either way.
+            self._note_failure(t, e)
             return
         t.ticks += 1
         trials = sum(st.trials for st in t.orch.state.values())
         t.trials = trials
         t.batches = trials // max(t.orch.batch_size, 1)
+        self._jlog("tick", {"tenant": t.spec.name,
+                            "fleet_tick": self.ticks,
+                            "trials": t.trials, "batches": t.batches,
+                            "ticks": t.ticks, "kills": t.kills})
         if t.driver.done:
             self._finalize(t)
             return
@@ -491,6 +720,11 @@ class CampaignScheduler:
                     t.orch.chaos.note_survived("kill_worker")
         t.wall_s = (time.monotonic() - t._t_admit) if t._t_admit else 0.0
         t.results = self._summarize(t)
+        self._jlog("status", {"tenant": t.spec.name, "status": t.status,
+                              "rc": t.rc, "trials": t.trials,
+                              "batches": t.batches,
+                              "wall_s": round(t.wall_s, 3),
+                              "results": t.results})
         t.orch.write_outputs()
         if t.orch.outdir and t.status == "complete":
             t.orch.checkpoint()          # the final-state dump _drive writes
@@ -534,9 +768,22 @@ class CampaignScheduler:
         while True:
             if self._drain:
                 return self._drain_all()
+            if self.chaos is not None:
+                # kill_fleet at a tick ordinal: the hard kill lands at
+                # the instruction boundary between ticks — nothing
+                # drains, nothing checkpoints; the journal is the only
+                # survivor (which is the point)
+                self.chaos.maybe_kill_fleet(tick=self.ticks)
             self._poll_queue()
             cands = self._candidates()
             if not cands:
+                if self._in_backoff():
+                    # a tenant waits out its retry backoff and nothing
+                    # else is runnable: consume an idle quantum — the
+                    # backoff is counted in fleet ticks, so idling must
+                    # advance them (deterministic, clock-free)
+                    self.ticks += 1
+                    continue
                 if self.queue is not None and not self.idle_exit:
                     time.sleep(self.poll_interval)
                     continue
@@ -549,6 +796,7 @@ class CampaignScheduler:
                 self.on_tick(self)
         self.write_outputs()
         if self.outdir:
+            self._jlog("shutdown", {"statuses": self._by_status()})
             self.checkpoint()
         if any(t.status == "aborted" for t in self.tenants.values()):
             return 3
@@ -562,7 +810,7 @@ class CampaignScheduler:
         for t in self.tenants.values():
             if t.status == "running":
                 t.driver.request_drain()
-                while not t.driver.done:
+                while t.driver is not None and not t.driver.done:
                     self.ticks += 1
                     t.ticks += 1
                     try:
@@ -578,13 +826,16 @@ class CampaignScheduler:
                         t.driver.request_drain()
                     except Exception as e:  # noqa: BLE001 — isolation,
                         # as in _tick_tenant: a dead tenant must not
-                        # stop the rest of the fleet from draining
-                        self._fail(t, e)
+                        # stop the rest of the fleet from draining (it
+                        # keeps its retry budget for the resumed fleet)
+                        self._note_failure(t, e)
                         break
                 if t.status == "running":
                     self._finalize(t)
         self.write_outputs()
         if self.outdir:
+            self._jlog("shutdown", {"drained": True,
+                                    "statuses": self._by_status()})
             self.checkpoint()
         debug.dprintf("Fleet", "fleet drained: %s", self._by_status())
         return 4
@@ -619,47 +870,219 @@ class CampaignScheduler:
         the campaign-checkpoint discipline): tenant specs, statuses,
         fair-share ledgers and result summaries.  Per-tenant campaign
         state lives in each tenant's namespaced checkpoint; this document
-        only has to say who exists and where they stand."""
+        only has to say who exists and where they stand.  A durable
+        snapshot compacts the write-ahead journal behind it (the
+        snapshot-first ordering makes a crash between the two leave
+        duplicates — skipped by seq at replay — never a gap)."""
         ckpt_dir = os.path.join(self.outdir, "fleet_ckpt")
         os.makedirs(ckpt_dir, exist_ok=True)
         doc = {"version": FLEET_CKPT_VERSION, "policy": self.policy,
                "depth_budget": self.depth_budget, "ticks": self.ticks,
+               "retry_budget": self.retry_budget,
+               "backoff_ticks": self.backoff_ticks,
+               "tick_timeout": self.tick_timeout,
+               "compact_every": self.compact_every,
+               "recoveries": self.recoveries,
+               "journal_seq": (self._journal.next_seq - 1
+                               if self._journal is not None else
+                               self._journal_floor - 1),
                "tenants": [t.to_dict() for t in self.tenants.values()]}
         doc["checksum"] = resil.doc_checksum(doc)
         resil.write_json_atomic(os.path.join(ckpt_dir, "fleet.json"), doc)
+        if self._journal is not None:
+            self._journal.compact()
         return ckpt_dir
+
+    def _admit_from_dict(self, td: dict) -> TenantState:
+        """Rebuild one TenantState from a snapshot/journal document —
+        the replay path, which must NOT re-journal the admission."""
+        spec = TenantSpec.from_dict(td["spec"])
+        t = TenantState(spec, order=int(td.get("order", len(self.tenants))),
+                        ticket=td.get("ticket", ""))
+        t.status = td.get("status", "queued")
+        t.trials = int(td.get("trials", 0))
+        t.batches = int(td.get("batches", 0))
+        t.ticks = int(td.get("ticks", 0))
+        t.kills = int(td.get("kills", 0))
+        t.failures = int(td.get("failures", 0))
+        t.errors = list(td.get("errors") or [])
+        t.rc = td.get("rc")
+        t.results = td.get("results")
+        t.queue_latency_s = float(td.get("queue_latency_s", 0.0))
+        t.wall_s = float(td.get("wall_s", 0.0))
+        self.tenants[spec.name] = t
+        return t
+
+    def _apply_record(self, r: dict) -> None:
+        """Replay one journal record onto the tenant table (idempotent:
+        records carry absolute values, not deltas)."""
+        kind = r.get("kind")
+        if kind == "config":
+            if "policy" in r and "policy" not in self._explicit_params:
+                self.policy = str(r["policy"])
+            for k, cast in (("depth_budget", int), ("retry_budget", int),
+                            ("backoff_ticks", int), ("compact_every", int),
+                            ("tick_timeout", float)):
+                if k in r and k not in self._explicit_params:
+                    setattr(self, k, cast(r[k]))
+            self._watchdog = (resil.DeviceWatchdog(
+                timeout=self.tick_timeout, name="fleet-tick")
+                if self.tick_timeout > 0 else None)
+            return
+        if kind == "admit":
+            if r.get("tenant") not in self.tenants:
+                self._admit_from_dict({"spec": r["spec"],
+                                       "order": r.get("order", 0),
+                                       "ticket": r.get("ticket", ""),
+                                       "status": "queued"})
+            return
+        t = self.tenants.get(r.get("tenant", ""))
+        if t is None:
+            return
+        if kind == "tick":
+            t.trials = int(r.get("trials", t.trials))
+            t.batches = int(r.get("batches", t.batches))
+            t.ticks = int(r.get("ticks", t.ticks))
+            t.kills = int(r.get("kills", t.kills))
+            self.ticks = max(self.ticks, int(r.get("fleet_tick", 0)))
+        elif kind == "failure":
+            t.failures = int(r.get("failures", t.failures))
+            t.errors.append({"tick": r.get("fleet_tick", 0),
+                             "error": r.get("error", "")})
+            del t.errors[:-_MAX_ERRORS]
+            t.status = "queued"
+            self.ticks = max(self.ticks, int(r.get("fleet_tick", 0)))
+        elif kind == "quarantine":
+            t.status = "quarantined"
+            t.failures = int(r.get("failures", t.failures))
+            t.errors = list(r.get("errors") or t.errors)
+            last = t.errors[-1]["error"] if t.errors else ""
+            t.results = {"error": last, "failures": t.failures}
+        elif kind == "tenant_kill":
+            t.kills = int(r.get("kills", t.kills))
+        elif kind == "status":
+            t.status = r.get("status", t.status)
+            if "rc" in r:
+                t.rc = r["rc"]
+            if "trials" in r:
+                t.trials = int(r["trials"])
+            if "batches" in r:
+                t.batches = int(r["batches"])
+            if "results" in r:
+                t.results = r["results"]
+            if "wall_s" in r:
+                t.wall_s = float(r["wall_s"])
+        # "shutdown" / "recover" records are informational
+
+    @classmethod
+    def recover(cls, outdir: str, mesh=None,
+                queue: SubmissionQueue | None = None,
+                **kw) -> "CampaignScheduler":
+        """Rebuild a fleet after ANY shutdown — graceful drain or hard
+        kill — by replaying ``fleet_ckpt/fleet.json`` plus every journal
+        record beyond it.  Terminal tenants (complete/aborted/quota/
+        quarantined) keep their recorded state; resumable ones are
+        re-queued and continue from their namespaced campaign
+        checkpoints on the next ``run()`` — bit-identical to an
+        undisturbed fleet, because per-batch tallies are pure functions
+        of their frozen PRNG keys no matter where the kill landed.  The
+        (possibly torn) journal is immediately folded into a fresh
+        snapshot, so recovery is itself crash-safe."""
+        ckpt_dir = os.path.join(outdir, "fleet_ckpt")
+        snap_path = os.path.join(ckpt_dir, "fleet.json")
+        snap = None
+        if os.path.exists(snap_path):
+            snap = resil.load_json_verified(snap_path)
+            if snap.get("version") not in _CKPT_VERSIONS:
+                raise ValueError(
+                    f"fleet checkpoint version {snap.get('version')} "
+                    f"not in {_CKPT_VERSIONS}")
+        jpath = journal_path(outdir)
+        records, torn, _valid = (FleetJournal.replay_path(jpath)
+                                 if os.path.exists(jpath) else ([], 0, 0))
+        snap_seq = int(snap.get("journal_seq", -1)) if snap else -1
+        fresh = [r for r in records if int(r["seq"]) > snap_seq]
+        # a lone config record is just this-or-a-prior open's preamble,
+        # not un-replayed fleet state
+        dirty = any(r["kind"] != "config" for r in fresh) or torn > 0
+        explicit = frozenset(
+            k for k in ("depth_budget", "policy", "retry_budget",
+                        "backoff_ticks", "tick_timeout", "compact_every")
+            if k in kw)
+
+        def _p(name, default):
+            return kw.pop(name, snap.get(name, default) if snap
+                          else default)
+
+        sched = cls(outdir=outdir, mesh=mesh, queue=queue,
+                    depth_budget=_p("depth_budget", 4),
+                    policy=_p("policy", "fair"),
+                    retry_budget=_p("retry_budget", 3),
+                    backoff_ticks=_p("backoff_ticks", 2),
+                    tick_timeout=_p("tick_timeout", 0.0),
+                    compact_every=_p("compact_every", 64), **kw)
+        sched._explicit_params = explicit
+        sched.journal_torn = torn
+        if snap:
+            sched.recoveries = int(snap.get("recoveries", 0))
+            sched.ticks = int(snap.get("ticks", 0))
+            for td in sorted(snap["tenants"], key=lambda d: d["order"]):
+                sched._admit_from_dict(td)
+        for r in fresh:
+            sched._apply_record(r)
+        for t in sched.tenants.values():
+            if t.status in _RESUMABLE:
+                t.status = "queued"    # _start resumes from its ckpt
+                t.retry_at = 0         # a recovery re-arms retries NOW;
+                #                        the failure count survives, so a
+                #                        poison tenant cannot mine a fresh
+                #                        budget out of every crash
+            elif (queue is not None and t.ticket
+                    and t.status in ("complete", "aborted", "quota",
+                                     "quarantined")
+                    and queue.done(t.ticket) is None):
+                # the kill landed between the terminal journal record
+                # and mark_done: the replayed state is authoritative, so
+                # publish the done-doc now or the submitter's ticket
+                # would stay claimed (and unanswered) forever
+                queue.mark_done(t.ticket, {
+                    "tenant": t.spec.name, "status": t.status,
+                    "rc": t.rc, "trials": t.trials,
+                    "batches": t.batches, "failures": t.failures,
+                    "wall_s": round(t.wall_s, 3), "results": t.results})
+        sched._journal_floor = max(
+            snap_seq + 1, (records[-1]["seq"] + 1) if records else 0)
+        sched._open_journal()
+        if dirty:
+            sched.recoveries += 1
+            sched._jlog("recover", {"recoveries": sched.recoveries,
+                                    "replayed": len(fresh),
+                                    "torn_dropped": torn})
+            debug.dprintf("Fleet", "recovered dirty fleet: %d journal "
+                          "records replayed, %d torn dropped",
+                          len(fresh), torn)
+        # fold the replayed state (and the recover record) into a fresh
+        # snapshot and truncate the (possibly torn) journal before any
+        # new work appends to it — recovery is itself crash-safe, and a
+        # recovered-then-idle fleet reads as clean
+        sched.checkpoint()
+        return sched
 
     @classmethod
     def resume(cls, outdir: str, mesh=None,
                queue: SubmissionQueue | None = None,
                **kw) -> "CampaignScheduler":
-        """Rebuild a drained fleet from ``outdir/fleet_ckpt/fleet.json``:
-        terminal tenants keep their recorded results; resumable ones
-        (queued/running/preempted) are re-admitted and continue from
-        their namespaced checkpoints on the next ``run()``."""
-        doc = resil.load_json_verified(
-            os.path.join(outdir, "fleet_ckpt", "fleet.json"))
-        if doc.get("version") != FLEET_CKPT_VERSION:
+        """Rebuild a CLEANLY drained fleet from its snapshot.  Refuses a
+        dirty shutdown (journal records beyond the snapshot — the
+        hard-kill signature) so un-replayed state is never silently
+        discarded; ``recover()`` is the superset that handles both."""
+        snap_path = os.path.join(outdir, "fleet_ckpt", "fleet.json")
+        if is_dirty(outdir):
             raise ValueError(
-                f"fleet checkpoint version {doc.get('version')} != "
-                f"{FLEET_CKPT_VERSION}")
-        sched = cls(outdir=outdir, mesh=mesh, queue=queue,
-                    depth_budget=kw.pop("depth_budget",
-                                        doc["depth_budget"]),
-                    policy=kw.pop("policy", doc["policy"]), **kw)
-        for td in sorted(doc["tenants"], key=lambda d: d["order"]):
-            spec = TenantSpec.from_dict(td["spec"])
-            t = sched.admit(spec, ticket=td.get("ticket", ""))
-            t.trials = int(td.get("trials", 0))
-            t.batches = int(td.get("batches", 0))
-            t.kills = int(td.get("kills", 0))
-            t.queue_latency_s = float(td.get("queue_latency_s", 0.0))
-            status = td.get("status", "queued")
-            if status in _RESUMABLE:
-                t.status = "queued"      # _start resumes from its ckpt
-            else:
-                t.status = status
-                t.rc = td.get("rc")
-                t.results = td.get("results")
-                t.wall_s = float(td.get("wall_s", 0.0))
-        return sched
+                f"{outdir}: dirty shutdown detected (journal holds "
+                "records beyond the snapshot) — resume would lose "
+                "state; use CampaignScheduler.recover() / "
+                "fleet.py --recover")
+        if not os.path.exists(snap_path):
+            raise FileNotFoundError(f"{snap_path}: no fleet checkpoint")
+        return cls.recover(outdir, mesh=mesh, queue=queue, **kw)

@@ -89,6 +89,14 @@ QUICK_MODULES = {
     # compiles through the shared executable cache, so the module is
     # compile-dominated once like its predecessors
     "test_fleet",
+    # fleet survivability: journal/lock/spool units plus the hard-kill →
+    # recover bit-identity integrations (kill_fleet at tick/journal
+    # ordinals, torn journal tail, poison-tenant quarantine, livelock
+    # watchdog) — the whole module reuses test_fleet's tiny-kernel
+    # compiles through the shared executable cache (~12 s total), and
+    # the crash-recovery smoke belongs in the on-every-push tier for
+    # the same reason the chaos/integrity smokes do
+    "test_fleet_survive",
 }
 QUICK_TESTS = {
     # one representative per subsystem (≈4-10 s each, compile-dominated)

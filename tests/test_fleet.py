@@ -265,11 +265,13 @@ def test_kill_worker_rescopes_to_tenant_and_recovers(tmp_path):
     _assert_tenant_matches(sched, "bystander", by_solo)
 
 
-def test_bad_tenant_fails_in_isolation(tmp_path):
+def test_bad_tenant_quarantines_in_isolation(tmp_path):
     # a plan that cannot elaborate (missing trace file) is THAT
-    # tenant's failure: parked as "failed" with the evidence, its spool
-    # ticket resolved, and every other tenant still served — a resident
-    # scheduler must never die on one bad submission
+    # tenant's failure: it burns its retry budget (tick-counted
+    # backoff), lands in durable "quarantined" with the exception
+    # ledger as evidence, its spool ticket resolved, and every other
+    # tenant still served — a resident scheduler must never die on one
+    # bad submission
     from shrewd_tpu.campaign.plan import CampaignPlan, TraceFileSpec
 
     q = SubmissionQueue(str(tmp_path / "spool"))
@@ -279,13 +281,15 @@ def test_bad_tenant_fails_in_isolation(tmp_path):
         min_trials=64)
     ticket = q.submit(TenantSpec(name="bad", plan=bad.to_dict()))
     good_solo = _solo_tallies(_plan(3, n_batches=3))
-    sched = CampaignScheduler(queue=q)
+    sched = CampaignScheduler(queue=q, retry_budget=1, backoff_ticks=1)
     sched.admit(TenantSpec(name="good", plan=_plan(3,
                                                    n_batches=3).to_dict()))
     assert sched.run() == 0
-    assert sched.tenants["bad"].status == "failed"
-    assert "error" in sched.tenants["bad"].results
-    assert q.done(ticket)["status"] == "failed"
+    t = sched.tenants["bad"]
+    assert t.status == "quarantined"
+    assert t.failures == 2                    # initial try + 1 retry
+    assert len(t.errors) == 2 and "error" in t.results
+    assert q.done(ticket)["status"] == "quarantined"
     _assert_tenant_matches(sched, "good", good_solo)
 
 
@@ -462,8 +466,8 @@ def test_graftlint_gl101_covers_service():
 
     cfg = load_config(os.path.join(os.path.dirname(__file__), ".."))
     for f in ("shrewd_tpu/service/scheduler.py",
-              "shrewd_tpu/service/queue.py"):
+              "shrewd_tpu/service/queue.py",
+              "shrewd_tpu/service/journal.py"):
         assert f in cfg.jit_modules
         assert f in cfg.checkpoint_modules
-    assert "shrewd_tpu/service/scheduler.py" in cfg.deterministic_modules
-    assert "shrewd_tpu/service/queue.py" in cfg.deterministic_modules
+        assert f in cfg.deterministic_modules

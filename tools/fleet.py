@@ -20,8 +20,17 @@ Three modes:
 
       python tools/fleet.py --plans a.json b.json --outdir fleet_out
 
-``--resume fleet_out`` rebuilds a drained fleet from its checkpoint and
-continues every resumable tenant.
+``--resume fleet_out`` rebuilds a CLEANLY drained fleet from its
+checkpoint; ``--recover fleet_out`` replays checkpoint + write-ahead
+journal after a HARD kill (SIGKILL/OOM — ``service/journal.py``) and
+continues every resumable tenant bit-identically.  ``--resume``
+auto-detects a dirty shutdown and routes to recovery; ``--serve`` over
+a dirty outdir refuses (run ``--recover`` first).  Every server mode
+takes an O_EXCL+pid lock on the spool (or fleet) directory so two
+servers cannot double-claim one fleet; a lock whose pid is dead is
+reaped automatically.  ``--chaos-plan`` arms the service-level chaos
+kinds (``kill_fleet`` / ``torn_journal`` / ``corrupt_submission``) for
+reproducible survivability drills.
 """
 
 from __future__ import annotations
@@ -60,37 +69,77 @@ def _report(sched) -> None:
     for name, t in sched.tenants.items():
         _log(f"  {name}: {t.status} (rc={t.rc}, {t.trials} trials, "
              f"{t.ticks} ticks, {t.wall_s:.1f}s"
-             + (f", {t.kills} kills survived" if t.kills else "") + ")")
+             + (f", {t.kills} kills survived" if t.kills else "")
+             + (f", {t.failures} failures" if t.failures else "") + ")")
     _log(f"fleet: {sched.ticks} ticks, fairness "
-         f"{sched.fairness_index():.3f}, statuses {sched._by_status()}")
+         f"{sched.fairness_index():.3f}, statuses {sched._by_status()}"
+         + (f", {sched.recoveries} recoveries" if sched.recoveries
+            else ""))
 
 
 def cmd_serve(a) -> int:
-    from shrewd_tpu.service import (CampaignScheduler, SubmissionQueue,
-                                    TenantSpec)
+    from shrewd_tpu.service import (CampaignScheduler, LockHeld,
+                                    ServerLock, SubmissionQueue,
+                                    TenantSpec, is_dirty)
 
     queue = SubmissionQueue(a.queue) if a.queue else None
-    if a.resume:
-        sched = CampaignScheduler.resume(
-            a.resume, queue=queue, certify=a.certify,
-            idle_exit=not a.stay_resident)
-    else:
-        sched = CampaignScheduler(
-            outdir=a.outdir, queue=queue, depth_budget=a.depth_budget,
-            policy=a.policy, certify=a.certify,
-            idle_exit=not a.stay_resident)
-    for i, path in enumerate(a.plans):
-        with open(path) as f:
-            plan = json.load(f)
-        name = f"t{i}_{os.path.splitext(os.path.basename(path))[0]}"
-        sched.admit(TenantSpec(name=name, plan=plan))
-    restore = sched.install_signal_handlers()
+    chaos = None
+    if a.chaos_plan:
+        from shrewd_tpu.chaos import ChaosEngine
+
+        chaos = ChaosEngine.from_path(a.chaos_plan, worker="fleet")
+    # single-server guard: one O_EXCL+pid lock per spool (or, spool-less,
+    # per fleet dir) — two servers racing one fleet would silently split
+    # its tenants across two schedulers and two journals
+    lock = ServerLock(a.queue or a.recover or a.resume or a.outdir)
     try:
-        rc = sched.run()
+        lock.acquire()
+    except LockHeld as e:
+        _log(f"another server owns this fleet: {e}")
+        return 2
+    try:
+        common = dict(queue=queue, certify=a.certify,
+                      idle_exit=not a.stay_resident, chaos=chaos)
+        # only explicit CLI values override the snapshot's persisted
+        # knobs on --resume/--recover (argparse default is None)
+        if a.retry_budget is not None:
+            common["retry_budget"] = a.retry_budget
+        if a.tick_timeout is not None:
+            common["tick_timeout"] = a.tick_timeout
+        if a.recover:
+            sched = CampaignScheduler.recover(a.recover, **common)
+            _log(f"recovered fleet: {sched.recoveries} recoveries, "
+                 f"{sched.journal_torn} torn journal records dropped")
+        elif a.resume:
+            if is_dirty(a.resume):
+                _log("dirty shutdown detected (journal ahead of "
+                     "snapshot) — recovering")
+                sched = CampaignScheduler.recover(a.resume, **common)
+            else:
+                sched = CampaignScheduler.resume(a.resume, **common)
+        else:
+            if is_dirty(a.outdir):
+                _log(f"{a.outdir}: dirty shutdown detected — refusing "
+                     "to serve over un-recovered state; run --recover "
+                     "first")
+                return 2
+            sched = CampaignScheduler(
+                outdir=a.outdir, depth_budget=a.depth_budget,
+                policy=a.policy, **common)
+        for i, path in enumerate(a.plans):
+            with open(path) as f:
+                plan = json.load(f)
+            name = f"t{i}_{os.path.splitext(os.path.basename(path))[0]}"
+            sched.admit(TenantSpec(name=name, plan=plan))
+        restore = sched.install_signal_handlers()
+        try:
+            rc = sched.run()
+        finally:
+            restore()
+        _report(sched)
+        return rc
     finally:
-        restore()
-    _report(sched)
-    return rc
+        lock.release()
 
 
 def main(argv=None) -> int:
@@ -108,7 +157,25 @@ def main(argv=None) -> int:
                     help="fleet artifact root (per-tenant namespaces under "
                          "tenants/, fleet checkpoint under fleet_ckpt/)")
     ap.add_argument("--resume", default="",
-                    help="resume a drained fleet from this outdir")
+                    help="resume a drained fleet from this outdir "
+                         "(auto-recovers on a detected dirty shutdown)")
+    ap.add_argument("--recover", default="",
+                    help="replay checkpoint + write-ahead journal after "
+                         "a hard kill and continue the fleet")
+    ap.add_argument("--chaos-plan", default="",
+                    help="fleet-level chaos plan JSON (kill_fleet / "
+                         "torn_journal / corrupt_submission) for "
+                         "reproducible survivability drills")
+    ap.add_argument("--retry-budget", type=int, default=None,
+                    help="tick-exception retries per tenant before "
+                         "durable quarantine (backoff is tick-counted "
+                         "exponential; default 3, resume/recover keep "
+                         "the snapshot's value unless overridden)")
+    ap.add_argument("--tick-timeout", type=float, default=None,
+                    help="per-tenant tick watchdog deadline seconds "
+                         "(0 = off, the default): a livelocked tenant "
+                         "is preempted and quarantined instead of "
+                         "wedging the fleet")
     ap.add_argument("--depth-budget", type=int, default=4,
                     help="global dispatch-depth budget shared by running "
                          "tenants")
@@ -143,7 +210,7 @@ def main(argv=None) -> int:
         jax.config.update("jax_platforms", a.platform)
     if a.submit:
         return cmd_submit(a)
-    if a.serve or a.plans or a.resume:
+    if a.serve or a.plans or a.resume or a.recover:
         return cmd_serve(a)
     ap.print_help()
     return 2
