@@ -183,12 +183,14 @@ print("fleet survive smoke: hard kill at tick 5 -> --recover -> "
       "3 tenants complete, tallies bit-identical to solo")
 SURVIVE_SMOKE
 
-# Non-fatal pipelined-bench smoke: bench.py --quick includes the
-# serial-vs-pipelined campaign-loop microbenchmark (warm executable cache,
-# best-of-2 per arm, bit-identity asserted) — the recorded BENCH_r06.json
-# keeps the speedup observable in the trajectory artifacts alongside the
+# Non-fatal bench smoke: bench.py --quick includes the serial-vs-
+# pipelined campaign-loop microbenchmark AND the until-CI convergence
+# microbenchmark (host stopping loop vs the device-resident fused
+# lax.while_loop — wall-clock + host round-trip counts per converged
+# campaign, bit-identity asserted fatally) — the recorded BENCH_r08.json
+# keeps both observable in the trajectory artifacts alongside the
 # earlier BENCH_r0X files.  Never affects the pass/fail status.
-timeout -k 10 560 env JAX_PLATFORMS=cpu python bench.py --quick > BENCH_r06.json \
-  || echo "WARNING: pipelined bench smoke failed (non-fatal)"
+timeout -k 10 560 env JAX_PLATFORMS=cpu python bench.py --quick > BENCH_r08.json \
+  || echo "WARNING: bench smoke failed (non-fatal)"
 
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

@@ -69,6 +69,76 @@ def _batch_args(camp, B: int):
                                                   B)),)
 
 
+def _until_ci_args(camp, S: int, B: int):
+    """Example args for the device-resident until-CI while-loop step:
+    the staged key stack plus the replicated cumulative-state/params
+    inputs (initial tallies [+ strata], integer and float stopping
+    params)."""
+    import jax.numpy as jnp
+
+    from shrewd_tpu.ops import classify as C
+    from shrewd_tpu.parallel.mesh import replicated
+    from shrewd_tpu.parallel.stopping import z_value
+
+    kd_sh = _interval_args(camp, S, B)[0]
+    tal0 = replicated(camp.mesh, jnp.zeros(C.N_OUTCOMES, jnp.int32))
+    if camp.stratify:
+        from shrewd_tpu.ops.trial import N_STRATA
+
+        strat0 = replicated(camp.mesh,
+                            jnp.zeros((N_STRATA, C.N_OUTCOMES), jnp.int32))
+    else:
+        strat0 = replicated(camp.mesh, jnp.int32(0))
+    iparams = replicated(camp.mesh, jnp.asarray([0, 1000], jnp.int32))
+    fparams = replicated(camp.mesh, jnp.asarray(
+        [0.01, z_value(0.95)], jnp.float32))
+    return (kd_sh, tal0, strat0, iparams, fparams)
+
+
+def violating_until_ci_step(camp, S: int):
+    """The until-CI seeded-violation fixture: the while-loop body with a
+    ``jax.debug.print`` smuggled in — a hidden host callback per
+    iteration, so the static transfer count is 2 > the 1-per-
+    super-interval budget.  The auditor MUST reject it."""
+    import jax
+    import jax.numpy as jnp
+
+    from shrewd_tpu.ops import classify as C
+    from shrewd_tpu.parallel.stopping import (should_stop_device,
+                                              wilson_halfwidth_device)
+
+    kernel, structure = camp.kernel, camp.structure
+
+    def broken(kd, tal0, strat0, iparams, fparams):
+        del strat0
+
+        def cond(carry):
+            i, _t, done = carry
+            return jnp.logical_and(i < S, jnp.logical_not(done))
+
+        def body(carry):
+            i, t, _done = carry
+            keys = jax.random.wrap_key_data(kd[i])
+            outs = kernel.outcomes_from_keys(keys, structure)
+            t = t + C.tally(outs)
+            jax.debug.print("tally={t}", t=t)     # the smuggled side effect
+            cum = tal0 + t
+            trials = iparams[0] + (i + 1) * kd.shape[1]
+            hw = wilson_halfwidth_device(
+                cum[C.OUTCOME_SDC] + cum[C.OUTCOME_DUE], trials,
+                fparams[1])
+            return (i + 1, t,
+                    should_stop_device(hw, trials, fparams[0], iparams[1]))
+
+        _i, t, _done = jax.lax.while_loop(
+            cond, body,
+            (jnp.int32(0), jnp.zeros(C.N_OUTCOMES, jnp.int32),
+             jnp.bool_(False)))
+        return t
+
+    return broken
+
+
 def violating_interval_step(camp, S: int):
     """The seeded-violation fixture: the interval step's scan body with a
     ``jax.debug.print`` inside — one hidden host callback, so the static
@@ -114,17 +184,31 @@ def certify_standard_executables(transfer_budget: int = 1,
             camp._build_interval_step(sync_every),
             _interval_args(camp, sync_every, batch_size),
             kind=f"{name}/interval", transfer_budget=transfer_budget)
+        # the device-resident until-CI while-loop step (the fused
+        # stopping rule): the whole super-interval — batches consumed,
+        # half-widths evaluated, the exit decision — must certify at the
+        # same ONE-transfer budget as the scan it wraps
+        certs[f"{name}/until_ci"] = audit_callable(
+            camp._build_until_ci_step(sync_every,
+                                      strat_rule=camp.stratify),
+            _until_ci_args(camp, sync_every, batch_size),
+            kind=f"{name}/until_ci", transfer_budget=transfer_budget)
     # pipelined-interval is the hybrid interval step (the engine's hot
     # path); alias it under the name the acceptance criteria use
     certs["pipelined/interval"] = certs["hybrid/interval"]
-    # the fixture that must FAIL
+    # the fixtures that must FAIL
     _, dense_camp = camps[0]
     broken_cert = audit_callable(
         violating_interval_step(dense_camp, sync_every),
         (_interval_args(dense_camp, sync_every, batch_size)[0],),
         kind="fixture/broken-interval", transfer_budget=transfer_budget)
     fixture_rejected = not broken_cert["ok"]
-    ok = fixture_rejected and all(
+    broken_ci_cert = audit_callable(
+        violating_until_ci_step(dense_camp, sync_every),
+        _until_ci_args(dense_camp, sync_every, batch_size),
+        kind="fixture/broken-until-ci", transfer_budget=transfer_budget)
+    ci_fixture_rejected = not broken_ci_cert["ok"]
+    ok = fixture_rejected and ci_fixture_rejected and all(
         c["ok"] and c["transfers"] <= transfer_budget
         for name, c in certs.items())
     return {
@@ -133,4 +217,6 @@ def certify_standard_executables(transfer_budget: int = 1,
         "certificates": certs,
         "violation_fixture": broken_cert,
         "fixture_rejected": fixture_rejected,
+        "until_ci_violation_fixture": broken_ci_cert,
+        "until_ci_fixture_rejected": ci_fixture_rejected,
     }

@@ -311,6 +311,11 @@ class Orchestrator:
         self._perf = pipeline_mod.PerfStats()
         self._engines: dict[tuple[int, str],
                             pipeline_mod.PipelinedEngine] = {}
+        # device-resident run-until-CI engines (pcfg.until_ci): the
+        # stopping rule fused into the jitted step — one transfer per
+        # super-interval instead of one per sync interval
+        self._ci_engines: dict[tuple[int, str],
+                               pipeline_mod.UntilCIEngine] = {}
         if self.pcfg.compilation_cache_dir:
             exec_cache.enable_persistent_cache(
                 self.pcfg.compilation_cache_dir)
@@ -529,6 +534,25 @@ class Orchestrator:
         pg.serial_fallbacks = statsmod.Formula(
             "serial_fallbacks", lambda: perf.serial_fallbacks,
             "intervals recovered through the serial per-batch ladder")
+        # device-resident run-until-CI accounting: the fused stopping rule
+        # is a host-round-trip optimization, so the round trips SAVED and
+        # the planner's behavior are first-class observables
+        pg.super_intervals = statsmod.Formula(
+            "super_intervals", lambda: perf.super_intervals,
+            "until-CI super-intervals believed through the fused "
+            "device-resident stopping loop")
+        pg.host_roundtrips_saved = statsmod.Formula(
+            "host_roundtrips_saved", lambda: perf.host_roundtrips_saved,
+            "device->host transfers avoided vs the per-batch host loop "
+            "(batches consumed minus one per super-interval)")
+        pg.hw_trajectory_final = statsmod.Formula(
+            "hw_trajectory_final", lambda: perf.hw_trajectory_final,
+            "last half-width the device-resident stopping rule observed "
+            "(NaN until a super-interval has run; stats.json nulls it)")
+        pg.auto_sync_every = statsmod.Formula(
+            "auto_sync_every", lambda: perf.auto_sync_every,
+            "last super-interval budget the half-width-trajectory "
+            "planner chose (the auto-tuned effective sync_every)")
         pg.executables_compiled = statsmod.Formula(
             "executables_compiled", lambda: exec_cache.cache().compiled,
             "campaign-step executables compiled (process-wide cache)")
@@ -694,6 +718,27 @@ class Orchestrator:
                 sp_name=sp_name, structure=structure)
         return self._engines[key]
 
+    def until_ci_engine(self, sp_idx: int, sp_name: str, structure: str
+                        ) -> pipeline_mod.UntilCIEngine:
+        """The device-resident until-CI engine for one campaign: shares
+        the orchestrator's integrity monitor, chaos engine and perf
+        ledger; its recovery path routes through the same checked
+        dispatcher the serial loop uses, re-deriving the stopping
+        decision with the HOST rule."""
+        key = (sp_idx, structure)
+        if key not in self._ci_engines:
+            self._ci_engines[key] = pipeline_mod.UntilCIEngine(
+                self.campaign(sp_idx, structure),
+                self.checked_dispatcher(sp_idx, sp_name, structure),
+                self._structure_prng_key(sp_idx, structure),
+                self.batch_size, self.monitor,
+                min_trials=int(self.plan.min_trials),
+                target_halfwidth=float(self.plan.target_halfwidth),
+                confidence=float(self.plan.confidence),
+                chaos=self.chaos, perf=self._perf,
+                sp_name=sp_name, structure=structure)
+        return self._ci_engines[key]
+
     @property
     def _ceiling_batches(self) -> int:
         """Batches the stopping rule could possibly consume (the
@@ -742,6 +787,19 @@ class Orchestrator:
                 or not camp.supports_intervals):
             return 0
         k = max(1, min(k, self._ceiling_batches - st.next_batch))
+        need = self._trials_needed(st, camp)
+        k = max(1, min(k, -(-int(max(need, 1)) // self.batch_size)))
+        k = 1 << (k.bit_length() - 1)          # power-of-two quantization
+        if k == 1 and not self._engine_holds(key, st):
+            return 0
+        return k
+
+    def _trials_needed(self, st: _State, camp: ShardedCampaign) -> float:
+        """Trials the stopping rule still plausibly needs: the min_trials
+        floor, extended — once data exists — by the half-width trajectory
+        estimate (Wilson hw ~∝ 1/√n at a stable p̂, so distance-to-target
+        is ~ n·((hw/target)² − 1)).  The single estimator behind the
+        adaptive sync interval AND the until-CI super-interval planner."""
         need = float(self.plan.min_trials - st.trials)
         if st.trials > 0:
             vulnerable = int(st.tallies[C.OUTCOME_SDC] +
@@ -757,10 +815,54 @@ class Orchestrator:
             if hw > target > 0:
                 need = max(need,
                            st.trials * ((hw / target) ** 2 - 1.0))
-        k = max(1, min(k, -(-int(max(need, 1)) // self.batch_size)))
-        k = 1 << (k.bit_length() - 1)          # power-of-two quantization
-        if k == 1 and not self._engine_holds(key, st):
+        return need
+
+    def _until_ci_len(self, st: _State, camp: ShardedCampaign,
+                      sp_name: str = "", structure: str = "") -> int:
+        """Super-interval budget for the device-resident until-CI step
+        (``pcfg.until_ci``), or 0 where fusing the stopping rule cannot
+        apply (elastic leasing, host-resolution/multi-process campaigns,
+        or cumulative counts past the device loop's int32 accumulators).
+
+        The planner auto-tunes the effective sync interval from the
+        observed half-width trajectory: plan 2× the trials-needed
+        estimate (the estimate assumes a stable p̂, and planned-but-
+        unconsumed batches cost only key staging — the device loop exits
+        at the exact stopping boundary, so overshooting the PLAN never
+        overshoots the TRIALS), rounded UP to a power of two (bounds the
+        shape-specialized executable variety), clamped by the remaining
+        max_trials ceiling and the bounded super-interval budget
+        (``pcfg.max_super_interval`` — integrity checks must keep gating
+        cumulative deltas at a bounded cadence)."""
+        if (not self.pcfg.until_ci or self._elastic is not None
+                or not camp.supports_intervals):
             return 0
+        # the device loop counts trials and tallies in int32: every count
+        # it can reach is bounded by ceiling_batches*batch_size, so gate
+        # on that product (max_trials alone is off by up to one batch)
+        if self._ceiling_batches * self.batch_size >= 2 ** 31:
+            return 0
+        remaining = self._ceiling_batches - st.next_batch
+        if remaining < 1:
+            return 0
+        need = max(self._trials_needed(st, camp), float(self.batch_size))
+        k = -(-int(need) // self.batch_size) * 2
+        k = 1 << (k - 1).bit_length()              # next power of two, up
+        k = max(1, min(k, remaining, int(self.pcfg.max_super_interval)))
+        if self.chaos is not None:
+            # serial parity of the chaos ledgers: a budget that extends
+            # past a scheduled batch-granular fault would arm it even
+            # when convergence lands first — a batch the serial loop
+            # never reaches.  Stop the super-interval just BEFORE the
+            # next fault strictly after the head batch (a head-batch
+            # fault is always consumed); if the campaign is still
+            # running, the next super-interval starts AT the fault's
+            # batch and arms it exactly when the serial loop would
+            nxt = self.chaos.next_batch_fault(
+                st.next_batch, sp_name, structure,
+                min_id=st.next_batch + 1)
+            if nxt is not None:
+                k = min(k, nxt - st.next_batch)
         return k
 
     def _engine_holds(self, key: tuple | None, st: _State) -> bool:
@@ -890,6 +992,11 @@ class Orchestrator:
                 if self._elastic is not None:
                     doc, adopted = self._elastic_obtain(
                         sp_idx, sp_name, structure, st, camp)
+                elif (s_ci := self._until_ci_len(st, camp, sp_name,
+                                                 structure)) >= 1:
+                    doc = self._compute_until_ci(
+                        sp_idx, sp_name, structure, camp, st, s_ci)
+                    adopted = False
                 elif (k_int := self._interval_len(
                         st, camp, (sp_idx, structure))) >= 1:
                     doc = self._compute_interval(
@@ -1144,6 +1251,48 @@ class Orchestrator:
         doc = self.engine(sp_idx, sp_name, structure).obtain(
             b0, k, stratified=camp.stratify)
         if self.chaos is not None:
+            self.chaos.end_batch()
+        doc["escapes"] = int(getattr(camp.kernel, "escapes", 0)) - esc0
+        doc["taint_trials"] = (int(getattr(camp.kernel, "taint_trials", 0))
+                               - tt0)
+        return doc
+
+    def _compute_until_ci(self, sp_idx: int, sp_name: str, structure: str,
+                          camp, st: _State, S: int) -> dict:
+        """Obtain ONE device-resident until-CI super-interval (budget S
+        batches; the device decides how many it consumes).  Same believed-
+        result document shape as ``_compute_interval`` — ``n_batches`` is
+        the device-decided consumed count, recorded into the checkpoint
+        through the ordinary accumulation path.
+
+        Chaos hook point: batch-granular faults scheduled on ANY of the
+        budgeted batch ids arm here (the union, like the interval path) —
+        the wedge fires under the armed deadline at materialization, tier
+        errors at consume time, tally corruption on the super-interval
+        result, the worker kill at the boundary before any work."""
+        b0 = st.next_batch
+        self._arm_chaos(range(b0, b0 + S), sp_name, structure)
+        esc0 = int(getattr(camp.kernel, "escapes", 0))
+        tt0 = int(getattr(camp.kernel, "taint_trials", 0))
+        # the stratified rule applies iff the strata history covers every
+        # counted trial — for a FRESH stratified campaign (no batches
+        # yet, strata still None) it covers vacuously, exactly as the
+        # serial loop's check does from its first accumulated batch on
+        strat_rule = camp.stratify and (
+            st.trials == 0 or stopping.strata_cover_trials(
+                st.strata, st.trials))
+        doc = self.until_ci_engine(sp_idx, sp_name, structure).obtain(
+            b0, S, st.tallies, st.strata if camp.stratify else None,
+            strat_rule)
+        if self.chaos is not None:
+            # arming advanced the per-process dispatch counter by the
+            # BUDGET S, but the device consumed possibly fewer batches —
+            # the serial loop advances it only per batch computed, so
+            # rewind the difference or later ``after_dispatches``
+            # triggers fire at shifted campaign coordinates (the
+            # fused-vs-serial chaos-ledger parity contract; the planner
+            # clamp already keeps un-consumed triggers from ARMING)
+            self.chaos.dispatches -= S - int(doc.get("n_batches", S))
             self.chaos.end_batch()
         doc["escapes"] = int(getattr(camp.kernel, "escapes", 0)) - esc0
         doc["taint_trials"] = (int(getattr(camp.kernel, "taint_trials", 0))

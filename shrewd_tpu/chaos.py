@@ -275,6 +275,41 @@ class ChaosEngine:
             self._armed.setdefault(s["kind"], []).append(
                 {"spec": s, "left": times, "fired": False})
 
+    def next_batch_fault(self, b0: int, simpoint: str = "",
+                         structure: str = "",
+                         min_id: int | None = None) -> int | None:
+        """Smallest batch id >= ``min_id`` (default ``b0``) at which a
+        batch-granular fault can still fire for this (simpoint,
+        structure), or None.  The until-CI super-interval planner bounds
+        its budget here: a fused campaign that converges before a
+        scheduled fault's batch must never spuriously arm it (the serial
+        loop would not have reached that batch, and the injected/survived
+        ledgers must agree between the serial and fused loops under the
+        same deterministic plan).  ``b0`` is the NEXT batch this process
+        will arm: ``after_dispatches`` triggers count armed batches, so
+        trigger d maps to batch ``b0 + (d - dispatches) - 1`` while this
+        structure's run is what advances the counter."""
+        lo = int(b0) if min_id is None else int(min_id)
+        best = None
+        for s in self.faults:
+            if s["kind"] in _NON_BATCH_KINDS or s["_fires_left"] <= 0:
+                continue
+            if s.get("simpoint") and simpoint \
+                    and s["simpoint"] != simpoint:
+                continue
+            if s.get("structure") and structure \
+                    and s["structure"] != structure:
+                continue
+            for b in s.get("at_batch", ()):
+                if b >= lo and (best is None or b < best):
+                    best = b
+            d = s.get("after_dispatches")
+            if d is not None and d - self.dispatches >= 1:
+                b = b0 + (d - self.dispatches) - 1
+                if b >= lo and (best is None or b < best):
+                    best = b
+        return best
+
     def begin_batches(self, batch_ids, simpoint: str = "",
                       structure: str = "") -> None:
         """Interval-scoped arming (the pipelined engine consumes one sync

@@ -78,6 +78,10 @@ def _apply_resilience_overrides(orch, args) -> None:
         pcfg.sync_every = args.sync_every
     if getattr(args, "pipeline_depth", None) is not None:
         pcfg.depth = args.pipeline_depth
+    if getattr(args, "until_ci", None):
+        pcfg.until_ci = True
+    if getattr(args, "max_super_interval", None) is not None:
+        pcfg.max_super_interval = args.max_super_interval
     if getattr(args, "compilation_cache_dir", None):
         from shrewd_tpu.parallel.exec_cache import enable_persistent_cache
 
@@ -383,6 +387,18 @@ def main(argv: list[str] | None = None) -> int:
                             "directory: re-runs and resumes skip "
                             "retrace/recompile of unchanged campaign "
                             "steps (plan.pipeline.compilation_cache_dir)")
+    resil.add_argument("--until-ci", action="store_true", default=None,
+                       help="device-resident run-until-CI: fuse the "
+                            "Wilson/post-stratified stopping rule into "
+                            "the jitted step (lax.while_loop) — ONE host "
+                            "transfer per super-interval, results "
+                            "bit-identical to the serial loop including "
+                            "the consumed trial count "
+                            "(plan.pipeline.until_ci)")
+    resil.add_argument("--max-super-interval", type=int, default=None,
+                       help="max batches per device-resident until-CI "
+                            "super-interval "
+                            "(plan.pipeline.max_super_interval)")
     resil.add_argument("--certify", default=None,
                        choices=("off", "warn", "strict"),
                        help="statically certify every compiled campaign "

@@ -189,6 +189,37 @@ class ShardedCampaign:
             self.collective_timeouts += 1
             raise
 
+    def _guarded_dispatch(self, work, n_batches: int):
+        """Deadline-guarded dispatch-side call shared by the interval
+        and until-CI paths: a backend that wedges at enqueue/compile
+        time (buffer allocation, device_put, the first AOT compile)
+        surfaces as ``DispatchTimeout`` in bounded time, the per-batch
+        deadline scaled by the dispatch's batch count."""
+        if self.watchdog is not None and self.watchdog.timeout > 0:
+            try:
+                return self.watchdog.call(
+                    work, timeout=self.watchdog.timeout * n_batches)
+            except DispatchTimeout:
+                self.collective_timeouts += 1
+                raise
+        return work()
+
+    def _guarded_fetch(self, fetch, handle: "InflightInterval",
+                       timeout: float | None):
+        """Deadline-enforcing materialization shared by the interval and
+        until-CI paths: the deadline armed at dispatch is enforced here,
+        default-scaled by the in-flight batch count."""
+        if self.watchdog is None:
+            return fetch()
+        if timeout is None and self.watchdog.timeout > 0:
+            timeout = self.watchdog.timeout * handle.n_batches
+        try:
+            return self.watchdog.call_armed(fetch, handle.armed_at,
+                                            timeout=timeout)
+        except DispatchTimeout:
+            self.collective_timeouts += 1
+            raise
+
     def _verify_shards(self, local, total) -> None:
         """The shard-vs-psum invariant (integrity layer): the locals each
         shard computed must sum to the replicated reduction everyone
@@ -333,6 +364,196 @@ class ShardedCampaign:
             local, mesh=self.mesh, in_specs=P(None, TRIAL_AXIS),
             out_specs=out_specs))
 
+    # --- device-resident run-until-CI (the fused stopping rule) ---------
+
+    def _build_until_ci_step(self, S: int, strat_rule: bool):
+        """Jitted device-resident run-until-CI step: a ``lax.while_loop``
+        around the per-batch tally step that keeps consuming frozen
+        per-batch keys from a pre-staged (S, B, ...) key stack,
+        accumulates tallies/strata/n_unres ON DEVICE, evaluates the
+        Wilson (pooled) or post-stratified half-width each batch, and
+        exits at the first batch boundary where the stopping rule fires —
+        or when the S-batch super-interval budget is exhausted.  ONE
+        result transfer per super-interval replaces one per batch.
+
+        Decision cadence is per batch — exactly the serial host loop's —
+        so for matching decisions (see ``stopping.wilson_halfwidth_device``
+        on float32 parity) the consumed batch count and therefore the
+        final tallies are bit-identical to the serial loop's.  Integer
+        gates (min_trials, the ceiling-clamped budget) are exact.
+
+        ``strat_rule``: evaluate the post-stratified rule (only offered
+        when the strata history covers every counted trial — the same
+        gate the host loop applies); the pooled Wilson rule otherwise.
+        Inputs beyond the key stack: initial cumulative tallies (+strata
+        when stratified), integer params (initial trials, min_trials) and
+        float params (target half-width, z) — all replicated, so one
+        executable serves any precision target at the same (S, B)."""
+        kernel, structure = self.kernel, self.structure
+        integrity = self.integrity_check
+        stratify = self.stratify
+        mesh_size = self.mesh.size
+        if strat_rule and not stratify:
+            raise ValueError("stratified stopping rule needs a stratified "
+                             "campaign")
+        if stratify:
+            from shrewd_tpu.ops.trial import N_STRATA
+
+        def batch_tally(keys):
+            """per-batch LOCAL tallies: (pooled tally, strata|None,
+            n_unres) — the same per-batch step the interval scan runs."""
+            if stratify:
+                th, nu = kernel.run_keys_stratified(keys, structure)
+                return th.sum(axis=0), th, nu
+            if self._device_step is not None:
+                t, nu = kernel.run_keys_device(keys, structure)
+                return t, None, nu
+            outs = kernel.outcomes_from_keys(keys, structure)
+            return C.tally(outs), None, jnp.int32(0)
+
+        def local(kd, tal0, strat0, iparams, fparams):
+            B_global = kd.shape[1] * mesh_size
+            trials0, min_trials = iparams[0], iparams[1]
+            target, z = fparams[0], fparams[1]
+
+            def cond(carry):
+                i, _dt, _loc, _ds, _nu, _hw, done = carry
+                return jnp.logical_and(i < S, jnp.logical_not(done))
+
+            def body(carry):
+                i, dt, loc, ds, nu, hw_buf, _done = carry
+                keys = jax.random.wrap_key_data(kd[i])
+                t, th, nu_b = batch_tally(keys)
+                dt = dt + jax.lax.psum(t, TRIAL_AXIS)
+                # the shard-local accumulator mirrors the RETURNED
+                # accumulator (strata when stratified, pooled otherwise)
+                # so the shard-vs-psum invariant checks what ships
+                loc = loc + (th if stratify else t)
+                if stratify:
+                    ds = ds + jax.lax.psum(th, TRIAL_AXIS)
+                nu = nu + jax.lax.psum(nu_b, TRIAL_AXIS)
+                trials = trials0 + (i + 1) * B_global
+                cum = tal0 + dt
+                if strat_rule:
+                    hw = stopping.post_stratified_halfwidth_device(
+                        strat0 + ds, z)
+                else:
+                    vul = cum[C.OUTCOME_SDC] + cum[C.OUTCOME_DUE]
+                    hw = stopping.wilson_halfwidth_device(vul, trials, z)
+                hw_buf = hw_buf.at[i].set(hw)
+                done = stopping.should_stop_device(hw, trials, target,
+                                                  min_trials)
+                return (i + 1, dt, loc, ds, nu, hw_buf, done)
+
+            zt = jnp.zeros(C.N_OUTCOMES, jnp.int32)
+            zs = (jnp.zeros((N_STRATA, C.N_OUTCOMES), jnp.int32)
+                  if stratify else jnp.int32(0))
+            carry0 = (jnp.int32(0), zt, (zs if stratify else zt), zs,
+                      jnp.int32(0),
+                      jnp.full((S,), jnp.nan, jnp.float32),
+                      jnp.bool_(False))
+            i, dt, loc, ds, nu, hw_buf, _done = jax.lax.while_loop(
+                cond, body, carry0)
+            out = ((ds if stratify else dt), nu, i, hw_buf)
+            if integrity:
+                # the per-shard local accumulator rides along for the
+                # shard-vs-psum invariant, exactly like the interval step
+                out = out + (loc[None],)
+            return out
+
+        out_specs = (P(), P(), P(), P())
+        if integrity:
+            out_specs = out_specs + (P(TRIAL_AXIS),)
+        return jax.jit(shard_map(
+            local, mesh=self.mesh,
+            in_specs=(P(None, TRIAL_AXIS), P(), P(), P(), P()),
+            out_specs=out_specs))
+
+    def dispatch_until_ci(self, keys_list, initial_tallies,
+                          initial_strata, trials0: int, min_trials: int,
+                          target_halfwidth: float, confidence: float,
+                          strat_rule: bool) -> "InflightInterval":
+        """Async-dispatch one device-resident until-CI super-interval
+        (budget = len(keys_list) batches) and return without blocking —
+        the device consumes batches and checks the stopping rule in-graph
+        until it fires or the budget runs out.  Same watchdog posture as
+        ``dispatch_interval`` (armed now, enforced at materialization);
+        same AOT executable-cache routing (shape-specialized per (S, B),
+        NOT per precision target — target/z/min_trials travel as
+        replicated scalars)."""
+        if not self.supports_intervals:
+            raise ValueError(f"{self.structure}: campaign does not support "
+                             "device-resident until-CI accumulation")
+        S = len(keys_list)
+        B = int(keys_list[0].shape[0])
+        armed_at = (self.watchdog.arm() if self.watchdog is not None
+                    else time.monotonic())
+
+        def dispatch_work():
+            from shrewd_tpu.parallel.mesh import replicated
+
+            kd = jnp.stack([jax.random.key_data(k) for k in keys_list])
+            kd_sh = shard_batch_stack(self.mesh, kd)
+            tal0 = replicated(self.mesh, jnp.asarray(
+                np.asarray(initial_tallies), jnp.int32))
+            if self.stratify:
+                from shrewd_tpu.ops.trial import N_STRATA
+                s0 = (np.zeros((N_STRATA, C.N_OUTCOMES), np.int64)
+                      if initial_strata is None
+                      else np.asarray(initial_strata))
+                strat0 = replicated(self.mesh, jnp.asarray(s0, jnp.int32))
+            else:
+                strat0 = replicated(self.mesh, jnp.int32(0))
+            iparams = replicated(self.mesh, jnp.asarray(
+                [int(trials0), int(min_trials)], jnp.int32))
+            fparams = replicated(self.mesh, jnp.asarray(
+                [float(target_halfwidth),
+                 stopping.z_value(float(confidence))], jnp.float32))
+            args = (kd_sh, tal0, strat0, iparams, fparams)
+            step = exec_cache.cache().get_aot(
+                exec_cache.step_key(self.kernel, self.mesh,
+                                    self.structure, kind="until_ci",
+                                    S=S, B=B, mode=self.mode,
+                                    resolution=self.resolution,
+                                    stratify=self.stratify,
+                                    rule=("strat" if strat_rule
+                                          else "pooled"),
+                                    integrity=self.integrity_check),
+                owner=self.kernel,
+                build=lambda: self._build_until_ci_step(S, strat_rule),
+                example_args=args)
+            return step(*args)
+
+        out = self._guarded_dispatch(dispatch_work, S)
+        return InflightInterval(out, armed_at, S, S * B)
+
+    def materialize_until_ci(self, handle: "InflightInterval",
+                             timeout: float | None = None):
+        """Block for / transfer one until-CI super-interval — ONE host
+        transfer covering however many batches the device consumed.
+        → (tally_delta int64 (N_OUTCOMES,), strata_delta int64 | None,
+        batches_consumed int, hw_trace float32 (consumed,)).  Escape
+        counters update from the CONSUMED batch count (device-decided),
+        and the shard-vs-psum invariant is verified on the super-interval
+        accumulators exactly like the interval path."""
+        host = self._guarded_fetch(lambda: jax.device_get(handle.out),
+                                   handle, timeout)
+        acc, n_unres, consumed, hw_buf = host[0], host[1], host[2], host[3]
+        consumed = int(consumed)
+        strata = None
+        if self.stratify:
+            strata = np.asarray(acc, dtype=np.int64)
+            tally = strata.sum(axis=0)
+        else:
+            tally = np.asarray(acc, dtype=np.int64)
+        if self.integrity_check:
+            self._verify_shards(host[4], acc)
+        if self.mode != "dense":
+            B = handle.n_trials // max(handle.n_batches, 1)
+            self.kernel.escapes += int(n_unres)
+            self.kernel.taint_trials += consumed * B
+        return tally, strata, consumed, np.asarray(hw_buf)[:consumed]
+
     def dispatch_interval(self, keys_list) -> "InflightInterval":
         """Async-dispatch one sync interval (len(keys_list) batches) and
         return WITHOUT blocking — jax dispatch is asynchronous, so the
@@ -367,21 +588,10 @@ class ShardedCampaign:
                 example_args=(kd_sh,))
             return step(kd_sh)
 
-        # the dispatch side is deadline-guarded too: a backend that
-        # wedges at enqueue/compile time (buffer allocation, device_put,
-        # the first AOT compile) must surface as DispatchTimeout in
-        # bounded time, exactly like the serial loop's guarded dispatch —
-        # arm() above starts the clock, so materialization only gets what
-        # the dispatch didn't spend
-        if self.watchdog is not None and self.watchdog.timeout > 0:
-            try:
-                out = self.watchdog.call(dispatch_work,
-                                         timeout=self.watchdog.timeout * S)
-            except DispatchTimeout:
-                self.collective_timeouts += 1
-                raise
-        else:
-            out = dispatch_work()
+        # the dispatch side is deadline-guarded too (arm() above starts
+        # the clock, so materialization only gets what the dispatch
+        # didn't spend)
+        out = self._guarded_dispatch(dispatch_work, S)
         return InflightInterval(out, armed_at, S, S * B)
 
     def materialize_interval(self, handle: "InflightInterval",
@@ -398,20 +608,8 @@ class ShardedCampaign:
         count; the pipelined engine passes a depth-scaled value on top,
         since a prefetched interval legitimately queues behind the
         intervals dispatched ahead of it."""
-        def fetch():
-            return jax.device_get(handle.out)
-
-        if self.watchdog is None:
-            host = fetch()
-        else:
-            if timeout is None and self.watchdog.timeout > 0:
-                timeout = self.watchdog.timeout * handle.n_batches
-            try:
-                host = self.watchdog.call_armed(fetch, handle.armed_at,
-                                                timeout=timeout)
-            except DispatchTimeout:
-                self.collective_timeouts += 1
-                raise
+        host = self._guarded_fetch(lambda: jax.device_get(handle.out),
+                                   handle, timeout)
         strata = None
         n_unres = None
         if self.stratify:

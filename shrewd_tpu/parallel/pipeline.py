@@ -66,6 +66,23 @@ class PipelineConfig(ConfigObject):
                                   "in new processes skip retrace/"
                                   "recompile (empty = in-process "
                                   "executable cache only)")
+    until_ci = Param(bool, False,
+                     "device-resident run-until-CI: fuse the Wilson/"
+                     "post-stratified stopping rule into a jitted "
+                     "lax.while_loop around the batch step — ONE host "
+                     "transfer per super-interval instead of one per "
+                     "batch, with per-batch decision cadence (results "
+                     "bit-identical to the serial loop, INCLUDING the "
+                     "consumed trial count).  Supersedes sync_every "
+                     "where it applies; off for chaos/elastic runs "
+                     "unless testing them fused")
+    max_super_interval = Param(int, 64,
+                               "max batches per device-resident "
+                               "super-interval: bounds the while-loop "
+                               "budget (integrity checks still gate "
+                               "every cumulative delta) and the "
+                               "shape-specialized executable variety",
+                               check=lambda v: v >= 1)
 
 
 class PerfStats:
@@ -83,6 +100,12 @@ class PerfStats:
         self.intervals = 0               # intervals believed pipelined
         self.serial_fallbacks = 0        # intervals recovered serially
         self.depth_hwm = 0               # in-flight high-water mark
+        # device-resident run-until-CI (UntilCIEngine): the stopping rule
+        # runs on device, so the host sees one transfer per super-interval
+        self.super_intervals = 0         # super-intervals believed fused
+        self.host_roundtrips_saved = 0   # batches consumed minus transfers
+        self.hw_trajectory_final = float("nan")  # last observed half-width
+        self.auto_sync_every = 0         # last planned super-interval len
 
     def overlap_fraction(self) -> float:
         """Fraction of device latency hidden behind host work: 1.0 means
@@ -103,7 +126,72 @@ class PerfStats:
             "intervals": self.intervals,
             "serial_fallbacks": self.serial_fallbacks,
             "depth_hwm": self.depth_hwm,
+            "super_intervals": self.super_intervals,
+            "host_roundtrips_saved": self.host_roundtrips_saved,
+            "hw_trajectory_final": round(self.hw_trajectory_final, 6)
+            if self.hw_trajectory_final == self.hw_trajectory_final
+            else None,
+            "auto_sync_every": self.auto_sync_every,
         }
+
+
+def _frozen_keys(sk, batch_size: int, batch_id: int):
+    """The frozen per-batch trial keys — ONE derivation shared by both
+    engines and their serial recovery paths, so the
+    pure-function-of-coordinates contract cannot drift between them."""
+    from shrewd_tpu.utils import prng
+
+    return prng.trial_keys(prng.batch_key(sk, batch_id), batch_size)
+
+
+def _believe_device_result(engine, tally, strata, n_batches: int, b0: int,
+                           audit_keys, esc0, tt0, recover):
+    """The shared believe/quarantine path both engines run on a
+    materialized device result covering ``n_batches`` batches: armed
+    corruption hook, invariants + canaries on the cumulative delta,
+    shard-counter sync, then the per-batch deterministic audit — any
+    problem rolls the kernel's escape counters back to (esc0, tt0),
+    records the quarantine and recovers through ``recover()`` (the
+    serial ladder).  → (believed doc, recovered flag); ONE copy so the
+    two engines' mismatch ledgers cannot drift."""
+    kernel = engine.campaign.kernel
+    res = resil.DispatchResult(np.asarray(tally, dtype=np.int64),
+                               None if strata is None
+                               else np.asarray(strata, dtype=np.int64),
+                               resil.TIER_DEVICE, 1)
+    res = engine.monitor.apply_corruption(res)
+    problems = engine.checked.check_result(res,
+                                           n_batches * engine.batch_size)
+    engine.checked.sync_shard_counters(b0)
+    if problems:
+        if esc0 is not None:
+            kernel.escapes = esc0
+        if tt0 is not None:
+            kernel.taint_trials = tt0
+        engine.monitor.record_quarantine({
+            "kind": problems[0]["kind"], "simpoint": engine.sp_name,
+            "structure": engine.structure, "batch_id": int(b0),
+            "interval": int(n_batches),
+            "tier": resil.TIERS[resil.TIER_DEVICE],
+            "problems": problems, "fatal": False})
+        engine.monitor.requeues += 1
+        doc = recover()
+        engine.monitor.recovered += 1
+        return doc, True
+    for i, keys in enumerate(audit_keys):
+        # same deterministic per-batch audit sample as the serial loop:
+        # the mismatch ledger is identical whichever loop ran
+        engine.checked.audit_batch(keys, b0 + i)
+    return {
+        "batch_id": int(b0),
+        "n_batches": int(n_batches),
+        "batch_size": int(engine.batch_size),
+        "tally": res.tally.tolist(),
+        "strata": (None if res.strata is None else res.strata.tolist()),
+        "tier": int(res.tier),
+        "tiers": [int(res.tier)] * int(n_batches),
+        "attempts": 1,
+    }, False
 
 
 class _Pending(NamedTuple):
@@ -144,10 +232,7 @@ class PipelinedEngine:
     # --- keys -----------------------------------------------------------
 
     def _keys(self, batch_id: int):
-        from shrewd_tpu.utils import prng
-
-        return prng.trial_keys(prng.batch_key(self.sk, batch_id),
-                               self.batch_size)
+        return _frozen_keys(self.sk, self.batch_size, batch_id)
 
     # --- dispatch-ahead -------------------------------------------------
 
@@ -237,45 +322,12 @@ class PipelinedEngine:
                           "recovery", self.sp_name, self.structure,
                           b0, b0 + k, e)
             return self._recover(b0, k, stratified)
-        res = resil.DispatchResult(np.asarray(tally, dtype=np.int64),
-                                   None if strata is None
-                                   else np.asarray(strata, dtype=np.int64),
-                                   resil.TIER_DEVICE, 1)
-        res = self.monitor.apply_corruption(res)
-        problems = self.checked.check_result(res, k * self.batch_size)
-        self.checked.sync_shard_counters(b0)
-        if problems:
-            if esc0 is not None:
-                kernel.escapes = esc0
-            if tt0 is not None:
-                kernel.taint_trials = tt0
-            self.monitor.record_quarantine({
-                "kind": problems[0]["kind"], "simpoint": self.sp_name,
-                "structure": self.structure, "batch_id": int(b0),
-                "interval": int(k), "tier": resil.TIERS[resil.TIER_DEVICE],
-                "problems": problems, "fatal": False})
-            self.monitor.requeues += 1
-            doc = self._recover(b0, k, stratified)
-            self.monitor.recovered += 1
-            return doc
-        for i, b in enumerate(range(b0, b0 + k)):
-            # audit each batch with the SAME deterministic per-batch
-            # sample as the serial loop: the mismatch ledger is identical
-            # whichever loop ran (and the re-runs overlap the next
-            # interval's device compute)
-            self.checked.audit_batch(head.keys[i], b)
-        self.perf.intervals += 1
-        return {
-            "batch_id": int(b0),
-            "n_batches": int(k),
-            "batch_size": int(self.batch_size),
-            "tally": res.tally.tolist(),
-            "strata": (None if res.strata is None
-                       else res.strata.tolist()),
-            "tier": int(res.tier),
-            "tiers": [int(res.tier)] * int(k),
-            "attempts": 1,
-        }
+        doc, recovered = _believe_device_result(
+            self, tally, strata, k, b0, head.keys, esc0, tt0,
+            lambda: self._recover(b0, k, stratified))
+        if not recovered:
+            self.perf.intervals += 1
+        return doc
 
     def _recover(self, b0: int, k: int, stratified: bool) -> dict:
         """Serial per-batch recovery on the frozen keys: the in-flight
@@ -283,31 +335,181 @@ class PipelinedEngine:
         dispatched to it), so drop it and route each batch through the
         integrity-checked resilience ladder — the exact serial path, so
         recovery is bit-identical by the ladder's own contract."""
-        from shrewd_tpu.ops import classify as C
-
         self._q.clear()
-        self.perf.serial_fallbacks += 1
-        tally = np.zeros(C.N_OUTCOMES, dtype=np.int64)
-        strata_sum = None
-        tiers: list[int] = []
-        attempts = 0
-        for b in range(b0, b0 + k):
-            res = self.checked.tally_batch(self._keys(b),
-                                           stratified=stratified,
-                                           batch_id=b)
-            tally += np.asarray(res.tally, dtype=np.int64)
+        return _serial_batches(self.checked, self._keys, b0, k, stratified,
+                               self.batch_size, self.perf)
+
+
+def _serial_batches(checked, keys_fn, b0: int, k: int, stratified: bool,
+                    batch_size: int, perf: PerfStats,
+                    stop_after=None) -> dict:
+    """The shared serial per-batch ladder loop behind both engines'
+    recovery paths (and the until-CI recovery's host-rule replay):
+    ``stop_after(j, res)`` — called after batch ``b0 + j`` is believed —
+    may end the loop early (the until-CI path re-derives the device's
+    stopping decision with the HOST rule, so a quarantined super-interval
+    recovers bit-identically without trusting the device-decided batch
+    count)."""
+    from shrewd_tpu.ops import classify as C
+
+    perf.serial_fallbacks += 1
+    tally = np.zeros(C.N_OUTCOMES, dtype=np.int64)
+    strata_sum = None
+    tiers: list[int] = []
+    attempts = 0
+    for j in range(k):
+        b = b0 + j
+        res = checked.tally_batch(keys_fn(b), stratified=stratified,
+                                  batch_id=b)
+        tally += np.asarray(res.tally, dtype=np.int64)
+        if res.strata is not None:
+            s = np.asarray(res.strata, dtype=np.int64)
+            strata_sum = s if strata_sum is None else strata_sum + s
+        tiers.append(int(res.tier))
+        attempts += int(res.attempts)
+        if stop_after is not None and stop_after(j, res):
+            break
+    return {
+        "batch_id": int(b0),
+        "n_batches": len(tiers),
+        "batch_size": int(batch_size),
+        "tally": tally.tolist(),
+        "strata": (None if strata_sum is None else strata_sum.tolist()),
+        "tier": int(max(tiers)),
+        "tiers": tiers,
+        "attempts": int(attempts),
+    }
+
+
+class UntilCIEngine:
+    """Device-resident run-until-CI driver for one campaign (the fused
+    stopping rule of ``ShardedCampaign.dispatch_until_ci``).
+
+    ``obtain(b0, S, tallies, strata, strat_rule)`` dispatches ONE
+    super-interval — the device consumes up to ``S`` frozen-key batches,
+    checking the Wilson/post-stratified half-width against the target
+    after each, and the host transfers ONE result when the rule fires or
+    the budget runs out.  The believed-result document is the interval
+    doc shape with ``n_batches`` = the device-decided consumed count,
+    plus the half-width trajectory tail for the orchestrator's
+    super-interval planner.
+
+    Integrity stance: the super-interval is bounded (``S``), and the
+    interval-boundary invariants, canary battery and sampled audit still
+    gate the cumulative delta before a converged result is believed.  A
+    quarantined or failed super-interval re-dispatches down the serial
+    per-batch ladder on the same frozen keys, re-deriving the stopping
+    decision with the HOST rule after every believed batch — so recovery
+    never trusts the device-decided count and is bit-identical by the
+    decision-parity contract (stopping.wilson_halfwidth_device)."""
+
+    def __init__(self, campaign, checked, structure_key, batch_size: int,
+                 monitor, *, min_trials: int, target_halfwidth: float,
+                 confidence: float, chaos=None,
+                 perf: PerfStats | None = None,
+                 sp_name: str = "", structure: str = ""):
+        self.campaign = campaign
+        self.checked = checked            # integrity.CheckedDispatcher
+        self.sk = structure_key
+        self.batch_size = int(batch_size)
+        self.monitor = monitor
+        self.min_trials = int(min_trials)
+        self.target_halfwidth = float(target_halfwidth)
+        self.confidence = float(confidence)
+        self.chaos = chaos
+        self.perf = perf if perf is not None else PerfStats()
+        self.sp_name = sp_name
+        self.structure = structure
+
+    def _keys(self, batch_id: int):
+        return _frozen_keys(self.sk, self.batch_size, batch_id)
+
+    def obtain(self, b0: int, S: int, tallies, strata,
+               strat_rule: bool) -> dict:
+        """One believed super-interval starting at batch ``b0`` with
+        budget ``S``, given the campaign's cumulative state (``tallies``
+        int64 (N_OUTCOMES,), ``strata`` int64 | None)."""
+        self.perf.auto_sync_every = int(S)
+        trials0 = int(np.asarray(tallies).sum())
+        keys = [self._keys(b) for b in range(b0, b0 + S)]
+        kernel = self.campaign.kernel
+        esc0 = getattr(kernel, "escapes", None)
+        tt0 = getattr(kernel, "taint_trials", None)
+        try:
+            handle = self.campaign.dispatch_until_ci(
+                keys, tallies, strata, trials0, self.min_trials,
+                self.target_halfwidth, self.confidence, strat_rule)
+            self.perf.dispatches += 1
+            if self.chaos is not None:
+                # armed device-tier chaos faults fire at consume time,
+                # exactly like the pipelined interval path
+                self.chaos.maybe_backend_error(resil.TIER_DEVICE)
+            wd = self.campaign.watchdog
+            tmo = (wd.timeout * S if wd is not None and wd.timeout > 0
+                   else None)
+            t0 = time.monotonic()
+            tally, strata_d, consumed, hw_tail = \
+                self.campaign.materialize_until_ci(handle, timeout=tmo)
+            t1 = time.monotonic()
+            self.perf.device_wait_seconds += t1 - t0
+            self.perf.device_step_seconds += t1 - handle.armed_at
+        except Exception as e:  # noqa: BLE001 — wedge, backend crash,
+            # shard-sum mismatch: recover serially on frozen keys with
+            # the host stopping rule deciding where to stop
+            debug.dprintf("Pipeline", "%s/%s until-CI super-interval "
+                          "[%d,%d): device loop failed (%s) — serial "
+                          "recovery", self.sp_name, self.structure,
+                          b0, b0 + S, e)
+            return self._recover(b0, S, tallies, strata, strat_rule)
+        doc, recovered = _believe_device_result(
+            self, tally, strata_d, consumed, b0, keys[:consumed],
+            esc0, tt0,
+            lambda: self._recover(b0, S, tallies, strata, strat_rule))
+        if recovered:
+            return doc
+        # super_intervals is the fused loop's own counter; perf.intervals
+        # stays pipelined-path-only (its stats description says so)
+        self.perf.super_intervals += 1
+        # the serial host loop would have paid one transfer per batch;
+        # the fused loop paid ONE for the whole super-interval
+        self.perf.host_roundtrips_saved += max(consumed - 1, 0)
+        if len(hw_tail):
+            self.perf.hw_trajectory_final = float(hw_tail[-1])
+        doc["hw_tail"] = [float(h) for h in hw_tail]
+        return doc
+
+    def _recover(self, b0: int, S: int, tallies, strata,
+                 strat_rule: bool) -> dict:
+        """Serial per-batch ladder replay of the super-interval on the
+        same frozen keys, with the HOST stopping rule re-deriving the
+        consumed batch count (never trusting a device-decided count from
+        an untrusted result)."""
+        from shrewd_tpu.parallel import stopping
+
+        cum = np.asarray(tallies, dtype=np.int64).copy()
+        cum_strata = (None if strata is None
+                      else np.asarray(strata, dtype=np.int64).copy())
+
+        def stop_after(_j, res) -> bool:
+            nonlocal cum, cum_strata
+            cum = cum + np.asarray(res.tally, dtype=np.int64)
             if res.strata is not None:
                 s = np.asarray(res.strata, dtype=np.int64)
-                strata_sum = s if strata_sum is None else strata_sum + s
-            tiers.append(int(res.tier))
-            attempts += int(res.attempts)
-        return {
-            "batch_id": int(b0),
-            "n_batches": int(k),
-            "batch_size": int(self.batch_size),
-            "tally": tally.tolist(),
-            "strata": (None if strata_sum is None else strata_sum.tolist()),
-            "tier": int(max(tiers)),
-            "tiers": tiers,
-            "attempts": int(attempts),
-        }
+                cum_strata = (s.copy() if cum_strata is None
+                              else cum_strata + s)
+            trials = int(cum.sum())
+            if strat_rule:
+                return stopping.should_stop_stratified(
+                    stopping.pairs_from_strata(cum_strata),
+                    self.target_halfwidth, self.confidence,
+                    self.min_trials)
+            from shrewd_tpu.ops import classify as C
+
+            vul = int(cum[C.OUTCOME_SDC] + cum[C.OUTCOME_DUE])
+            return stopping.should_stop(vul, trials,
+                                        self.target_halfwidth,
+                                        self.confidence, self.min_trials)
+
+        return _serial_batches(self.checked, self._keys, b0, S,
+                               self.campaign.stratify, self.batch_size,
+                               self.perf, stop_after=stop_after)

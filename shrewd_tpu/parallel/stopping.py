@@ -13,14 +13,22 @@ from __future__ import annotations
 import math
 from typing import NamedTuple
 
-# two-sided z for common confidence levels
+import numpy as np
+
+from shrewd_tpu.ops import classify as C
+
+# two-sided z for common confidence levels; non-tabulated confidences are
+# bisected once and memoized here — the 80-iteration erf bisection used to
+# rerun on EVERY should_stop call (once per batch per campaign for e.g.
+# confidence=0.975)
 _Z = {0.90: 1.6448536269514722, 0.95: 1.959963984540054,
       0.99: 2.5758293035489004}
 
 
 def z_value(confidence: float) -> float:
-    if confidence in _Z:
-        return _Z[confidence]
+    z = _Z.get(confidence)
+    if z is not None:
+        return z
     # Acklam-style rational approximation is overkill here; bisect the
     # complementary error function instead (exact enough for stopping).
     lo, hi = 0.0, 10.0
@@ -31,7 +39,9 @@ def z_value(confidence: float) -> float:
             lo = mid
         else:
             hi = mid
-    return (lo + hi) / 2
+    z = (lo + hi) / 2
+    _Z[confidence] = z
+    return z
 
 
 class Interval(NamedTuple):
@@ -120,9 +130,6 @@ def pairs_from_strata(strata) -> list:
     post_stratified/should_stop_stratified.  The single definition of
     "vulnerable" for stratified stopping — the orchestrator and
     run_until_ci must not diverge on it."""
-    from shrewd_tpu.ops import classify as C
-
-    import numpy as np
     s = np.asarray(strata)
     vul_h = s[:, C.OUTCOME_SDC] + s[:, C.OUTCOME_DUE]
     return list(zip(vul_h.tolist(), s.sum(axis=1).tolist()))
@@ -131,5 +138,79 @@ def pairs_from_strata(strata) -> list:
 def strata_cover_trials(strata, trials: int) -> bool:
     """True iff the strata history accounts for every counted trial (the
     gate for using the stratified rule over pooled Wilson)."""
-    import numpy as np
     return strata is not None and int(np.asarray(strata).sum()) == trials
+
+
+# --------------------------------------------------------------------------
+# device mirrors (the device-resident run-until-CI step)
+# --------------------------------------------------------------------------
+#
+# jnp mirrors of the two stopping half-widths, traced into the
+# ``lax.while_loop`` until-CI step (parallel/campaign.py
+# ``_build_until_ci_step``) so the convergence decision runs where the
+# cumulative tallies live instead of costing a device→host transfer per
+# check.  Each mirror follows the HOST formula's operation order so the
+# only divergence is float32-vs-float64 rounding; the host↔device
+# decision-parity pin (tests/test_until_ci.py) sweeps campaign-realistic
+# tallies and requires the stop/continue DECISION to match exactly.  A
+# tally within float32 epsilon of the target boundary could in principle
+# flip either way: an EARLY device stop is caught by the host rule's
+# re-evaluation of the believed cumulative tallies (cost: one extra
+# super-interval, never a wrong interval), while a LATE device stop
+# keeps the extra consumed batches — still valid frozen-key trials with
+# an honest host-computed CI over everything counted, but a consumed
+# count above the serial loop's.  The parity pin is what makes both
+# directions empirically vacuous at campaign-realistic tallies; it is a
+# pin, not a proof.  Import note: this module already imports jax
+# transitively (ops.classify, hoisted for pairs_from_strata); the
+# mirrors defer jax.numpy to call time only because they run during a
+# trace, not to keep the module jax-free.
+
+
+def wilson_halfwidth_device(successes, trials, z):
+    """``wilson(successes, trials).halfwidth`` as traceable float32 math
+    (``successes``/``trials`` int32 scalars, ``z`` a float32 scalar)."""
+    import jax.numpy as jnp
+
+    n = jnp.maximum(trials, 1).astype(jnp.float32)
+    s = successes.astype(jnp.float32)
+    p = s / n
+    zz = z * z
+    denom = 1.0 + zz / n
+    center = (p + zz / (2.0 * n)) / denom
+    margin = (z / denom) * jnp.sqrt(
+        p * (1.0 - p) / n + zz / (4.0 * n * n))
+    lo = jnp.maximum(0.0, center - margin)
+    hi = jnp.minimum(1.0, center + margin)
+    return (hi - lo) / 2.0
+
+
+def post_stratified_halfwidth_device(strata, z):
+    """``post_stratified(pairs_from_strata(strata)).halfwidth`` as
+    traceable float32 math over the (N_STRATA, N_OUTCOMES) cumulative
+    tally: observed-share weights, Agresti-Coull-adjusted per-stratum
+    variance, empty strata contributing nothing (the host's zero-variance
+    guard, mirrored with a where-mask instead of a continue)."""
+    import jax.numpy as jnp
+
+    n_h = strata.sum(axis=1).astype(jnp.float32)
+    s_h = (strata[:, C.OUTCOME_SDC]
+           + strata[:, C.OUTCOME_DUE]).astype(jnp.float32)
+    n = jnp.maximum(n_h.sum(), 1.0)
+    nz = n_h > 0
+    safe_n_h = jnp.maximum(n_h, 1.0)
+    w = jnp.where(nz, n_h / n, 0.0)
+    p = jnp.sum(jnp.where(nz, w * (s_h / safe_n_h), 0.0))
+    pt = (s_h + 2.0) / (n_h + 4.0)
+    var = jnp.sum(jnp.where(nz, w * w * pt * (1.0 - pt) / safe_n_h, 0.0))
+    margin = z * jnp.sqrt(var)
+    lo = jnp.maximum(0.0, p - margin)
+    hi = jnp.minimum(1.0, p + margin)
+    return (hi - lo) / 2.0
+
+
+def should_stop_device(halfwidth, trials, target_halfwidth, min_trials):
+    """The stopping decision on device: enough trials AND CI tight
+    enough — the integer gates are exact mirrors of ``should_stop``; only
+    the half-width comparison carries float32 rounding."""
+    return (trials >= min_trials) & (halfwidth <= target_halfwidth)

@@ -97,6 +97,12 @@ QUICK_MODULES = {
     # the crash-recovery smoke belongs in the on-every-push tier for
     # the same reason the chaos/integrity smokes do
     "test_fleet_survive",
+    # device-resident run-until-CI: stopping-mirror parity sweeps are
+    # sub-second; the fused-vs-host-loop bit-identity integrations reuse
+    # the test_pipeline tiny-kernel geometry through the shared executable
+    # cache, and the convergence-correctness smoke (the north-star loop
+    # itself) belongs in the on-every-push tier like the layers under it
+    "test_until_ci",
 }
 QUICK_TESTS = {
     # one representative per subsystem (≈4-10 s each, compile-dominated)

@@ -1,0 +1,427 @@
+"""Device-resident run-until-CI (the fused stopping rule).
+
+The contract under test is the ISSUE acceptance criterion: the
+``lax.while_loop`` until-CI step (``ShardedCampaign.dispatch_until_ci``)
+stops at EXACTLY the batch boundary the host rule would have chosen at
+the same per-batch check cadence, so final tallies AND the consumed
+batch/trial count are bit-identical to the serial host loop — for the
+dense, hybrid (device-resolution) and stratified paths, across a
+checkpoint/resume that lands mid-(would-be)-super-interval, under an
+injected mid-super-interval tally corruption (quarantine → serial
+host-rule recovery), and through the multi-tenant fleet scheduler
+(variable batches-per-tick must keep fair-share vtime correct).  The
+host↔device decision-parity pin sweeps the jnp Wilson/post-stratified
+mirrors against the float64 host reference on campaign-realistic
+tallies, and the new while-loop executable must certify at ONE
+device→host transfer per super-interval (with the seeded-violation
+fixture demonstrably rejected).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from shrewd_tpu.parallel import stopping
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# --- stopping-layer units ----------------------------------------------------
+
+def test_z_value_memoizes_nontabulated_confidences():
+    # the 80-iteration erf bisection must run at most once per confidence
+    stopping._Z.pop(0.975, None)
+    z = stopping.z_value(0.975)
+    assert abs(z - 2.241402727604944) < 1e-12
+    assert 0.975 in stopping._Z          # memoized for the next call
+    assert stopping.z_value(0.975) == z
+    # tabulated entries still hit the table
+    assert stopping.z_value(0.95) == 1.959963984540054
+
+
+def test_pairs_from_strata_uses_module_level_imports():
+    # the per-call numpy/classify imports were hoisted; the function is a
+    # pure module-level computation now
+    strata = np.array([[10, 2, 1, 0], [5, 0, 0, 3]])
+    pairs = stopping.pairs_from_strata(strata)
+    assert pairs == [(3, 13), (0, 8)]
+    import shrewd_tpu.parallel.stopping as sm
+    assert hasattr(sm, "np") and hasattr(sm, "C")
+
+
+# --- host <-> device decision parity ----------------------------------------
+
+def _realistic_tallies():
+    """(vulnerable, trials) decision points the NORTHSTAR sweep actually
+    visits: for every per-simpoint campaign in NORTHSTAR_r05.json, the
+    per-batch trajectory at its converged AVF (p̂ is stable well before
+    the rule fires, so round(avf·n) at each batch boundary is the tally
+    the host rule actually evaluated)."""
+    with open(os.path.join(REPO_ROOT, "NORTHSTAR_r05.json")) as f:
+        doc = json.load(f)
+    out = []
+    for wl in doc["workloads"].values():
+        for st in wl["structures"].values():
+            for sp in st["simpoints"]:
+                n_final, avf = int(sp["trials"]), float(sp["avf"])
+                for n in range(4096, n_final + 1, 4096):
+                    out.append((int(round(avf * n)), n))
+    return sorted(set(out))
+
+
+def test_device_wilson_parity_on_northstar_tallies():
+    import jax.numpy as jnp
+
+    pts = _realistic_tallies()
+    assert len(pts) > 100                   # the sweep is real
+    z64 = stopping.z_value(0.95)
+    z32 = jnp.float32(z64)
+    target = 0.01                           # the NORTHSTAR precision
+    for vul, n in pts:
+        host_hw = stopping.wilson(vul, n, 0.95).halfwidth
+        dev_hw = float(stopping.wilson_halfwidth_device(
+            jnp.int32(vul), jnp.int32(n), z32))
+        assert abs(dev_hw - host_hw) <= 2e-6 + 1e-5 * host_hw, (vul, n)
+        # the stop/continue DECISION matches exactly at every point the
+        # sweep produces (min_trials=1000, the plan default)
+        host_stop = stopping.should_stop(vul, n, target, 0.95, 1000)
+        dev_stop = bool(
+            stopping.should_stop_device(
+                stopping.wilson_halfwidth_device(jnp.int32(vul),
+                                                 jnp.int32(n), z32),
+                jnp.int32(n), jnp.float32(target), jnp.int32(1000)))
+        assert dev_stop == host_stop, (vul, n, host_hw, dev_hw)
+
+
+def test_device_wilson_parity_grid():
+    """Synthetic sweep over (p, n, confidence): half-widths agree to
+    float32 slack including the lo/hi clamp corners (p → 0 and 1)."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(7)
+    for conf in (0.90, 0.95, 0.975, 0.99):
+        z = jnp.float32(stopping.z_value(conf))
+        for n in (1000, 4096, 32768, 704512):
+            for p in (0.0, 1e-5, 1e-3, 0.01, 0.1, 0.3137, 0.5,
+                      0.9, 0.999, 1.0):
+                vul = int(round(p * n))
+                host_hw = stopping.wilson(vul, n, conf).halfwidth
+                dev_hw = float(stopping.wilson_halfwidth_device(
+                    jnp.int32(vul), jnp.int32(n), z))
+                assert abs(dev_hw - host_hw) <= 2e-6 + 1e-5 * host_hw
+        # random strata for the post-stratified mirror
+        for _ in range(24):
+            strata = rng.integers(0, 2000, size=(8, 4))
+            if rng.random() < 0.3:
+                strata[rng.integers(0, 8)] = 0     # empty stratum
+            host_hw = stopping.post_stratified(
+                stopping.pairs_from_strata(strata), conf).halfwidth
+            dev_hw = float(stopping.post_stratified_halfwidth_device(
+                jnp.asarray(strata, jnp.int32), z))
+            assert abs(dev_hw - host_hw) <= 2e-6 + 1e-5 * host_hw
+
+
+# --- campaign-level bit-identity ---------------------------------------------
+
+def _tiny_campaign(mode, stratify):
+    from shrewd_tpu.models.o3 import O3Config
+    from shrewd_tpu.ops.trial import TrialKernel
+    from shrewd_tpu.parallel.campaign import ShardedCampaign
+    from shrewd_tpu.parallel.mesh import make_mesh
+    from shrewd_tpu.trace.synth import WorkloadConfig, generate
+
+    tr = generate(WorkloadConfig(n=96, nphys=32, mem_words=64,
+                                 working_set_words=32, seed=7))
+    kernel = TrialKernel(tr, O3Config(replay_kernel=mode))
+    return kernel, ShardedCampaign(kernel, make_mesh(), "regfile",
+                                   stratify=stratify, integrity_check=True)
+
+
+@pytest.mark.parametrize("mode,stratify", [
+    ("hybrid", False), ("dense", False), ("hybrid", True)])
+def test_until_ci_step_matches_host_loop(mode, stratify):
+    """The device while-loop consumes EXACTLY the batches the per-batch
+    host stopping loop would, with identical tallies/strata and escape
+    counters — for a rule that fires mid-budget."""
+    from shrewd_tpu.ops import classify as C
+    from shrewd_tpu.utils import prng
+
+    kernel, camp = _tiny_campaign(mode, stratify)
+    B, S = 32, 16
+    target, conf, min_trials = 0.12, 0.95, 64
+    sk = prng.structure_key(prng.simpoint_key(prng.campaign_key(0), 0), 0)
+
+    def keys(b):
+        return prng.trial_keys(prng.batch_key(sk, b), B)
+
+    tal = np.zeros(C.N_OUTCOMES, np.int64)
+    strat = np.zeros((8, C.N_OUTCOMES), np.int64)
+    trials, consumed_host = 0, None
+    for b in range(S):
+        if stratify:
+            th = np.asarray(camp.tally_batch_stratified(keys(b)), np.int64)
+            strat += th
+            t = th.sum(axis=0)
+        else:
+            t = np.asarray(camp.tally_batch(keys(b)), np.int64)
+        tal += t
+        trials += B
+        vul = int(tal[C.OUTCOME_SDC] + tal[C.OUTCOME_DUE])
+        if stratify:
+            stop = stopping.should_stop_stratified(
+                stopping.pairs_from_strata(strat), target, conf,
+                min_trials)
+        else:
+            stop = stopping.should_stop(vul, trials, target, conf,
+                                        min_trials)
+        if stop:
+            consumed_host = b + 1
+            break
+    assert consumed_host is not None and consumed_host < S  # mid-budget
+    esc_host = kernel.escapes
+    kernel.escapes = kernel.taint_trials = 0
+
+    h = camp.dispatch_until_ci(
+        [keys(b) for b in range(S)], np.zeros(C.N_OUTCOMES, np.int64),
+        np.zeros((8, C.N_OUTCOMES), np.int64) if stratify else None,
+        0, min_trials, target, conf, strat_rule=stratify)
+    dtal, dstrat, consumed, hw_tail = camp.materialize_until_ci(h)
+    assert consumed == consumed_host
+    assert len(hw_tail) == consumed           # the trajectory tail rides
+    np.testing.assert_array_equal(dtal, tal)
+    if stratify:
+        np.testing.assert_array_equal(dstrat, strat)
+    assert kernel.escapes == esc_host
+
+
+# --- orchestrator-level bit-identity ----------------------------------------
+
+def _tiny_plan(until_ci, target=0.1, stratify=False, batch_size=32,
+               max_batches=64, min_trials=64, **kw):
+    from shrewd_tpu.campaign.plan import CampaignPlan, WorkloadSpec
+    from shrewd_tpu.trace.synth import WorkloadConfig
+
+    plan = CampaignPlan(
+        simpoints=[WorkloadSpec(
+            name="w0", workload=WorkloadConfig(n=96, nphys=32, mem_words=64,
+                                               working_set_words=32,
+                                               seed=7))],
+        structures=["regfile"], batch_size=batch_size,
+        target_halfwidth=target, confidence=0.95,
+        max_trials=batch_size * max_batches, min_trials=min_trials,
+        stratify=stratify, **kw)
+    plan.integrity.audit_rate = 0.0
+    plan.resilience.backoff_base = 0.0
+    plan.pipeline.until_ci = until_ci
+    return plan
+
+
+def _run(plan, outdir=None):
+    from shrewd_tpu.campaign.orchestrator import Orchestrator
+    from shrewd_tpu.sim.exit_event import ExitEvent
+
+    orch = Orchestrator(plan, outdir=outdir)
+    events = list(orch.events())
+    results = (dict(events[-1][1])
+               if events[-1][0] is ExitEvent.CAMPAIGN_COMPLETE else None)
+    return orch, results
+
+
+def test_orchestrator_until_ci_bit_identical_and_observable():
+    from shrewd_tpu import stats as statsmod
+
+    _, serial = _run(_tiny_plan(False, target=0.08))
+    orch, fused = _run(_tiny_plan(True, target=0.08))
+    assert serial is not None and fused is not None
+    for key in serial:
+        np.testing.assert_array_equal(serial[key].tallies,
+                                      fused[key].tallies)
+        # trial-count equality IS consumed-batch-count equality: the
+        # device decided where to stop, and it chose the host's boundary
+        assert serial[key].trials == fused[key].trials
+        assert serial[key].converged and fused[key].converged
+    # the fused loop is observable: one transfer per super-interval, the
+    # saved round-trips ledgered, the planner's budget and the final
+    # half-width on the record
+    perf = statsmod.to_dict(orch.stats)["perf"]
+    assert perf["super_intervals"] >= 1
+    assert perf["host_roundtrips_saved"] >= 1
+    assert perf["auto_sync_every"] >= 1
+    assert perf["hw_trajectory_final"] is not None
+    assert perf["hw_trajectory_final"] <= 0.08
+    assert perf["serial_fallbacks"] == 0
+
+
+def test_orchestrator_until_ci_stratified_bit_identical():
+    _, serial = _run(_tiny_plan(False, target=0.08, stratify=True))
+    _, fused = _run(_tiny_plan(True, target=0.08, stratify=True))
+    for key in serial:
+        np.testing.assert_array_equal(serial[key].tallies,
+                                      fused[key].tallies)
+        assert serial[key].trials == fused[key].trials
+        # the post-stratified interval is a pure function of the strata
+        assert serial[key].avf_interval == fused[key].avf_interval
+
+
+def test_until_ci_resume_mid_super_interval(tmp_path):
+    from shrewd_tpu.campaign.orchestrator import Orchestrator
+
+    _, clean = _run(_tiny_plan(False, target=0.08))
+    # the serial run leaves its last checkpoint mid-run at a boundary the
+    # fused run's super-interval grid would have jumped past — the
+    # resumed device loop must still stop at the host boundary
+    plan = _tiny_plan(False, target=0.08, checkpoint_every=3)
+    _run(plan, outdir=str(tmp_path / "out"))
+    ckpt = str(tmp_path / "out" / "campaign_ckpt")
+    doc = Orchestrator.load_checkpoint_doc(ckpt)
+    st = doc["state"]["w0"]["regfile"]
+    assert 0 < st["next_batch"] * 32 < clean[("w0", "regfile")].trials
+    orch2 = Orchestrator.resume(ckpt, outdir=str(tmp_path / "out2"))
+    orch2.pcfg.until_ci = True             # resume FUSED
+    events = list(orch2.events())
+    results = dict(events[-1][1])
+    for key in clean:
+        np.testing.assert_array_equal(clean[key].tallies,
+                                      results[key].tallies)
+        assert clean[key].trials == results[key].trials
+
+
+def test_until_ci_corrupt_tally_mid_super_interval_recovers():
+    from shrewd_tpu.campaign.orchestrator import Orchestrator
+    from shrewd_tpu.chaos import ChaosEngine
+
+    clean_orch, clean = _run(_tiny_plan(False, target=0.08))
+    plan = _tiny_plan(True, target=0.08)
+    orch = Orchestrator(plan)
+    # batch 2 lands inside the first super-interval: the corrupted
+    # cumulative delta must quarantine and recover through the serial
+    # ladder with the HOST rule re-deriving the stopping boundary
+    orch.attach_chaos(ChaosEngine({"faults": [
+        {"kind": "corrupt_tally", "at_batch": 2, "delta": 3}]}))
+    events = list(orch.events())
+    results = dict(events[-1][1])
+    for key in clean:
+        np.testing.assert_array_equal(clean[key].tallies,
+                                      results[key].tallies)
+        assert clean[key].trials == results[key].trials
+    assert orch.chaos.injected == {"corrupt_tally": 1}
+    assert orch.chaos.survived == orch.chaos.injected
+    assert orch.monitor.quarantined >= 1
+    assert orch._perf.serial_fallbacks >= 1
+    assert orch.monitor.recovered >= 1
+    # escape-counter parity under quarantine (the rollback discipline)
+    key = ("w0", "regfile")
+    assert orch.state[key].escapes == clean_orch.state[key].escapes
+
+
+def test_until_ci_fault_past_convergence_never_arms():
+    """Serial parity of the chaos ledgers: a batch-granular fault
+    scheduled PAST the convergence boundary never fires in the serial
+    loop, so the fused planner must bound its super-interval budget
+    before the fault's batch instead of spuriously arming it
+    (`ChaosEngine.next_batch_fault`)."""
+    from shrewd_tpu.campaign.orchestrator import Orchestrator
+    from shrewd_tpu.chaos import ChaosEngine
+
+    _, clean = _run(_tiny_plan(False, target=0.08))
+    # the serial loop converges at batch 5 (160 trials) — batch 10 is
+    # never reached, so the fault must never arm in the fused run either
+    orch = Orchestrator(_tiny_plan(True, target=0.08))
+    orch.attach_chaos(ChaosEngine({"faults": [
+        {"kind": "corrupt_tally", "at_batch": 10, "delta": 3}]}))
+    results = dict(list(orch.events())[-1][1])
+    for key in clean:
+        np.testing.assert_array_equal(clean[key].tallies,
+                                      results[key].tallies)
+        assert clean[key].trials == results[key].trials
+    assert dict(orch.chaos.injected) == {}
+    assert orch.monitor.quarantined == 0
+
+
+def test_until_ci_after_dispatches_counter_parity():
+    """The per-process ``after_dispatches`` trigger counts batches the
+    process COMPUTED: the fused path arms a whole budget up front, so it
+    must rewind the counter to the consumed count — and the planner must
+    clamp the budget before the trigger's mapped batch — or the fault
+    fires at different campaign coordinates than the serial loop."""
+    from shrewd_tpu.campaign.orchestrator import Orchestrator
+    from shrewd_tpu.chaos import ChaosEngine
+
+    plan_fault = {"faults": [
+        {"kind": "corrupt_tally", "after_dispatches": 4, "delta": 3}]}
+    orch_s = Orchestrator(_tiny_plan(False, target=0.08))
+    orch_s.attach_chaos(ChaosEngine(dict(plan_fault)))
+    serial = dict(list(orch_s.events())[-1][1])
+    orch_f = Orchestrator(_tiny_plan(True, target=0.08))
+    orch_f.attach_chaos(ChaosEngine(dict(plan_fault)))
+    fused = dict(list(orch_f.events())[-1][1])
+    for key in serial:
+        np.testing.assert_array_equal(serial[key].tallies,
+                                      fused[key].tallies)
+        assert serial[key].trials == fused[key].trials
+    # same fault fired in both runs, at the same campaign coordinates,
+    # and the dispatch counters agree after convergence
+    assert dict(orch_f.chaos.injected) == dict(orch_s.chaos.injected)
+    assert dict(orch_f.chaos.survived) == dict(orch_s.chaos.survived)
+    assert orch_f.chaos.dispatches == orch_s.chaos.dispatches
+
+
+def test_until_ci_through_fleet_scheduler_bit_identical():
+    """Variable batches-per-tick through the multi-tenant scheduler: a
+    fused tenant's tick consumes a whole super-interval, and fair-share
+    vtime (trials/weight, recomputed from orchestrator state) stays
+    correct — tallies bit-identical to the solo run either way."""
+    from shrewd_tpu.service import CampaignScheduler, TenantSpec
+
+    _, solo = _run(_tiny_plan(True, target=0.08))
+    sched = CampaignScheduler()
+    sched.admit(TenantSpec(name="fused",
+                           plan=_tiny_plan(True, target=0.08).to_dict()))
+    sched.admit(TenantSpec(name="host",
+                           plan=_tiny_plan(False, target=0.08).to_dict()))
+    assert sched.run() == 0
+    fused_t = sched.tenants["fused"]
+    host_t = sched.tenants["host"]
+    for k, r in solo.items():
+        got = sched.tenant_tallies("fused")[k]
+        np.testing.assert_array_equal(got, r.tallies)
+        got_h = sched.tenant_tallies("host")[k]
+        np.testing.assert_array_equal(got_h, r.tallies)
+    # equal work at equal weight: the recomputed-vtime accounting agrees
+    # between a per-batch tenant and a per-super-interval tenant
+    assert fused_t.trials == host_t.trials > 0
+    assert fused_t.vtime == host_t.vtime
+    # the fused tenant reached the same trials in far fewer ticks
+    assert fused_t.ticks < host_t.ticks
+
+
+# --- certification -----------------------------------------------------------
+
+def test_until_ci_step_certifies_at_one_transfer():
+    from shrewd_tpu.analysis import audit_callable
+    from shrewd_tpu.analysis.certify import _until_ci_args
+
+    _, camp = _tiny_campaign("hybrid", False)
+    cert = audit_callable(camp._build_until_ci_step(4, strat_rule=False),
+                          _until_ci_args(camp, 4, 32),
+                          kind="until_ci", transfer_budget=1)
+    assert cert["ok"], cert["violations"]
+    assert cert["transfers"] == 1
+    assert cert["callbacks"] == {}
+
+
+def test_broken_until_ci_step_is_rejected():
+    from shrewd_tpu.analysis import audit_callable
+    from shrewd_tpu.analysis.certify import (_until_ci_args,
+                                             violating_until_ci_step)
+
+    _, camp = _tiny_campaign("dense", False)
+    cert = audit_callable(violating_until_ci_step(camp, 4),
+                          _until_ci_args(camp, 4, 32),
+                          kind="until_ci", transfer_budget=1)
+    assert not cert["ok"]
+    assert cert["transfers"] == 2
+    assert any("debug_callback" in v for v in cert["violations"])
+    assert any("transfer budget" in v for v in cert["violations"])
