@@ -183,14 +183,71 @@ print("fleet survive smoke: hard kill at tick 5 -> --recover -> "
       "3 tenants complete, tallies bit-identical to solo")
 SURVIVE_SMOKE
 
+# Non-fatal obs smoke: a small campaign run through the REAL CLI with
+# --trace and an injected corrupt-tally quarantine must leave (1) a
+# Perfetto trace.json that loads and has events, and (2) a flight-
+# recorder dump whose window contains the quarantine span — the
+# dispatch → integrity-verdict → quarantine → ladder-recovery timeline
+# reconstructable from one artifact (shrewd_tpu/obs/).  Event counts
+# land in OBS_r09.json.  Never affects the pass/fail status.
+timeout -k 10 240 env JAX_PLATFORMS=cpu python - <<'OBS_SMOKE' \
+  || echo "WARNING: obs smoke failed (non-fatal)"
+import json, os, subprocess, sys, tempfile
+from shrewd_tpu.campaign.plan import CampaignPlan, WorkloadSpec
+from shrewd_tpu.obs import export as obs_export
+from shrewd_tpu.trace.synth import WorkloadConfig
+
+td = tempfile.mkdtemp(prefix="obs_smoke_")
+p = CampaignPlan(
+    simpoints=[WorkloadSpec(name="w0", workload=WorkloadConfig(
+        n=64, nphys=32, mem_words=64, working_set_words=32, seed=3))],
+    structures=["regfile"], batch_size=32, target_halfwidth=0.5,
+    max_trials=96, min_trials=96)
+p.integrity.canary_trials = 0
+p.integrity.audit_rate = 0.0
+p.resilience.backoff_base = 0.0
+ppath = os.path.join(td, "plan.json")
+with open(ppath, "w") as f:
+    json.dump(p.to_dict(), f)
+cpath = os.path.join(td, "chaos.json")
+with open(cpath, "w") as f:
+    json.dump({"faults": [
+        {"kind": "corrupt_tally", "at_batch": 1, "delta": 1}]}, f)
+outdir = os.path.join(td, "out")
+env = dict(os.environ, JAX_PLATFORMS="cpu")
+r = subprocess.run([sys.executable, "-m", "shrewd_tpu", "run", ppath,
+                    "--outdir", outdir, "--trace", "--chaos-plan", cpath],
+                   env=env)
+assert r.returncode == 0, f"traced run rc {r.returncode}"
+with open(os.path.join(outdir, "trace.json")) as f:
+    doc = json.load(f)
+assert doc["traceEvents"], "Perfetto export is empty"
+with open(os.path.join(outdir, "flightrec.json")) as f:
+    rec = json.load(f)
+names = [ev["name"] for ev in rec["events"]]
+for want in ("invariant_verdict", "quarantine", "quarantine_recovered",
+             "batch_believed"):
+    assert want in names, f"flight recorder missing {want}: {names}"
+summary = obs_export.summarize(rec["events"])
+with open("OBS_r09.json", "w") as f:
+    json.dump({"reason": rec["reason"],
+               "trace_events": len(doc["traceEvents"]),
+               "flight_events": summary["events"],
+               "by_name": summary["by_name"]}, f, indent=1)
+    f.write("\n")
+print(f"obs smoke: quarantine timeline in flightrec.json "
+      f"({summary['events']} events), trace.json loads "
+      f"({len(doc['traceEvents'])} trace events) -> OBS_r09.json")
+OBS_SMOKE
+
 # Non-fatal bench smoke: bench.py --quick includes the serial-vs-
-# pipelined campaign-loop microbenchmark AND the until-CI convergence
-# microbenchmark (host stopping loop vs the device-resident fused
-# lax.while_loop — wall-clock + host round-trip counts per converged
-# campaign, bit-identity asserted fatally) — the recorded BENCH_r08.json
-# keeps both observable in the trajectory artifacts alongside the
-# earlier BENCH_r0X files.  Never affects the pass/fail status.
-timeout -k 10 560 env JAX_PLATFORMS=cpu python bench.py --quick > BENCH_r08.json \
+# pipelined campaign-loop microbenchmark (now surfacing the PerfStats
+# overlap ledger — host/device-wait/device-step seconds, depth HWM),
+# the until-CI convergence microbenchmark, AND the obs-overhead stage
+# (disabled-tracer ≈zero-overhead pin + tracing-on/off bit-identity,
+# asserted fatally) — recorded as BENCH_r09.json alongside the earlier
+# BENCH_r0X trajectory files.  Never affects the pass/fail status.
+timeout -k 10 560 env JAX_PLATFORMS=cpu python bench.py --quick > BENCH_r09.json \
   || echo "WARNING: bench smoke failed (non-fatal)"
 
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

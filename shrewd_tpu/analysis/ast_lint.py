@@ -4,7 +4,7 @@ The dynamic layers (pinned tests, in-run canaries, the integrity
 quarantine) prove determinism *after* code runs; these passes prove the
 repo-specific preconditions *before* anything runs, the way the reference
 builds its CheckerCPU redundancy into the design rather than the test
-suite.  Five rules, each encoding a contract another subsystem already
+suite.  Six rules, each encoding a contract another subsystem already
 depends on:
 
 ========  ============  =====================================================
@@ -35,6 +35,12 @@ GL105     key-genesis   ``jax.random.key`` / ``PRNGKey`` only in
                         ``utils/prng.py`` — every key derives from the plan
                         seed through the campaign-coordinate helpers, which
                         is what makes re-dispatch on frozen keys possible
+GL106     clock         obs-instrumented modules read clocks only through
+                        the sanctioned ``obs.clock`` seam (``time.time`` /
+                        ``monotonic`` / ``perf_counter`` and ``_ns``
+                        variants) — timestamps attach to events without
+                        wall clock scattering into deterministic regions;
+                        ``time.sleep`` is not a read and is not flagged
 ========  ============  =====================================================
 
 **Waivers**: a finding is waived by a comment on the same line, the line
@@ -69,6 +75,15 @@ _WALL_CLOCK = {
     ("time", "time"), ("time", "time_ns"),
     ("datetime", "now"), ("datetime", "utcnow"), ("datetime", "today"),
     ("date", "today"),
+}
+
+#: clock reads of ANY kind (GL106): in obs-instrumented modules these
+#: must route through the sanctioned ``obs.clock`` seam —
+#: ``time.sleep`` is not a read and stays unflagged
+_CLOCK_READS = {
+    ("time", "time"), ("time", "time_ns"),
+    ("time", "monotonic"), ("time", "monotonic_ns"),
+    ("time", "perf_counter"), ("time", "perf_counter_ns"),
 }
 
 _WAIVER_RE = re.compile(
@@ -285,6 +300,25 @@ class _FileLint:
                     "be pure functions of campaign coordinates (batch "
                     "ids, checkpoint ordinals, seeded samples)")
 
+    # --- GL106: direct clock reads in obs-instrumented modules ----------
+
+    def check_clock(self) -> None:
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            if not isinstance(fn, ast.Attribute):
+                continue
+            qual = _dotted(fn.value).rsplit(".", 1)[-1]
+            if (qual, fn.attr) in _CLOCK_READS:
+                self._report(
+                    "GL106", node,
+                    f"direct clock read {qual}.{fn.attr}() in an "
+                    "obs-instrumented module — route it through the "
+                    "sanctioned obs.clock seam (clock.monotonic()/"
+                    "clock.now()) so timestamps stay auditable at one "
+                    "import site, or waive with a reason")
+
     # --- GL103: raw persisted writes ------------------------------------
 
     def check_raw_write(self) -> None:
@@ -392,6 +426,8 @@ def lint_file(path: str, rel: str, cfg: GraftlintConfig) -> list:
         fl.check_wall_clock()
     if rel_n in cfg.checkpoint_modules:
         fl.check_raw_write()
+    if rel_n in cfg.clock_modules:
+        fl.check_clock()
     fl.check_key_reuse()
     if rel_n not in cfg.key_genesis_allow:
         fl.check_key_genesis()

@@ -36,6 +36,7 @@ RULES = {
     "GL103": "raw-write",
     "GL104": "key-reuse",
     "GL105": "key-genesis",
+    "GL106": "clock",
 }
 
 SEVERITIES = ("error", "warn", "off")
@@ -105,6 +106,25 @@ class GraftlintConfig:
     # from the plan seed through utils/prng.py)
     key_genesis_allow: list = field(default_factory=lambda: [
         "shrewd_tpu/utils/prng.py",
+    ])
+    # GL106: obs-instrumented modules where every clock read
+    # (time.time/monotonic/perf_counter and the _ns variants) must route
+    # through the sanctioned obs.clock seam — one audited import site
+    # instead of scattered reads.  obs/clock.py itself is deliberately
+    # NOT listed: it IS the seam (and carries the GL102 waiver for its
+    # one wall-clock read).
+    clock_modules: list = field(default_factory=lambda: [
+        "shrewd_tpu/campaign/orchestrator.py",
+        "shrewd_tpu/parallel/pipeline.py",
+        "shrewd_tpu/parallel/elastic.py",
+        "shrewd_tpu/resilience.py",
+        "shrewd_tpu/chaos.py",
+        "shrewd_tpu/service/scheduler.py",
+        "shrewd_tpu/service/queue.py",
+        "shrewd_tpu/service/journal.py",
+        "shrewd_tpu/obs/trace.py",
+        "shrewd_tpu/obs/export.py",
+        "shrewd_tpu/obs/metrics.py",
     ])
     severity: dict = field(default_factory=lambda: {
         rid: "error" for rid in RULES})
@@ -210,7 +230,8 @@ def load_config(root: str) -> GraftlintConfig:
     with open(path) as f:
         doc = parse_graftlint_toml(f.read())
     for key in ("jit_modules", "deterministic_modules",
-                "checkpoint_modules", "key_genesis_allow"):
+                "checkpoint_modules", "key_genesis_allow",
+                "clock_modules"):
         if key in doc:
             setattr(cfg, key, list(doc[key]))
     if "transfer_budget" in doc:

@@ -19,7 +19,6 @@ no device computation is in flight).
 from __future__ import annotations
 
 import os
-import time
 import warnings
 from typing import Iterator, NamedTuple
 
@@ -29,6 +28,9 @@ from shrewd_tpu import chaos as chaosmod
 from shrewd_tpu import integrity as integ
 from shrewd_tpu import resilience as resil
 from shrewd_tpu import stats as statsmod
+from shrewd_tpu.obs import clock as obs_clock
+from shrewd_tpu.obs import export as obs_export
+from shrewd_tpu.obs import trace as obs_trace
 from shrewd_tpu.campaign.plan import COHERENCE_SP_NAME, CampaignPlan
 from shrewd_tpu.models.o3 import STRUCTURES
 from shrewd_tpu.ops import classify as C
@@ -338,6 +340,16 @@ class Orchestrator:
         self.pp_structure = self.probes.add_point("StructureComplete")
         self.pp_checkpoint = self.probes.add_point("Checkpoint")
         self.pp_degraded = self.probes.add_point("BackendDegraded")
+        # abnormal exits (integrity abort, chaos hard kill) dump the
+        # flight recorder here — pre-registered because the kill seam
+        # fires with no outdir in hand (obs/trace.py maybe_flight_dump).
+        # First registration wins: in fleet mode the SCHEDULER owns the
+        # fleet-level path, and per-tenant orchestrators must not steal
+        # it (a hard-kill dump would land in whichever tenant's outdir
+        # elaborated last)
+        if outdir and obs_trace.tracer().flight_path is None:
+            obs_trace.tracer().set_flight_path(
+                os.path.join(outdir, obs_trace.FLIGHT_NAME))
         self._build_stats()
 
     # --- chaos / elastic / preemption attachment ---
@@ -575,6 +587,26 @@ class Orchestrator:
             "per-content-key hit/miss/evict counters (cross-tenant "
             "compile dedupe observability: a co-scheduled tenant on a "
             "shared window shows hits and zero new misses)")
+        # observability accounting (shrewd_tpu/obs/): the tracer's own
+        # ledger — zeros while tracing is disabled (the no-op constant)
+        og = statsmod.Group("obs")
+        self.stats.obs = og
+        og.tracing = statsmod.Formula(
+            "tracing", lambda: 1 if obs_trace.tracer().enabled else 0,
+            "1 while a live tracer is installed (0 = no-op constant)")
+        og.events_emitted = statsmod.Formula(
+            "events_emitted", lambda: obs_trace.tracer().emitted,
+            "structured events emitted process-wide")
+        og.events_dropped = statsmod.Formula(
+            "events_dropped", lambda: obs_trace.tracer().dropped,
+            "ring overwrites (events no longer in the flight window)")
+        og.flight_dumps = statsmod.Formula(
+            "flight_dumps", lambda: obs_trace.tracer().flight_dumps,
+            "flight-recorder dumps written (abnormal-exit artifacts)")
+        og.events_by_name = statsmod.Formula(
+            "events_by_name",
+            lambda: dict(sorted(obs_trace.tracer().by_name.items())),
+            "event count per name (the trace's table of contents)")
         # refresh from restored state (resume path)
         for (spn, s), st in self.state.items():
             sg = getattr(getattr(self.stats, f"sp_{spn}"), f"st_{s}")
@@ -804,13 +836,9 @@ class Orchestrator:
         if st.trials > 0:
             vulnerable = int(st.tallies[C.OUTCOME_SDC] +
                              st.tallies[C.OUTCOME_DUE])
-            strata_ok = camp.stratify and stopping.strata_cover_trials(
-                st.strata, st.trials)
-            hw = (stopping.post_stratified(
-                stopping.pairs_from_strata(st.strata),
-                self.plan.confidence).halfwidth if strata_ok
-                else stopping.wilson(vulnerable, st.trials,
-                                     self.plan.confidence).halfwidth)
+            hw = stopping.live_halfwidth(
+                vulnerable, st.trials, st.strata, camp.stratify,
+                self.plan.confidence)
             target = float(self.plan.target_halfwidth)
             if hw > target > 0:
                 need = max(need,
@@ -930,7 +958,7 @@ class Orchestrator:
         camp = self.campaign(sp_idx, structure)
         sk = self._structure_prng_key(sp_idx, structure)
         sg = getattr(getattr(self.stats, f"sp_{sp_name}"), f"st_{structure}")
-        t0 = time.monotonic()
+        t0 = obs_clock.monotonic()
         while True:
             # stopping rule first, so a resumed campaign re-evaluates the
             # restored tallies instead of running one extra batch (the
@@ -967,9 +995,13 @@ class Orchestrator:
                         int(st.tallies[C.OUTCOME_SDC]), st.trials,
                         plan.confidence),
                     converged=converged,
-                    wall_seconds=time.monotonic() - t0)
+                    wall_seconds=obs_clock.monotonic() - t0)
                 self.results[(sp_name, structure)] = result
                 self.pp_structure.notify(result)
+                obs_trace.tracer().emit(
+                    "structure_complete", cat="campaign", sp=sp_name,
+                    structure=structure, trials=int(st.trials),
+                    converged=bool(converged))
                 yield (ExitEvent.CI_CONVERGED if converged
                        else ExitEvent.MAX_TRIALS), result
                 return
@@ -1021,11 +1053,14 @@ class Orchestrator:
                 # (events() sees .aborted; the CLI exits rc 3)
                 self.aborted = True
                 self.abort_reason = "integrity violation"
-                self._persist_evidence()
+                self._persist_evidence(flight=False)
                 for ev in self.monitor.take_events():
                     yield ExitEvent.INTEGRITY_VIOLATION, ev
                 if self.outdir:
                     self.checkpoint()
+                obs_trace.flight_dump(
+                    self.outdir, "integrity_violation", sp=sp_name,
+                    structure=structure, batch_id=int(st.next_batch))
                 return
             # elastic bit-identity guard: the effective batch size is
             # rounded to the LOCAL mesh, so workers with different device
@@ -1078,11 +1113,15 @@ class Orchestrator:
                         "fatal": True})
                     self.aborted = True
                     self.abort_reason = "integrity violation"
-                    self._persist_evidence()
+                    self._persist_evidence(flight=False)
                     for ev in self.monitor.take_events():
                         yield ExitEvent.INTEGRITY_VIOLATION, ev
                     if self.outdir:
                         self.checkpoint()
+                    obs_trace.flight_dump(
+                        self.outdir, "integrity_violation", sp=sp_name,
+                        structure=structure,
+                        batch_id=int(st.next_batch))
                     return
             st.tallies += tally
             prev_nb = st.next_batch
@@ -1096,6 +1135,11 @@ class Orchestrator:
             sg.trials += n_new
             sg.outcomes += tally
             avf_live = float(C.avf(st.tallies))
+            obs_trace.tracer().emit(
+                "batch_believed", cat="campaign", sp=sp_name,
+                structure=structure, b0=int(prev_nb),
+                n_batches=int(n_batches), trials=int(st.trials),
+                tier=TIERS[tier], adopted=bool(adopted))
             debug.dprintf("Campaign", "%s/%s batch %d: trials=%d avf=%.4f"
                           " tier=%s%s", sp_name, structure, st.next_batch,
                           st.trials, avf_live, TIERS[tier],
@@ -1136,7 +1180,16 @@ class Orchestrator:
                     self.monitor.ledger.rate(), self.icfg.audit_threshold,
                     self.icfg.audit_action,
                     dict(self.monitor.ledger.reasons))
-                self._persist_evidence()
+                self._persist_evidence(flight=False)
+                # one dump with the SPECIFIC reason on warn and abort
+                # alike — the generic quarantine_evidence label would
+                # misattribute an audit-budget breach (possibly with
+                # zero quarantines) to a quarantine that never happened
+                obs_trace.flight_dump(
+                    self.outdir, "audit_budget", sp=sp_name,
+                    structure=structure,
+                    rate=self.monitor.ledger.rate(),
+                    action=self.icfg.audit_action)
                 yield ExitEvent.INTEGRITY_VIOLATION, ainfo
                 if self.icfg.audit_action == "abort":
                     self.aborted = True
@@ -1162,6 +1215,9 @@ class Orchestrator:
                     self.abort_reason = "escalation budget"
                     if self.outdir:
                         self.checkpoint()
+                    obs_trace.flight_dump(
+                        self.outdir, "escalation_budget", sp=sp_name,
+                        structure=structure, rate=self.budget.rate())
                     return
 
             # interval-aware cadence: a sync interval may jump next_batch
@@ -1173,6 +1229,9 @@ class Orchestrator:
                     > prev_nb // plan.checkpoint_every):
                 ckpt = self.checkpoint()
                 self.pp_checkpoint.notify(ckpt)
+                obs_trace.tracer().emit(
+                    "checkpoint", cat="campaign", sp=sp_name,
+                    structure=structure, next_batch=int(st.next_batch))
                 yield ExitEvent.CHECKPOINT, ckpt
 
     def _arm_chaos(self, batch_ids, sp_name: str, structure: str) -> None:
@@ -1385,6 +1444,14 @@ class Orchestrator:
                                os.path.join(self.outdir, "stats.h5"))
         except ImportError:        # h5py is optional (env without HDF5)
             pass
+        tracer = obs_trace.tracer()
+        if tracer.enabled:
+            # Chrome/Perfetto trace_event export of the retained event
+            # window (process-wide: in fleet mode per-tenant lanes ride
+            # the pid axis).  Atomic like every persisted artifact.
+            resil.write_json_atomic(
+                os.path.join(self.outdir, "trace.json"),
+                obs_export.to_trace_event(tracer.snapshot()))
 
     # --- campaign checkpoint/resume ---
 
@@ -1520,11 +1587,14 @@ class Orchestrator:
     RC_ABORTED = 3
     RC_PREEMPTED = 4
 
-    def _persist_evidence(self) -> None:
+    def _persist_evidence(self, flight: bool = True) -> None:
         """Persist the integrity evidence record
         (``outdir/integrity_evidence.json``, atomic): quarantine log +
         mismatch ledger, so a violated run is inspectable without parsing
-        checkpoints."""
+        checkpoints.  ``flight=False`` on paths that immediately follow
+        with their own specific-reason flight dump (one dump per
+        trigger, with the most specific reason winning by
+        construction)."""
         if not self.outdir:
             return
         os.makedirs(self.outdir, exist_ok=True)
@@ -1532,6 +1602,14 @@ class Orchestrator:
             os.path.join(self.outdir, "integrity_evidence.json"),
             {"quarantine": list(self.monitor.quarantine_log),
              "ledger": self.monitor.ledger.to_dict()})
+        # quarantine is one of the flight recorder's abnormal-exit
+        # triggers: dump the recent-event window NOW, while the failing
+        # batch's dispatch → verdict → quarantine → recovery events are
+        # still in the ring ("why did this batch quarantine" must be
+        # answerable from one artifact even when the run then completes)
+        if flight:
+            obs_trace.flight_dump(self.outdir, "quarantine_evidence",
+                                  quarantined=self.monitor.quarantined)
 
 
 class StepDriver:

@@ -80,6 +80,7 @@ import time
 
 import numpy as np
 
+from shrewd_tpu.obs import trace as obs_trace
 from shrewd_tpu.resilience import BackendError, TIERS
 from shrewd_tpu.utils import debug
 from shrewd_tpu.utils.config import ConfigObject, Param
@@ -232,6 +233,9 @@ class ChaosEngine:
         if detail:
             ev.update(detail)
         self.fires.append(ev)
+        obs_trace.tracer().emit(
+            "chaos_inject", cat="chaos", kind=kind,
+            at=list(self._batch), **(detail or {}))
         debug.dprintf("Chaos", "injected %s at %s", kind, self._batch)
 
     def note_fired(self, kind: str) -> None:
@@ -244,6 +248,7 @@ class ChaosEngine:
 
     def note_survived(self, kind: str) -> None:
         self.survived[kind] = self.survived.get(kind, 0) + 1
+        obs_trace.tracer().emit("chaos_survived", cat="chaos", kind=kind)
         debug.dprintf("Chaos", "survived %s", kind)
 
     # --- batch lifecycle ------------------------------------------------
@@ -373,10 +378,15 @@ class ChaosEngine:
     def kill_now(self, rc=None) -> None:
         """Fire the kill seam: the configured ``kill_action`` (a fleet
         rescopes it; tests install a raising action) or a true hard
-        ``os._exit`` — no atexit, no flush, no drain."""
+        ``os._exit`` — no atexit, no flush, no drain.  The flight
+        recorder dumps FIRST (to its pre-registered path): a hard death
+        is exactly the exit whose last events are otherwise lost."""
+        rc = int(KILL_DEFAULT_RC if rc is None else rc)
+        obs_trace.tracer().maybe_flight_dump("hard_kill", rc=rc,
+                                             worker=self.worker)
         kill = self.kill_action if self.kill_action is not None \
             else os._exit
-        kill(int(KILL_DEFAULT_RC if rc is None else rc))
+        kill(rc)
 
     # --- service-level hook points (the fleet scheduler/journal/spool) --
 

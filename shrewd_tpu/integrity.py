@@ -38,6 +38,7 @@ from typing import NamedTuple
 
 import numpy as np
 
+from shrewd_tpu.obs import trace as obs_trace
 from shrewd_tpu.resilience import (DispatchResult, ResilientDispatcher,
                                    TIERS)
 from shrewd_tpu.utils import debug
@@ -515,6 +516,13 @@ class IntegrityMonitor:
         self.quarantine_log.append(evidence)
         del self.quarantine_log[:-MAX_EVIDENCE]
         self._pending_events.append(evidence)
+        obs_trace.tracer().emit(
+            "quarantine", cat="integrity",
+            kind=str(evidence.get("kind", "")),
+            sp=evidence.get("simpoint", ""),
+            structure=evidence.get("structure", ""),
+            batch_id=int(evidence.get("batch_id", -1)),
+            fatal=bool(evidence.get("fatal", False)))
         debug.dprintf("Integrity", "quarantine: %s", evidence)
 
     def take_events(self) -> list[dict]:
@@ -608,7 +616,8 @@ class CheckedDispatcher:
                 return fn
         return self.dispatcher.tiers[0][1]
 
-    def _check(self, res: DispatchResult, batch_size: int) -> list[dict]:
+    def _check(self, res: DispatchResult, batch_size: int,
+               batch_id: int = -1) -> list[dict]:
         """Invariants + canaries for one dispatch result; returns the
         failure evidence (empty = batch believed)."""
         mon = self.monitor
@@ -619,6 +628,10 @@ class CheckedDispatcher:
             if viol:
                 mon.invariant_violations += 1
                 problems.append({"kind": "invariant", "violations": viol})
+            obs_trace.tracer().emit(
+                "invariant_verdict", cat="integrity", ok=not viol,
+                sp=self.sp_name, structure=self.structure,
+                batch_id=int(batch_id))
         if self._battery is not None:
             mon.canary_runs += 1
             try:
@@ -632,12 +645,20 @@ class CheckedDispatcher:
                 problems.append({"kind": "canary_dispatch",
                                  "error": f"{type(e).__name__}: "
                                           f"{str(e)[:300]}"})
+                obs_trace.tracer().emit(
+                    "canary_verdict", cat="integrity", ok=False,
+                    dispatch_error=True, sp=self.sp_name,
+                    structure=self.structure, batch_id=int(batch_id))
                 return problems
             mon.canary_trials += cres.trials
             if not cres.ok:
                 mon.canary_failures += len(cres.failures)
                 problems.append({"kind": "canary",
                                  "failures": cres.failures})
+            obs_trace.tracer().emit(
+                "canary_verdict", cat="integrity", ok=cres.ok,
+                trials=int(cres.trials), sp=self.sp_name,
+                structure=self.structure, batch_id=int(batch_id))
         return problems
 
     def _audit(self, keys, batch_id: int) -> None:
@@ -668,6 +689,11 @@ class CheckedDispatcher:
                           context={"simpoint": self.sp_name,
                                    "structure": self.structure,
                                    "batch_id": int(batch_id)})
+        obs_trace.tracer().emit(
+            "audit_verdict", cat="integrity", ok=not mismatches,
+            audited=int(idx.size), mismatches=len(mismatches),
+            sp=self.sp_name, structure=self.structure,
+            batch_id=int(batch_id))
         if mismatches:
             debug.dprintf("Integrity", "audit: %d/%d mismatches in %s/%s "
                           "batch %d", len(mismatches), idx.size,
@@ -683,12 +709,12 @@ class CheckedDispatcher:
     # samples each batch with its own deterministic per-batch draw — so
     # the mismatch ledger is identical whichever loop ran.
 
-    def check_result(self, res: DispatchResult,
-                     n_trials: int) -> list[dict]:
+    def check_result(self, res: DispatchResult, n_trials: int,
+                     batch_id: int = -1) -> list[dict]:
         """Invariants + canaries for a believed-result candidate covering
         ``n_trials`` trials (a batch or a whole sync interval); returns
         failure evidence (empty = believed)."""
-        return self._check(res, n_trials)
+        return self._check(res, n_trials, batch_id=batch_id)
 
     def audit_batch(self, keys, batch_id: int) -> None:
         """Differential-audit one batch's keys under its own
@@ -712,7 +738,8 @@ class CheckedDispatcher:
             with _CounterGuard(self.campaign.kernel) as guard:
                 res = dispatcher.tally_batch(keys, stratified=stratified)
                 res = mon.apply_corruption(res)
-                problems = self._check(res, int(keys.shape[0]))
+                problems = self._check(res, int(keys.shape[0]),
+                                       batch_id=batch_id)
                 if not problems:
                     guard._esc = getattr(self.campaign.kernel,
                                          "escapes", None)
@@ -727,6 +754,11 @@ class CheckedDispatcher:
                         "structure": self.structure,
                         "batch_id": int(batch_id), "tier": TIERS[res.tier],
                         "attempts": attempt + 1})
+                    obs_trace.tracer().emit(
+                        "quarantine_recovered", cat="integrity",
+                        sp=self.sp_name, structure=self.structure,
+                        batch_id=int(batch_id), tier=TIERS[res.tier],
+                        attempts=attempt + 1)
                 self._audit(keys, batch_id)
                 return res
             evidence = {

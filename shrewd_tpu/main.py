@@ -37,6 +37,17 @@ def _apply_common(args) -> None:
         jax.config.update("jax_platforms", args.platform)
 
 
+def _apply_obs(args) -> None:
+    """--trace installs the process-wide tracer BEFORE the orchestrator
+    is built (elaboration events are part of the run's story); without
+    it the tracer stays the zero-overhead no-op constant."""
+    if getattr(args, "trace", None):
+        from shrewd_tpu.obs import trace as obs_trace
+
+        obs_trace.enable(ring=getattr(args, "trace_ring", None)
+                         or obs_trace.DEFAULT_RING)
+
+
 def _apply_resilience_overrides(orch, args) -> None:
     """CLI flags override the plan's resilience posture (and land in the
     config/checkpoint dumps, so the overridden run stays reproducible)."""
@@ -230,6 +241,7 @@ def cmd_run(args) -> int:
     from shrewd_tpu.campaign.orchestrator import Orchestrator
     from shrewd_tpu.campaign.plan import CampaignPlan
 
+    _apply_obs(args)
     with open(args.plan) as f:
         plan = CampaignPlan.from_dict(json.load(f))
     orch = Orchestrator(plan, outdir=args.outdir)
@@ -239,6 +251,7 @@ def cmd_run(args) -> int:
 def cmd_resume(args) -> int:
     from shrewd_tpu.campaign.orchestrator import Orchestrator
 
+    _apply_obs(args)
     orch = Orchestrator.resume(args.ckpt_dir, outdir=args.outdir)
     return _drive(orch, args)
 
@@ -399,6 +412,18 @@ def main(argv: list[str] | None = None) -> int:
                        help="max batches per device-resident until-CI "
                             "super-interval "
                             "(plan.pipeline.max_super_interval)")
+    resil.add_argument("--trace", action="store_true", default=None,
+                       help="install the process-wide tracer "
+                            "(shrewd_tpu/obs/): structured events at "
+                            "every load-bearing seam, Perfetto "
+                            "trace.json in --outdir, flight-recorder "
+                            "dump on abnormal exits.  Off by default "
+                            "(the disabled tracer is a no-op constant)")
+    resil.add_argument("--trace-ring", type=int, default=None,
+                       help="flight-recorder ring capacity in events "
+                            "(default 8192; bounds memory and dump "
+                            "size, never correctness — drops are "
+                            "counted in campaign.obs.events_dropped)")
     resil.add_argument("--certify", default=None,
                        choices=("off", "warn", "strict"),
                        help="statically certify every compiled campaign "

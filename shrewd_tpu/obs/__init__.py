@@ -1,0 +1,41 @@
+"""Deterministic observability: flight-recorder tracing + live fleet metrics.
+
+The campaign stack is built on one invariant — every result is a pure
+function of frozen campaign coordinates — and the reference answers
+"what happened in this run" with its stats dump and O3PipeView/debug-flag
+traces (PAPER §stats; ``trace/pipeview.py`` mirrors the instruction-level
+side).  This package is the *campaign-level* analog, built so that
+observing a run can never perturb it:
+
+- ``obs.clock`` — the ONE sanctioned wall-clock seam.  Instrumented
+  modules read time only through it (graftlint GL106), so timestamps
+  attach to events without wall clock leaking into any trigger or
+  scheduling decision (the GL102 contract).
+- ``obs.trace`` — a process-wide ``Tracer`` emitting structured events
+  at every load-bearing seam (dispatch/materialize, exec-cache
+  hit/miss/compile, integrity verdicts, quarantine→recovery, chaos
+  injections, watchdog arms/fires, lease claims, scheduler decisions,
+  journal appends).  Event identity derives from semantic coordinates
+  (batch_id, super-interval ordinal, tenant name, journal seq) — never
+  wall clock or object identity — so two identical runs produce
+  byte-identical streams after timestamp normalization.  The disabled
+  tracer is a no-op constant (≈zero overhead, pinned in bench).
+- ``obs.export`` — Chrome/Perfetto ``trace_event`` JSON (the async
+  pipeline timeline: dispatch vs. materialize overlap, per-tenant
+  lanes), stream normalization, and text summaries (``tools/obs.py``).
+- ``obs.metrics`` — atomic per-tick fleet metrics snapshots
+  (``metrics.json`` + Prometheus text exposition) published by the
+  resident scheduler.
+
+The **flight recorder** is the tracer's bounded ring dumped atomically to
+``outdir/flightrec.json`` on every abnormal exit — integrity abort
+(rc 3), escalation abort, tenant quarantine, fleet hard-kill — so "why
+did this tenant quarantine" is answerable post-hoc from one artifact.
+
+Import discipline: jax-free (pure host-side bookkeeping; instrumented
+modules include the jax-free-at-import campaign layers).
+"""
+
+from shrewd_tpu.obs import clock  # noqa: F401
+from shrewd_tpu.obs.trace import (  # noqa: F401
+    NULL_TRACER, Tracer, disable, enable, flight_dump, tracer)

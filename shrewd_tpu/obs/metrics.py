@@ -1,0 +1,211 @@
+"""Live fleet metrics: atomic per-tick snapshots + Prometheus exposition.
+
+The resident scheduler (``service/scheduler.py``) calls ``publish`` each
+tick: a JSON snapshot lands atomically at ``<outdir>/metrics.json``
+(readers never observe a torn document) and the same numbers render as
+Prometheus text exposition at ``<outdir>/metrics.prom`` — the pull
+surface a scraper or ``tools/obs.py --tail`` consumes without touching
+scheduler internals.
+
+Per tenant: status, trials served, trials/s, scheduling quanta, virtual
+time (the fair-share position), queue latency, failures/kills, and the
+live Wilson half-width per (simpoint, structure) — the half-width
+trajectory that says how far each tenant is from convergence.  Fleet-
+wide: tick count, fairness index, executable-cache hit rate (the
+cross-tenant compile-dedupe observable), write-ahead-journal depth, and
+recovery/quarantine counts.
+
+Wall-clock reads route through ``obs.clock`` (GL106): rates are
+*observability*, never scheduling inputs — every scheduling decision
+still consumes only admission order, trial counts and weights.
+
+Import discipline: jax-free at module import (the half-width helper
+lazy-imports the stopping module — by publish time the scheduler has
+long since built its mesh).
+"""
+
+from __future__ import annotations
+
+import os
+
+from shrewd_tpu.obs import clock
+
+METRICS_JSON = "metrics.json"
+METRICS_PROM = "metrics.prom"
+
+#: exposition prefix — one namespace for every gauge this module emits
+_PROM_NS = "shrewd_fleet"
+
+
+def _halfwidths(orch) -> dict:
+    """Live half-width per (simpoint, structure) of one tenant's
+    orchestrator — the convergence-distance trajectory, computed by the
+    SAME estimator selection the stopping rule applies (post-stratified
+    when the strata history covers the trials, pooled Wilson otherwise)
+    so the published distance never disagrees with the rule that
+    decides stopping."""
+    from shrewd_tpu.ops import classify as C
+    from shrewd_tpu.parallel import stopping
+
+    out = {}
+    for (sp, st), s in orch.state.items():
+        if s.trials <= 0:
+            continue
+        vul = int(s.tallies[C.OUTCOME_SDC] + s.tallies[C.OUTCOME_DUE])
+        hw = stopping.live_halfwidth(vul, s.trials, s.strata,
+                                     orch.plan.stratify,
+                                     orch.plan.confidence)
+        out[f"{sp}/{st}"] = round(float(hw), 6)
+    return out
+
+
+def snapshot(sched) -> dict:
+    """One JSON-able metrics snapshot of a ``CampaignScheduler``."""
+    from shrewd_tpu.parallel import exec_cache
+
+    now_mono = clock.monotonic()
+    tenants = {}
+    for name, t in sched.tenants.items():
+        wall = t.wall_s
+        if not wall and t._t_admit is not None:
+            wall = now_mono - t._t_admit
+        row = {
+            "status": t.status,
+            "priority": t.spec.priority,
+            "weight": t.spec.weight,
+            "trials": t.trials,
+            "batches": t.batches,
+            "ticks": t.ticks,
+            "vtime": round(t.vtime, 3),
+            "trials_per_s": (round(t.trials / wall, 2) if wall > 0
+                             else 0.0),
+            "queue_latency_s": round(t.queue_latency_s, 3),
+            "failures": t.failures,
+            "kills": t.kills,
+            "rc": t.rc,
+        }
+        if t.orch is not None:
+            row["halfwidth"] = _halfwidths(t.orch)
+        tenants[name] = row
+    cs = exec_cache.cache().stats()
+    fleet = {
+        "ticks": sched.ticks,
+        "tenants": len(sched.tenants),
+        "by_status": sched._by_status(),
+        "fairness_index": round(sched.fairness_index(), 4),
+        "depth_budget": sched.depth_budget,
+        "cache_compiled": cs["compiled"],
+        "cache_reused": cs["reused"],
+        "cache_hit_rate": round(
+            cs["reused"] / max(cs["reused"] + cs["compiled"], 1), 4),
+        "journal_depth": (sched._journal.since_compact
+                          if sched._journal is not None else 0),
+        "recoveries": sched.recoveries,
+        "quarantined": sum(1 for t in sched.tenants.values()
+                           if t.status == "quarantined"),
+    }
+    return {"schema": 1, "tick": sched.ticks, "wall_time": clock.now(),
+            "tenants": tenants, "fleet": fleet}
+
+
+def _label_escape(v) -> str:
+    """Prometheus label-value escaping (exposition format: backslash,
+    double quote and newline must be escaped — an unescaped tenant name
+    would make the scraper reject the whole exposition)."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def prometheus_text(snap: dict) -> str:
+    """Prometheus text exposition (gauge-only) of one snapshot."""
+    lines = []
+
+    def gauge(name: str, value, labels: dict | None = None,
+              help_: str = ""):
+        full = f"{_PROM_NS}_{name}"
+        if help_:
+            lines.append(f"# HELP {full} {help_}")
+            lines.append(f"# TYPE {full} gauge")
+        lab = ""
+        if labels:
+            body = ",".join(f'{k}="{_label_escape(v)}"'
+                            for k, v in sorted(labels.items()))
+            lab = "{" + body + "}"
+        try:
+            v = float(value)
+        except (TypeError, ValueError):
+            return
+        lines.append(f"{full}{lab} {v:g}")
+
+    fleet = snap.get("fleet", {})
+    gauge("ticks", fleet.get("ticks", 0),
+          help_="scheduling quanta dispatched fleet-wide")
+    gauge("fairness_index", fleet.get("fairness_index", 1.0),
+          help_="Jain index over weight-normalized trials served")
+    gauge("cache_hit_rate", fleet.get("cache_hit_rate", 0.0),
+          help_="process-wide executable-cache hit rate")
+    gauge("journal_depth", fleet.get("journal_depth", 0),
+          help_="write-ahead journal records since last compaction")
+    gauge("recoveries", fleet.get("recoveries", 0),
+          help_="hard-kill recoveries survived")
+    gauge("tenants_quarantined", fleet.get("quarantined", 0),
+          help_="poison tenants parked in durable quarantine")
+    # metric-family-OUTER, tenant-inner: the exposition format requires
+    # every sample of one family contiguous under a single HELP/TYPE —
+    # interleaving per tenant makes promtool reject the whole document
+    tenants = sorted(snap.get("tenants", {}).items())
+    families = dict(
+        trials="trials served", trials_per_s="serving rate",
+        ticks="scheduling quanta", vtime="fair-share virtual time",
+        queue_latency_s="submit-to-admission seconds",
+        failures="tick/elaboration exceptions")
+    for key, hp in families.items():
+        first = True
+        for name, row in tenants:
+            gauge(f"tenant_{key}", row.get(key, 0), {"tenant": name},
+                  help_=hp if first else "")
+            first = False
+    first = True
+    for name, row in tenants:
+        for lane, hw in sorted((row.get("halfwidth") or {}).items()):
+            gauge("tenant_halfwidth", hw, {"tenant": name, "lane": lane},
+                  help_="live Wilson half-width" if first else "")
+            first = False
+    return "\n".join(lines) + "\n"
+
+
+def publish(outdir: str, sched) -> dict:
+    """Snapshot + write both surfaces atomically; returns the snapshot.
+
+    Atomic means RENAME-atomic only — readers racing the scheduler never
+    see a torn document — but deliberately UNSYNCED: publish runs on
+    every scheduler tick, the snapshot is overwritten by the next tick,
+    and an fsync per tick would serialize disk latency into the dispatch
+    hot loop for durability nobody needs (crash recovery reads the WAL,
+    never metrics)."""
+    import json
+
+    snap = snapshot(sched)
+    os.makedirs(outdir, exist_ok=True)
+    tmp = os.path.join(outdir, METRICS_JSON + ".tmp")
+    with open(tmp, "w") as f:
+        # graftlint: allow-raw-write -- per-tick metrics snapshot:
+        # atomic rename, deliberately unsynced (overwritten next tick;
+        # a per-tick fsync would stall the scheduling loop, and crash
+        # recovery reads the journal, never this file)
+        json.dump(snap, f, default=str)
+    os.replace(tmp, os.path.join(outdir, METRICS_JSON))
+    prom = prometheus_text(snap)
+    tmp = os.path.join(outdir, METRICS_PROM + ".tmp")
+    with open(tmp, "w") as f:
+        f.write(prom)
+    os.replace(tmp, os.path.join(outdir, METRICS_PROM))
+    return snap
+
+
+def read(outdir: str) -> dict:
+    """Load the latest snapshot (``tools/obs.py --tail``)."""
+    import json
+
+    with open(os.path.join(outdir, METRICS_JSON)) as f:
+        return json.load(f)

@@ -42,6 +42,7 @@ import re
 import time
 from typing import Callable, NamedTuple
 
+from shrewd_tpu.obs import trace as obs_trace
 from shrewd_tpu.resilience import load_json_verified, write_json_atomic
 from shrewd_tpu.utils import debug
 from shrewd_tpu.utils.config import ConfigObject, Param
@@ -164,6 +165,10 @@ class Membership:
             # graftlint: allow-wall-clock -- heartbeat staleness is
             # wall-clock liveness, not a trigger decision: tallies stay
             # bit-identical under any membership (frozen-key re-dispatch)
+            # graftlint: allow-clock -- lease revocation compares against
+            # real filesystem mtimes, so this read must NOT route through
+            # the fake-able obs.clock seam (a test-installed clock would
+            # mass-revoke live workers or never revoke dead ones)
             age = time.time() - os.stat(self._hb_path(worker)).st_mtime
         except OSError:
             return False                 # left gracefully or never joined
@@ -377,6 +382,9 @@ class ElasticContext:
                 mine = doc.get("worker") == self.worker
                 if not mine:
                     self.adopted += 1
+                    obs_trace.tracer().emit(
+                        "lease_adopt", cat="elastic", key=target_key,
+                        peer=str(doc.get("worker", "")))
                 # a revocation we won may have been computed by a third
                 # worker first: the reclaim credit belongs to whoever
                 # computed it, not to our next unrelated claim
@@ -384,6 +392,9 @@ class ElasticContext:
                 return doc, not mine
             if self.board.claim(target_key):
                 self.claimed += 1
+                obs_trace.tracer().emit(
+                    "lease_claim", cat="elastic", key=target_key,
+                    worker=self.worker)
                 if self._reclaim_pending:
                     self.reclaimed += 1
                     self._reclaim_pending = False
@@ -397,6 +408,9 @@ class ElasticContext:
             if not self.membership.alive(owner):
                 if self.board.revoke(target_key, expected_owner=owner):
                     self.revoked += 1
+                    obs_trace.tracer().emit(
+                        "lease_revoke", cat="elastic", key=target_key,
+                        lost=owner)
                     self.lost_workers.add(owner)
                     self._reclaim_pending = True
                     self._pending_lost.append(WorkerLostInfo(

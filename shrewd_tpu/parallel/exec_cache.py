@@ -42,6 +42,7 @@ from typing import Callable
 
 import numpy as np
 
+from shrewd_tpu.obs import trace as obs_trace
 from shrewd_tpu.utils import debug
 
 debug.register_flag("ExecCache", "shared executable cache hits/misses")
@@ -151,6 +152,9 @@ class ExecutableCache:
         self._entries.move_to_end(key)
         self.reused += 1
         self._key_stat(key)["hits"] += 1
+        obs_trace.tracer().emit(
+            "exec_cache_hit", cat="exec_cache",
+            kind=str(key[0]) if key else "step", digest=key_digest(key))
         debug.dprintf("ExecCache", "reuse %s", key[0] if key else key)
         return fn
 
@@ -256,6 +260,10 @@ class ExecutableCache:
             return fn
         self.compiled += 1
         self._key_stat(key)["misses"] += 1
+        obs_trace.tracer().emit(
+            "exec_cache_compile", cat="exec_cache",
+            kind=str(key[0]) if key else "step", digest=key_digest(key),
+            aot=False)
         debug.dprintf("ExecCache", "compile %s", key[0] if key else key)
         return self._store(key, owner,
                            self._audited_on_first_call(key, build()))
@@ -272,6 +280,10 @@ class ExecutableCache:
             return fn
         self.compiled += 1
         self._key_stat(key)["misses"] += 1
+        obs_trace.tracer().emit(
+            "exec_cache_compile", cat="exec_cache",
+            kind=str(key[0]) if key else "step", digest=key_digest(key),
+            aot=True)
         jit_fn = build()
         # the AOT path has example args in hand: certify at ADMISSION —
         # a strict-mode violation refuses the executable before the

@@ -35,13 +35,14 @@ enters only through the campaign/dispatcher objects the engine drives.
 
 from __future__ import annotations
 
-import time
 from collections import deque
 from typing import NamedTuple
 
 import numpy as np
 
 from shrewd_tpu import resilience as resil
+from shrewd_tpu.obs import clock as obs_clock
+from shrewd_tpu.obs import trace as obs_trace
 from shrewd_tpu.utils import debug
 from shrewd_tpu.utils.config import ConfigObject, Param
 
@@ -160,8 +161,8 @@ def _believe_device_result(engine, tally, strata, n_batches: int, b0: int,
                                else np.asarray(strata, dtype=np.int64),
                                resil.TIER_DEVICE, 1)
     res = engine.monitor.apply_corruption(res)
-    problems = engine.checked.check_result(res,
-                                           n_batches * engine.batch_size)
+    problems = engine.checked.check_result(
+        res, n_batches * engine.batch_size, batch_id=b0)
     engine.checked.sync_shard_counters(b0)
     if problems:
         if esc0 is not None:
@@ -236,6 +237,18 @@ class PipelinedEngine:
 
     # --- dispatch-ahead -------------------------------------------------
 
+    def _drop_inflight(self) -> None:
+        """Discard the in-flight queue, CLOSING each interval's async
+        span (same name/coords as its "B", so exporters pair them):
+        routinely-dropped speculation must not read as wedged dispatches
+        in the flight recorder — unclosed spans are the wedge signal."""
+        for p in self._q:
+            obs_trace.tracer().emit(
+                "interval_inflight", cat="dispatch", ph="E",
+                sp=self.sp_name, structure=self.structure,
+                b0=int(p.b0), k=int(p.k), dropped=True)
+        self._q.clear()
+
     def _fill(self, b0: int, k: int) -> None:
         q = self._q
         if q and (q[0].b0 != b0 or q[0].k != k):
@@ -245,7 +258,7 @@ class PipelinedEngine:
             debug.dprintf("Pipeline", "%s/%s: dropping %d stale in-flight "
                           "intervals (head %d!=%d)", self.sp_name,
                           self.structure, len(q), q[0].b0, b0)
-            q.clear()
+            self._drop_inflight()
         while len(q) < self.depth:
             nb = (q[-1].b0 + q[-1].k) if q else b0
             if nb >= self.ceiling:
@@ -260,10 +273,20 @@ class PipelinedEngine:
             if not q:
                 kk = k            # the head must match the caller's ask
             keys = [self._keys(b) for b in range(nb, nb + kk)]
+            # async-span begin: the interval is now in flight — the
+            # matching "E" lands at materialization, so the exported
+            # timeline shows dispatch-ahead overlap and queue depth
+            obs_trace.tracer().emit(
+                "interval_inflight", cat="dispatch", ph="B",
+                sp=self.sp_name, structure=self.structure,
+                b0=int(nb), k=int(kk))
             handle = self.campaign.dispatch_interval(keys)
             q.append(_Pending(nb, kk, keys, handle))
             self.perf.dispatches += 1
             self.perf.depth_hwm = max(self.perf.depth_hwm, len(q))
+            obs_trace.tracer().counter(
+                "dispatch_depth", len(q), cat="dispatch",
+                sp=self.sp_name, structure=self.structure)
         if not q or q[0].b0 != b0:
             raise RuntimeError(
                 f"{self.sp_name}/{self.structure}: interval at batch {b0} "
@@ -272,7 +295,7 @@ class PipelinedEngine:
     # --- the believed-interval protocol ---------------------------------
 
     def obtain(self, b0: int, k: int, stratified: bool = False) -> dict:
-        now = time.monotonic()
+        now = obs_clock.monotonic()
         if self._last_return is not None:
             # host-side time since the last interval was handed over:
             # stats/stopping/checkpoint work that ran while the next
@@ -281,7 +304,7 @@ class PipelinedEngine:
         try:
             return self._obtain(b0, k, stratified)
         finally:
-            self._last_return = time.monotonic()
+            self._last_return = obs_clock.monotonic()
 
     def _obtain(self, b0: int, k: int, stratified: bool) -> dict:
         try:
@@ -308,12 +331,16 @@ class PipelinedEngine:
             kernel = self.campaign.kernel
             esc0 = getattr(kernel, "escapes", None)
             tt0 = getattr(kernel, "taint_trials", None)
-            t0 = time.monotonic()
+            t0 = obs_clock.monotonic()
             tally, strata = self.campaign.materialize_interval(
                 head.handle, timeout=tmo)
-            t1 = time.monotonic()
+            t1 = obs_clock.monotonic()
             self.perf.device_wait_seconds += t1 - t0
             self.perf.device_step_seconds += t1 - head.handle.armed_at
+            obs_trace.tracer().emit(
+                "interval_inflight", cat="dispatch", ph="E",
+                sp=self.sp_name, structure=self.structure,
+                b0=int(b0), k=int(k))
         except Exception as e:  # noqa: BLE001 — wedge, backend crash,
             # shard-sum mismatch: every dispatch/materialization failure
             # recovers through the serial per-batch ladder on frozen keys
@@ -335,7 +362,10 @@ class PipelinedEngine:
         dispatched to it), so drop it and route each batch through the
         integrity-checked resilience ladder — the exact serial path, so
         recovery is bit-identical by the ladder's own contract."""
-        self._q.clear()
+        self._drop_inflight()
+        obs_trace.tracer().emit(
+            "serial_recovery", cat="dispatch", sp=self.sp_name,
+            structure=self.structure, b0=int(b0), k=int(k))
         return _serial_batches(self.checked, self._keys, b0, k, stratified,
                                self.batch_size, self.perf)
 
@@ -436,6 +466,10 @@ class UntilCIEngine:
         esc0 = getattr(kernel, "escapes", None)
         tt0 = getattr(kernel, "taint_trials", None)
         try:
+            obs_trace.tracer().emit(
+                "super_interval_inflight", cat="dispatch", ph="B",
+                sp=self.sp_name, structure=self.structure,
+                b0=int(b0), k=int(S))
             handle = self.campaign.dispatch_until_ci(
                 keys, tallies, strata, trials0, self.min_trials,
                 self.target_halfwidth, self.confidence, strat_rule)
@@ -447,12 +481,16 @@ class UntilCIEngine:
             wd = self.campaign.watchdog
             tmo = (wd.timeout * S if wd is not None and wd.timeout > 0
                    else None)
-            t0 = time.monotonic()
+            t0 = obs_clock.monotonic()
             tally, strata_d, consumed, hw_tail = \
                 self.campaign.materialize_until_ci(handle, timeout=tmo)
-            t1 = time.monotonic()
+            t1 = obs_clock.monotonic()
             self.perf.device_wait_seconds += t1 - t0
             self.perf.device_step_seconds += t1 - handle.armed_at
+            obs_trace.tracer().emit(
+                "super_interval_inflight", cat="dispatch", ph="E",
+                sp=self.sp_name, structure=self.structure,
+                b0=int(b0), k=int(S), consumed=int(consumed))
         except Exception as e:  # noqa: BLE001 — wedge, backend crash,
             # shard-sum mismatch: recover serially on frozen keys with
             # the host stopping rule deciding where to stop
@@ -485,6 +523,11 @@ class UntilCIEngine:
         consumed batch count (never trusting a device-decided count from
         an untrusted result)."""
         from shrewd_tpu.parallel import stopping
+
+        obs_trace.tracer().emit(
+            "serial_recovery", cat="dispatch", sp=self.sp_name,
+            structure=self.structure, b0=int(b0), k=int(S),
+            host_rule=True)
 
         cum = np.asarray(tallies, dtype=np.int64).copy()
         cum_strata = (None if strata is None

@@ -141,6 +141,21 @@ def strata_cover_trials(strata, trials: int) -> bool:
     return strata is not None and int(np.asarray(strata).sum()) == trials
 
 
+def live_halfwidth(vulnerable: int, trials: int, strata,
+                   stratify: bool, confidence: float) -> float:
+    """The half-width the live stopping rule actually tracks: the
+    post-stratified (Agresti-Coull) estimator when the campaign
+    stratifies and the strata history covers every counted trial, pooled
+    Wilson otherwise — the same selection the orchestrator's convergence
+    check applies, so any published convergence distance (metrics
+    snapshots, the trials-needed planner) agrees with the rule that
+    decides stopping."""
+    if stratify and strata_cover_trials(strata, trials):
+        return post_stratified(pairs_from_strata(strata),
+                               confidence).halfwidth
+    return wilson(vulnerable, trials, confidence).halfwidth
+
+
 # --------------------------------------------------------------------------
 # device mirrors (the device-resident run-until-CI step)
 # --------------------------------------------------------------------------

@@ -46,6 +46,8 @@ from typing import Callable, NamedTuple
 
 import numpy as np
 
+from shrewd_tpu.obs import clock as obs_clock
+from shrewd_tpu.obs import trace as obs_trace
 from shrewd_tpu.utils import debug
 from shrewd_tpu.utils.config import ConfigObject, Param
 
@@ -213,6 +215,9 @@ class DeviceWatchdog:
         if not done.wait(tmo):
             self.timeouts += 1
             self.healthy = False
+            obs_trace.tracer().emit(
+                "watchdog_fire", cat="resilience", watchdog=self.name,
+                timeout_s=round(tmo, 3), dispatch=self.dispatches)
             # the dispatch thread is stuck in C; abandon it (daemon — it
             # dies with the process) and let the caller's ladder decide.
             # Track the orphan: repeated wedges accumulate threads (and
@@ -252,7 +257,10 @@ class DeviceWatchdog:
     def arm(self) -> float:
         """Future mode, dispatch side: stamp the moment a dispatch was
         enqueued.  Pass the token to ``call_armed`` at materialization."""
-        return time.monotonic()
+        obs_trace.tracer().emit("watchdog_arm", cat="resilience",
+                                watchdog=self.name,
+                                dispatch=self.dispatches)
+        return obs_clock.monotonic()
 
     #: minimum materialization grace even when the armed deadline has
     #: fully elapsed while the host did other work: an already-complete
@@ -269,7 +277,7 @@ class DeviceWatchdog:
         tmo = self.timeout if timeout is None else float(timeout)
         if tmo <= 0:
             return self.call(fn, timeout=0.0)
-        remaining = tmo - (time.monotonic() - armed_at)
+        remaining = tmo - (obs_clock.monotonic() - armed_at)
         return self.call(fn, timeout=max(remaining, self.armed_floor))
 
     def probe(self, fn: Callable, timeout: float | None = None) -> bool:

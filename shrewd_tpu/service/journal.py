@@ -47,6 +47,7 @@ import json
 import os
 
 from shrewd_tpu import resilience as resil
+from shrewd_tpu.obs import trace as obs_trace
 from shrewd_tpu.utils import debug
 
 #: the journal file inside ``<outdir>/fleet_ckpt/``
@@ -158,6 +159,8 @@ class FleetJournal:
         self.next_seq += 1
         self.appended += 1
         self.since_compact += 1
+        obs_trace.tracer().emit("journal_append", cat="journal",
+                                kind=rec["kind"], seq=rec["seq"])
         if self.chaos is not None:
             # kill_fleet at a journal ordinal: the boundary right after
             # record ``seq`` became durable (mid-tick, from the
@@ -182,6 +185,8 @@ class FleetJournal:
         self._f = open(self.path, "a")
         self.compactions += 1
         self.since_compact = 0
+        obs_trace.tracer().emit("journal_compact", cat="journal",
+                                next_seq=self.next_seq)
         debug.dprintf("Fleet", "journal compacted (next seq %d)",
                       self.next_seq)
 

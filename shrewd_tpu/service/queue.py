@@ -40,8 +40,8 @@ from __future__ import annotations
 import json
 import os
 import re
-import time
 
+from shrewd_tpu.obs import clock as obs_clock
 from shrewd_tpu.resilience import (doc_checksum, load_json_verified,
                                    write_json_atomic)
 from shrewd_tpu.utils import debug
@@ -138,11 +138,12 @@ class SubmissionQueue:
         document and skips it, never a half-spec."""
         doc = spec.to_dict()
         if not doc.get("submitted_at"):
-            # graftlint: allow-wall-clock -- submission timestamp feeds
-            # the queue-latency observability stat only; scheduling
-            # decisions are pure functions of admission order and batch
-            # counts, and tallies are frozen-key pure either way
-            doc["submitted_at"] = time.time()
+            # submission timestamp feeds the queue-latency observability
+            # stat only; scheduling decisions are pure functions of
+            # admission order and batch counts, and tallies are
+            # frozen-key pure either way.  Routed through the sanctioned
+            # obs.clock seam (GL106).
+            doc["submitted_at"] = obs_clock.now()
         # content checksum: a claimed doc that PARSES but fails this is
         # definitively poisoned (bit-rot, tampering) and takes the bad/
         # quarantine path, never the in-flight-skip path

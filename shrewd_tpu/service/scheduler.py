@@ -75,6 +75,9 @@ import numpy as np
 from shrewd_tpu import chaos as chaos_mod
 from shrewd_tpu import resilience as resil
 from shrewd_tpu import stats as statsmod
+from shrewd_tpu.obs import clock as obs_clock
+from shrewd_tpu.obs import metrics as obs_metrics
+from shrewd_tpu.obs import trace as obs_trace
 from shrewd_tpu.service.journal import FleetJournal, is_dirty, journal_path
 from shrewd_tpu.service.queue import SubmissionQueue, TenantSpec, sanitize
 from shrewd_tpu.utils import debug
@@ -222,7 +225,13 @@ class CampaignScheduler:
         self._watchdog = (resil.DeviceWatchdog(timeout=self.tick_timeout,
                                                name="fleet-tick")
                           if self.tick_timeout > 0 else None)
-        self._t0 = time.monotonic()
+        self._t0 = obs_clock.monotonic()
+        # abnormal exits (chaos hard kill, quarantine) dump the flight
+        # recorder here — pre-registered because the kill seam fires
+        # with no outdir in hand (obs/trace.py maybe_flight_dump)
+        if outdir:
+            obs_trace.tracer().set_flight_path(
+                os.path.join(outdir, obs_trace.FLIGHT_NAME))
         self._build_stats()
 
     # --- mesh / stats -----------------------------------------------------
@@ -387,14 +396,18 @@ class CampaignScheduler:
             raise ValueError(f"tenant {spec.name!r} already admitted")
         t = TenantState(spec, order=len(self.tenants), ticket=ticket)
         if spec.submitted_at:
-            # graftlint: allow-wall-clock -- queue latency is
-            # observability (submit → admission seconds across
-            # processes); every scheduling decision reads only admission
-            # order, trial counts and weights
-            t.queue_latency_s = max(0.0, time.time() - spec.submitted_at)
+            # queue latency is observability (submit → admission seconds
+            # across processes); every scheduling decision reads only
+            # admission order, trial counts and weights.  Routed through
+            # the sanctioned obs.clock seam (GL106).
+            t.queue_latency_s = max(0.0, obs_clock.now()
+                                    - spec.submitted_at)
         self.tenants[spec.name] = t
         self._jlog("admit", {"tenant": spec.name, "spec": spec.to_dict(),
                              "ticket": ticket, "order": t.order})
+        obs_trace.tracer().emit(
+            "tenant_admit", cat="fleet", tenant=spec.name,
+            order=t.order, priority=spec.priority, weight=spec.weight)
         debug.dprintf("Fleet", "admitted %s (priority=%d weight=%g%s)",
                       spec.name, spec.priority, spec.weight,
                       f" ticket={ticket}" if ticket else "")
@@ -452,8 +465,11 @@ class CampaignScheduler:
         t.driver = t.orch.stepper()
         t.status = "running"
         self._jlog("status", {"tenant": t.spec.name, "status": "running"})
+        obs_trace.tracer().emit(
+            "tenant_start", cat="fleet", tenant=t.spec.name,
+            resumed=bool(resumable))
         if t._t_admit is None:
-            t._t_admit = time.monotonic()
+            t._t_admit = obs_clock.monotonic()
         self._rebalance()
 
     def _scope_chaos(self, t: TenantState, engine=None) -> None:
@@ -595,6 +611,10 @@ class CampaignScheduler:
                                "fleet_tick": self.ticks,
                                "retry_at": t.retry_at,
                                "error": entry["error"]})
+        obs_trace.tracer().emit(
+            "tenant_failure", cat="fleet", tenant=t.spec.name,
+            failures=t.failures, fleet_tick=self.ticks,
+            retry_at=t.retry_at)
         debug.dprintf("Fleet", "%s: failure %d/%d (%s) — retry at tick "
                       "%d", t.spec.name, t.failures, self.retry_budget,
                       err, t.retry_at)
@@ -609,7 +629,11 @@ class CampaignScheduler:
         t.status = "quarantined"
         last = t.errors[-1]["error"] if t.errors else ""
         t.results = {"error": last, "failures": t.failures}
-        t.wall_s = (time.monotonic() - t._t_admit) if t._t_admit else 0.0
+        t.wall_s = (obs_clock.monotonic() - t._t_admit) if t._t_admit \
+            else 0.0
+        obs_trace.tracer().emit(
+            "tenant_quarantine", cat="fleet", tenant=t.spec.name,
+            failures=t.failures, fleet_tick=self.ticks)
         outdir = self.tenant_outdir(t.spec.name)
         if outdir:
             os.makedirs(outdir, exist_ok=True)
@@ -629,6 +653,11 @@ class CampaignScheduler:
         self._rebalance()
         if self.outdir:
             self.checkpoint()
+        # "why did this tenant quarantine" must be answerable from one
+        # artifact: dump the recent-event window now, while the failing
+        # tenant's dispatch/verdict/failure events are still in the ring
+        obs_trace.flight_dump(self.outdir, "tenant_quarantine",
+                              tenant=t.spec.name, failures=t.failures)
 
     def _pick(self, cands: list[TenantState]) -> TenantState:
         top = max(t.spec.priority for t in cands)
@@ -649,6 +678,8 @@ class CampaignScheduler:
         debug.dprintf("Fleet", "%s: %s — rebuilding tenant", t.spec.name, e)
         self._jlog("tenant_kill", {"tenant": t.spec.name,
                                    "kills": t.kills})
+        obs_trace.tracer().emit("tenant_kill", cat="fleet",
+                                tenant=t.spec.name, kills=t.kills)
         engine = t.orch.chaos
         t.status = "queued"
         t.orch = t.driver = None
@@ -656,6 +687,16 @@ class CampaignScheduler:
         self._scope_chaos(t, engine=engine)
 
     def _tick_tenant(self, t: TenantState) -> None:
+        # ambient tenant scope: every event the tick emits from nested
+        # seams (exec cache, watchdog, integrity, chaos) lands in this
+        # tenant's lane without threading identity through every call
+        with obs_trace.tracer().scope(tenant=t.spec.name):
+            self._tick_tenant_scoped(t)
+
+    def _tick_tenant_scoped(self, t: TenantState) -> None:
+        obs_trace.tracer().emit(
+            "tenant_tick", cat="fleet", tenant=t.spec.name,
+            fleet_tick=self.ticks, tick=t.ticks)
         try:
             if self._watchdog is not None:
                 # per-tenant tick watchdog: a livelocked tick (wedged
@@ -718,8 +759,12 @@ class CampaignScheduler:
                 # group reports)
                 for _ in range(t.kills):
                     t.orch.chaos.note_survived("kill_worker")
-        t.wall_s = (time.monotonic() - t._t_admit) if t._t_admit else 0.0
+        t.wall_s = (obs_clock.monotonic() - t._t_admit) if t._t_admit \
+            else 0.0
         t.results = self._summarize(t)
+        obs_trace.tracer().emit(
+            "tenant_done", cat="fleet", tenant=t.spec.name,
+            status=t.status, rc=t.rc, trials=t.trials)
         self._jlog("status", {"tenant": t.spec.name, "status": t.status,
                               "rc": t.rc, "trials": t.trials,
                               "batches": t.batches,
@@ -792,6 +837,7 @@ class CampaignScheduler:
             self.schedule_log.append(t.spec.name)
             self.ticks += 1
             self._tick_tenant(t)
+            self._publish_metrics()
             if self.on_tick is not None:
                 self.on_tick(self)
         self.write_outputs()
@@ -840,6 +886,20 @@ class CampaignScheduler:
         debug.dprintf("Fleet", "fleet drained: %s", self._by_status())
         return 4
 
+    def _publish_metrics(self) -> None:
+        """Atomic per-tick metrics snapshot (``metrics.json`` +
+        Prometheus text) — the live pull surface ``tools/obs.py --tail``
+        and scrapers consume.  Best-effort: an observability write must
+        never take the fleet down."""
+        if not self.outdir:
+            return
+        try:
+            obs_metrics.publish(self.outdir, self)
+        except Exception as e:  # noqa: BLE001 — the publish path runs
+            # real computation (half-widths, serialization) per tick; NO
+            # exception from it may take the resident fleet down
+            debug.dprintf("Fleet", "metrics publish failed: %s", e)
+
     # --- fleet state persistence / outputs --------------------------------
 
     def results(self) -> dict:
@@ -860,10 +920,20 @@ class CampaignScheduler:
         if not self.outdir:
             return
         os.makedirs(self.outdir, exist_ok=True)
+        self._publish_metrics()     # terminal statuses visible to tailers
         with open(os.path.join(self.outdir, "fleet_stats.txt"), "w") as f:
             statsmod.dump_text(self.stats, f)
         with open(os.path.join(self.outdir, "fleet_stats.json"), "w") as f:
             statsmod.dump_json(self.stats, f)
+        tracer = obs_trace.tracer()
+        if tracer.enabled:
+            from shrewd_tpu.obs import export as obs_export
+
+            # fleet-level Perfetto export: per-tenant lanes on the pid
+            # axis (the tenant scope every tick wraps its events in)
+            resil.write_json_atomic(
+                os.path.join(self.outdir, "trace.json"),
+                obs_export.to_trace_event(tracer.snapshot()))
 
     def checkpoint(self) -> str:
         """Persist the fleet's own resumable state (atomic, checksummed —
@@ -1058,6 +1128,10 @@ class CampaignScheduler:
             sched._jlog("recover", {"recoveries": sched.recoveries,
                                     "replayed": len(fresh),
                                     "torn_dropped": torn})
+            obs_trace.tracer().emit(
+                "fleet_recover", cat="fleet",
+                recoveries=sched.recoveries, replayed=len(fresh),
+                torn_dropped=torn)
             debug.dprintf("Fleet", "recovered dirty fleet: %d journal "
                           "records replayed, %d torn dropped",
                           len(fresh), torn)

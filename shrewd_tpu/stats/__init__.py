@@ -22,8 +22,8 @@ from typing import Any, Callable, Iterator
 import numpy as np
 
 __all__ = [
-    "Scalar", "Vector", "Distribution", "Histogram", "Formula", "Group",
-    "dump_text", "dump_json", "to_dict",
+    "Scalar", "Vector", "Distribution", "Histogram", "Formula", "Text",
+    "Group", "dump_text", "dump_json", "to_dict",
 ]
 
 
@@ -256,6 +256,31 @@ class Formula(StatBase):
         return self.fn()
 
 
+class Text(StatBase):
+    """A string-valued stat (the reference's ``statistics::Info`` prose
+    fields): run identity, posture labels, abort reasons.  Every dump
+    backend is string-safe for it — ``dump_hdf5`` writes a variable-
+    length string dataset (the same fallback dict-valued Formulas with
+    string leaves already get), so a prose value never trips the
+    numeric-only Formula contract."""
+
+    def __init__(self, name: str, value: str = "", desc: str = ""):
+        super().__init__(name, desc)
+        self.value = str(value)
+
+    def set(self, value) -> None:
+        self.value = str(value)
+
+    def reset(self) -> None:
+        self.value = ""
+
+    def rows(self, prefix):
+        yield f"{prefix}{self.name}", self.value, self.desc
+
+    def to_value(self):
+        return self.value
+
+
 class Group:
     """Hierarchical stat container (``statistics::Group``).
 
@@ -400,21 +425,32 @@ def dump_hdf5(group: Group, path: str) -> None:
     moment attributes.  One dump per call (overwrite semantics)."""
     import h5py
 
-    def write_dict(h5g, d: dict) -> None:
+    def write_dict(h5g, d: dict, path: str) -> None:
         """Dict-valued Formula payloads, possibly nested (e.g. the
         per-content-key executable-cache ledger) and possibly carrying
         string leaves — strings land as variable-length string scalars,
-        numbers as float64."""
+        numbers as float64.  Non-numeric leaves raise with the full
+        stat path, like the scalar branch below."""
         for key, val in d.items():
+            leaf = f"{path}.{key}"
             if isinstance(val, dict):
-                write_dict(h5g.require_group(str(key)), val)
+                write_dict(h5g.require_group(str(key)), val, leaf)
             elif isinstance(val, str):
                 h5g.create_dataset(str(key), data=val)
             else:
-                h5g.create_dataset(str(key), data=float(val))
+                try:
+                    fv = float(val)
+                except (TypeError, ValueError):
+                    raise TypeError(
+                        f"stat {leaf!r}: Formula must be numeric, got "
+                        f"{type(val).__name__} ({val!r}) — return a "
+                        "number (NaN is fine), a dict of numbers/"
+                        "strings, or use stats.Text for prose") from None
+                h5g.create_dataset(str(key), data=fv)
 
-    def write_group(h5g, g: Group) -> None:
+    def write_group(h5g, g: Group, prefix: str) -> None:
         for s in g._stats.values():
+            stat_path = f"{prefix}{s.name}"
             if isinstance(s, Distribution):      # includes Histogram
                 v = s.to_value()
                 ds = h5g.create_dataset(
@@ -427,19 +463,37 @@ def dump_hdf5(group: Group, path: str) -> None:
                     s.name, data=np.asarray(s.value, np.float64))
                 if s.subnames:
                     ds.attrs["subnames"] = [str(x) for x in s.subnames]
-            else:                                 # Scalar / Formula
+            else:                                 # Scalar / Formula / Text
                 v = s.to_value()
                 if isinstance(v, dict):           # dict-valued Formula
-                    write_dict(h5g.require_group(s.name), v)
+                    write_dict(h5g.require_group(s.name), v, stat_path)
+                elif isinstance(v, str):          # Text / prose Formula:
+                    # the same string-safe fallback write_dict gives
+                    # nested string leaves
+                    h5g.create_dataset(s.name, data=v)
                 else:
-                    h5g.create_dataset(s.name, data=float(v))
+                    try:
+                        fv = float(v)
+                    except (TypeError, ValueError):
+                        # name the offending stat: the bare float(v)
+                        # TypeError ("Formula must be numeric") gave no
+                        # path, which once cost a session 17 tests of
+                        # archaeology
+                        raise TypeError(
+                            f"stat {stat_path!r}: Formula must be "
+                            f"numeric, got {type(v).__name__} ({v!r}) — "
+                            "return a number (NaN is fine), a dict of "
+                            "numbers/strings, or use stats.Text for "
+                            "prose") from None
+                    h5g.create_dataset(s.name, data=fv)
             h5g[s.name].attrs["description"] = s.desc
         for sub in g._groups.values():
-            write_group(h5g.require_group(sub.name), sub)
+            write_group(h5g.require_group(sub.name), sub,
+                        f"{prefix}{sub.name}.")
 
     with h5py.File(path, "w") as f:
         root = f.require_group(group.name) if group.name else f["/"]
-        write_group(root, group)
+        write_group(root, group, f"{group.name}." if group.name else "")
 
 
 __all__.append("dump_hdf5")

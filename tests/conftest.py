@@ -103,6 +103,12 @@ QUICK_MODULES = {
     # cache, and the convergence-correctness smoke (the north-star loop
     # itself) belongs in the on-every-push tier like the layers under it
     "test_until_ci",
+    # observability: tracer/exporter/metrics units are sub-second; the
+    # trace-determinism and tracing-on/off bit-identity integrations
+    # reuse the shared tiny-kernel compiles, and the observability-
+    # never-perturbs-the-run contract guards every other pin in this
+    # tier — it belongs on every push
+    "test_obs",
 }
 QUICK_TESTS = {
     # one representative per subsystem (≈4-10 s each, compile-dominated)

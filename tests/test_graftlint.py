@@ -79,21 +79,29 @@ def test_gl101_bare_jit_positive_and_negative(tmp_path):
 
 def test_gl102_wall_clock_positive_and_negative(tmp_path):
     rel = "shrewd_tpu/chaos.py"
+    # chaos.py is BOTH wall-clock-gated (GL102) and obs-clock-gated
+    # (GL106) since the obs PR: a wall-clock read trips both rules
     bad = _lint_src(tmp_path, """
         import time
         def should_fire(batch_id):
             return time.time() % 2 < 1
     """, rel=rel)
-    assert _rules(bad) == ["GL102"]
-    # monotonic perf ledgers and sleeps are not schedule-bearing reads
+    assert _rules(bad) == ["GL102", "GL106"]
+    # sleeps are not schedule-bearing reads (and not clock reads either)
     good = _lint_src(tmp_path, """
         import time
         def wedge():
             time.sleep(0.1)
+    """, rel=rel)
+    assert _rules(good) == []
+    # a monotonic perf ledger is GL102-clean (not a wall-clock read) but
+    # must still route through the obs.clock seam in instrumented modules
+    mono = _lint_src(tmp_path, """
+        import time
         def ledger():
             return time.monotonic()
     """, rel=rel)
-    assert _rules(good) == []
+    assert _rules(mono) == ["GL106"]
 
 
 def test_gl103_raw_write_positive_and_negative(tmp_path):
@@ -158,6 +166,55 @@ def test_gl105_key_genesis_positive_and_negative(tmp_path):
             return jax.random.key(seed)
     """, rel="shrewd_tpu/utils/prng.py")
     assert _rules(allowed) == []
+
+
+def test_gl106_clock_seam_positive_and_negative(tmp_path):
+    rel = "shrewd_tpu/parallel/pipeline.py"
+    # every direct clock read (wall, monotonic, perf_counter, _ns
+    # variants) must route through obs.clock in instrumented modules
+    bad = _lint_src(tmp_path, """
+        import time
+        def ledger():
+            return time.monotonic(), time.perf_counter_ns()
+    """, rel=rel)
+    assert _rules(bad) == ["GL106"]
+    # the sanctioned seam is quiet, and sleep is not a read
+    good = _lint_src(tmp_path, """
+        import time
+        from shrewd_tpu.obs import clock
+        def ledger():
+            time.sleep(0.01)
+            return clock.monotonic(), clock.now()
+    """, rel=rel)
+    assert _rules(good) == []
+    # out-of-scope module: rule does not apply
+    off = _lint_src(tmp_path, """
+        import time
+        t = time.monotonic()
+    """, rel="shrewd_tpu/models/o3.py")
+    assert _rules(off) == []
+    # waiverable with a reason, like every other rule
+    waived = _lint_src(tmp_path, """
+        import time
+        # graftlint: allow-clock -- fixture: sanctioned-seam bootstrap
+        t = time.monotonic()
+    """, rel=rel)
+    assert _rules(waived) == [] and _rules(waived, waived=True) == ["GL106"]
+
+
+def test_gl106_obs_clock_is_the_one_sanctioned_seam():
+    """obs/clock.py itself is deliberately NOT clock-gated (it IS the
+    seam) and carries the one reasoned GL102 waiver for its wall-clock
+    read; the other obs modules are fully gated."""
+    cfg = load_config(REPO_ROOT)
+    assert "shrewd_tpu/obs/clock.py" not in cfg.clock_modules
+    assert "shrewd_tpu/obs/trace.py" in cfg.clock_modules
+    assert "shrewd_tpu/obs/trace.py" in cfg.deterministic_modules
+    assert "shrewd_tpu/obs/metrics.py" in cfg.checkpoint_modules
+    report = lint_tree(REPO_ROOT, cfg)
+    seam = [f for f in report.waivers
+            if f.path.endswith("obs/clock.py") and f.rule == "GL102"]
+    assert len(seam) == 1 and "sanctioned" in seam[0].waiver_reason
 
 
 def test_waiver_covers_but_only_with_reason(tmp_path):

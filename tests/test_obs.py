@@ -1,0 +1,430 @@
+"""Observability subsystem (shrewd_tpu/obs/): tracer, exporters, flight
+recorder, fleet metrics — and the contracts that make it safe to leave
+on everywhere:
+
+- the DISABLED tracer is a no-op constant (the default every hot path
+  pays for);
+- two identical runs emit byte-identical event streams after timestamp
+  normalization (event identity is campaign coordinates, never wall
+  clock or object identity) — including a chaos-quarantined run
+  replayed;
+- tracing on vs. off is bit-identical in every tally (observability
+  never perturbs what it observes), for dense/hybrid/stratified and a
+  2-tenant fleet;
+- a quarantined run leaves a flight-recorder dump from which the
+  failing batch's dispatch → integrity-verdict → quarantine →
+  ladder-recovery timeline is reconstructable;
+- the resident scheduler publishes an atomic metrics snapshot
+  (metrics.json + Prometheus text) each tick.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from shrewd_tpu.obs import clock as obs_clock
+from shrewd_tpu.obs import export as obs_export
+from shrewd_tpu.obs import metrics as obs_metrics
+from shrewd_tpu.obs import trace as obs_trace
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _obs_clean_slate():
+    """Tracing is process-global: every test starts and ends with the
+    no-op constant and the real clocks."""
+    obs_trace.disable()
+    obs_clock.reset()
+    yield
+    obs_trace.disable()
+    obs_clock.reset()
+
+
+# --- tracer units -----------------------------------------------------------
+
+def test_null_tracer_is_noop_constant():
+    t = obs_trace.tracer()
+    assert t is obs_trace.NULL_TRACER and not t.enabled
+    # every method is a no-op; the context managers are ONE shared object
+    t.emit("x", cat="y", b0=1)
+    t.counter("d", 3)
+    assert t.span("a") is t.span("b") is t.scope(k=1)
+    with t.span("a"):
+        pass
+    assert t.snapshot() == [] and t.emitted == 0 and t.dropped == 0
+    t.maybe_flight_dump("nothing")      # no path, no write, no raise
+
+
+def test_tracer_ring_counters_and_disable_returns_window():
+    live = obs_trace.enable(ring=4, timestamps=False)
+    assert obs_trace.tracer() is live and live.enabled
+    for i in range(6):
+        live.emit("ev", cat="c", i=i)
+    assert live.emitted == 6 and live.dropped == 2
+    window = live.snapshot()
+    assert [ev["seq"] for ev in window] == [2, 3, 4, 5]
+    assert all(ev["ts"] is None for ev in window)
+    assert live.by_name == {"ev": 6}
+    prev = obs_trace.disable()
+    assert prev is live and obs_trace.tracer() is obs_trace.NULL_TRACER
+    # the returned tracer still holds its window for post-hoc export
+    assert len(prev.snapshot()) == 4
+
+
+def test_scope_merges_and_span_pairs():
+    live = obs_trace.enable(timestamps=False)
+    with live.scope(tenant="t0"):
+        with live.span("interval", cat="dispatch", b0=3):
+            live.counter("depth", 2, cat="dispatch")
+    evs = live.snapshot()
+    assert [e["ph"] for e in evs] == ["B", "C", "E"]
+    assert all(e["args"]["tenant"] == "t0" for e in evs)
+    assert evs[0]["args"]["b0"] == 3 and evs[2]["args"]["b0"] == 3
+    assert evs[1]["args"]["value"] == 2
+    # scope restored: later events carry no tenant
+    live.emit("after")
+    assert "tenant" not in live.snapshot()[-1]["args"]
+
+
+def test_fake_clock_installs_and_resets():
+    ticks = iter(range(100))
+    obs_clock.install(mono=lambda: float(next(ticks)), wall=lambda: 1e9)
+    live = obs_trace.enable()
+    live.emit("a")
+    live.emit("b")
+    ts = [e["ts"] for e in live.snapshot()]
+    assert ts == [0.0, 1.0] and obs_clock.now() == 1e9
+    obs_clock.reset()
+    assert obs_clock.now() > 1e9 - 1   # real epoch again
+
+
+# --- exporters --------------------------------------------------------------
+
+def test_normalize_strips_only_timestamps():
+    evs = [{"seq": 0, "name": "a", "cat": "c", "ph": "i",
+            "args": {"b0": 1}, "ts": 12.5}]
+    norm = obs_export.normalize(evs)
+    assert norm == [{"seq": 0, "name": "a", "cat": "c", "ph": "i",
+                     "args": {"b0": 1}}]
+    # canonical bytes are insensitive to timestamps and key order
+    evs2 = [{"ts": 99.0, "args": {"b0": 1}, "ph": "i", "cat": "c",
+             "name": "a", "seq": 0}]
+    assert (obs_export.canonical_bytes(evs)
+            == obs_export.canonical_bytes(evs2))
+
+
+def test_to_trace_event_lanes_and_phases():
+    live = obs_trace.enable(timestamps=False)
+    with live.scope(tenant="t0"):
+        with live.span("interval", cat="dispatch", sp="w0",
+                       structure="regfile", b0=0):
+            pass
+    live.emit("quarantine", cat="integrity", sp="w0", structure="regfile")
+    live.counter("depth", 1, cat="dispatch")
+    doc = obs_export.to_trace_event(live.snapshot())
+    evs = doc["traceEvents"]
+    meta = [e for e in evs if e["ph"] == "M"]
+    assert {m["args"]["name"] for m in meta if m["name"] == "process_name"} \
+        == {"t0", "campaign"}
+    b = next(e for e in evs if e["ph"] == "b")
+    e = next(e for e in evs if e["ph"] == "e")
+    assert b["id"] == e["id"]           # async pair by semantic identity
+    c = next(e for e in evs if e["ph"] == "C")
+    assert c["args"] == {"depth": 1}
+    inst = next(e for e in evs if e["ph"] == "i")
+    assert inst["name"] == "quarantine"
+    # clock-free events render on the deterministic seq axis
+    assert b["ts"] == 0.0
+
+
+def test_summarize_and_render_text():
+    live = obs_trace.enable(timestamps=False)
+    with live.scope(tenant="t1"):
+        with live.span("tick", cat="fleet"):
+            live.emit("quarantine", cat="integrity", sp="w0",
+                      structure="rob")
+    s = obs_export.summarize(live.snapshot())
+    assert s["events"] == 3 and s["by_name"]["quarantine"] == 1
+    assert s["tenants"] == ["t1"] and s["unclosed_spans"] == 0
+    assert "w0/rob" in s["lanes"]
+    txt = obs_export.render_text(live.snapshot())
+    assert "quarantine" in txt and "tenant=t1" in txt
+
+
+# --- campaign-level contracts -----------------------------------------------
+
+def _tiny_plan(seed=0, mode="hybrid", stratify=False, n_batches=3,
+               sync_every=1, chaos=None):
+    from shrewd_tpu.campaign.plan import CampaignPlan, WorkloadSpec
+    from shrewd_tpu.models.o3 import O3Config
+    from shrewd_tpu.trace.synth import WorkloadConfig
+
+    p = CampaignPlan(
+        simpoints=[WorkloadSpec(
+            name="w0", workload=WorkloadConfig(n=96, nphys=32, mem_words=64,
+                                               working_set_words=32,
+                                               seed=7))],
+        structures=["regfile"], batch_size=32, target_halfwidth=0.5,
+        max_trials=32 * n_batches, min_trials=32 * n_batches, seed=seed,
+        machine=O3Config(replay_kernel=mode), stratify=stratify)
+    p.integrity.canary_trials = 0
+    p.integrity.audit_rate = 0.0
+    p.resilience.backoff_base = 0.0
+    p.pipeline.sync_every = sync_every
+    return p
+
+
+def _run(plan, chaos=None, outdir=None):
+    from shrewd_tpu.campaign.orchestrator import Orchestrator
+    from shrewd_tpu.chaos import ChaosEngine
+    from shrewd_tpu.sim.exit_event import ExitEvent
+
+    orch = Orchestrator(plan, outdir=outdir)
+    if chaos is not None:
+        orch.attach_chaos(ChaosEngine(chaos))
+    events = list(orch.events())
+    results = (dict(events[-1][1])
+               if events[-1][0] is ExitEvent.CAMPAIGN_COMPLETE else None)
+    return orch, results
+
+
+CORRUPT = {"faults": [{"kind": "corrupt_tally", "at_batch": 1,
+                       "delta": 1}]}
+
+
+def test_two_identical_runs_emit_byte_identical_streams():
+    """Event identity is campaign coordinates: same plan, same process,
+    warm cache → byte-identical streams after timestamp normalization."""
+    _run(_tiny_plan())                       # warm (compiles traced out)
+    streams = []
+    for _ in range(2):
+        live = obs_trace.enable()
+        _run(_tiny_plan())
+        obs_trace.disable()
+        streams.append(obs_export.canonical_bytes(live.snapshot()))
+        assert live.emitted > 0
+    assert streams[0] == streams[1]
+
+
+def test_chaos_quarantined_run_replays_byte_identical():
+    """The quarantine→ladder-recovery path is deterministic too: a
+    corrupt-tally run and its replay produce the same stream, and the
+    stream tells the whole story in order."""
+    _run(_tiny_plan(), chaos=CORRUPT)        # warm incl. recovery tiers
+    streams, names = [], None
+    for _ in range(2):
+        live = obs_trace.enable()
+        _run(_tiny_plan(), chaos=CORRUPT)
+        obs_trace.disable()
+        streams.append(obs_export.canonical_bytes(live.snapshot()))
+        names = [e["name"] for e in live.snapshot()]
+    assert streams[0] == streams[1]
+    # dispatch → verdict(bad) → quarantine → verdict(ok) → recovery
+    i_inj = names.index("chaos_inject")
+    i_q = names.index("quarantine")
+    i_rec = names.index("quarantine_recovered")
+    assert i_inj < i_q < i_rec
+    assert "batch_believed" in names[i_rec:]
+
+
+@pytest.mark.parametrize("mode,stratify", [
+    ("hybrid", False), ("dense", False), ("hybrid", True)])
+def test_tracing_on_vs_off_is_bit_identical(mode, stratify):
+    _, off = _run(_tiny_plan(mode=mode, stratify=stratify))
+    obs_trace.enable()
+    _, on = _run(_tiny_plan(mode=mode, stratify=stratify))
+    obs_trace.disable()
+    for k in off:
+        np.testing.assert_array_equal(off[k].tallies, on[k].tallies)
+        assert off[k].trials == on[k].trials
+
+
+def test_pipelined_run_records_interval_spans():
+    """sync_every > 1 emits paired in-flight interval spans plus the
+    dispatch-depth counter — the async timeline the exporter draws."""
+    _run(_tiny_plan(n_batches=8, sync_every=4))   # warm interval step
+    live = obs_trace.enable()
+    _run(_tiny_plan(n_batches=8, sync_every=4))
+    obs_trace.disable()
+    evs = live.snapshot()
+    b = [e for e in evs if e["name"] == "interval_inflight"
+         and e["ph"] == "B"]
+    e = [e for e in evs if e["name"] == "interval_inflight"
+         and e["ph"] == "E"]
+    assert b and len(b) == len(e)
+    assert any(e["name"] == "dispatch_depth" and e["ph"] == "C"
+               for e in evs)
+    s = obs_export.summarize(evs)
+    assert s["unclosed_spans"] == 0
+
+
+def test_flight_recorder_dump_reconstructs_quarantine(tmp_path):
+    """The acceptance artifact: a chaos-quarantined run with an outdir
+    leaves flightrec.json; the failing batch's dispatch →
+    integrity-verdict → quarantine → ladder-recovery timeline is
+    reconstructable from that one file, and write_outputs exports the
+    Perfetto trace alongside."""
+    obs_trace.enable()
+    orch, results = _run(_tiny_plan(), chaos=CORRUPT,
+                         outdir=str(tmp_path))
+    orch.write_outputs()
+    obs_trace.disable()
+    assert results is not None
+    rec_path = tmp_path / "flightrec.json"
+    assert rec_path.exists()
+    with open(rec_path) as f:
+        rec = json.load(f)
+    assert rec["reason"] == "quarantine_evidence"
+    evs = rec["events"]
+    assert [e["seq"] for e in evs] == sorted(e["seq"] for e in evs)
+    by_name = {}
+    for e in evs:
+        by_name.setdefault(e["name"], []).append(e)
+    bad = by_name["invariant_verdict"][0]["args"]
+    q = by_name["quarantine"][0]["args"]
+    rcv = by_name["quarantine_recovered"][0]["args"]
+    # the timeline names the SAME failing batch at every step
+    assert not by_name["invariant_verdict"][1]["args"]["ok"]
+    assert q["batch_id"] == rcv["batch_id"] \
+        == by_name["invariant_verdict"][1]["args"]["batch_id"] == 1
+    assert q["sp"] == "w0" and q["structure"] == "regfile"
+    assert not q["fatal"] and rcv["attempts"] >= 2
+    # Perfetto export loads and carries the same story
+    with open(tmp_path / "trace.json") as f:
+        doc = json.load(f)
+    assert any(r["name"] == "quarantine" for r in doc["traceEvents"])
+    # stats bridge: the obs group counted what the tracer did
+    from shrewd_tpu import stats as statsmod
+
+    obs_stats = statsmod.to_dict(orch.stats)["obs"]
+    assert obs_stats["tracing"] == 0          # disabled again by now
+    assert (tmp_path / "stats.json").exists()
+
+
+def test_flight_dump_is_noop_without_tracing_or_outdir(tmp_path):
+    assert obs_trace.flight_dump(str(tmp_path), "x") is None
+    obs_trace.enable()
+    assert obs_trace.flight_dump(None, "x") is None
+    path = obs_trace.flight_dump(str(tmp_path), "why", batch_id=4)
+    obs_trace.disable()
+    with open(path) as f:
+        doc = json.load(f)
+    assert doc["reason"] == "why" and doc["coords"] == {"batch_id": 4}
+
+
+# --- fleet: per-tenant lanes + live metrics ---------------------------------
+
+def test_traced_fleet_bit_identical_with_metrics(tmp_path):
+    from shrewd_tpu.service import CampaignScheduler, TenantSpec
+
+    solos = {}
+    warm = []
+    for seed in (0, 9):
+        orch, results = _run(_tiny_plan(seed=seed, n_batches=2))
+        warm.append(orch)    # keep kernels alive (owner-guarded cache)
+        solos[seed] = {k: v.tallies for k, v in results.items()}
+    obs_trace.enable()
+    sched = CampaignScheduler(outdir=str(tmp_path))
+    sched.admit(TenantSpec(name="t0", plan=_tiny_plan(
+        seed=0, n_batches=2).to_dict()))
+    sched.admit(TenantSpec(name="t9", plan=_tiny_plan(
+        seed=9, n_batches=2).to_dict()))
+    assert sched.run() == 0
+    live = obs_trace.disable()
+    for name, seed in (("t0", 0), ("t9", 9)):
+        got = sched.tenant_tallies(name)
+        for k, t in solos[seed].items():
+            np.testing.assert_array_equal(got[k], np.asarray(t))
+    # per-tenant lanes: scheduler + nested seam events carry the tenant
+    evs = live.snapshot()
+    tenants = {e["args"].get("tenant") for e in evs} - {None}
+    assert tenants == {"t0", "t9"}
+    for name in ("tenant_admit", "tenant_tick", "tenant_done",
+                 "journal_append"):
+        assert any(e["name"] == name for e in evs), name
+    nested = [e for e in evs if e["name"] == "batch_believed"]
+    assert nested and all("tenant" in e["args"] for e in nested)
+    # live metrics: atomic snapshot + Prometheus text published per tick
+    snap = obs_metrics.read(str(tmp_path))
+    assert snap["tick"] == snap["fleet"]["ticks"] > 0
+    for name in ("t0", "t9"):
+        row = snap["tenants"][name]
+        assert row["status"] == "complete" and row["trials"] == 64
+        assert "halfwidth" in row and "w0/regfile" in row["halfwidth"]
+    assert 0.0 < snap["fleet"]["fairness_index"] <= 1.0
+    with open(tmp_path / "metrics.prom") as f:
+        prom = f.read()
+    assert 'shrewd_fleet_tenant_trials{tenant="t0"} 64' in prom
+    assert "shrewd_fleet_fairness_index" in prom
+    # fleet-level Perfetto export rides write_outputs
+    with open(tmp_path / "trace.json") as f:
+        doc = json.load(f)
+    lanes = {m["args"]["name"] for m in doc["traceEvents"]
+             if m.get("ph") == "M" and m["name"] == "process_name"}
+    assert {"t0", "t9"} <= lanes
+
+
+def test_prometheus_text_renders_a_snapshot():
+    snap = {"tick": 3,
+            "fleet": {"ticks": 3, "fairness_index": 0.98,
+                      "cache_hit_rate": 0.75, "journal_depth": 4,
+                      "recoveries": 1, "quarantined": 0},
+            "tenants": {"a": {"trials": 64, "trials_per_s": 10.0,
+                              "ticks": 2, "vtime": 64.0,
+                              "queue_latency_s": 0.5, "failures": 0,
+                              "halfwidth": {"w0/regfile": 0.21}}}}
+    text = obs_metrics.prometheus_text(snap)
+    assert "# TYPE shrewd_fleet_ticks gauge" in text
+    assert "shrewd_fleet_recoveries 1" in text
+    assert ('shrewd_fleet_tenant_halfwidth{lane="w0/regfile",'
+            'tenant="a"} 0.21') in text
+    # exposition grouping: with 2+ tenants every family's samples are
+    # CONTIGUOUS under one HELP/TYPE (promtool rejects interleaving)
+    snap["tenants"]["b"] = {"trials": 32, "trials_per_s": 5.0,
+                            "ticks": 1, "vtime": 32.0,
+                            "queue_latency_s": 0.1, "failures": 1}
+    lines = obs_metrics.prometheus_text(snap).splitlines()
+    trials = [i for i, ln in enumerate(lines)
+              if ln.startswith("shrewd_fleet_tenant_trials{")]
+    assert trials == list(range(trials[0], trials[0] + 2))
+    assert sum(1 for ln in lines
+               if ln == "# TYPE shrewd_fleet_tenant_trials gauge") == 1
+    # label values are exposition-escaped: a hostile tenant name cannot
+    # inject lines or break the scrape
+    snap["tenants"] = {'a"b\n': {"trials": 1}}
+    text = obs_metrics.prometheus_text(snap)
+    assert 'tenant="a\\"b\\n"' in text and '\nb\n' not in text
+
+
+# --- tools/obs.py -----------------------------------------------------------
+
+def test_obs_cli_summarize_and_timeline(tmp_path):
+    obs_trace.enable()
+    orch, _ = _run(_tiny_plan(), chaos=CORRUPT, outdir=str(tmp_path))
+    orch.write_outputs()
+    obs_trace.disable()
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    tool = os.path.join(REPO_ROOT, "tools", "obs.py")
+    r = subprocess.run(
+        [sys.executable, tool, "--summarize",
+         str(tmp_path / "flightrec.json")],
+        capture_output=True, text=True, env=env)
+    assert r.returncode == 0, r.stderr
+    summary = json.loads(r.stdout)
+    assert summary["reason"] == "quarantine_evidence"
+    assert summary["by_name"]["quarantine"] == 1
+    # the Perfetto document loads through the same CLI
+    r = subprocess.run(
+        [sys.executable, tool, "--summarize", str(tmp_path / "trace.json")],
+        capture_output=True, text=True, env=env)
+    assert r.returncode == 0 and json.loads(r.stdout)["events"] > 0
+    r = subprocess.run(
+        [sys.executable, tool, "--timeline",
+         str(tmp_path / "flightrec.json")],
+        capture_output=True, text=True, env=env)
+    assert r.returncode == 0 and "quarantine" in r.stdout

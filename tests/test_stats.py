@@ -228,3 +228,64 @@ def test_dump_hdf5_dict_formula(tmp_path):
     with h5py.File(path) as f:
         assert float(f["x/ratios/a"][()]) == 0.25
         assert float(f["x/ratios/b"][()]) == 0.75
+
+
+def test_text_stat_string_safe_everywhere(tmp_path):
+    """``stats.Text`` (the reference's string-valued Info fields): prose
+    survives text/json dumps, and the HDF5 backend writes a string
+    dataset instead of tripping the numeric Formula contract."""
+    import io
+    import json as _json
+
+    from shrewd_tpu.stats import (Group, Text, dump_json, dump_text,
+                                  to_dict)
+
+    g = Group("run")
+    g.posture = Text("posture", "certify=strict", "run posture label")
+    assert to_dict(g)["posture"] == "certify=strict"
+    buf = io.StringIO()
+    dump_text(g, buf)
+    assert "certify=strict" in buf.getvalue()
+    buf = io.StringIO()
+    dump_json(g, buf)
+    assert _json.loads(buf.getvalue())["posture"] == "certify=strict"
+    g.posture.set("aborted: escalation")
+    assert g.posture.to_value() == "aborted: escalation"
+    g.posture.reset()
+    assert g.posture.to_value() == ""
+
+    h5py = pytest.importorskip("h5py")
+    from shrewd_tpu.stats import dump_hdf5
+
+    g.posture.set("resumable")
+    path = tmp_path / "t.h5"
+    dump_hdf5(g, str(path))
+    with h5py.File(path) as f:
+        raw = f["run/posture"][()]
+        val = raw.decode() if isinstance(raw, bytes) else str(raw)
+        assert val == "resumable"
+
+
+def test_dump_hdf5_names_the_offending_stat(tmp_path):
+    """A non-numeric Formula fails with the full stat PATH in the error
+    (the bare "Formula must be numeric" float() TypeError once cost a
+    session 17 tests of archaeology), and points at stats.Text."""
+    pytest.importorskip("h5py")
+    from shrewd_tpu.stats import Formula, Group, dump_hdf5
+
+    g = Group("campaign")
+    sub = Group("perf")
+    g.perf = sub
+    sub.bad = Formula("bad", lambda: None, "returns None by mistake")
+    with pytest.raises(TypeError) as ei:
+        dump_hdf5(g, str(tmp_path / "bad.h5"))
+    msg = str(ei.value)
+    assert "campaign.perf.bad" in msg
+    assert "Formula must be numeric" in msg
+    assert "stats.Text" in msg
+    # the nested dict-Formula path names the full LEAF path too
+    g2 = Group("campaign")
+    g2.ledger = Formula("ledger", lambda: {"a": {"b": [1, 2]}}, "oops")
+    with pytest.raises(TypeError) as ei:
+        dump_hdf5(g2, str(tmp_path / "bad2.h5"))
+    assert "campaign.ledger.a.b" in str(ei.value)

@@ -82,6 +82,10 @@ def cmd_serve(a) -> int:
                                     ServerLock, SubmissionQueue,
                                     TenantSpec, is_dirty)
 
+    if a.trace:
+        from shrewd_tpu.obs import trace as obs_trace
+
+        obs_trace.enable(ring=a.trace_ring or obs_trace.DEFAULT_RING)
     queue = SubmissionQueue(a.queue) if a.queue else None
     chaos = None
     if a.chaos_plan:
@@ -137,6 +141,9 @@ def cmd_serve(a) -> int:
         finally:
             restore()
         _report(sched)
+        if sched.outdir:
+            _log(f"live metrics: {sched.outdir}/metrics.json (+ .prom) — "
+                 "tail with tools/obs.py --tail")
         return rc
     finally:
         lock.release()
@@ -188,6 +195,14 @@ def main(argv=None) -> int:
                     choices=("", "off", "warn", "strict"),
                     help="admission-time graftlint certification floor "
                          "applied to every tenant's executables")
+    ap.add_argument("--trace", action="store_true",
+                    help="install the process-wide tracer "
+                         "(shrewd_tpu/obs/): per-tenant event lanes, "
+                         "Perfetto trace.json, flight-recorder dump on "
+                         "quarantine/hard-kill")
+    ap.add_argument("--trace-ring", type=int, default=0,
+                    help="flight-recorder ring capacity in events "
+                         "(default 8192)")
     ap.add_argument("--stay-resident", action="store_true",
                     help="keep serving an empty queue (SIGTERM drains); "
                          "default exits when all tenants are terminal "
