@@ -240,6 +240,67 @@ print(f"obs smoke: quarantine timeline in flightrec.json "
       f"({len(doc['traceEvents'])} trace events) -> OBS_r09.json")
 OBS_SMOKE
 
+# Non-fatal scenario-matrix smoke: a 2x2 mini-matrix (O3 regfile +
+# MESI directory x parity/dmr schemes) served through the closed
+# Pareto loop (shrewd_tpu/scenario/) — the cross-product expands
+# deterministically, every cell runs through the resident fleet, the
+# de-weighted dmr cells are pruned once their parity mates converge
+# and dominate (journaled revoke_quota), and the PARETO artifact's
+# front + decisions land in SCENARIO_r10.json.  Never affects the
+# pass/fail status.
+timeout -k 10 300 env JAX_PLATFORMS=cpu python - <<'SCENARIO_SMOKE' \
+  || echo "WARNING: scenario smoke failed (non-fatal)"
+import json, os, tempfile
+from shrewd_tpu.parallel import exec_cache
+from shrewd_tpu.scenario import ScenarioMatrix, ScenarioRunner, pareto
+
+matrix = ScenarioMatrix(
+    tag="r10", seed=3,
+    workloads=[{"name": "wl", "simpoints": [{
+        "type": "WorkloadSpec", "name": "w0",
+        "workload": {"n": 96, "nphys": 32, "mem_words": 64,
+                     "working_set_words": 32, "seed": 7}}]}],
+    targets=["regfile", "mesi:state"],
+    schemes=[{"name": "parity", "detect": 1.0, "area": 1.03},
+             {"name": "dmr", "detect": 1.0, "area": 2.0,
+              "weight": 0.2}],
+    base={"batch_size": 32, "max_trials": 192, "min_trials": 192,
+          "target_halfwidth": 0.2, "coherence_accesses": 64,
+          "coherence_mem_words": 64,
+          "integrity": {"canary_trials": 0, "audit_rate": 0.0},
+          "resilience": {"backoff_base": 0.0}})
+outdir = os.path.join(tempfile.mkdtemp(prefix="scenario_smoke_"), "out")
+before = exec_cache.cache().stats()
+runner = ScenarioRunner(matrix, outdir, pareto_every=1)
+assert runner.serve() == 0, "matrix fleet did not complete"
+after = exec_cache.cache().stats()
+sched = runner.sched
+statuses = {n: t.status for n, t in sched.tenants.items()}
+assert len(statuses) == 4, statuses
+doc = json.load(open(pareto.artifact_path(outdir, "r10")))
+decisions = doc["decisions"]
+assert decisions, "no Pareto prune fired on the dominated dmr cells"
+for d in decisions:
+    assert sched.tenants[d["cell"]].status == "pruned", statuses
+assert doc["search"], "no converged system group searched"
+with open("SCENARIO_r10.json", "w") as f:
+    json.dump({"cells": statuses,
+               "decisions": decisions,
+               "fronts": {g: [[p["area"], p["sdc_rate"]]
+                              for p in r["pareto"]]
+                          for g, r in doc["search"].items()},
+               "pruned_trials_saved": {
+                   d["cell"]: 192 - sched.tenants[d["cell"]].trials
+                   for d in decisions},
+               "cache": {"compiled": after["compiled"]
+                         - before["compiled"],
+                         "reused": after["reused"] - before["reused"]}},
+              f, indent=1)
+    f.write("\n")
+print(f"scenario smoke: 2x2 matrix -> {len(decisions)} cells pruned "
+      f"by the closed loop, PARETO front emitted -> SCENARIO_r10.json")
+SCENARIO_SMOKE
+
 # Non-fatal bench smoke: bench.py --quick includes the serial-vs-
 # pipelined campaign-loop microbenchmark (now surfacing the PerfStats
 # overlap ledger — host/device-wait/device-step seconds, depth HWM),

@@ -103,6 +103,8 @@ def snapshot(sched) -> dict:
         "recoveries": sched.recoveries,
         "quarantined": sum(1 for t in sched.tenants.values()
                            if t.status == "quarantined"),
+        "pruned": sum(1 for t in sched.tenants.values()
+                      if t.status == "pruned"),
     }
     return {"schema": 1, "tick": sched.ticks, "wall_time": clock.now(),
             "tenants": tenants, "fleet": fleet}

@@ -35,6 +35,7 @@ availability via ``shadow_scheme(kernel)`` (models/fupool.py).
 
 from __future__ import annotations
 
+import hashlib
 import itertools
 from typing import NamedTuple
 
@@ -43,6 +44,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from shrewd_tpu.ops import classify as C
+from shrewd_tpu.parallel import exec_cache
 
 
 class Scheme(NamedTuple):
@@ -149,25 +151,77 @@ def shadow_scheme(kernel, area: float = 1.5, name: str = "shadow",
 
 
 class StructureProfile(NamedTuple):
-    """One structure's measured raw vulnerability profile."""
+    """One structure's measured raw vulnerability profile.
+
+    ``halfwidth`` carries the live CI half-width of the tally the
+    profile was fit from (0.0 = treat as exact): profiles may now be
+    fit from *running* campaigns — the scenario-matrix Pareto loop
+    (``shrewd_tpu/scenario/``) re-fits after every fleet fold — and a
+    decision made over an unconverged tally must know how far the
+    point estimate could still move."""
 
     name: str
     bits: int               # storage size (area & fault-rate proxy)
     probs: np.ndarray       # P(outcome | fault in s), shape (N_OUTCOMES,)
     fit_per_bit: float = 1.0e-3   # raw upset rate per bit (FIT-style unit)
+    halfwidth: float = 0.0  # live CI half-width of the source tally
 
     @classmethod
     def from_tally(cls, name: str, bits: int, tally,
-                   fit_per_bit: float = 1.0e-3) -> "StructureProfile":
+                   fit_per_bit: float = 1.0e-3, halfwidth: float = 0.0,
+                   conservative: bool = False) -> "StructureProfile":
+        """Fit from a raw outcome tally — converged or LIVE.
+
+        With ``halfwidth`` the profile records the tally's current CI
+        half-width; with ``conservative=True`` the vulnerable outcome
+        probabilities (SDC, DUE) are additionally raised to their
+        ``+halfwidth`` upper bounds (each clipped to [0,1]) and the
+        non-vulnerable mass rescaled so the distribution still sums to
+        one — the *worst* distribution the running campaign could still
+        converge to, which is the safe side for pruning a design point
+        early."""
         t = np.asarray(tally, dtype=np.float64)
         n = t.sum()
         if n <= 0:
             raise ValueError(f"{name}: empty tally")
-        return cls(name, int(bits), t / n, float(fit_per_bit))
+        hw = float(halfwidth)
+        if not 0.0 <= hw <= 1.0:
+            raise ValueError(f"{name}: halfwidth {hw} outside [0, 1]")
+        probs = t / n
+        if conservative and hw > 0.0:
+            probs = probs.copy()
+            vul = np.zeros_like(probs, dtype=bool)
+            vul[C.OUTCOME_SDC] = vul[C.OUTCOME_DUE] = True
+            # raise each vulnerable outcome toward its +halfwidth bound,
+            # but cap the ADDED mass at the distribution's remaining
+            # headroom (scaled proportionally when both bounds cannot
+            # fit) — the conservative probabilities may never fall
+            # below the observed point estimates, whatever the bounds
+            # sum to (a post-hoc renormalize would shrink them)
+            add = np.minimum(1.0, probs[vul] + hw) - probs[vul]
+            headroom = max(0.0, 1.0 - probs[vul].sum())
+            if add.sum() > headroom:
+                add *= (headroom / add.sum()) if add.sum() > 0 else 0.0
+            probs[vul] += add
+            rest = probs[~vul].sum()
+            spare = max(0.0, 1.0 - probs[vul].sum())
+            probs[~vul] *= (spare / rest) if rest > 0 else 0.0
+            probs = probs / probs.sum()
+        return cls(name, int(bits), probs, float(fit_per_bit), hw)
 
     @property
     def fit(self) -> float:
         return self.fit_per_bit * self.bits
+
+    def p_lo(self, outcome: int) -> float:
+        """Lower CI bound of one outcome probability at the recorded
+        half-width (the most optimistic value still reachable)."""
+        return float(max(0.0, self.probs[outcome] - self.halfwidth))
+
+    def p_hi(self, outcome: int) -> float:
+        """Upper CI bound of one outcome probability at the recorded
+        half-width (the most pessimistic value still reachable)."""
+        return float(min(1.0, self.probs[outcome] + self.halfwidth))
 
 
 class SearchResult(NamedTuple):
@@ -221,20 +275,31 @@ class DesignSpace:
         self._cor = jnp.asarray([s.correct for s in self.schemes])
         self._area = jnp.asarray([s.area for s in self.schemes])
 
-        def one(cfg):
-            cor = self._cor[cfg]
-            areaf = self._area[cfg]
-            # outcome-conditioned residuals: the SDC term uses
-            # E[detect | SDC-bound fault] (see Scheme docstring)
-            resid_sdc = 1.0 - self._det_sdc[cfg] - cor
-            resid_due = 1.0 - self._det_due[cfg] - cor
-            rate = self._fit * areaf          # protection bits are targets too
-            sdc = jnp.sum(rate * resid_sdc * self._p[:, C.OUTCOME_SDC])
-            due = jnp.sum(rate * resid_due * self._p[:, C.OUTCOME_DUE])
-            area = jnp.sum(self._bits * areaf)
-            return sdc, due, area
+        def build_evaluate():
+            def one(cfg):
+                cor = self._cor[cfg]
+                areaf = self._area[cfg]
+                # outcome-conditioned residuals: the SDC term uses
+                # E[detect | SDC-bound fault] (see Scheme docstring)
+                resid_sdc = 1.0 - self._det_sdc[cfg] - cor
+                resid_due = 1.0 - self._det_due[cfg] - cor
+                rate = self._fit * areaf     # protection bits are targets too
+                sdc = jnp.sum(rate * resid_sdc * self._p[:, C.OUTCOME_SDC])
+                due = jnp.sum(rate * resid_due * self._p[:, C.OUTCOME_DUE])
+                area = jnp.sum(self._bits * areaf)
+                return sdc, due, area
 
-        self._evaluate = jax.jit(jax.vmap(one))
+            return jax.jit(jax.vmap(one))
+
+        # routed through the content-keyed executable cache (GL101): the
+        # scenario-matrix Pareto loop builds a fresh DesignSpace per
+        # fleet fold, and every fold over unchanged converged tallies
+        # must reuse one compiled sweep instead of re-tracing it.  The
+        # key is pure content (tables + scheme algebra), so owner=None:
+        # no id() enters the key and any equal-content space — including
+        # one built after this instance died — shares the executable.
+        self._evaluate = exec_cache.cache().get(
+            ("protect_eval", self._content_key()), None, build_evaluate)
 
         # The unprotected reference config: per structure, the identity
         # scheme (detect=0, correct=0, area=1) if allowed, else the
@@ -247,6 +312,22 @@ class DesignSpace:
                 ks, key=lambda k: self.schemes[k].area)
         self._baseline_cfg = np.array(
             [baseline_choice(ks) for ks in self.allowed], dtype=np.int32)
+
+    def _content_key(self) -> str:
+        """Digest of everything the compiled sweep closes over: profile
+        tables (probs content, fit, bits), scheme algebra, and the
+        per-structure allowed sets.  Equal keys ⇒ interchangeable
+        executables (the exec-cache content contract)."""
+        h = hashlib.sha1()
+        for p in self.profiles:
+            h.update(f"{p.name}|{p.bits}|{p.fit_per_bit}".encode())
+            h.update(np.ascontiguousarray(
+                np.asarray(p.probs, dtype=np.float64)).tobytes())
+        for s in self.schemes:
+            h.update(f"{s.detect}|{s.correct}|{s.area}|"
+                     f"{s.d_sdc}|{s.d_due}".encode())
+        h.update(repr(self.allowed).encode())
+        return h.hexdigest()
 
     # Enumeration guard: the cross product grows as len(schemes)^n_structures;
     # past this many configs the host materialization alone is multi-GB.

@@ -34,6 +34,17 @@ as tenants come and go), with the per-tenant plan depth as ceiling and a
 floor of 1 — the fleet cannot over-subscribe the mesh the way N
 independent processes would.
 
+**Quota revocation** (the sanctioned early-stop seam): a supervising
+controller — the scenario-matrix Pareto loop (``shrewd_tpu/scenario/``)
+is the canonical caller — may call ``revoke_quota(tenant, reason)`` to
+withdraw a tenant's remaining service.  The decision is journaled as a
+``revoke`` record BEFORE any state changes (so replay after a hard kill
+re-applies it exactly), a running tenant drains its in-flight batch to
+a resumable checkpoint, and the tenant lands in the terminal status
+``pruned`` — excluded from fair share like quarantine, but *not* a
+failure: its partial tallies/results stay first-class (they are the
+provenance a Pareto artifact cites).
+
 Failure isolation: every tenant owns its watchdog, ladder, integrity
 monitor and chaos engine, so a wedge or corrupt tally quarantines and
 recovers INSIDE the afflicted tenant.  A chaos ``kill_worker`` is
@@ -146,6 +157,7 @@ class TenantState:
         self.failures = 0            # tick/elaboration exceptions (lifetime)
         self.retry_at = 0            # fleet tick gating the next retry
         self.errors: list[dict] = []  # exception ledger {tick, error}
+        self.revoked = ""            # quota-revocation reason ("" = none)
         self.rc: int | None = None
         self.queue_latency_s = 0.0   # submit → admission
         self.wall_s = 0.0            # admission → terminal
@@ -163,7 +175,7 @@ class TenantState:
                 "trials": self.trials, "batches": self.batches,
                 "ticks": self.ticks, "kills": self.kills,
                 "failures": self.failures, "errors": list(self.errors),
-                "rc": self.rc,
+                "revoked": self.revoked, "rc": self.rc,
                 "queue_latency_s": round(self.queue_latency_s, 3),
                 "wall_s": round(self.wall_s, 3), "results": self.results}
 
@@ -307,6 +319,12 @@ class CampaignScheduler:
             lambda: sum(1 for t in self.tenants.values()
                         if t.status == "quarantined"),
             "poison tenants parked in durable quarantine")
+        fg.pruned = statsmod.Formula(
+            "pruned",
+            lambda: sum(1 for t in self.tenants.values()
+                        if t.status == "pruned"),
+            "tenants whose remaining quota was revoked (Pareto-"
+            "dominated scenario cells; partial results stay first-class)")
         fg.tenant_failures = statsmod.Formula(
             "tenant_failures",
             lambda: {n: t.failures for n, t in self.tenants.items()
@@ -570,6 +588,13 @@ class CampaignScheduler:
     def _candidates(self) -> list[TenantState]:
         out = []
         for t in self.tenants.values():
+            if t.status == "queued" and t.revoked:
+                # a revocation that outlived its tenant's start (journal
+                # replay re-queued it, or the revoke landed while it sat
+                # in backoff): prune WITHOUT elaborating — revocation
+                # must never cost a plan build
+                self._prune_queued(t)
+                continue
             if t.status == "queued" and t.retry_at <= self.ticks:
                 try:
                     self._start(t)
@@ -659,6 +684,60 @@ class CampaignScheduler:
         obs_trace.flight_dump(self.outdir, "tenant_quarantine",
                               tenant=t.spec.name, failures=t.failures)
 
+    # --- quota revocation (the sanctioned early-stop seam) ----------------
+
+    def revoke_quota(self, tenant: str, reason: str = "") -> bool:
+        """Withdraw a tenant's remaining service (the scenario-matrix
+        Pareto loop's prune seam).  Journaled BEFORE any state changes so
+        a hard kill between the decision and the drain replays it
+        exactly; a running tenant drains its in-flight batch to a
+        resumable checkpoint and finalizes as ``pruned`` (terminal,
+        excluded from fair share like quarantine — but its partial
+        results stay first-class provenance, never an error).  Returns
+        False when the tenant is already terminal or already revoked
+        (idempotent: callers may re-decide every tick)."""
+        t = self.tenants.get(tenant)
+        if t is None:
+            raise KeyError(f"unknown tenant {tenant!r}")
+        if t.revoked or t.status not in ("queued", "running"):
+            return False
+        t.revoked = reason or "revoked"
+        self._jlog("revoke", {"tenant": t.spec.name, "reason": t.revoked,
+                              "fleet_tick": self.ticks})
+        obs_trace.tracer().emit(
+            "tenant_revoke", cat="fleet", tenant=t.spec.name,
+            reason=t.revoked, fleet_tick=self.ticks)
+        debug.dprintf("Fleet", "%s: quota revoked (%s)", t.spec.name,
+                      t.revoked)
+        if t.status == "queued":
+            self._prune_queued(t)
+        else:
+            t.driver.request_drain()
+        return True
+
+    def _prune_queued(self, t: TenantState) -> None:
+        """A revoked tenant that never started (or was re-queued by a
+        recovery) goes terminal WITHOUT elaboration — revocation must
+        not cost a plan build, and a plan that cannot elaborate must
+        still be prunable."""
+        t.status = "pruned"
+        t.wall_s = (obs_clock.monotonic() - t._t_admit) if t._t_admit \
+            else 0.0
+        obs_trace.tracer().emit(
+            "tenant_pruned", cat="fleet", tenant=t.spec.name,
+            trials=t.trials, reason=t.revoked)
+        self._jlog("status", {"tenant": t.spec.name, "status": "pruned",
+                              "trials": t.trials, "batches": t.batches,
+                              "wall_s": round(t.wall_s, 3),
+                              "results": t.results})
+        if self.queue is not None and t.ticket:
+            self.queue.mark_done(t.ticket, {
+                "tenant": t.spec.name, "status": "pruned",
+                "reason": t.revoked, "trials": t.trials,
+                "results": t.results})
+        if self.outdir:
+            self.checkpoint()
+
     def _pick(self, cands: list[TenantState]) -> TenantState:
         top = max(t.spec.priority for t in cands)
         cls = [t for t in cands if t.spec.priority == top]
@@ -745,12 +824,21 @@ class CampaignScheduler:
         t.rc = t.driver.rc
         from shrewd_tpu.campaign.orchestrator import Orchestrator
 
-        if t.rc == Orchestrator.RC_PREEMPTED:
+        if t.rc == Orchestrator.RC_ABORTED:
+            # honesty outranks the revocation: an abort (integrity/
+            # budget) during the drain is still an abort
+            t.status = "aborted"
+        elif t.revoked:
+            # the journaled revocation decision is authoritative over
+            # every cooperative ending — including a campaign whose
+            # final in-flight batch happened to complete it during the
+            # drain (rc 0): the quota WAS withdrawn first, and the
+            # Pareto artifact's decision list must match the statuses
+            t.status = "pruned"
+        elif t.rc == Orchestrator.RC_PREEMPTED:
             t.status = ("quota" if t.spec.quota_batches
                         and t.batches >= t.spec.quota_batches
                         else "preempted")
-        elif t.rc == Orchestrator.RC_ABORTED:
-            t.status = "aborted"
         else:
             t.status = "complete"
             if t.kills and t.orch.chaos is not None:
@@ -774,10 +862,16 @@ class CampaignScheduler:
         if t.orch.outdir and t.status == "complete":
             t.orch.checkpoint()          # the final-state dump _drive writes
         if self.queue is not None and t.ticket:
-            self.queue.mark_done(t.ticket, {
+            done = {
                 "tenant": t.spec.name, "status": t.status, "rc": t.rc,
                 "trials": t.trials, "batches": t.batches,
-                "wall_s": round(t.wall_s, 3), "results": t.results})
+                "wall_s": round(t.wall_s, 3), "results": t.results}
+            if t.revoked:
+                # same done-doc shape as the queued-prune path: a
+                # submitter whose cell was pruned mid-run learns the
+                # dominator from its ticket too
+                done["reason"] = t.revoked
+            self.queue.mark_done(t.ticket, done)
         debug.dprintf("Fleet", "%s: %s (rc=%s, %d trials, %d ticks)",
                       t.spec.name, t.status, t.rc, t.trials, t.ticks)
         self._rebalance()
@@ -788,20 +882,33 @@ class CampaignScheduler:
         """JSON-able per-(simpoint, structure) final state: completed
         tenants summarize their StructureResults; preempted/aborted ones
         summarize their partial cumulative state (what the checkpoint
-        holds)."""
+        holds).  The per-stratum tally history rides along (from the
+        orchestrator's cumulative state, the one place it lives) so a
+        stratified campaign's half-width can be recomputed from the
+        summary with the SAME estimator the stopping rule used —
+        downstream folds (the scenario Pareto loop) must not silently
+        degrade to pooled Wilson on terminal tenants."""
+        def strata_of(sp, st):
+            s = t.orch.state.get((sp, st)) if t.orch is not None else None
+            return (s.strata.tolist()
+                    if s is not None and s.strata is not None else None)
+
         out = {}
         if t.driver.results is not None:
             for (sp, st), r in t.driver.results.items():
                 out[f"{sp}/{st}"] = {
                     "tallies": np.asarray(r.tallies).tolist(),
                     "trials": int(r.trials), "avf": float(r.avf),
-                    "converged": bool(r.converged)}
+                    "converged": bool(r.converged),
+                    "strata": strata_of(sp, st)}
         else:
             for (sp, st), s in t.orch.state.items():
                 out[f"{sp}/{st}"] = {
                     "tallies": s.tallies.tolist(),
                     "trials": int(s.trials), "avf": None,
-                    "converged": bool(s.converged)}
+                    "converged": bool(s.converged),
+                    "strata": (s.strata.tolist()
+                               if s.strata is not None else None)}
         return out
 
     def run(self) -> int:
@@ -976,6 +1083,7 @@ class CampaignScheduler:
         t.kills = int(td.get("kills", 0))
         t.failures = int(td.get("failures", 0))
         t.errors = list(td.get("errors") or [])
+        t.revoked = str(td.get("revoked") or "")
         t.rc = td.get("rc")
         t.results = td.get("results")
         t.queue_latency_s = float(td.get("queue_latency_s", 0.0))
@@ -1030,6 +1138,14 @@ class CampaignScheduler:
             t.results = {"error": last, "failures": t.failures}
         elif kind == "tenant_kill":
             t.kills = int(r.get("kills", t.kills))
+        elif kind == "revoke":
+            # the revocation DECISION is durable the instant it is made:
+            # a kill between the decision and the drain replays it here,
+            # and _candidates prunes the re-queued tenant without ever
+            # elaborating it — the journaled decision, not the drain,
+            # is what makes prune-replay exact
+            t.revoked = str(r.get("reason") or "revoked")
+            self.ticks = max(self.ticks, int(r.get("fleet_tick", 0)))
         elif kind == "status":
             t.status = r.get("status", t.status)
             if "rc" in r:
@@ -1109,17 +1225,20 @@ class CampaignScheduler:
                 #                        budget out of every crash
             elif (queue is not None and t.ticket
                     and t.status in ("complete", "aborted", "quota",
-                                     "quarantined")
+                                     "quarantined", "pruned")
                     and queue.done(t.ticket) is None):
                 # the kill landed between the terminal journal record
                 # and mark_done: the replayed state is authoritative, so
                 # publish the done-doc now or the submitter's ticket
                 # would stay claimed (and unanswered) forever
-                queue.mark_done(t.ticket, {
+                done = {
                     "tenant": t.spec.name, "status": t.status,
                     "rc": t.rc, "trials": t.trials,
                     "batches": t.batches, "failures": t.failures,
-                    "wall_s": round(t.wall_s, 3), "results": t.results})
+                    "wall_s": round(t.wall_s, 3), "results": t.results}
+                if t.revoked:
+                    done["reason"] = t.revoked
+                queue.mark_done(t.ticket, done)
         sched._journal_floor = max(
             snap_seq + 1, (records[-1]["seq"] + 1) if records else 0)
         sched._open_journal()

@@ -109,10 +109,22 @@ QUICK_MODULES = {
     # never-perturbs-the-run contract guards every other pin in this
     # tier — it belongs on every push
     "test_obs",
+    # scenario-matrix campaigns: expansion/Pareto-algebra units are
+    # sub-second; the matrix-vs-solo, kill-recover and prune-replay
+    # integrations ride the same tiny-kernel compiles through the
+    # shared executable cache (zero-new-compiles is itself one of the
+    # pins), and the closed-loop correctness smoke belongs on every
+    # push like the fleet layers it drives
+    "test_scenario",
 }
 QUICK_TESTS = {
     # one representative per subsystem (≈4-10 s each, compile-dominated)
     "test_null_fault_is_masked",           # dense replay semantics
+    # live-profile fitting + exec-cache routing of the DesignSpace sweep
+    # (the protect.py surfaces the scenario Pareto loop depends on)
+    "test_from_tally_records_halfwidth_and_bounds",
+    "test_from_tally_conservative_takes_upper_vulnerable_bounds",
+    "test_design_space_evaluate_routes_through_exec_cache",
     "test_regfile_fault_consumed_is_sdc",  # inject→propagate→classify
     "test_unmapped_va_traps",              # VA crash model (MemMap)
     "test_fp_fault_propagates_to_sdc",     # FP µop lanes

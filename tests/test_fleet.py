@@ -471,3 +471,143 @@ def test_graftlint_gl101_covers_service():
         assert f in cfg.jit_modules
         assert f in cfg.checkpoint_modules
         assert f in cfg.deterministic_modules
+
+
+# --- quota revocation (the sanctioned early-stop seam) ----------------------
+#
+# The scheduler-side contract the scenario-matrix Pareto loop builds on
+# (shrewd_tpu/scenario/), tested here INDEPENDENT of scenario/: a
+# supervising controller may withdraw a tenant's remaining service at
+# any time; the decision is journaled before any state change, a
+# running tenant drains to the terminal status "pruned" with its
+# partial results first-class, a queued tenant prunes WITHOUT paying a
+# plan elaboration, and the pruned status is excluded from fair share
+# like quarantine — but is never an error.
+
+def test_revoke_queued_tenant_prunes_without_elaboration(tmp_path):
+    # the victim's plan CANNOT elaborate (missing trace file): pruning
+    # it must not cost a plan build, so it lands in "pruned" with zero
+    # failures — never in the quarantine path
+    from shrewd_tpu.campaign.plan import CampaignPlan, TraceFileSpec
+
+    q = SubmissionQueue(str(tmp_path / "spool"))
+    bad = CampaignPlan(simpoints=[TraceFileSpec(
+        name="w0", path=str(tmp_path / "missing.npz"))],
+        structures=["regfile"], batch_size=32, max_trials=64,
+        min_trials=64)
+    ticket = q.submit(TenantSpec(name="victim", plan=bad.to_dict()))
+    good_solo = _solo_tallies(_plan(3, n_batches=2))
+    sched = CampaignScheduler(outdir=str(tmp_path / "out"), queue=q)
+    sched.admit(TenantSpec(name="good",
+                           plan=_plan(3, n_batches=2).to_dict()))
+    sched._poll_queue()
+    assert sched.revoke_quota("victim", "operator: superseded")
+    assert sched.run() == 0
+    t = sched.tenants["victim"]
+    assert t.status == "pruned" and t.revoked == "operator: superseded"
+    assert t.failures == 0 and t.trials == 0       # never elaborated
+    done = q.done(ticket)
+    assert done["status"] == "pruned"
+    assert done["reason"] == "operator: superseded"
+    _assert_tenant_matches(sched, "good", good_solo)
+
+
+def test_revoke_running_tenant_drains_to_pruned_with_partial_results():
+    from shrewd_tpu.campaign.orchestrator import Orchestrator
+    from shrewd_tpu.obs import metrics as obs_metrics
+
+    calls = []
+
+    def revoke_mid_run(sched):
+        t = sched.tenants["victim"]
+        if not calls and 0 < t.trials < 32 * 8:
+            calls.append(sched.revoke_quota("victim", "test: dominated"))
+            # idempotent: the second call on an already-revoked tenant
+            # declines (callers may re-decide every tick)
+            calls.append(sched.revoke_quota("victim", "again"))
+
+    sched = CampaignScheduler(on_tick=revoke_mid_run)
+    sched.admit(TenantSpec(name="victim",
+                           plan=_plan(3, n_batches=8).to_dict()))
+    sched.admit(TenantSpec(name="bystander",
+                           plan=_plan(5, n_batches=2).to_dict()))
+    assert sched.run() == 0
+    assert calls == [True, False]
+    t = sched.tenants["victim"]
+    assert t.status == "pruned" and t.rc == Orchestrator.RC_PREEMPTED
+    assert t.revoked == "test: dominated"
+    # partial service, with the partial tallies summarized first-class
+    assert 0 < t.trials < 32 * 8
+    row = t.results["w0/regfile"]
+    assert row["trials"] == t.trials and not row["converged"]
+    assert sched.tenants["bystander"].status == "complete"
+    # pruned is terminal: no further revoke, and the metrics surface
+    # counts it separately from quarantine
+    assert not sched.revoke_quota("victim", "too late")
+    snap = obs_metrics.snapshot(sched)
+    assert snap["fleet"]["pruned"] == 1
+    assert snap["fleet"]["quarantined"] == 0
+    assert sched.stats.fleet.pruned.fn() == 1
+
+
+def test_revoke_unknown_tenant_raises():
+    sched = CampaignScheduler()
+    with pytest.raises(KeyError):
+        sched.revoke_quota("nobody")
+
+
+def test_revoke_decision_replays_after_hard_kill(tmp_path):
+    # the revoke record is journaled BEFORE any state change: a hard
+    # kill between the decision and the drain replays it on recovery,
+    # and the re-queued tenant prunes without ever elaborating again
+    from shrewd_tpu.service import FleetKilled
+
+    state = {}
+
+    def revoke_then_die(sched):
+        t = sched.tenants["victim"]
+        if t.trials >= 32 and not t.revoked:
+            assert sched.revoke_quota("victim", "test: dominated")
+            state["revoked_at"] = t.trials
+            raise FleetKilled(137)      # dead before the drain tick
+
+    sched = CampaignScheduler(outdir=str(tmp_path),
+                              on_tick=revoke_then_die)
+    sched.admit(TenantSpec(name="victim",
+                           plan=_plan(3, n_batches=8).to_dict()))
+    with pytest.raises(FleetKilled):
+        sched.run()
+    assert sched.tenants["victim"].status == "running"   # drain never ran
+
+    rec = CampaignScheduler.recover(str(tmp_path))
+    t = rec.tenants["victim"]
+    assert t.revoked == "test: dominated"       # the WAL replayed it
+    assert t.status == "queued"                 # resumable → re-queued
+    assert rec.run() == 0
+    t = rec.tenants["victim"]
+    assert t.status == "pruned" and t.failures == 0
+    assert t.trials == state["revoked_at"]      # decision-time service
+
+
+def test_revoke_racing_completion_still_finalizes_pruned():
+    # the revocation decision is authoritative over a cooperative
+    # ending: a tenant revoked after its final batch (but before the
+    # completion tick) still lands "pruned" — the journaled decision
+    # and the terminal status may never disagree (the Pareto artifact's
+    # decision list is keyed off both)
+    from shrewd_tpu.campaign.orchestrator import Orchestrator
+
+    def revoke_at_cap(sched):
+        t = sched.tenants["victim"]
+        if t.status == "running" and not t.revoked and t.trials >= 32 * 2:
+            assert sched.revoke_quota("victim", "test: raced")
+
+    sched = CampaignScheduler(on_tick=revoke_at_cap)
+    sched.admit(TenantSpec(name="victim",
+                           plan=_plan(3, n_batches=2).to_dict()))
+    assert sched.run() == 0
+    t = sched.tenants["victim"]
+    assert t.rc == Orchestrator.RC_COMPLETE     # the campaign DID finish
+    assert t.status == "pruned"                 # ...but the decision wins
+    assert t.revoked == "test: raced"
+    assert t.results["w0/regfile"]["trials"] == 64

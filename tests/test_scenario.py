@@ -1,0 +1,603 @@
+"""Scenario-matrix campaigns (shrewd_tpu/scenario/): expansion
+determinism, fleet execution, and the closed Pareto loop.
+
+The contracts under test are the ISSUE acceptance criteria: a matrix
+spanning ≥3 fault-model families (O3 + MESI + NoC) and ≥2 protection
+schemes runs through the resident fleet with per-cell tallies
+BIT-IDENTICAL to solo serial runs — including after a mid-matrix hard
+kill + recover — cells sharing a window admit with ZERO new kernel
+compiles (exec-cache counters), Pareto-dominated cells are pruned
+through the scheduler's journaled ``revoke_quota`` seam with decisions
+that replay exactly, and the ``PARETO_<tag>.json`` artifact schema is
+pinned.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from shrewd_tpu.parallel import exec_cache
+from shrewd_tpu.scenario import (Cell, ScenarioMatrix, ScenarioRunner,
+                                 cell_seed, pareto)
+from shrewd_tpu.scenario.runner import PRUNE_REASON
+from shrewd_tpu.service import FleetKilled
+
+
+# --- matrix fixtures --------------------------------------------------------
+
+def _sp(name="w0", seed=7, n=96):
+    return {"type": "WorkloadSpec", "name": name,
+            "workload": {"n": n, "nphys": 32, "mem_words": 64,
+                         "working_set_words": 32, "seed": seed}}
+
+
+def _base(n_batches=2, batch_size=32, **kw):
+    base = {"batch_size": batch_size, "target_halfwidth": 0.2,
+            "max_trials": batch_size * n_batches,
+            "min_trials": batch_size * n_batches,
+            "integrity": {"canary_trials": 0, "audit_rate": 0.0},
+            "resilience": {"backoff_base": 0.0},
+            "coherence_accesses": 64, "coherence_mem_words": 64}
+    base.update(kw)
+    return base
+
+
+SCHEMES = [{"name": "none"},
+           {"name": "parity", "detect": 1.0, "area": 1.03}]
+
+
+def _matrix(tag="m", targets=("regfile",), schemes=None, thermal=None,
+            workloads=None, base=None, seed=3, **kw):
+    return ScenarioMatrix(
+        tag=tag,
+        workloads=workloads or [{"name": "wl", "simpoints": [_sp()]}],
+        targets=list(targets),
+        schemes=schemes or [dict(s) for s in SCHEMES],
+        thermal=thermal, base=base or _base(), seed=seed, **kw)
+
+
+def _solo_tallies(cell):
+    """One run-to-completion serial campaign of a cell's own plan — the
+    reference point every matrix assertion compares against."""
+    from shrewd_tpu.campaign.orchestrator import Orchestrator
+    from shrewd_tpu.sim.exit_event import ExitEvent
+
+    orch = Orchestrator(cell.build_plan())
+    events = list(orch.events())
+    assert events[-1][0] is ExitEvent.CAMPAIGN_COMPLETE
+    return orch, {f"{sp}/{st}": np.asarray(v.tallies, dtype=np.int64)
+                  for (sp, st), v in dict(events[-1][1]).items()}
+
+
+# --- expansion determinism (jax-free units) ---------------------------------
+
+def test_expand_determinism_and_stable_naming():
+    """Identical documents expand to identical cells — names, order,
+    seeds, plans — every time (the cell name is the tenant identity,
+    the checkpoint namespace, and the Pareto provenance key)."""
+    m1 = _matrix(targets=["regfile", "mesi:state"],
+                 thermal=[{"name": "tnom"},
+                          {"name": "hot", "temperature_c": 100.0}])
+    m2 = ScenarioMatrix.from_dict(
+        json.loads(json.dumps(m1.to_dict())))       # disk round trip
+    c1, c2 = m1.expand(), m2.expand()
+    assert [c.name for c in c1] == [c.name for c in c2]
+    assert [c.plan for c in c1] == [c.plan for c in c2]
+    assert [c.name for c in c1] == [
+        "m.wl.w0.regfile.none.tnom", "m.wl.w0.regfile.none.hot",
+        "m.wl.w0.regfile.parity.tnom", "m.wl.w0.regfile.parity.hot",
+        "m.coherence.coherence.mesi+state.none.tnom",
+        "m.coherence.coherence.mesi+state.none.hot",
+        "m.coherence.coherence.mesi+state.parity.tnom",
+        "m.coherence.coherence.mesi+state.parity.hot"]
+
+
+def test_measurement_seed_shared_by_scheme_and_thermal_mates():
+    """Campaign seeds derive from MEASUREMENT coordinates only:
+    scheme-/thermal-mates replay identical frozen keys (their raw
+    tallies are directly comparable and their executables shared);
+    different windows/targets draw distinct seeds."""
+    m = _matrix(targets=["regfile", "rob"],
+                thermal=[{"name": "tnom"},
+                         {"name": "hot", "temperature_c": 100.0}])
+    cells = m.expand()
+    by = {}
+    for c in cells:
+        by.setdefault((c.workload, c.window, c.target), []).append(c)
+    for (wl, win, tg), mates in by.items():
+        assert len({c.plan["seed"] for c in mates}) == 1
+        assert {c.plan["seed"] for c in mates} == {
+            cell_seed(m.seed, wl, win, tg)}
+        # non-NoC mates share the ENTIRE plan document (the zero-new-
+        # compiles economy): scheme/thermal are analytic axes
+        assert len({json.dumps(c.plan, sort_keys=True)
+                    for c in mates}) == 1
+    seeds = {cell_seed(m.seed, "wl", "w0", t) for t in ("regfile", "rob")}
+    assert len(seeds) == 2
+
+
+def test_noc_cells_bake_thermal_envelope_into_plan():
+    """Only NoC cells carry the envelope into the campaign (the flit
+    fault-type mix is temperature-dependent); every other family keeps
+    one plan across envelopes."""
+    m = _matrix(targets=["regfile", "noc:router"],
+                thermal=[{"name": "tnom"},
+                         {"name": "hot", "temperature_c": 101.5}])
+    for c in m.expand():
+        if c.target == "noc:router":
+            assert c.plan["noc"]["temperature_c"] == \
+                c.thermal["temperature_c"]
+        else:
+            assert "noc" not in c.plan or "temperature_c" not in c.plan[
+                "noc"]
+
+
+def test_coherence_targets_collapse_workload_axes():
+    """Plan-level targets (mesi:/noc:) measure plan-level synthetic
+    traffic: one cell per (target, scheme, thermal), never one per
+    window."""
+    m = _matrix(targets=["mesi:state", "noc:router"],
+                workloads=[{"name": "wl",
+                            "simpoints": [_sp("w0"), _sp("w1", seed=9)]}])
+    cells = m.expand()
+    assert len(cells) == 2 * 2       # 2 targets × 2 schemes × 1 thermal
+    assert all(c.window == "coherence" for c in cells)
+    assert all(c.plan["simpoints"] == [] for c in cells)
+
+
+def test_axis_scheduling_inheritance():
+    """priority sums across axes, weight multiplies, tightest non-zero
+    quota wins."""
+    m = _matrix(
+        targets=[{"name": "regfile", "priority": 2, "weight": 0.5,
+                  "quota_batches": 8}],
+        schemes=[{"name": "none", "priority": 1, "weight": 2.0,
+                  "quota_batches": 3}],
+        workloads=[{"name": "wl", "priority": 4, "simpoints": [_sp()]}],
+        tenant={"priority": 1, "weight": 2.0, "quota_batches": 0})
+    (c,) = m.expand()
+    assert c.priority == 1 + 2 + 1 + 4
+    assert c.weight == pytest.approx(2.0 * 0.5 * 2.0)
+    assert c.quota_batches == 3
+    spec = c.spec()
+    assert (spec.priority, spec.weight, spec.quota_batches) == \
+        (c.priority, c.weight, c.quota_batches)
+
+
+def test_matrix_validation_rejects_bad_documents():
+    with pytest.raises(ValueError, match="unknown target"):
+        _matrix(targets=["bogus"])
+    with pytest.raises(ValueError, match="duplicate scheme"):
+        _matrix(schemes=[{"name": "a"}, {"name": "a"}])
+    with pytest.raises(ValueError, match="empty scheme axis"):
+        ScenarioMatrix(tag="m", workloads=[{"name": "wl",
+                                            "simpoints": [_sp()]}],
+                       targets=["regfile"], schemes=[])
+    with pytest.raises(ValueError, match="detect\\+correct"):
+        _matrix(schemes=[{"name": "bad", "detect": 0.8, "correct": 0.5}])
+    with pytest.raises(ValueError, match="area"):
+        _matrix(schemes=[{"name": "bad", "area": 0.5}])
+    with pytest.raises(ValueError, match="at least one workload simpoint"):
+        _matrix(workloads=[{"name": "wl", "simpoints": []}])
+    # ... even when plan-level targets would still expand: silently
+    # dropping the per-window coverage is the failure mode this guards
+    with pytest.raises(ValueError, match="at least one workload simpoint"):
+        _matrix(targets=["regfile", "mesi:state"],
+                workloads=[{"name": "wl"}])
+    with pytest.raises(ValueError, match="schema"):
+        ScenarioMatrix.from_dict({"schema": 99, "tag": "x",
+                                  "targets": [], "schemes": []})
+
+
+def test_default_bits_deterministic_for_every_target():
+    from shrewd_tpu.scenario.matrix import KNOWN_TARGETS, default_bits
+
+    plan = _base()
+    bits = {t: default_bits(t, plan) for t in KNOWN_TARGETS}
+    assert all(b > 0 for b in bits.values())
+    assert bits == {t: default_bits(t, plan) for t in KNOWN_TARGETS}
+
+
+# --- Pareto algebra (jax-free units) ----------------------------------------
+
+def _pt(name, area, sdc_lo, sdc_hi, status="running", converged=False):
+    return {"cell": name, "status": status, "converged": converged,
+            "area": area, "sdc_lo": sdc_lo, "sdc_hi": sdc_hi}
+
+
+def _cellstub(name, target="regfile", scheme="s"):
+    return Cell(name=name, workload="wl", window="w0", target=target,
+                scheme={"name": scheme}, thermal={"name": "tnom",
+                                                  "temperature_c": 71.0},
+                plan={}, priority=0, weight=1.0, quota_batches=0,
+                bits=1024, fit_per_bit=1e-3)
+
+
+def test_dominates_is_conservative_against_halfwidth():
+    dom = _pt("a", area=100.0, sdc_lo=0.0, sdc_hi=0.1, converged=True)
+    # running cell whose optimistic bound could still beat dom: NOT prunable
+    assert not pareto.dominates(dom, _pt("b", 120.0, 0.05, 0.5))
+    # even the optimistic bound loses on both axes: prunable
+    assert pareto.dominates(dom, _pt("b", 120.0, 0.2, 0.6))
+    # equal on both axes (no strict edge): not domination
+    assert not pareto.dominates(dom, _pt("b", 100.0, 0.1, 0.1))
+    # strictly better area alone suffices when sdc ties
+    assert pareto.dominates(dom, _pt("b", 120.0, 0.1, 0.5))
+
+
+def test_cell_point_sdc_bounds_use_sdc_specific_wilson():
+    """The prune bounds must be a valid CI on p_sdc ITSELF: at a large
+    DUE share the stopping rule's combined vulnerable interval is
+    narrower than the SDC proportion's own, and borrowing it would let
+    a dominator prune a cell whose converged SDC rate could still beat
+    it."""
+    from shrewd_tpu.ops import classify as C
+    from shrewd_tpu.parallel import stopping
+
+    tallies = np.zeros(C.N_OUTCOMES, dtype=np.int64)
+    tallies[C.OUTCOME_SDC] = 15          # p_sdc ≈ 0.47: widest Wilson
+    tallies[C.OUTCOME_DUE] = 15          # p_vul ≈ 0.94: narrow Wilson
+    tallies[C.OUTCOME_MASKED] = 2
+    trials = int(tallies.sum())
+    hw_vul = stopping.live_halfwidth(30, trials, None, False, 0.95)
+    pt = pareto.cell_point(_cellstub("a"), tallies, trials, hw_vul,
+                           converged=False, status="running")
+    iv = stopping.wilson(15, trials, 0.95)
+    rate_resid = pt["sdc"] / pt["p_sdc"]
+    assert pt["sdc_lo"] == pytest.approx(rate_resid * iv.lo)
+    assert pt["sdc_hi"] == pytest.approx(rate_resid * iv.hi)
+    # the combined hw really is tighter here — the bug this pins against
+    assert hw_vul < iv.halfwidth
+    assert pt["sdc_hi"] > rate_resid * (pt["p_sdc"] + hw_vul)
+    # halfwidth still reports the stopping rule's convergence distance
+    assert pt["halfwidth"] == pytest.approx(hw_vul)
+    # zero-trial points keep the full [0, 1] bracket
+    z = pareto.cell_point(_cellstub("z"), np.zeros(C.N_OUTCOMES), 0,
+                          1.0, converged=False, status="queued")
+    assert z["sdc_lo"] == 0.0
+    assert z["sdc_hi"] == pytest.approx(rate_resid)   # rate·resid·1.0
+
+
+def test_prune_decisions_only_running_unconverged_cells():
+    a, b, c = (_cellstub("a", scheme="cheap"),
+               _cellstub("b", scheme="mid"),
+               _cellstub("c", scheme="big"))
+    points = {
+        "a": _pt("a", 100.0, 0.0, 0.0, status="complete", converged=True),
+        "b": _pt("b", 200.0, 0.0, 0.0, status="running", converged=True),
+        "c": _pt("c", 300.0, 0.0, 0.0, status="running"),
+    }
+    dec = pareto.prune_decisions([a, b, c], points)
+    # b has converged (prune would save nothing provable), c is dominated
+    assert dec == [{"cell": "c", "dominated_by": "a"}]
+    # already-revoked cells are the journal's decisions, not ours
+    assert pareto.prune_decisions([a, b, c], points,
+                                  revoked={"c": "a"}) == []
+    # cells in other prune groups never dominate each other
+    d = _cellstub("d", target="rob", scheme="big")
+    points["d"] = _pt("d", 300.0, 0.0, 0.0, status="running")
+    assert pareto.prune_decisions([a, b, c, d], points,
+                                  revoked={"c": "a"}) == []
+
+
+# --- the fleet integrations -------------------------------------------------
+
+def test_matrix_vs_solo_bit_identity_heterogeneous(tmp_path):
+    """≥3 fault-model families (O3 regfile + MESI directory + NoC
+    router) × 2 schemes through one fleet: every cell's tallies
+    bit-identical to a solo serial run of that cell's own plan."""
+    m = _matrix(tag="hetero",
+                targets=["regfile", "mesi:state", "noc:router"])
+    cells = m.expand()
+    # 1 window × 1 per-window target × 2 schemes + 2 coherence targets
+    # × 2 schemes
+    assert len(cells) == 2 + 4
+    solos = {}
+    warm = []        # keep kernels alive: cache entries are owner-guarded
+    for c in cells:
+        orch, tallies = _solo_tallies(c)
+        warm.append(orch)
+        solos[c.name] = tallies
+    runner = ScenarioRunner(m, str(tmp_path / "out"), prune=False)
+    assert runner.serve() == 0
+    for c in cells:
+        t = runner.sched.tenants[c.name]
+        assert t.status == "complete"
+        assert set(t.results) == set(solos[c.name])
+        for k, want in solos[c.name].items():
+            np.testing.assert_array_equal(
+                np.asarray(t.results[k]["tallies"], dtype=np.int64), want)
+    # the artifact folded every cell and searched every system group
+    doc = json.load(open(pareto.artifact_path(str(tmp_path / "out"),
+                                              "hetero")))
+    assert len(doc["cells"]) == len(cells)
+    assert all(pt["converged"] for pt in doc["cells"].values())
+
+
+def test_shared_window_cells_admit_with_zero_new_compiles(tmp_path):
+    """Scheme-mates over one window share content-keyed executables:
+    after one solo warm run of the measurement, the whole matrix admits
+    and runs with ZERO new kernel-step compiles (the only new key is
+    the protect-eval sweep, which is not a campaign step)."""
+    m = _matrix(tag="dedupe")
+    cells = m.expand()
+    orch, _ = _solo_tallies(cells[0])     # warm the window's executables
+    before = {d: s["misses"]
+              for d, s in exec_cache.cache().per_key_stats().items()}
+    runner = ScenarioRunner(m, str(tmp_path / "out"), prune=False)
+    assert runner.serve() == 0
+    new_step_misses = {
+        d: (s["misses"] - before.get(d, 0), s["kind"])
+        for d, s in exec_cache.cache().per_key_stats().items()
+        if s["kind"] != "protect_eval"
+        and s["misses"] - before.get(d, 0) > 0}
+    assert new_step_misses == {}, new_step_misses
+    del orch
+
+
+def test_kill_fleet_mid_matrix_recovers_completed_cells_intact(tmp_path):
+    """A hard-killed matrix fleet recovers from matrix.json + the WAL:
+    cells completed before the kill keep their recorded results, the
+    rest resume from namespaced checkpoints, and the final state is
+    bit-identical to an undisturbed run."""
+    def mk(tag):
+        return _matrix(
+            tag=tag,
+            base=_base(n_batches=2),
+            # de-weight the parity cell so the none cell finishes first
+            # (a completed cell exists when the kill lands)
+            schemes=[{"name": "none"},
+                     {"name": "parity", "detect": 1.0, "area": 1.03,
+                      "weight": 0.25}])
+
+    clean = ScenarioRunner(mk("undisturbed"),
+                           str(tmp_path / "clean"), prune=False)
+    assert clean.serve() == 0
+    want = {c.name.replace("undisturbed", "killed"):
+            {k: np.asarray(v["tallies"], dtype=np.int64)
+             for k, v in clean.sched.tenants[c.name].results.items()}
+            for c in clean.cells}
+
+    armed = []
+
+    def kill_after_first_completion(sched):
+        # the in-process hard-kill stand-in (the FleetKilled idiom of
+        # tests/test_fleet_survive.py): one tick AFTER the first cell
+        # completes — a still-running cell's tick record then sits in
+        # the journal beyond the completion checkpoint (the dirty-
+        # shutdown signature), while a completed cell's results are on
+        # the line
+        if armed:
+            raise FleetKilled(137)
+        by = {t.status for t in sched.tenants.values()}
+        if "complete" in by and by != {"complete"}:
+            armed.append(sched.ticks)
+
+    outdir = str(tmp_path / "killed")
+    runner = ScenarioRunner(mk("killed"), outdir, prune=False,
+                            on_tick=kill_after_first_completion)
+    with pytest.raises(FleetKilled):
+        runner.serve()
+    done_at_kill = {n: t.results for n, t in runner.sched.tenants.items()
+                    if t.status == "complete"}
+    assert done_at_kill       # at least one cell completed pre-kill
+
+    rec = ScenarioRunner.recover(outdir, prune=False)
+    assert rec.matrix.tag == "killed"
+    assert rec.sched.recoveries == 1
+    assert rec.run() == 0
+    for name, res in done_at_kill.items():
+        # completed cells' recorded results survived the kill verbatim
+        assert rec.sched.tenants[name].results == res
+    for name, tallies in want.items():
+        t = rec.sched.tenants[name]
+        assert t.status == "complete"
+        for k, w in tallies.items():
+            np.testing.assert_array_equal(
+                np.asarray(t.results[k]["tallies"], dtype=np.int64), w)
+
+
+def _prune_matrix(tag):
+    """parity strictly dominates dmr (equal residual SDC, lower area);
+    dmr is de-weighted so parity converges while dmr still runs — the
+    closed loop must revoke dmr's remaining quota."""
+    return _matrix(
+        tag=tag, base=_base(n_batches=6),
+        schemes=[{"name": "parity", "detect": 1.0, "area": 1.03},
+                 {"name": "dmr", "detect": 1.0, "area": 2.0,
+                  "weight": 0.2}])
+
+
+def test_pareto_prune_fires_and_is_replay_exact(tmp_path):
+    runner = ScenarioRunner(_prune_matrix("pr"), str(tmp_path / "a"),
+                            pareto_every=1)
+    assert runner.serve() == 0
+    sched = runner.sched
+    parity, dmr = "pr.wl.w0.regfile.parity.tnom", "pr.wl.w0.regfile.dmr.tnom"
+    assert sched.tenants[parity].status == "complete"
+    t = sched.tenants[dmr]
+    assert t.status == "pruned"
+    assert t.revoked == PRUNE_REASON + parity
+    assert 0 < t.trials < 6 * 32          # partial service, not zero/full
+    doc = json.load(open(pareto.artifact_path(str(tmp_path / "a"), "pr")))
+    assert doc["decisions"] == [{"cell": dmr, "dominated_by": parity}]
+    # the pruned cell's partial tallies stay first-class provenance
+    assert doc["cells"][dmr]["status"] == "pruned"
+    assert doc["cells"][dmr]["trials"] == t.trials
+
+    # determinism: an identical matrix in a fresh outdir makes the SAME
+    # decision at the same tally state (tick-counted fold, frozen keys)
+    r2 = ScenarioRunner(_prune_matrix("pr"), str(tmp_path / "b"),
+                        pareto_every=1)
+    assert r2.serve() == 0
+    assert r2.decisions(r2.sched) == {dmr: parity}
+    assert r2.sched.tenants[dmr].trials == t.trials
+
+
+def test_prune_decision_survives_hard_kill_exactly(tmp_path):
+    """The journaled revoke record IS the decision: a fleet hard-killed
+    BETWEEN the decision and the drain replays it on recovery — the
+    revoked cell prunes without re-elaboration, keeps exactly the
+    partial trials the decision left it with, and the final artifact
+    cites the same decision set as the undisturbed run."""
+    from shrewd_tpu.chaos import ChaosEngine
+
+    # undisturbed reference run; learn the fleet tick the revoke landed
+    # on (deterministic: tick-counted fold over frozen-key tallies)
+    seen = {}
+
+    def watch(sched):
+        if "tick" not in seen and any(t.revoked
+                                      for t in sched.tenants.values()):
+            seen["tick"] = sched.ticks      # first tick AFTER the revoke
+
+    r0 = ScenarioRunner(_prune_matrix("pk"), str(tmp_path / "ref"),
+                        pareto_every=1, on_tick=watch)
+    assert r0.serve() == 0
+    dmr = "pk.wl.w0.regfile.dmr.tnom"
+    parity = "pk.wl.w0.regfile.parity.tnom"
+    ref = r0.sched.tenants[dmr]
+    assert ref.status == "pruned"
+    revoke_tick = seen["tick"] - 1          # the revoke's own fleet tick
+
+    # kill_fleet at the revoke's tick fires at the NEXT loop top: after
+    # the journaled decision, before the revoked tenant's drain tick
+    eng = ChaosEngine({"faults": [{"kind": "kill_fleet",
+                                   "at_tick": revoke_tick}]})
+    eng.kill_action = lambda rc: (_ for _ in ()).throw(FleetKilled(rc))
+    outdir = str(tmp_path / "killed")
+    runner = ScenarioRunner(_prune_matrix("pk"), outdir, pareto_every=1,
+                            chaos=eng)
+    with pytest.raises(FleetKilled):
+        runner.serve()
+    killed = runner.sched.tenants[dmr]
+    assert killed.revoked == PRUNE_REASON + parity
+    assert killed.status == "running"       # decision made, drain not
+
+    rec = ScenarioRunner.recover(outdir, pareto_every=1)
+    t = rec.sched.tenants[dmr]
+    assert t.revoked == PRUNE_REASON + parity   # replayed from the WAL
+    assert rec.run() == 0
+    # the re-queued revoked tenant pruned WITHOUT elaborating (no
+    # failures burned) and with exactly the decision-time service
+    t = rec.sched.tenants[dmr]
+    assert t.status == "pruned" and t.failures == 0
+    assert t.trials == ref.trials
+    assert rec.decisions(rec.sched) == {dmr: parity}
+    assert rec.sched.tenants[parity].status == "complete"
+    doc = json.load(open(pareto.artifact_path(outdir, "pk")))
+    assert doc["decisions"] == [{"cell": dmr, "dominated_by": parity}]
+
+
+# --- artifact schema pin ----------------------------------------------------
+
+def test_pareto_artifact_schema_pin(tmp_path):
+    """The PARETO document layout is an interchange surface: schema
+    version, axes, per-cell point fields, decisions, and the search
+    groups are pinned here so downstream consumers can rely on them."""
+    m = _matrix(tag="pin")
+    runner = ScenarioRunner(m, str(tmp_path / "out"), prune=False)
+    assert runner.serve() == 0
+    doc = json.load(open(pareto.artifact_path(str(tmp_path / "out"),
+                                              "pin")))
+    assert doc["schema"] == pareto.PARETO_SCHEMA == 1
+    assert set(doc) == {"schema", "tag", "sdc_target", "axes", "cells",
+                        "decisions", "search", "fleet"}
+    assert set(doc["axes"]) == {"workloads", "windows", "targets",
+                                "schemes", "thermal"}
+    assert doc["axes"]["schemes"] == ["none", "parity"]
+    pt = doc["cells"]["pin.wl.w0.regfile.none.tnom"]
+    assert set(pt) == {"cell", "status", "trials", "converged",
+                       "halfwidth", "tallies", "p_sdc", "area", "sdc",
+                       "due", "sdc_lo", "sdc_hi", "thermal_factor",
+                       "prune_group", "system_group"}
+    assert pt["sdc_lo"] <= pt["sdc"] <= pt["sdc_hi"]
+    (group,) = doc["search"].values()
+    assert set(group) == {"cells", "feasible", "assignment", "area",
+                          "sdc_rate", "due_rate", "baseline_area",
+                          "baseline_sdc", "n_configs", "pareto"}
+    # profile fit picks the converged mate with the most trials, ties on
+    # cell name (scheme-mates measure the same distribution, so the
+    # choice only has to be deterministic)
+    assert group["cells"] == {"regfile": "pin.wl.w0.regfile.parity.tnom"}
+    # the front is over the matrix's OWN schemes
+    assert {p["assignment"]["regfile"] for p in group["pareto"]} <= {
+        "none", "parity"}
+
+
+def test_stratified_cells_fold_with_the_stratified_estimator(tmp_path):
+    """Terminal cells' summaries carry the per-stratum tally history, so
+    a stratified matrix's fold recomputes half-widths with the SAME
+    estimator the stopping rule used — never silently degrading to
+    pooled Wilson (which would stall the prune loop exactly where
+    stratification converges fastest)."""
+    from shrewd_tpu.ops import classify as C
+    from shrewd_tpu.parallel import stopping
+
+    m = _matrix(tag="strat", base=_base(stratify=True),
+                schemes=[{"name": "none"}])
+    runner = ScenarioRunner(m, str(tmp_path / "out"), prune=False)
+    assert runner.serve() == 0
+    (cell,) = runner.cells
+    row = runner.sched.tenants[cell.name].results["w0/regfile"]
+    strata = row["strata"]
+    assert strata is not None
+    assert int(np.asarray(strata).sum()) == row["trials"]
+    pt = runner.points(runner.sched)[cell.name]
+    t = np.asarray(row["tallies"])
+    vul = int(t[C.OUTCOME_SDC] + t[C.OUTCOME_DUE])
+    want = stopping.live_halfwidth(vul, row["trials"], strata, True, 0.95)
+    assert pt["halfwidth"] == pytest.approx(want)
+    # and the stratified selection really differs from pooled Wilson
+    assert want != pytest.approx(
+        stopping.live_halfwidth(vul, row["trials"], None, False, 0.95))
+
+
+def test_failed_final_fold_keeps_the_fleet_rc(tmp_path, monkeypatch):
+    """The artifact is derived state: a fold that cannot compute (e.g. a
+    design space past the enumeration guard) must not discard the rc of
+    a fully served matrix — the journal stays the ground truth and
+    --pareto can re-fold later."""
+    from shrewd_tpu.scenario import pareto as par
+
+    def boom(*a, **kw):
+        raise ValueError("design space too large")
+
+    monkeypatch.setattr(par, "design_search", boom)
+    runner = ScenarioRunner(_matrix(tag="ff"), str(tmp_path / "out"),
+                            prune=False)
+    assert runner.serve() == 0              # rc survives the fold failure
+    assert {t.status for t in runner.sched.tenants.values()} == \
+        {"complete"}
+    with pytest.raises(ValueError, match="too large"):
+        runner.emit_artifact()              # the one-shot surface raises
+
+
+def test_runner_status_reads_persisted_surfaces(tmp_path):
+    m = _matrix(tag="st")
+    runner = ScenarioRunner(m, str(tmp_path / "out"), prune=False)
+    assert runner.serve() == 0
+    status = ScenarioRunner.status(str(tmp_path / "out"))
+    assert status["tag"] == "st"
+    assert set(status["tenants"]) == {c.name for c in m.expand()}
+    assert status["decisions"] == []
+    assert list(status["search"]) == ["wl/w0/tnom"]
+
+
+# --- lint gates -------------------------------------------------------------
+
+def test_graftlint_gates_cover_scenario_and_search():
+    """The ISSUE pins shrewd_tpu/scenario/ under GL101/GL102/GL103/GL106
+    and search/protect.py under GL101 (jit routed through exec_cache)."""
+    from shrewd_tpu.analysis.config import load_config
+
+    cfg = load_config(os.path.join(os.path.dirname(__file__), ".."))
+    scenario = {f"shrewd_tpu/scenario/{f}" for f in
+                ("__init__.py", "matrix.py", "pareto.py", "runner.py")}
+    assert scenario <= set(cfg.jit_modules)
+    assert scenario <= set(cfg.deterministic_modules)
+    assert scenario <= set(cfg.checkpoint_modules)
+    assert scenario <= set(cfg.clock_modules)
+    assert "shrewd_tpu/search/protect.py" in set(cfg.jit_modules)

@@ -136,3 +136,81 @@ def test_end_to_end_campaign_to_search():
     assert res.n_configs == len(DEFAULT_SCHEMES) ** 3
     assert res.feasible            # TMR everywhere always reaches 1% residual
     assert res.sdc_rate <= res_target
+
+
+# --- live (half-width-aware) profiles + exec-cache routing -----------------
+#
+# The scenario-matrix Pareto loop (shrewd_tpu/scenario/) re-fits
+# profiles from RUNNING campaigns after every fleet fold: from_tally
+# must accept unconverged tallies with their live CI half-width, expose
+# conservative bounds, and the DesignSpace sweep must route through the
+# content-keyed executable cache so every fold over unchanged tallies
+# reuses one compiled executable.
+
+def test_from_tally_records_halfwidth_and_bounds():
+    p = StructureProfile.from_tally(
+        "rf", 1024, np.array([60, 30, 10, 0]), halfwidth=0.05)
+    assert p.halfwidth == 0.05
+    assert p.p_hi(C.OUTCOME_SDC) == pytest.approx(0.35)
+    assert p.p_lo(C.OUTCOME_SDC) == pytest.approx(0.25)
+    # bounds clip to [0, 1]
+    z = StructureProfile.from_tally(
+        "rf", 1024, np.array([100, 0, 0, 0]), halfwidth=0.1)
+    assert z.p_lo(C.OUTCOME_SDC) == 0.0
+    with pytest.raises(ValueError, match="halfwidth"):
+        StructureProfile.from_tally("rf", 1024, np.array([1, 0, 0, 0]),
+                                    halfwidth=1.5)
+
+
+def test_from_tally_conservative_takes_upper_vulnerable_bounds():
+    p = StructureProfile.from_tally(
+        "rf", 1024, np.array([50, 40, 10, 0]), halfwidth=0.1,
+        conservative=True)
+    # SDC/DUE at their +halfwidth bounds, non-vulnerable mass rescaled,
+    # still a distribution
+    assert p.probs[C.OUTCOME_SDC] == pytest.approx(0.5)
+    assert p.probs[C.OUTCOME_DUE] == pytest.approx(0.2)
+    assert p.probs.sum() == pytest.approx(1.0)
+    # converged (hw=0) conservative fit is the plain fit
+    q = StructureProfile.from_tally(
+        "rf", 1024, np.array([50, 40, 10, 0]), conservative=True)
+    np.testing.assert_allclose(q.probs, [0.5, 0.4, 0.1, 0.0])
+    # saturation: when the +hw bounds cannot all fit, the added mass is
+    # capped at the remaining headroom — NEVER renormalized below the
+    # observed point estimates (the worst-case contract)
+    s = StructureProfile.from_tally(
+        "rf", 1024, np.array([0, 90, 10, 0]), halfwidth=0.3,
+        conservative=True)
+    assert s.probs[C.OUTCOME_SDC] >= 0.9 - 1e-12
+    assert s.probs[C.OUTCOME_DUE] >= 0.1 - 1e-12
+    assert s.probs.sum() == pytest.approx(1.0)
+    h = StructureProfile.from_tally(
+        "rf", 1024, np.array([10, 70, 20, 0]), halfwidth=0.3,
+        conservative=True)
+    assert h.probs[C.OUTCOME_SDC] >= 0.7 and h.probs[C.OUTCOME_DUE] >= 0.2
+    assert h.probs.sum() == pytest.approx(1.0)
+
+
+def test_design_space_evaluate_routes_through_exec_cache():
+    from shrewd_tpu.parallel import exec_cache
+
+    p = profile("rf", 1000, 50, 40, 10)
+    before = exec_cache.cache().stats()
+    ds1 = DesignSpace([p])
+    mid = exec_cache.cache().stats()
+    assert mid["compiled"] == before["compiled"] + 1
+    # an equal-content space REUSES the compiled sweep (the per-fold
+    # economy of the scenario Pareto loop)...
+    ds2 = DesignSpace([p])
+    after = exec_cache.cache().stats()
+    assert after["compiled"] == mid["compiled"]
+    assert after["reused"] == mid["reused"] + 1
+    r1 = [np.asarray(x) for x in ds1.evaluate(ds1.enumerate())]
+    r2 = [np.asarray(x) for x in ds2.evaluate(ds2.enumerate())]
+    for a, b in zip(r1, r2):
+        np.testing.assert_array_equal(a, b)
+    # ...while different content compiles its own executable
+    p2 = profile("rf", 1000, 50, 41, 9)
+    DesignSpace([p2])
+    assert exec_cache.cache().stats()["compiled"] == \
+        after["compiled"] + 1

@@ -135,6 +135,29 @@ def cmd_serve(a) -> int:
                 plan = json.load(f)
             name = f"t{i}_{os.path.splitext(os.path.basename(path))[0]}"
             sched.admit(TenantSpec(name=name, plan=plan))
+        if a.matrix:
+            # open-loop scenario matrix: admit the expanded cell set as
+            # plain tenants (full cross-product, no Pareto prune — the
+            # closed loop lives in tools/scenario.py --serve).  The
+            # matrix document is persisted so tools/scenario.py
+            # --status/--pareto work over this fleet's outdir too.
+            from shrewd_tpu.resilience import write_json_atomic
+            from shrewd_tpu.scenario import MATRIX_DOC, ScenarioMatrix
+
+            with open(a.matrix) as f:
+                matrix = ScenarioMatrix.from_dict(json.load(f))
+            if sched.outdir:
+                os.makedirs(sched.outdir, exist_ok=True)
+                write_json_atomic(os.path.join(sched.outdir, MATRIX_DOC),
+                                  matrix.to_dict())
+            n = 0
+            for spec in matrix.tenant_specs():
+                if spec.name not in sched.tenants:
+                    sched.admit(spec)
+                    n += 1
+            _log(f"matrix {matrix.tag!r}: admitted {n} cells "
+                 "(open loop — no Pareto prune; use tools/scenario.py "
+                 "--serve for the closed loop)")
         restore = sched.install_signal_handlers()
         try:
             rc = sched.run()
@@ -158,6 +181,11 @@ def main(argv=None) -> int:
                     help="spool one tenant into --queue and exit")
     ap.add_argument("--plans", nargs="*", default=[],
                     help="plan JSONs admitted directly (no spool needed)")
+    ap.add_argument("--matrix", default="",
+                    help="ScenarioMatrix JSON: admit the expanded "
+                         "cross-product cell set as plain tenants "
+                         "(open loop; closed-loop pruning lives in "
+                         "tools/scenario.py --serve)")
     ap.add_argument("--queue", default="",
                     help="submission spool directory (service/queue.py)")
     ap.add_argument("--outdir", default="fleet_out",
@@ -225,7 +253,7 @@ def main(argv=None) -> int:
         jax.config.update("jax_platforms", a.platform)
     if a.submit:
         return cmd_submit(a)
-    if a.serve or a.plans or a.resume or a.recover:
+    if a.serve or a.plans or a.matrix or a.resume or a.recover:
         return cmd_serve(a)
     ap.print_help()
     return 2
