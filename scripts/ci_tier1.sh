@@ -12,15 +12,31 @@ cd "$(dirname "$0")/.." || exit 1
 # graftlint gate (FATAL): static determinism & replay-safety
 # certification (shrewd_tpu/analysis/, tools/graftlint.py).  AST passes
 # over the package (exec-cache jit routing, no wall clock in
-# deterministic regions, atomic checkpoint writes, PRNG hygiene) plus
-# the jaxpr/HLO audit of the standard campaign executables (frozen-key
-# RNG lineage, no host callbacks, ONE device->host transfer per sync
-# interval, donation consistency) — recorded as LINT_r06.json.  A NEW
-# violation fails the build; pre-existing findings are waived in-source
-# with "# graftlint: allow-<rule> -- <reason>" (re-run with
-# --baseline LINT_r06.json to gate only on regressions).
-timeout -k 10 420 env JAX_PLATFORMS=cpu python tools/graftlint.py --strict --json LINT_r06.json \
+# deterministic regions, atomic checkpoint writes, PRNG hygiene, and
+# the GL2xx crash-safety family: journal-before-mutate dominance,
+# journal-kind exhaustiveness, fsync-before-rename, best-effort
+# guards) plus the jaxpr/HLO audit of the standard campaign
+# executables (frozen-key RNG lineage, no host callbacks, ONE
+# device->host transfer per sync interval, donation consistency) —
+# recorded as LINT_r11.json + SARIF annotations.  --audit-waivers
+# additionally fails on STALE waivers, so the reasoned-waiver ledger
+# cannot rot.  A NEW violation fails the build; findings are waived
+# in-source with "# graftlint: allow-<rule> -- <reason>" (re-run with
+# --baseline LINT_r11.json to gate only on regressions).
+timeout -k 10 420 env JAX_PLATFORMS=cpu python tools/graftlint.py --strict --audit-waivers --json LINT_r11.json --sarif LINT_r11.sarif \
   || { echo "FATAL: graftlint gate failed (static determinism/replay-safety violations)"; exit 1; }
+
+# crashcheck gate (FATAL): exhaustive crash-point model checking of the
+# fleet WAL (shrewd_tpu/analysis/crashcheck.py).  A 3-tenant fleet runs
+# under the instrumented VFS shim, every durability boundary (journal
+# append / compaction / atomic rename) is snapshotted, and recover() is
+# re-executed from EVERY boundary plus a torn-tail variant of every
+# append — final tallies must be bit-identical to the undisturbed run
+# at every single crash point, with journal seqs never regressing.
+# This replaces single-kill-point sampling with full coverage of the
+# crash surface — recorded as CRASH_r11.json.
+timeout -k 10 420 env JAX_PLATFORMS=cpu python tools/graftlint.py --no-jaxpr --crashcheck --crash-json CRASH_r11.json \
+  || { echo "FATAL: crashcheck gate failed (a crash point did not recover bit-identically)"; exit 1; }
 
 # Non-fatal backend-probe smoke: catches probe drift (import breakage,
 # verdict-format changes) in tier-1 without ever affecting the pass/fail

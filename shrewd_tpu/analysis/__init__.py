@@ -10,7 +10,15 @@ Two layers over the campaign stack (CLI: ``tools/graftlint.py``; CI gate:
   donation consistency).  Strict mode refuses admission.
 - **Layer 2** (``ast_lint``) — repo-specific AST passes: exec-cache
   routing for jits, no wall clock in deterministic chaos/elastic regions,
-  atomic checkpoint writes, PRNG key hygiene.
+  atomic checkpoint writes, PRNG key hygiene — plus the GL2xx
+  crash/replay-safety family (``replay_lint``): journal-before-mutate
+  CFG dominance, journal-record-kind exhaustiveness, fsync-before-rename
+  ordering, best-effort-seam guards, and the GL205 stale-waiver audit.
+- **Layer 3** (``crashcheck``) — dynamic, exhaustive: a small real fleet
+  under an instrumented VFS shim, then ``recover()`` re-executed from
+  EVERY recorded durability boundary (+ torn-append variants), asserting
+  bit-identical final tallies at each (the SLICC-style exhaustive-
+  checking posture applied to the fleet's own crash surface).
 
 Import discipline: jax-free at package import (the linter runs in
 accelerator-less tooling contexts; jax enters only inside the audit

@@ -43,6 +43,12 @@ GL106     clock         obs-instrumented modules read clocks only through
                         ``time.sleep`` is not a read and is not flagged
 ========  ============  =====================================================
 
+The GL2xx *crash/replay-safety* family (journal-before-mutate dominance,
+journal-kind exhaustiveness, fsync-before-rename ordering, best-effort
+guards) lives in ``replay_lint.py`` and runs through the same scoping,
+waiver and severity machinery; ``lint_tree`` additionally audits for
+**stale waivers** (GL205) — waiver comments no rule matched.
+
 **Waivers**: a finding is waived by a comment on the same line, the line
 above, or a decorator line of the flagged statement::
 
@@ -62,6 +68,7 @@ import os
 import re
 from dataclasses import dataclass, field
 
+from shrewd_tpu.analysis import replay_lint
 from shrewd_tpu.analysis.config import RULES, GraftlintConfig
 
 #: call-router attribute names that mark a jit as cache-routed (GL101):
@@ -114,6 +121,11 @@ class Finding:
 @dataclass
 class LintReport:
     findings: list = field(default_factory=list)
+    #: GL205: waiver comments whose rule no longer fires at their site
+    #: (the reasoned-waiver ledger must not rot) — kept apart from
+    #: ``findings`` so ``violations`` semantics (and --baseline keys)
+    #: stay stable; the CLI gates on these under ``--audit-waivers``
+    stale: list = field(default_factory=list)
 
     @property
     def violations(self) -> list:
@@ -132,7 +144,8 @@ class LintReport:
     def to_dict(self) -> dict:
         return {"violations": [f.to_dict() for f in self.violations],
                 "warnings": [f.to_dict() for f in self.warnings],
-                "waivers": [f.to_dict() for f in self.waivers]}
+                "waivers": [f.to_dict() for f in self.waivers],
+                "stale_waivers": [f.to_dict() for f in self.stale]}
 
 
 def _parents(tree: ast.AST) -> dict:
@@ -194,6 +207,10 @@ class _FileLint:
                 reason = f"{reason} {nxt.lstrip('#').strip()}"
                 j += 1
             self.waiver_lines[i] = (m.group(1), reason)
+        #: waiver lines some rule actually matched (the complement is
+        #: the stale-waiver set — ledger rot the --audit-waivers gate
+        #: fails on)
+        self.consumed: set[int] = set()
         self.findings: list[Finding] = []
 
     # --- waiver lookup --------------------------------------------------
@@ -206,7 +223,7 @@ class _FileLint:
         while i >= 1 and start - i <= depth:
             got = self.waiver_lines.get(i)
             if got and got[0] == rule_name:
-                return got
+                return (i, *got)
             i -= 1
             text = self.lines[i - 1].strip() if 0 < i <= len(self.lines) \
                 else ""
@@ -231,13 +248,21 @@ class _FileLint:
                 return got
         return None
 
-    def _report(self, rule: str, node, msg: str) -> None:
+    def _report(self, rule: str, node, msg: str,
+                severity: str | None = None) -> None:
         name = RULES[rule]
-        sev = self.cfg.rule_severity(rule)
-        if sev == "off":
-            return
         waiver = self._waiver_for(node, name)
-        if waiver is not None and not waiver[1]:
+        if waiver is not None:
+            # matched = not stale, even when malformed (missing reason)
+            # or when the rule is configured off — an off rule's waivers
+            # must not rot into GL205 findings, or disabling a rule
+            # would force deleting the very waivers re-enabling it needs
+            self.consumed.add(waiver[0])
+        cfg_sev = self.cfg.rule_severity(rule)
+        if cfg_sev == "off":
+            return                   # "off" beats any per-call severity
+        sev = severity if severity is not None else cfg_sev
+        if waiver is not None and not waiver[2]:
             self.findings.append(Finding(
                 rule, self.rel, node.lineno,
                 f"waiver 'allow-{name}' is missing its reason "
@@ -247,7 +272,7 @@ class _FileLint:
         self.findings.append(Finding(
             rule, self.rel, node.lineno, msg,
             waived=waiver is not None,
-            waiver_reason=waiver[1] if waiver else "",
+            waiver_reason=waiver[2] if waiver else "",
             severity=sev))
 
     # --- GL101: bare jax.jit -------------------------------------------
@@ -416,9 +441,13 @@ class _FileLint:
                     "is what makes frozen-key re-dispatch bit-identical")
 
 
-def lint_file(path: str, rel: str, cfg: GraftlintConfig) -> list:
-    """Every applicable pass over one file → findings."""
-    fl = _FileLint(path, rel, cfg)
+def _run_file_passes(fl: _FileLint, cfg: GraftlintConfig,
+                     recovery_reads: set | None = None) -> None:
+    """Every per-file pass the file's path scopes it into.  The GL2xx
+    replay-safety passes live in ``replay_lint.py``; ``recovery_reads``
+    is the cross-module artifact read set (computed over the whole
+    durability scope by ``lint_tree``; single-file mode derives it from
+    the file itself)."""
     rel_n = fl.rel
     if rel_n in cfg.jit_modules:
         fl.check_bare_jit()
@@ -431,21 +460,74 @@ def lint_file(path: str, rel: str, cfg: GraftlintConfig) -> list:
     fl.check_key_reuse()
     if rel_n not in cfg.key_genesis_allow:
         fl.check_key_genesis()
+    if rel_n in cfg.journaled_modules:
+        replay_lint.check_journal_before_mutate(fl)
+    if rel_n in cfg.durability_modules:
+        replay_lint.check_fsync_before_rename(fl)
+        reads = recovery_reads if recovery_reads is not None \
+            else replay_lint.collect_recovery_reads([fl], cfg)
+        replay_lint.check_recovery_read_raw_writes(fl, reads)
+    if rel_n in cfg.best_effort_modules:
+        replay_lint.check_best_effort_guard(fl)
+
+
+def stale_waivers(fl: _FileLint) -> list:
+    """GL205: waiver comments no rule matched after every applicable
+    pass ran — a waiver whose finding evaporated (code moved, rule
+    rescoped) is ledger rot, not evidence."""
+    out = []
+    for line, (name, _reason) in sorted(fl.waiver_lines.items()):
+        if line in fl.consumed:
+            continue
+        sev = fl.cfg.rule_severity("GL205")
+        if sev == "off":
+            continue
+        out.append(Finding(
+            "GL205", fl.rel, line,
+            f"stale waiver 'allow-{name}': the rule does not fire at "
+            "this site any more — delete the waiver (the reasoned-"
+            "waiver ledger is evidence and must not rot)",
+            severity=sev))
+    return out
+
+
+def lint_file(path: str, rel: str, cfg: GraftlintConfig) -> list:
+    """Every applicable pass over one file → findings (single-file
+    surface for fixtures; the cross-module GL202 pass and the stale-
+    waiver audit run only from ``lint_tree``)."""
+    fl = _FileLint(path, rel, cfg)
+    _run_file_passes(fl, cfg)
     return fl.findings
 
 
 def lint_tree(root: str, cfg: GraftlintConfig | None = None,
               package: str = "shrewd_tpu") -> LintReport:
-    """Lint every ``.py`` file under ``<root>/<package>`` → LintReport."""
+    """Lint every ``.py`` file under ``<root>/<package>`` → LintReport:
+    per-file passes, then the cross-module GL202 journal-kind
+    exhaustiveness check, then the GL205 stale-waiver audit (a waiver
+    is stale only once every pass that could consume it has run)."""
     cfg = cfg if cfg is not None else GraftlintConfig()
     report = LintReport()
     base = os.path.join(root, package)
+    fls: list[_FileLint] = []
     for dirpath, _dirnames, filenames in os.walk(base):
         for name in sorted(filenames):
             if not name.endswith(".py"):
                 continue
             path = os.path.join(dirpath, name)
             rel = os.path.relpath(path, root)
-            report.findings.extend(lint_file(path, rel, cfg))
+            fls.append(_FileLint(path, rel, cfg))
+    fls.sort(key=lambda fl: fl.rel)
+    dur = [fl for fl in fls if fl.rel in cfg.durability_modules]
+    reads = replay_lint.collect_recovery_reads(dur, cfg)
+    for fl in fls:
+        _run_file_passes(fl, cfg, recovery_reads=reads)
+    journal_scope = set(cfg.journaled_modules) | set(cfg.durability_modules)
+    replay_lint.check_journal_exhaustive(
+        [fl for fl in fls if fl.rel in journal_scope], cfg)
+    for fl in fls:
+        report.findings.extend(fl.findings)
+        report.stale.extend(stale_waivers(fl))
     report.findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    report.stale.sort(key=lambda f: (f.path, f.line))
     return report

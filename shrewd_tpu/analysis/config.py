@@ -37,6 +37,14 @@ RULES = {
     "GL104": "key-reuse",
     "GL105": "key-genesis",
     "GL106": "clock",
+    # --- the GL2xx replay-safety family (analysis/replay_lint.py) ---
+    "GL201": "journal-before-mutate",
+    "GL202": "journal-exhaustive",
+    "GL203": "fsync-rename",
+    "GL204": "best-effort-guard",
+    # GL205 is computed, never matched by a waiver: a waiver whose rule
+    # no longer fires at its site IS the finding
+    "GL205": "stale-waiver",
 }
 
 SEVERITIES = ("error", "warn", "off")
@@ -125,6 +133,63 @@ class GraftlintConfig:
         "shrewd_tpu/obs/trace.py",
         "shrewd_tpu/obs/export.py",
         "shrewd_tpu/obs/metrics.py",
+    ])
+    # ------------------------------------------------------------------
+    # GL2xx: crash/replay-safety certification of the fleet layer
+    # (analysis/replay_lint.py)
+    # ------------------------------------------------------------------
+    # GL201: modules whose journaled scheduler state must only mutate
+    # UNDER a dominating journal call (the WAL contract made static)
+    journaled_modules: list = field(default_factory=lambda: [
+        "shrewd_tpu/service/scheduler.py",
+        "shrewd_tpu/scenario/runner.py",
+    ])
+    # the attributes GL201 tracks as journaled scheduler state (tenant
+    # status, fair-share/vtime inputs, quota-revocation and the failure
+    # ledger — the fields recover() replays)
+    journaled_attrs: list = field(default_factory=lambda: [
+        "status", "revoked", "trials", "batches", "failures",
+        "retry_at", "errors", "kills",
+    ])
+    # call names that COUNT as journaling (the WAL append surfaces)
+    journal_call_names: list = field(default_factory=lambda: [
+        "_jlog",
+    ])
+    # functions exempt from GL201: constructors build fresh objects and
+    # the replay path must NOT re-journal what it replays
+    replay_functions: list = field(default_factory=lambda: [
+        "__init__", "_apply_record", "_admit_from_dict", "recover",
+        "resume", "replay_path", "from_dict",
+    ])
+    # GL202: the journal-record dispatch function — every kind appended
+    # anywhere in the journaled/durability modules must be handled here
+    replay_dispatch: str = "_apply_record"
+    # GL203: modules whose renames must be fsync-dominated and whose
+    # recovery-read artifacts must never be written raw
+    durability_modules: list = field(default_factory=lambda: [
+        "shrewd_tpu/service/journal.py",
+        "shrewd_tpu/service/scheduler.py",
+        "shrewd_tpu/service/queue.py",
+        "shrewd_tpu/scenario/runner.py",
+        "shrewd_tpu/resilience.py",
+        "shrewd_tpu/campaign/orchestrator.py",
+    ])
+    # functions whose reads define the recovery-read artifact set (any
+    # basename they open/load is crash-surface state)
+    recovery_functions: list = field(default_factory=lambda: [
+        "recover", "resume", "replay_path", "is_dirty",
+        "load_checkpoint_doc", "status", "journal_path",
+    ])
+    # GL204: modules whose best-effort observability calls must be
+    # exception-guarded (one failure must never become two)
+    best_effort_modules: list = field(default_factory=lambda: [
+        "shrewd_tpu/service/scheduler.py",
+        "shrewd_tpu/service/queue.py",
+        "shrewd_tpu/scenario/runner.py",
+    ])
+    # trailing attribute names of the best-effort seams
+    best_effort_calls: list = field(default_factory=lambda: [
+        "publish", "flight_dump", "maybe_flight_dump",
     ])
     severity: dict = field(default_factory=lambda: {
         rid: "error" for rid in RULES})
@@ -231,9 +296,14 @@ def load_config(root: str) -> GraftlintConfig:
         doc = parse_graftlint_toml(f.read())
     for key in ("jit_modules", "deterministic_modules",
                 "checkpoint_modules", "key_genesis_allow",
-                "clock_modules"):
+                "clock_modules", "journaled_modules", "journaled_attrs",
+                "journal_call_names", "replay_functions",
+                "durability_modules", "recovery_functions",
+                "best_effort_modules", "best_effort_calls"):
         if key in doc:
             setattr(cfg, key, list(doc[key]))
+    if "replay_dispatch" in doc:
+        cfg.replay_dispatch = str(doc["replay_dispatch"])
     if "transfer_budget" in doc:
         cfg.transfer_budget = int(doc["transfer_budget"])
     sev = doc.get("severity", {})

@@ -1,0 +1,345 @@
+"""crashcheck: exhaustive crash-point model checking of the fleet WAL.
+
+The static layer (``replay_lint.py``) certifies the crash-safety
+*preconditions*; this dynamic layer proves the *property*: run a small
+real fleet under an instrumented VFS shim that records every durability
+boundary — each write-ahead-journal append, each journal compaction,
+each atomic-rename commit — then **exhaustively** re-execute
+``CampaignScheduler.recover()`` from the filesystem state at every one
+of those boundaries (plus a torn-tail variant of every append) and
+assert that each recovered fleet reaches final tallies bit-identical to
+the undisturbed run, with journal sequence numbers never regressing.
+This replaces the single-kill-point chaos smoke with full coverage of
+the crash surface, in the same spirit the coherence models are
+validated by exhaustive checking against the SLICC sources
+(MESI_SLICC_VALIDATE, PARITY §2.6).
+
+The model, and its one approximation:
+
+- a crash AT boundary *i* leaves exactly the durable bytes the recorder
+  snapshotted at *i* (every durable writer fsyncs before the hook
+  fires, and the fleet is single-threaded between boundaries);
+- files written WITHOUT fsync (per-tick metrics, Perfetto exports,
+  stats dumps) may not survive a real crash even though a same-process
+  snapshot sees them — so the recorder **scrubs** them from every
+  snapshot, which doubles as a proof that recovery never depends on a
+  non-durable file;
+- a crash *between* boundaries leaves the same durable state as the
+  boundary before it, so boundary enumeration is exhaustive;
+- a crash *during* an append is the torn-tail variant: the snapshot's
+  last journal line is truncated mid-record, exactly the prefix a
+  power loss would leave.
+
+Import discipline: jax-free at module import (jax enters when the
+fleets run); the recorder itself is pure host-side file work.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import shutil
+from dataclasses import dataclass
+
+from shrewd_tpu import resilience as resil
+from shrewd_tpu.service.journal import FleetJournal, journal_path
+from shrewd_tpu.utils import debug
+
+#: files written without fsync — scrubbed from every crash-point
+#: snapshot (a real crash may lose them; recovery must not need them)
+NON_DURABLE = ("metrics.json", "metrics.prom", "trace.json",
+               "fleet_stats.txt", "fleet_stats.json", "flightrec.json")
+
+
+@dataclass
+class CrashPoint:
+    """One durability boundary of the recorded run."""
+
+    index: int
+    event: str                 # append | compact | rename
+    path: str                  # boundary file, relative to the outdir
+    seq: int | None = None     # journal seq (append boundaries)
+    kind: str | None = None    # journal record kind (append boundaries)
+    snapshot: str = ""         # directory holding the durable state
+
+    def label(self) -> dict:
+        return {"index": self.index, "event": self.event,
+                "path": self.path, "seq": self.seq, "kind": self.kind}
+
+
+class DurabilityRecorder:
+    """The instrumented VFS shim: observes every durability boundary
+    under ``outdir`` (via ``resilience.set_durability_hook``) and
+    snapshots the durable filesystem state at each — the crash-point
+    enumeration the checker replays from."""
+
+    def __init__(self, outdir: str, points_dir: str):
+        self.outdir = os.path.abspath(outdir)
+        self.points_dir = points_dir
+        self.points: list[CrashPoint] = []
+        self._prev = None
+
+    def __enter__(self) -> "DurabilityRecorder":
+        self._prev = resil.set_durability_hook(self)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        resil.set_durability_hook(self._prev)
+
+    def __call__(self, event: str, path: str, seq=None, kind=None,
+                 **meta) -> None:
+        path = os.path.abspath(path)
+        if not path.startswith(self.outdir + os.sep):
+            return                   # a boundary outside the watched fleet
+        idx = len(self.points)
+        snap = os.path.join(self.points_dir, f"{idx:04d}")
+        snapshot_tree(self.outdir, snap)
+        self.points.append(CrashPoint(
+            index=idx, event=event,
+            path=os.path.relpath(path, self.outdir),
+            seq=seq, kind=kind, snapshot=snap))
+
+
+def snapshot_tree(src: str, dst: str) -> None:
+    """Copy the durable state of ``src`` into ``dst``, scrubbing the
+    known non-durable (unsynced) files — see module doc."""
+    shutil.copytree(src, dst)
+    for root, _dirs, files in os.walk(dst):
+        for name in files:
+            if name in NON_DURABLE or name.endswith(".tmp"):
+                os.unlink(os.path.join(root, name))
+
+
+def tear_journal_tail(outdir: str, keep_fraction: float = 0.5) -> bool:
+    """Truncate the journal's LAST record mid-line — the byte prefix a
+    power loss during the append would leave.  Returns False when there
+    is no complete record to tear."""
+    jp = journal_path(outdir)
+    if not os.path.exists(jp) or os.path.getsize(jp) == 0:
+        return False
+    with open(jp, "rb") as f:
+        data = f.read()
+    if not data.endswith(b"\n"):
+        return False                 # already torn
+    body = data[:-1]
+    start = body.rfind(b"\n") + 1
+    line = data[start:]
+    keep = start + max(1, int(len(line) * keep_fraction))
+    with open(jp, "r+b") as f:
+        f.truncate(keep)
+        f.flush()
+        os.fsync(f.fileno())
+    return True
+
+
+# --------------------------------------------------------------------------
+# fleet construction + comparison
+# --------------------------------------------------------------------------
+
+def small_fleet_plans(seeds=(3, 5, 7), n_batches: int = 2,
+                      batch_size: int = 32) -> dict:
+    """The bounded quick-crashcheck fleet: N tiny synth-workload tenants
+    over ONE shared window (the executable cache dedupes every compile
+    across tenants and across crash-point re-executions)."""
+    from shrewd_tpu.campaign.plan import CampaignPlan, WorkloadSpec
+    from shrewd_tpu.trace.synth import WorkloadConfig
+
+    plans = {}
+    for i, seed in enumerate(seeds):
+        p = CampaignPlan(
+            simpoints=[WorkloadSpec(name="w0", workload=WorkloadConfig(
+                n=96, nphys=32, mem_words=64, working_set_words=32,
+                seed=7))],
+            seed=seed, structures=["regfile"], batch_size=batch_size,
+            target_halfwidth=0.2, max_trials=batch_size * n_batches,
+            min_trials=batch_size * n_batches)
+        p.integrity.canary_trials = 0
+        p.integrity.audit_rate = 0.0
+        p.resilience.backoff_base = 0.0
+        plans[f"t{i}"] = p.to_dict()
+    return plans
+
+
+def _run_fleet(outdir: str, plans: dict, **sched_kw):
+    from shrewd_tpu.service.queue import TenantSpec
+    from shrewd_tpu.service.scheduler import CampaignScheduler
+
+    sched = CampaignScheduler(outdir=outdir, **sched_kw)
+    for name, plan in plans.items():
+        sched.admit(TenantSpec(name=name, plan=plan))
+    rc = sched.run()
+    return sched, rc
+
+
+def _tallies(sched) -> dict:
+    import numpy as np
+
+    out = {}
+    for name, t in sched.tenants.items():
+        out[name] = {k: np.asarray(v["tallies"], dtype=np.int64)
+                     for k, v in (t.results or {}).items()}
+    return out
+
+
+def _tallies_equal(a: dict, b: dict) -> bool:
+    import numpy as np
+
+    if a.keys() != b.keys():
+        return False
+    for name in a:
+        if a[name].keys() != b[name].keys():
+            return False
+        for k in a[name]:
+            if not np.array_equal(a[name][k], b[name][k]):
+                return False
+    return True
+
+
+def _tally_digest(tallies: dict) -> dict:
+    return {name: hashlib.sha256(
+        b"".join(lanes[k].tobytes() for k in sorted(lanes))).hexdigest()
+        for name, lanes in tallies.items()}
+
+
+def _max_durable_seq(outdir: str) -> int:
+    """The highest journal seq visible in a crash-point snapshot
+    (snapshot's ``journal_seq`` or the last valid journal record) —
+    the floor the recovered fleet's seqs must never dip below."""
+    hi = -1
+    try:
+        snap = resil.load_json_verified(
+            os.path.join(outdir, "fleet_ckpt", "fleet.json"))
+        hi = int(snap.get("journal_seq", -1))
+    except (OSError, ValueError):
+        pass
+    jp = journal_path(outdir)
+    if os.path.exists(jp):
+        records, _torn, _valid = FleetJournal.replay_path(jp)
+        if records:
+            hi = max(hi, int(records[-1]["seq"]))
+    return hi
+
+
+# --------------------------------------------------------------------------
+# the checker
+# --------------------------------------------------------------------------
+
+def check_point(point: CrashPoint, scratch: str, plans: dict,
+                baseline: dict, torn: bool = False) -> dict:
+    """Re-execute recovery from one crash point: copy the snapshot,
+    optionally tear the last journal record (the mid-append crash),
+    ``recover()``, re-admit any tenant the crash landed before its
+    admit record, run to completion, and compare against the
+    undisturbed baseline."""
+    from shrewd_tpu.service.queue import TenantSpec
+    from shrewd_tpu.service.scheduler import CampaignScheduler
+
+    shutil.copytree(point.snapshot, scratch)
+    if torn and not tear_journal_tail(scratch):
+        shutil.rmtree(scratch, ignore_errors=True)
+        return {**point.label(), "torn": True, "skipped": True,
+                "ok": True}
+    pre_max = _max_durable_seq(scratch)
+    if torn:
+        # the torn record was never acknowledged: the durable floor is
+        # everything strictly before it
+        pre_max = min(pre_max, (point.seq or 0) - 1)
+    result = {**point.label(), "torn": torn, "ok": False}
+    try:
+        sched = CampaignScheduler.recover(scratch)
+        for name, plan in plans.items():
+            if name not in sched.tenants:
+                # the crash landed before this tenant's admit record
+                # became durable: the operator (here: the checker)
+                # resubmits, exactly like the spool would
+                sched.admit(TenantSpec(name=name, plan=plan))
+        rc = sched.run()
+        got = _tallies(sched)
+        statuses = {n: t.status for n, t in sched.tenants.items()}
+        post_max = _max_durable_seq(scratch)
+        result.update(
+            rc=rc,
+            identical=_tallies_equal(got, baseline),
+            statuses=statuses,
+            seq_monotonic=post_max >= max(pre_max, 0),
+            recoveries=sched.recoveries)
+        result["ok"] = (rc == 0 and result["identical"]
+                        and result["seq_monotonic"]
+                        and all(s == "complete" for s in
+                                statuses.values()))
+    except Exception as e:  # noqa: BLE001 — a crash point that breaks
+        # recovery outright is the most important finding of all; it
+        # must land in the report, not abort the sweep
+        result["error"] = f"{type(e).__name__}: {e}"
+    finally:
+        shutil.rmtree(scratch, ignore_errors=True)
+    return result
+
+
+def run_crashcheck(workdir: str, plans: dict | None = None,
+                   torn: bool = True, max_points: int | None = None,
+                   compact_every: int = 8, **sched_kw) -> dict:
+    """The full sweep (see module doc).  Returns the machine-readable
+    report (the ``CRASH_r11.json`` artifact the CI gate records);
+    ``report["ok"]`` is the gate bit."""
+    plans = plans if plans is not None else small_fleet_plans()
+    # 1. the undisturbed reference run
+    sched, rc = _run_fleet(os.path.join(workdir, "baseline"), plans,
+                           compact_every=compact_every, **sched_kw)
+    if rc != 0:
+        raise RuntimeError(f"crashcheck baseline fleet rc {rc}")
+    baseline = _tallies(sched)
+    # 2. the recorded run: identical fleet, every durability boundary
+    #    snapshotted by the VFS shim
+    rec_dir = os.path.join(workdir, "recorded")
+    points_dir = os.path.join(workdir, "points")
+    os.makedirs(points_dir, exist_ok=True)
+    with DurabilityRecorder(rec_dir, points_dir) as recorder:
+        sched2, rc2 = _run_fleet(rec_dir, plans,
+                                 compact_every=compact_every, **sched_kw)
+    if rc2 != 0 or not _tallies_equal(_tallies(sched2), baseline):
+        raise RuntimeError(
+            "crashcheck recorded run diverged from baseline — the "
+            "recorder must be observation-only")
+    points = recorder.points
+    dropped = 0
+    if max_points is not None and len(points) > max_points:
+        dropped = len(points) - max_points
+        points = points[:max_points]
+        debug.dprintf("Crashcheck", "bounded sweep: checking %d of %d "
+                      "crash points", max_points, max_points + dropped)
+    # 3. exhaustive recovery re-execution
+    results = []
+    for pt in points:
+        scratch = os.path.join(workdir, f"chk_{pt.index:04d}")
+        results.append(check_point(pt, scratch, plans, baseline))
+        if torn and pt.event == "append":
+            scratch = os.path.join(workdir, f"chk_{pt.index:04d}_torn")
+            results.append(check_point(pt, scratch, plans, baseline,
+                                       torn=True))
+    failures = [r for r in results if not r["ok"]]
+    doc = {
+        "tool": "crashcheck",
+        "tenants": sorted(plans),
+        "points": len(recorder.points),
+        "points_checked": len(points),
+        "points_dropped": dropped,
+        "checks": len(results),
+        "torn_checks": sum(1 for r in results if r["torn"]),
+        "events": [pt.label() for pt in recorder.points],
+        "boundaries_by_event": _count_by(recorder.points, "event"),
+        "baseline_digest": _tally_digest(baseline),
+        "failures": failures,
+        "seq_monotonic": all(r.get("seq_monotonic", True)
+                             for r in results),
+        "ok": not failures and dropped == 0,
+    }
+    return doc
+
+
+def _count_by(points, field: str) -> dict:
+    out: dict = {}
+    for pt in points:
+        key = getattr(pt, field)
+        out[key] = out.get(key, 0) + 1
+    return out

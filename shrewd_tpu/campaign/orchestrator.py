@@ -1500,6 +1500,12 @@ class Orchestrator:
                 debug.dprintf("Campaign", "latest checkpoint is torn — "
                               "overwriting in place, keeping prev")
             else:
+                # graftlint: allow-fsync-rename -- rotation of an
+                # ALREADY-durable checkpoint: its bytes were fsync'd by
+                # write_json_atomic when it was written, and the
+                # dir-fsync just below is what makes the rotation
+                # itself durable (fsync-after is the correct order for
+                # renaming a durable file)
                 os.replace(path,
                            os.path.join(ckpt_dir, "campaign.prev.json"))
                 # durability: the rotation rename is only crash-safe once

@@ -31,7 +31,8 @@ from __future__ import annotations
 import json
 import os
 
-from shrewd_tpu.resilience import write_json_atomic
+from shrewd_tpu.resilience import (doc_checksum, load_json_verified,
+                                   write_json_atomic)
 from shrewd_tpu.scenario import pareto
 from shrewd_tpu.scenario.matrix import COHERENCE, ScenarioMatrix
 from shrewd_tpu.service.scheduler import CampaignScheduler
@@ -63,9 +64,14 @@ class ScenarioRunner:
     # --- construction -----------------------------------------------------
 
     def _persist_matrix(self) -> None:
+        """The matrix document is a RECOVERY INPUT (a hard-killed fleet
+        rebuilds the whole matrix from it), so it carries a content
+        checksum like every other crash-surface artifact — recovery
+        verifies it rather than trusting whatever bytes survived."""
         os.makedirs(self.outdir, exist_ok=True)
-        write_json_atomic(os.path.join(self.outdir, MATRIX_DOC),
-                          self.matrix.to_dict())
+        doc = self.matrix.to_dict()
+        doc["checksum"] = doc_checksum(doc)
+        write_json_atomic(os.path.join(self.outdir, MATRIX_DOC), doc)
 
     def _admit_missing(self) -> int:
         """Admit every cell the scheduler does not already know — all of
@@ -95,9 +101,11 @@ class ScenarioRunner:
                 **sched_kw) -> "ScenarioRunner":
         """Rebuild a matrix fleet after ANY shutdown from its persisted
         matrix document + the fleet WAL (``CampaignScheduler.recover``
-        semantics; journaled prune decisions replay exactly)."""
-        with open(os.path.join(outdir, MATRIX_DOC)) as f:
-            matrix = ScenarioMatrix.from_dict(json.load(f))
+        semantics; journaled prune decisions replay exactly).  The
+        matrix document is checksum-verified: recovering a whole matrix
+        from torn bytes would be worse than refusing."""
+        matrix = ScenarioMatrix.from_dict(
+            load_json_verified(os.path.join(outdir, MATRIX_DOC)))
         runner = cls(matrix, outdir, prune=prune,
                      pareto_every=pareto_every, on_tick=on_tick,
                      **sched_kw)
@@ -236,8 +244,7 @@ class ScenarioRunner:
         lock, no journal replay, safe against a live server."""
         from shrewd_tpu.obs import metrics as obs_metrics
 
-        with open(os.path.join(outdir, MATRIX_DOC)) as f:
-            mdoc = json.load(f)
+        mdoc = load_json_verified(os.path.join(outdir, MATRIX_DOC))
         out = {"tag": mdoc["tag"], "outdir": outdir, "tenants": {},
                "fleet": {}}
         try:

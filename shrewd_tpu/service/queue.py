@@ -202,6 +202,11 @@ class SubmissionQueue:
                 continue
             dst = os.path.join(self.claimed_dir, ticket)
             try:
+                # graftlint: allow-fsync-rename -- cross-dir move of an
+                # already-durable document (content fsync'd at submit);
+                # a power loss that drops the rename re-pends the
+                # ticket, and re-claim is safe: admission refuses
+                # duplicate tenant names loudly
                 os.rename(src, dst)
             except OSError:
                 continue             # lost the claim race
@@ -216,6 +221,10 @@ class SubmissionQueue:
         src = os.path.join(self.pending_dir, ticket)
         dst = os.path.join(self.bad_dir, ticket)
         try:
+            # graftlint: allow-fsync-rename -- cross-dir move of an
+            # already-durable (if poisoned) document; losing the rename
+            # re-pends the ticket and the next poll re-quarantines it —
+            # the decision is deterministic, so replaying it is free
             os.rename(src, dst)
         except OSError:
             return                   # raced away (claimed or re-quarantined)

@@ -394,13 +394,24 @@ class CampaignScheduler:
 
     def _jlog(self, kind: str, data: dict | None = None) -> None:
         """Durably journal one state transition BEFORE the in-memory
-        ledgers are trusted (the WAL contract), compacting into the
-        snapshot every ``compact_every`` records."""
+        ledgers are trusted (the WAL contract, statically certified as
+        GL201: every mutation of journaled state is dominated by its
+        _jlog).  Deliberately does NOT compact: a compaction riding the
+        append would snapshot the PRE-mutation ledgers while truncating
+        the very record that carries the transition — compaction runs
+        only at loop-safe points (``_maybe_compact``), after the tick's
+        mutations are applied."""
         j = self._open_journal()
         if j is None:
             return
         j.append(kind, data)
-        if j.since_compact >= self.compact_every:
+
+    def _maybe_compact(self) -> None:
+        """Fold the WAL into a fresh snapshot once ``compact_every``
+        records accumulate — called between ticks, never from inside
+        ``_jlog`` (see there)."""
+        j = self._journal
+        if j is not None and j.since_compact >= self.compact_every:
             self.checkpoint()
 
     # --- admission --------------------------------------------------------
@@ -420,9 +431,9 @@ class CampaignScheduler:
             # the sanctioned obs.clock seam (GL106).
             t.queue_latency_s = max(0.0, obs_clock.now()
                                     - spec.submitted_at)
-        self.tenants[spec.name] = t
         self._jlog("admit", {"tenant": spec.name, "spec": spec.to_dict(),
                              "ticket": ticket, "order": t.order})
+        self.tenants[spec.name] = t
         obs_trace.tracer().emit(
             "tenant_admit", cat="fleet", tenant=spec.name,
             order=t.order, priority=spec.priority, weight=spec.weight)
@@ -481,8 +492,8 @@ class CampaignScheduler:
             "depth", t.orch.pcfg.depth)
         t._plan_depth = max(1, int(spec_depth))
         t.driver = t.orch.stepper()
-        t.status = "running"
         self._jlog("status", {"tenant": t.spec.name, "status": "running"})
+        t.status = "running"
         obs_trace.tracer().emit(
             "tenant_start", cat="fleet", tenant=t.spec.name,
             resumed=bool(resumable))
@@ -618,24 +629,29 @@ class CampaignScheduler:
         """One tick/elaboration exception: ledger it, tear down the dead
         driver, and either schedule a deterministic retry (exponential
         backoff counted in FLEET TICKS — no wall clock enters any
-        decision) or quarantine the tenant for good."""
-        t.failures += 1
+        decision) or quarantine the tenant for good.  The transition is
+        journaled BEFORE any ledger mutates (GL201): a kill inside the
+        append leaves the in-memory state untouched and the record
+        absent — never a half-applied failure."""
         entry = {"tick": self.ticks,
                  "error": f"{type(err).__name__}: {err}"}
-        t.errors.append(entry)
-        del t.errors[:-_MAX_ERRORS]
-        t.orch = t.driver = None
-        if t.failures > self.retry_budget:
-            self._quarantine(t)
+        failures = t.failures + 1
+        errors = (t.errors + [entry])[-_MAX_ERRORS:]
+        if failures > self.retry_budget:
+            self._quarantine(t, failures, errors)
             return
-        delay = self.backoff_ticks * (2 ** (t.failures - 1))
-        t.retry_at = self.ticks + delay
-        t.status = "queued"
+        delay = self.backoff_ticks * (2 ** (failures - 1))
+        retry_at = self.ticks + delay
         self._jlog("failure", {"tenant": t.spec.name,
-                               "failures": t.failures,
+                               "failures": failures,
                                "fleet_tick": self.ticks,
-                               "retry_at": t.retry_at,
+                               "retry_at": retry_at,
                                "error": entry["error"]})
+        t.failures = failures
+        t.errors = errors
+        t.retry_at = retry_at
+        t.orch = t.driver = None
+        t.status = "queued"
         obs_trace.tracer().emit(
             "tenant_failure", cat="fleet", tenant=t.spec.name,
             failures=t.failures, fleet_tick=self.ticks,
@@ -645,15 +661,25 @@ class CampaignScheduler:
                       err, t.retry_at)
         self._rebalance()
 
-    def _quarantine(self, t: TenantState) -> None:
+    def _quarantine(self, t: TenantState, failures: int | None = None,
+                    errors: list | None = None) -> None:
         """Retry budget exhausted: the tenant is poison.  Park it in a
-        DURABLE ``quarantined`` status — journal record, persisted
-        exception ledger in its namespace, done-doc for its ticket — so
-        it never stalls the fleet, never burns fair share, and never
-        silently retries across a resume/recover."""
+        DURABLE ``quarantined`` status — journal record (FIRST, before
+        any ledger mutates), persisted exception ledger in its
+        namespace, done-doc for its ticket — so it never stalls the
+        fleet, never burns fair share, and never silently retries
+        across a resume/recover."""
+        failures = t.failures if failures is None else failures
+        errors = list(t.errors) if errors is None else errors
+        last = errors[-1]["error"] if errors else ""
+        self._jlog("quarantine", {"tenant": t.spec.name,
+                                  "failures": failures,
+                                  "errors": list(errors)})
         t.status = "quarantined"
-        last = t.errors[-1]["error"] if t.errors else ""
-        t.results = {"error": last, "failures": t.failures}
+        t.failures = failures
+        t.errors = errors
+        t.orch = t.driver = None
+        t.results = {"error": last, "failures": failures}
         t.wall_s = (obs_clock.monotonic() - t._t_admit) if t._t_admit \
             else 0.0
         obs_trace.tracer().emit(
@@ -666,9 +692,6 @@ class CampaignScheduler:
                 os.path.join(outdir, "quarantine.json"),
                 {"tenant": t.spec.name, "failures": t.failures,
                  "errors": list(t.errors)})
-        self._jlog("quarantine", {"tenant": t.spec.name,
-                                  "failures": t.failures,
-                                  "errors": list(t.errors)})
         if self.queue is not None and t.ticket:
             self.queue.mark_done(t.ticket, {
                 "tenant": t.spec.name, "status": "quarantined",
@@ -680,9 +703,15 @@ class CampaignScheduler:
             self.checkpoint()
         # "why did this tenant quarantine" must be answerable from one
         # artifact: dump the recent-event window now, while the failing
-        # tenant's dispatch/verdict/failure events are still in the ring
-        obs_trace.flight_dump(self.outdir, "tenant_quarantine",
-                              tenant=t.spec.name, failures=t.failures)
+        # tenant's dispatch/verdict/failure events are still in the
+        # ring.  Guarded (GL204): the recorder is evidence, and an
+        # exporter crash must never turn one failure into two.
+        try:
+            obs_trace.flight_dump(self.outdir, "tenant_quarantine",
+                                  tenant=t.spec.name,
+                                  failures=t.failures)
+        except Exception as e:  # noqa: BLE001 — best-effort seam
+            debug.dprintf("Fleet", "flight dump failed: %s", e)
 
     # --- quota revocation (the sanctioned early-stop seam) ----------------
 
@@ -701,9 +730,14 @@ class CampaignScheduler:
             raise KeyError(f"unknown tenant {tenant!r}")
         if t.revoked or t.status not in ("queued", "running"):
             return False
-        t.revoked = reason or "revoked"
-        self._jlog("revoke", {"tenant": t.spec.name, "reason": t.revoked,
+        # the DECISION is journaled before the ledger mutates (GL201):
+        # a kill inside the append either replays the revocation or
+        # leaves the tenant untouched — never a revoked-in-memory
+        # tenant whose journal never heard about it
+        reason = reason or "revoked"
+        self._jlog("revoke", {"tenant": t.spec.name, "reason": reason,
                               "fleet_tick": self.ticks})
+        t.revoked = reason
         obs_trace.tracer().emit(
             "tenant_revoke", cat="fleet", tenant=t.spec.name,
             reason=t.revoked, fleet_tick=self.ticks)
@@ -720,16 +754,17 @@ class CampaignScheduler:
         recovery) goes terminal WITHOUT elaboration — revocation must
         not cost a plan build, and a plan that cannot elaborate must
         still be prunable."""
-        t.status = "pruned"
-        t.wall_s = (obs_clock.monotonic() - t._t_admit) if t._t_admit \
+        wall_s = (obs_clock.monotonic() - t._t_admit) if t._t_admit \
             else 0.0
+        self._jlog("status", {"tenant": t.spec.name, "status": "pruned",
+                              "trials": t.trials, "batches": t.batches,
+                              "wall_s": round(wall_s, 3),
+                              "results": t.results})
+        t.status = "pruned"
+        t.wall_s = wall_s
         obs_trace.tracer().emit(
             "tenant_pruned", cat="fleet", tenant=t.spec.name,
             trials=t.trials, reason=t.revoked)
-        self._jlog("status", {"tenant": t.spec.name, "status": "pruned",
-                              "trials": t.trials, "batches": t.batches,
-                              "wall_s": round(t.wall_s, 3),
-                              "results": t.results})
         if self.queue is not None and t.ticket:
             self.queue.mark_done(t.ticket, {
                 "tenant": t.spec.name, "status": "pruned",
@@ -753,10 +788,11 @@ class CampaignScheduler:
         survive the rebuild or the kill would re-fire forever), and
         keep running.  Frozen keys make the recovered tallies
         bit-identical either way."""
-        t.kills += 1
+        kills = t.kills + 1
         debug.dprintf("Fleet", "%s: %s — rebuilding tenant", t.spec.name, e)
         self._jlog("tenant_kill", {"tenant": t.spec.name,
-                                   "kills": t.kills})
+                                   "kills": kills})
+        t.kills = kills
         obs_trace.tracer().emit("tenant_kill", cat="fleet",
                                 tenant=t.spec.name, kills=t.kills)
         engine = t.orch.chaos
@@ -800,14 +836,15 @@ class CampaignScheduler:
             # serving either way.
             self._note_failure(t, e)
             return
-        t.ticks += 1
         trials = sum(st.trials for st in t.orch.state.values())
-        t.trials = trials
-        t.batches = trials // max(t.orch.batch_size, 1)
+        batches = trials // max(t.orch.batch_size, 1)
         self._jlog("tick", {"tenant": t.spec.name,
                             "fleet_tick": self.ticks,
-                            "trials": t.trials, "batches": t.batches,
-                            "ticks": t.ticks, "kills": t.kills})
+                            "trials": trials, "batches": batches,
+                            "ticks": t.ticks + 1, "kills": t.kills})
+        t.ticks += 1
+        t.trials = trials
+        t.batches = batches
         if t.driver.done:
             self._finalize(t)
             return
@@ -821,43 +858,47 @@ class CampaignScheduler:
             t.driver.request_drain()
 
     def _finalize(self, t: TenantState) -> None:
-        t.rc = t.driver.rc
+        rc = t.driver.rc
         from shrewd_tpu.campaign.orchestrator import Orchestrator
 
-        if t.rc == Orchestrator.RC_ABORTED:
+        if rc == Orchestrator.RC_ABORTED:
             # honesty outranks the revocation: an abort (integrity/
             # budget) during the drain is still an abort
-            t.status = "aborted"
+            status = "aborted"
         elif t.revoked:
             # the journaled revocation decision is authoritative over
             # every cooperative ending — including a campaign whose
             # final in-flight batch happened to complete it during the
             # drain (rc 0): the quota WAS withdrawn first, and the
             # Pareto artifact's decision list must match the statuses
-            t.status = "pruned"
-        elif t.rc == Orchestrator.RC_PREEMPTED:
-            t.status = ("quota" if t.spec.quota_batches
-                        and t.batches >= t.spec.quota_batches
-                        else "preempted")
+            status = "pruned"
+        elif rc == Orchestrator.RC_PREEMPTED:
+            status = ("quota" if t.spec.quota_batches
+                      and t.batches >= t.spec.quota_batches
+                      else "preempted")
         else:
-            t.status = "complete"
+            status = "complete"
             if t.kills and t.orch.chaos is not None:
                 # the killed tenant finished with believed tallies: the
                 # injected kill was survived (the ledger the chaos stats
                 # group reports)
                 for _ in range(t.kills):
                     t.orch.chaos.note_survived("kill_worker")
-        t.wall_s = (obs_clock.monotonic() - t._t_admit) if t._t_admit \
+        wall_s = (obs_clock.monotonic() - t._t_admit) if t._t_admit \
             else 0.0
-        t.results = self._summarize(t)
+        results = self._summarize(t)
+        self._jlog("status", {"tenant": t.spec.name, "status": status,
+                              "rc": rc, "trials": t.trials,
+                              "batches": t.batches,
+                              "wall_s": round(wall_s, 3),
+                              "results": results})
+        t.status = status
+        t.rc = rc
+        t.wall_s = wall_s
+        t.results = results
         obs_trace.tracer().emit(
             "tenant_done", cat="fleet", tenant=t.spec.name,
             status=t.status, rc=t.rc, trials=t.trials)
-        self._jlog("status", {"tenant": t.spec.name, "status": t.status,
-                              "rc": t.rc, "trials": t.trials,
-                              "batches": t.batches,
-                              "wall_s": round(t.wall_s, 3),
-                              "results": t.results})
         t.orch.write_outputs()
         if t.orch.outdir and t.status == "complete":
             t.orch.checkpoint()          # the final-state dump _drive writes
@@ -944,6 +985,7 @@ class CampaignScheduler:
             self.schedule_log.append(t.spec.name)
             self.ticks += 1
             self._tick_tenant(t)
+            self._maybe_compact()
             self._publish_metrics()
             if self.on_tick is not None:
                 self.on_tick(self)
@@ -1107,6 +1149,12 @@ class CampaignScheduler:
                 timeout=self.tick_timeout, name="fleet-tick")
                 if self.tick_timeout > 0 else None)
             return
+        if kind in ("shutdown", "recover"):
+            # lifecycle markers: nothing to restore, but the dispatch
+            # handles them EXPLICITLY so the GL202 exhaustiveness check
+            # can prove every appended kind has a considered replay
+            # story (an unlisted kind is a recovery gap, not noise)
+            return
         if kind == "admit":
             if r.get("tenant") not in self.tenants:
                 self._admit_from_dict({"spec": r["spec"],
@@ -1158,7 +1206,6 @@ class CampaignScheduler:
                 t.results = r["results"]
             if "wall_s" in r:
                 t.wall_s = float(r["wall_s"])
-        # "shutdown" / "recover" records are informational
 
     @classmethod
     def recover(cls, outdir: str, mesh=None,

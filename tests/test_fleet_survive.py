@@ -26,8 +26,10 @@ import pytest
 
 from test_fleet import _assert_tenant_matches, _plan, _solo_tallies
 
+from shrewd_tpu.analysis import crashcheck
 from shrewd_tpu.chaos import ChaosEngine, ChaosPlanError
 from shrewd_tpu.resilience import load_json_verified
+from shrewd_tpu.service import journal as journal_mod
 from shrewd_tpu.service import (CampaignScheduler, FleetJournal,
                                 FleetKilled, LockHeld, ServerLock,
                                 SubmissionQueue, TenantSpec, is_dirty,
@@ -448,6 +450,157 @@ def test_drain_during_admission_certification(tmp_path, monkeypatch):
     # the certify floor still holds on the resumed tenant
     assert resumed.tenants["t"].orch.plan.analysis.certify == "warn"
     _assert_tenant_matches(resumed, "t", solo)
+
+
+# --- the WAL contract: journal BEFORE mutate (GL201, dynamically) -----------
+
+def _append_raising_on(monkeypatch, kind):
+    """Patch FleetJournal.append to die INSIDE the append of one record
+    kind — the tightest crash window the journal-before-mutate ordering
+    must survive: the decision is either durable or unmade, never
+    half-applied in memory."""
+    real = journal_mod.FleetJournal.append
+
+    def boom(self, k, data=None):
+        if k == kind:
+            raise RuntimeError(f"kill inside append({k!r})")
+        return real(self, k, data)
+
+    monkeypatch.setattr(journal_mod.FleetJournal, "append", boom)
+    return real
+
+
+def test_revoke_journals_before_any_mutation(tmp_path, monkeypatch):
+    sched = CampaignScheduler(outdir=str(tmp_path))
+    sched.admit(TenantSpec(name="t", plan=_plan(3,
+                                                n_batches=1).to_dict()))
+    t = sched.tenants["t"]
+    real = _append_raising_on(monkeypatch, "revoke")
+    with pytest.raises(RuntimeError, match="inside append"):
+        sched.revoke_quota("t", "pareto:rival")
+    # the kill landed inside the append: the in-memory decision is
+    # UNMADE (journal-first), so nothing disagrees with the journal
+    assert t.revoked == "" and t.status == "queued"
+    # and the seam still works once the journal is healthy again
+    monkeypatch.setattr(journal_mod.FleetJournal, "append", real)
+    assert sched.revoke_quota("t", "pareto:rival") is True
+    assert t.revoked == "pareto:rival" and t.status == "pruned"
+
+
+def test_admit_journals_before_roster_insert(tmp_path, monkeypatch):
+    sched = CampaignScheduler(outdir=str(tmp_path))
+    _append_raising_on(monkeypatch, "admit")
+    with pytest.raises(RuntimeError, match="inside append"):
+        sched.admit(TenantSpec(name="t", plan=_plan(3).to_dict()))
+    assert "t" not in sched.tenants
+
+
+def test_note_failure_journals_before_ledger(tmp_path, monkeypatch):
+    sched = CampaignScheduler(outdir=str(tmp_path), retry_budget=3)
+    sched.admit(TenantSpec(name="t", plan=_plan(3).to_dict()))
+    t = sched.tenants["t"]
+    _append_raising_on(monkeypatch, "failure")
+    with pytest.raises(RuntimeError, match="inside append"):
+        sched._note_failure(t, ValueError("boom"))
+    assert t.failures == 0 and t.errors == [] and t.retry_at == 0
+
+
+def test_quarantine_journals_before_ledger(tmp_path, monkeypatch):
+    sched = CampaignScheduler(outdir=str(tmp_path), retry_budget=0)
+    sched.admit(TenantSpec(name="t", plan=_plan(3).to_dict()))
+    t = sched.tenants["t"]
+    _append_raising_on(monkeypatch, "quarantine")
+    with pytest.raises(RuntimeError, match="inside append"):
+        sched._note_failure(t, ValueError("boom"))
+    assert t.status == "queued" and t.failures == 0 and t.results is None
+
+
+# --- crashcheck: exhaustive crash-point model checking ----------------------
+
+def test_tear_journal_tail_semantics(tmp_path):
+    # the torn-write model: the last record loses its tail mid-line,
+    # replay drops ONLY it, and an empty/absent journal refuses to tear
+    outdir = str(tmp_path)
+    path = journal_path(outdir)
+    assert crashcheck.tear_journal_tail(outdir) is False    # no journal
+    j = FleetJournal(path)
+    for i in range(3):
+        j.append("tick", {"i": i})
+    j.close()
+    assert crashcheck.tear_journal_tail(outdir) is True
+    recs, torn, _ = FleetJournal.replay_path(path)
+    assert [r["seq"] for r in recs] == [0, 1] and torn == 1
+    # an already-torn tail cannot tear again
+    assert crashcheck.tear_journal_tail(outdir) is False
+
+
+def test_snapshot_tree_scrubs_non_durable(tmp_path):
+    src = tmp_path / "src"
+    (src / "fleet_ckpt").mkdir(parents=True)
+    (src / "fleet_ckpt" / "fleet.json").write_text("{}")
+    (src / "metrics.json").write_text("{}")
+    (src / "fleet_stats.json").write_text("{}")
+    (src / "fleet_ckpt" / "fleet.json.tmp").write_text("{")
+    dst = str(tmp_path / "dst")
+    crashcheck.snapshot_tree(str(src), dst)
+    kept = sorted(os.path.relpath(os.path.join(r, f), dst)
+                  for r, _d, fs in os.walk(dst) for f in fs)
+    # durable state survives; unsynced observability and tmp legs do not
+    assert kept == [os.path.join("fleet_ckpt", "fleet.json")]
+
+
+def _record_points(tmp_path, tag):
+    plans = crashcheck.small_fleet_plans(seeds=(3,), n_batches=1)
+    rec_dir = str(tmp_path / f"rec{tag}")
+    pts_dir = str(tmp_path / f"pts{tag}")
+    os.makedirs(pts_dir)
+    with crashcheck.DurabilityRecorder(rec_dir, pts_dir) as rec:
+        _sched, rc = crashcheck._run_fleet(rec_dir, plans)
+    assert rc == 0
+    return rec.points
+
+
+def test_crash_point_enumeration_is_deterministic(tmp_path):
+    # two identical fleets must expose the identical crash surface:
+    # same boundaries, same order, same journal seqs — crashcheck's
+    # exhaustiveness claim rests on this
+    a = [pt.label() for pt in _record_points(tmp_path, "a")]
+    b = [pt.label() for pt in _record_points(tmp_path, "b")]
+    assert a == b
+    assert any(pt["event"] == "append" for pt in a)
+    assert any(pt["event"] == "rename" for pt in a)
+
+
+def test_crashcheck_catches_divergence(tmp_path):
+    # negative control: the checker must FAIL when recovery does not
+    # reproduce the reference tallies (here: a corrupted reference)
+    plans = crashcheck.small_fleet_plans(seeds=(3,), n_batches=1)
+    points = _record_points(tmp_path, "neg")
+    base_sched, rc = crashcheck._run_fleet(str(tmp_path / "base"), plans)
+    assert rc == 0
+    baseline = crashcheck._tallies(base_sched)
+    for lanes in baseline.values():
+        for k in lanes:
+            lanes[k] = lanes[k] + 1          # nobody can reach this
+    res = crashcheck.check_point(points[-1], str(tmp_path / "chk"),
+                                 plans, baseline)
+    assert res["ok"] is False and res["identical"] is False
+
+
+def test_crashcheck_three_tenant_fleet_exhaustive(tmp_path):
+    # the acceptance pin: EVERY durability boundary of a 3-tenant fleet
+    # (plus a torn-tail variant of every journal append) recovers to
+    # bit-identical final tallies with journal seqs never regressing —
+    # the single-kill-point chaos smoke generalized to the whole crash
+    # surface
+    plans = crashcheck.small_fleet_plans(seeds=(3, 5, 7), n_batches=1)
+    doc = crashcheck.run_crashcheck(str(tmp_path), plans=plans)
+    assert doc["ok"], doc["failures"][:3]
+    assert doc["failures"] == [] and doc["seq_monotonic"]
+    assert doc["points"] >= 15 and doc["torn_checks"] >= 8
+    assert set(doc["boundaries_by_event"]) >= {"append", "rename"}
+    assert sorted(doc["tenants"]) == ["t0", "t1", "t2"]
+    assert doc["points_dropped"] == 0
 
 
 # --- observability ----------------------------------------------------------

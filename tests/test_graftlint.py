@@ -245,6 +245,42 @@ def test_severity_warn_and_off(tmp_path):
                      cfg=cfg) == []
 
 
+def test_off_rule_keeps_waivers_live_and_beats_overrides(tmp_path):
+    """Turning a rule off must not (1) rot its waivers into GL205 stale
+    findings — re-enabling the rule needs them back — or (2) leak
+    findings whose call site passes an explicit severity override."""
+    from shrewd_tpu.analysis import replay_lint
+    from shrewd_tpu.analysis.ast_lint import (_run_file_passes,
+                                              stale_waivers)
+
+    cfg = GraftlintConfig()
+    cfg.severity["GL101"] = "off"
+    fl = _file_lint(tmp_path, """
+        import jax
+        # graftlint: allow-jit -- fixture: live waiver under an off rule
+        step = jax.jit(lambda x: x)
+    """, "shrewd_tpu/parallel/campaign.py", cfg)
+    _run_file_passes(fl, cfg)
+    assert fl.findings == []                  # off: nothing reported
+    assert stale_waivers(fl) == []            # ...and nothing rots
+    # an explicit severity= at the call site must not resurrect an off
+    # rule (GL202's dead-arm warning is the one override user)
+    cfg2 = GraftlintConfig()
+    cfg2.severity["GL202"] = "off"
+    fl2 = _file_lint(tmp_path, """
+        class S:
+            def act(self):
+                self._jlog("admit", {})
+
+            def _apply_record(self, r):
+                kind = r.get("kind")
+                if kind in ("admit", "ghost"):
+                    return
+    """, SCHED_REL, cfg2)
+    replay_lint.check_journal_exhaustive([fl2], cfg2)
+    assert fl2.findings == []
+
+
 def test_repo_lints_clean_with_reasoned_waivers():
     """The CI gate's precondition: zero unwaived violations across the
     package, and every waiver carries its reason."""
@@ -261,6 +297,375 @@ def test_pyproject_graftlint_block_parses():
     assert "shrewd_tpu/parallel/campaign.py" in cfg.jit_modules
     assert "shrewd_tpu/chaos.py" in cfg.deterministic_modules
     assert cfg.rule_severity("GL101") == "error"
+
+
+# --- GL2xx: crash/replay-safety (analysis/replay_lint.py) -------------------
+
+def _file_lint(tmp_path, src: str, rel: str,
+               cfg: GraftlintConfig | None = None):
+    """A ready-to-pass _FileLint over fixture source at a virtual repo
+    path (the GL2xx passes and the stale-waiver audit consume the
+    object, not just its findings)."""
+    from shrewd_tpu.analysis.ast_lint import _FileLint
+
+    cfg = cfg if cfg is not None else GraftlintConfig()
+    path = tmp_path / (rel.replace("/", "+") + ".fixture.py")
+    path.write_text(textwrap.dedent(src))
+    return _FileLint(str(path), rel, cfg)
+
+
+SCHED_REL = "shrewd_tpu/service/scheduler.py"
+
+
+def test_gl201_journal_before_mutate_positive_and_negative(tmp_path):
+    # mutation BEFORE the journal call: the WAL contract inverted
+    bad = _lint_src(tmp_path, """
+        class S:
+            def finish(self, t):
+                t.status = "complete"
+                self._jlog("status", {"status": t.status})
+    """, rel=SCHED_REL)
+    assert _rules(bad) == ["GL201"]
+    # journal-first is quiet, straight-line or branchy
+    good = _lint_src(tmp_path, """
+        class S:
+            def finish(self, t, rc):
+                status = "aborted" if rc else "complete"
+                self._jlog("status", {"status": status})
+                t.status = status
+                t.trials = 0
+    """, rel=SCHED_REL)
+    assert _rules(good) == []
+    # a branch that can SKIP the journal call does not dominate
+    branchy = _lint_src(tmp_path, """
+        class S:
+            def finish(self, t, loud):
+                if loud:
+                    self._jlog("status", {})
+                t.status = "complete"
+    """, rel=SCHED_REL)
+    assert _rules(branchy) == ["GL201"]
+    # an early-return arm above an unconditional journal stays dominated
+    early = _lint_src(tmp_path, """
+        class S:
+            def revoke(self, t, reason):
+                if t.revoked:
+                    return False
+                self._jlog("revoke", {"reason": reason})
+                t.revoked = reason
+                return True
+    """, rel=SCHED_REL)
+    assert _rules(early) == []
+
+
+def test_gl201_exemptions_and_waiver(tmp_path):
+    # constructors and the replay path are exempt: they must NOT journal
+    exempt = _lint_src(tmp_path, """
+        class T:
+            def __init__(self):
+                self.status = "queued"
+
+        class S:
+            def _apply_record(self, t, r):
+                t.status = r["status"]
+    """, rel=SCHED_REL)
+    assert _rules(exempt) == []
+    # out-of-scope module: the rule does not apply
+    off = _lint_src(tmp_path, """
+        def f(t):
+            t.status = "x"
+    """, rel="shrewd_tpu/models/o3.py")
+    assert _rules(off) == []
+    # waiverable with a reason, like every other rule
+    waived = _lint_src(tmp_path, """
+        class S:
+            def fixup(self, t):
+                # graftlint: allow-journal-before-mutate -- fixture:
+                # in-memory scratch copy, never journaled
+                t.status = "x"
+    """, rel=SCHED_REL)
+    assert _rules(waived) == [] and _rules(waived, waived=True) == ["GL201"]
+    # reads are not mutations: subscript KEYS and rvalues stay quiet
+    reads = _lint_src(tmp_path, """
+        class S:
+            def _by_status(self, out, t):
+                out[t.status] = out.get(t.status, 0) + 1
+                return t.status
+    """, rel=SCHED_REL)
+    assert _rules(reads) == []
+
+
+def test_gl202_exhaustiveness_positive_and_negative(tmp_path):
+    from shrewd_tpu.analysis import replay_lint
+
+    cfg = GraftlintConfig()
+    # 'orphan' is appended but the dispatch never handles it
+    fl = _file_lint(tmp_path, """
+        class S:
+            def act(self):
+                self._jlog("admit", {})
+                self._jlog("orphan", {})
+
+            def _apply_record(self, r):
+                kind = r.get("kind")
+                if kind == "admit":
+                    return
+    """, SCHED_REL, cfg)
+    replay_lint.check_journal_exhaustive([fl], cfg)
+    errs = [f for f in fl.findings if not f.waived
+            and f.severity == "error"]
+    assert [f.rule for f in errs] == ["GL202"]
+    assert "'orphan'" in errs[0].msg
+    # a dead dispatch arm is a warning, not an error
+    warns = [f for f in fl.findings if f.severity == "warn"]
+    assert warns == []
+    fl2 = _file_lint(tmp_path, """
+        class S:
+            def act(self):
+                self._jlog("admit", {})
+
+            def _apply_record(self, r):
+                kind = r.get("kind")
+                if kind in ("admit", "ghost"):
+                    return
+    """, SCHED_REL, cfg)
+    replay_lint.check_journal_exhaustive([fl2], cfg)
+    assert [f.rule for f in fl2.findings
+            if f.severity == "warn"] == ["GL202"]
+    # field probes like '"rc" in r' must NOT read as handled kinds
+    assert replay_lint._handled_kinds(
+        fl2.tree.body[0].body[1]) == {"admit", "ghost"}
+
+
+def test_gl202_no_dispatch_is_an_error(tmp_path):
+    from shrewd_tpu.analysis import replay_lint
+
+    cfg = GraftlintConfig()
+    fl = _file_lint(tmp_path, """
+        class S:
+            def act(self):
+                self._jlog("admit", {})
+    """, SCHED_REL, cfg)
+    replay_lint.check_journal_exhaustive([fl], cfg)
+    assert [f.rule for f in fl.findings] == ["GL202"]
+    assert "no replay dispatch" in fl.findings[0].msg
+
+
+def test_gl203_fsync_before_rename(tmp_path):
+    rel = "shrewd_tpu/service/journal.py"
+    bad = _lint_src(tmp_path, """
+        import os
+        def compact(path):
+            with open(path + ".tmp", "w") as f:
+                f.write("")
+            os.replace(path + ".tmp", path)
+    """, rel=rel)
+    assert _rules(bad) == ["GL203"]
+    good = _lint_src(tmp_path, """
+        import os
+        def compact(path):
+            with open(path + ".tmp", "w") as f:
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(path + ".tmp", path)
+    """, rel=rel)
+    assert _rules(good) == []
+    # fsync in only ONE branch does not dominate
+    branchy = _lint_src(tmp_path, """
+        import os
+        def compact(path, sync):
+            if sync:
+                os.fsync(0)
+            os.rename(path + ".tmp", path)
+    """, rel=rel)
+    assert _rules(branchy) == ["GL203"]
+
+
+def test_gl203_recovery_read_raw_write(tmp_path):
+    # the same module both recovers from fleet.json and writes it raw:
+    # the crash surface itself can tear
+    rel = SCHED_REL
+    bad = _lint_src(tmp_path, """
+        import json, os
+        def recover(outdir):
+            with open(os.path.join(outdir, "fleet.json")) as f:
+                return json.load(f)
+        def save(outdir, doc):
+            with open(os.path.join(outdir, "fleet.json"), "w") as f:
+                f.write("x")
+    """, rel=rel)
+    assert "GL203" in _rules(bad)
+    # routed through the atomic writer (no raw open of the artifact)
+    good = _lint_src(tmp_path, """
+        import json, os
+        from shrewd_tpu.resilience import write_json_atomic
+        def recover(outdir):
+            with open(os.path.join(outdir, "fleet.json")) as f:
+                return json.load(f)
+        def save(outdir, doc):
+            write_json_atomic(os.path.join(outdir, "fleet.json"), doc)
+    """, rel=rel)
+    assert "GL203" not in _rules(good)
+    # a non-recovery artifact may be written raw (GL103 scoping aside)
+    unrelated = _lint_src(tmp_path, """
+        import json, os
+        def recover(outdir):
+            with open(os.path.join(outdir, "fleet.json")) as f:
+                return json.load(f)
+        def save(outdir, doc):
+            with open(os.path.join(outdir, "notes.txt"), "w") as f:
+                f.write("x")
+    """, rel=rel)
+    assert "GL203" not in _rules(unrelated)
+
+
+def test_gl204_best_effort_guard(tmp_path):
+    rel = SCHED_REL
+    bad = _lint_src(tmp_path, """
+        from shrewd_tpu.obs import trace as obs_trace
+        def quarantine(outdir):
+            obs_trace.flight_dump(outdir, "why")
+    """, rel=rel)
+    assert _rules(bad) == ["GL204"]
+    good = _lint_src(tmp_path, """
+        from shrewd_tpu.obs import trace as obs_trace
+        def quarantine(outdir):
+            try:
+                obs_trace.flight_dump(outdir, "why")
+            except Exception:
+                pass
+    """, rel=rel)
+    assert _rules(good) == []
+    # a narrow handler is not a guard — the seam can still take the
+    # fleet down with anything it did not anticipate
+    narrow = _lint_src(tmp_path, """
+        from shrewd_tpu.obs import trace as obs_trace
+        def quarantine(outdir):
+            try:
+                obs_trace.flight_dump(outdir, "why")
+            except OSError:
+                pass
+    """, rel=rel)
+    assert _rules(narrow) == ["GL204"]
+    # out-of-scope module: quiet
+    off = _lint_src(tmp_path, """
+        from shrewd_tpu.obs import trace as obs_trace
+        def f(outdir):
+            obs_trace.flight_dump(outdir, "why")
+    """, rel="shrewd_tpu/models/o3.py")
+    assert _rules(off) == []
+
+
+# --- stale-waiver audit (GL205) ---------------------------------------------
+
+def test_stale_waiver_detected_and_live_waiver_not(tmp_path):
+    from shrewd_tpu.analysis.ast_lint import (_run_file_passes,
+                                              stale_waivers)
+
+    cfg = GraftlintConfig()
+    fl = _file_lint(tmp_path, """
+        import jax
+        # graftlint: allow-jit -- fixture: a LIVE waiver (jit below)
+        step = jax.jit(lambda x: x)
+        # graftlint: allow-jit -- fixture: STALE (nothing to waive here)
+        plain = 1
+    """, "shrewd_tpu/parallel/campaign.py", cfg)
+    _run_file_passes(fl, cfg)
+    stale = stale_waivers(fl)
+    assert [f.rule for f in stale] == ["GL205"]
+    assert stale[0].line == 5 and "stale waiver" in stale[0].msg
+    # the live waiver was consumed, not reported
+    assert [f.rule for f in fl.findings if f.waived] == ["GL101"]
+
+
+def test_repo_has_no_stale_waivers():
+    """The --audit-waivers CI gate's precondition: every waiver in the
+    package still covers a live finding."""
+    report = lint_tree(REPO_ROOT, load_config(REPO_ROOT))
+    assert report.stale == [], [str(f) for f in report.stale]
+
+
+def test_repo_journal_kinds_are_exhaustive():
+    """The GL202 ground truth on the real scheduler: the set of kinds
+    appended anywhere equals the set _apply_record handles, exactly —
+    a new journal record without a replay handler cannot land."""
+    from shrewd_tpu.analysis import replay_lint
+    from shrewd_tpu.analysis.ast_lint import _FileLint
+
+    cfg = load_config(REPO_ROOT)
+    fls = [_FileLint(os.path.join(REPO_ROOT, rel), rel, cfg)
+           for rel in sorted(set(cfg.journaled_modules)
+                             | set(cfg.durability_modules))]
+    appended, handled, dispatch = replay_lint.collect_journal_kinds(
+        fls, cfg)
+    assert dispatch is not None
+    assert set(appended) == {
+        "config", "admit", "status", "tick", "failure", "quarantine",
+        "tenant_kill", "revoke", "shutdown", "recover"}
+    assert set(appended) == handled
+
+
+# --- SARIF export + CLI gates ----------------------------------------------
+
+def _fixture_repo(tmp_path) -> str:
+    """A tiny virtual repo with one violation and one stale waiver."""
+    pkg = tmp_path / "shrewd_tpu" / "parallel"
+    pkg.mkdir(parents=True)
+    (tmp_path / "shrewd_tpu" / "__init__.py").write_text("")
+    (pkg / "__init__.py").write_text("")
+    (pkg / "campaign.py").write_text(textwrap.dedent("""
+        import jax
+        step = jax.jit(lambda x: x)
+        # graftlint: allow-wall-clock -- fixture: stale on purpose
+        plain = 1
+    """))
+    return str(tmp_path)
+
+
+def test_cli_sarif_export_and_audit_waivers_gate(tmp_path):
+    import json
+    import subprocess
+    import sys
+
+    root = _fixture_repo(tmp_path)
+    out_sarif = str(tmp_path / "out.sarif")
+    out_json = str(tmp_path / "out.json")
+    cmd = [sys.executable, os.path.join(REPO_ROOT, "tools", "graftlint.py"),
+           "--no-jaxpr", "--root", root, "--sarif", out_sarif,
+           "--json", out_json]
+    r = subprocess.run(cmd, capture_output=True, text=True)
+    assert r.returncode == 1, r.stdout + r.stderr   # the GL101 violation
+    sarif = json.load(open(out_sarif))
+    assert sarif["version"] == "2.1.0"
+    results = sarif["runs"][0]["results"]
+    assert any(res["ruleId"] == "GL101" and res["level"] == "error"
+               for res in results)
+    loc = results[0]["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"].endswith("campaign.py")
+    assert loc["region"]["startLine"] >= 1
+    rules = {x["id"] for x in sarif["runs"][0]["tool"]["driver"]["rules"]}
+    assert {"GL101", "GL201", "GL202", "GL203", "GL204",
+            "GL205"} <= rules
+    doc = json.load(open(out_json))
+    # the stale waiver is REPORTED either way, but gates only under
+    # --audit-waivers
+    assert len(doc["stale_waivers"]) == 1
+    assert doc["violations"] and not doc["ok"]
+    # with the violation waived, the stale waiver alone decides the rc
+    (tmp_path / "shrewd_tpu" / "parallel" / "campaign.py").write_text(
+        textwrap.dedent("""
+            import jax
+            # graftlint: allow-jit -- fixture: waived for the gate test
+            step = jax.jit(lambda x: x)
+            # graftlint: allow-wall-clock -- fixture: stale on purpose
+            plain = 1
+        """))
+    r = subprocess.run(cmd, capture_output=True, text=True)
+    assert r.returncode == 0, r.stdout + r.stderr
+    r = subprocess.run(cmd + ["--audit-waivers"], capture_output=True,
+                       text=True)
+    assert r.returncode == 1
+    assert "STALE" in r.stdout
 
 
 # --- jaxpr auditor ----------------------------------------------------------

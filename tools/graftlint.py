@@ -16,16 +16,38 @@ Two layers (shrewd_tpu/analysis/):
   ONE device→host transfer per invocation, donation consistency — and
   prove the auditor has teeth by rejecting a seeded-violation fixture.
 
+The AST layer includes the GL2xx crash/replay-safety family
+(``analysis/replay_lint.py``): journal-before-mutate dominance,
+journal-record-kind exhaustiveness, fsync-before-rename ordering and
+best-effort-seam guards.  On top:
+
+- ``--audit-waivers`` additionally FAILS on stale waivers (GL205) —
+  waiver comments whose rule no longer fires at that site — so the
+  reasoned-waiver ledger cannot rot;
+- ``--sarif OUT`` exports findings as SARIF 2.1.0 so CI renders them
+  as annotations instead of log greps;
+- ``--crashcheck`` runs the bounded dynamic model checker
+  (``analysis/crashcheck.py``): a small real fleet under the
+  instrumented VFS shim, then exhaustive ``recover()`` re-execution
+  from EVERY durability boundary (+ torn-append variants), asserting
+  bit-identical final tallies at each; ``--crash-json`` records the
+  artifact (the ``CRASH_r11.json`` the CI gate pins).
+
 Exit status: 0 = clean (or only waived/baseline findings), 1 = new
 violations (or a standard executable failed certification / the
-violation fixture was NOT rejected), 2 = usage/environment error.
+violation fixture was NOT rejected / stale waivers under
+``--audit-waivers`` / a crash point failed under ``--crashcheck``),
+2 = usage/environment error.
 
 Usage::
 
-    python tools/graftlint.py --strict --json LINT_r06.json   # the CI gate
-    python tools/graftlint.py --no-jaxpr                      # fast, AST only
-    python tools/graftlint.py --baseline LINT_r06.json        # only NEW
-                                                              # violations fail
+    python tools/graftlint.py --strict --audit-waivers \
+        --json LINT_r11.json --sarif LINT_r11.sarif       # the CI gate
+    python tools/graftlint.py --no-jaxpr                  # fast, AST only
+    python tools/graftlint.py --no-jaxpr --crashcheck \
+        --crash-json CRASH_r11.json                       # the crash gate
+    python tools/graftlint.py --baseline LINT_r11.json    # only NEW
+                                                          # violations fail
 """
 
 from __future__ import annotations
@@ -46,6 +68,46 @@ def _violation_key(v: dict) -> tuple:
     return (v["path"], v["rule"], v["msg"])
 
 
+_SARIF_LEVELS = {"error": "error", "warn": "warning"}
+
+
+def to_sarif(doc: dict) -> dict:
+    """SARIF 2.1.0 over the lint artifact: violations (error), warnings
+    (warning) and stale waivers (error) — waived findings stay out (they
+    are ledger, not actionable)."""
+    from shrewd_tpu.analysis import RULES
+
+    results = []
+    for group, level in (("violations", None), ("warnings", None),
+                         ("stale_waivers", "error")):
+        for v in doc.get(group, []):
+            results.append({
+                "ruleId": v["rule"],
+                "level": level or _SARIF_LEVELS.get(
+                    v.get("severity", "error"), "error"),
+                "message": {"text": v["msg"]},
+                "locations": [{"physicalLocation": {
+                    "artifactLocation": {"uri": v["path"]},
+                    "region": {"startLine": max(1, int(v["line"]))}}}],
+            })
+    return {
+        "version": "2.1.0",
+        "$schema": "https://raw.githubusercontent.com/oasis-tcs/"
+                   "sarif-spec/master/Schemata/sarif-schema-2.1.0.json",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "graftlint",
+                "informationUri":
+                    "shrewd_tpu/analysis/ (ast_lint + replay_lint)",
+                "rules": [{"id": rid, "name": name,
+                           "shortDescription": {"text": name}}
+                          for rid, name in sorted(RULES.items())],
+            }},
+            "results": results,
+        }],
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="graftlint", description=__doc__.splitlines()[0])
@@ -63,6 +125,21 @@ def main(argv=None) -> int:
     ap.add_argument("--no-jaxpr", action="store_true",
                     help="skip the jaxpr/HLO executable audit (fast "
                          "AST-only mode; no jax import)")
+    ap.add_argument("--audit-waivers", action="store_true",
+                    help="fail on STALE waivers (GL205): waiver "
+                         "comments whose rule no longer fires at that "
+                         "site — the reasoned-waiver ledger must not "
+                         "rot")
+    ap.add_argument("--sarif", default=None, metavar="OUT",
+                    help="export findings as SARIF 2.1.0 (CI "
+                         "annotations instead of log greps)")
+    ap.add_argument("--crashcheck", action="store_true",
+                    help="run the bounded dynamic crash-point model "
+                         "checker (analysis/crashcheck.py): exhaustive "
+                         "recover() re-execution from every durability "
+                         "boundary of a small real fleet")
+    ap.add_argument("--crash-json", default=None, metavar="OUT",
+                    help="write the crashcheck artifact (CRASH_r11.json)")
     ap.add_argument("--root", default=REPO_ROOT,
                     help="repo root (default: the checkout this script "
                          "lives in)")
@@ -93,6 +170,28 @@ def main(argv=None) -> int:
         doc["executables"] = cert_doc
         certify_ok = cert_doc["ok"]
 
+    crash_ok = True
+    if args.crashcheck:
+        import shutil
+        import tempfile
+
+        from shrewd_tpu.analysis.crashcheck import run_crashcheck
+
+        workdir = tempfile.mkdtemp(prefix="crashcheck_")
+        try:
+            crash_doc = run_crashcheck(workdir)
+        finally:
+            shutil.rmtree(workdir, ignore_errors=True)
+        crash_ok = crash_doc["ok"]
+        doc["crashcheck"] = {k: crash_doc[k] for k in (
+            "points", "checks", "torn_checks", "boundaries_by_event",
+            "seq_monotonic", "ok")}
+        if args.crash_json:
+            with open(args.crash_json, "w") as f:
+                json.dump(crash_doc, f, indent=1)
+                f.write("\n")
+            print(f"wrote {args.crash_json}")
+
     new_violations = [f.to_dict() for f in report.violations]
     if args.baseline and os.path.exists(args.baseline):
         with open(args.baseline) as f:
@@ -100,14 +199,19 @@ def main(argv=None) -> int:
                     for v in json.load(f).get("violations", [])}
         new_violations = [v for v in new_violations
                          if _violation_key(v) not in base]
+    stale_gate = bool(report.stale) and args.audit_waivers
     doc["new_violations"] = new_violations
-    doc["ok"] = certify_ok and not new_violations
+    doc["ok"] = certify_ok and crash_ok and not new_violations \
+        and not stale_gate
 
     # --- human-readable report ---
     for f in report.violations:
         print(f"VIOLATION {f}")
     for f in report.warnings:
         print(f"warning   {f}")
+    for f in report.stale:
+        tag = "STALE" if args.audit_waivers else "stale"
+        print(f"{tag}     {f}")
     for f in report.waivers:
         print(f"waived    {f.path}:{f.line} {f.rule} -- {f.waiver_reason}")
     if not args.no_jaxpr:
@@ -120,10 +224,15 @@ def main(argv=None) -> int:
         print("violation fixture: "
               + ("rejected (auditor has teeth)" if ex["fixture_rejected"]
                  else "NOT REJECTED — the auditor is blind"))
+    if args.crashcheck:
+        cc = doc["crashcheck"]
+        print(f"crashcheck: {cc['checks']} recoveries from "
+              f"{cc['points']} crash points ({cc['torn_checks']} torn) "
+              f"-> {'bit-identical at every one' if cc['ok'] else 'FAILED'}")
     n_v, n_w = len(report.violations), len(report.waivers)
     print(f"graftlint: {n_v} violation(s) "
           f"({len(new_violations)} new), {len(report.warnings)} "
-          f"warning(s), {n_w} waiver(s)"
+          f"warning(s), {n_w} waiver(s), {len(report.stale)} stale"
           + ("" if args.no_jaxpr else
              f", executables {'ok' if certify_ok else 'FAILED'}"))
 
@@ -132,11 +241,17 @@ def main(argv=None) -> int:
             json.dump(doc, f, indent=1)
             f.write("\n")
         print(f"wrote {args.json}")
+    if args.sarif:
+        with open(args.sarif, "w") as f:
+            json.dump(to_sarif(doc), f, indent=1)
+            f.write("\n")
+        print(f"wrote {args.sarif}")
 
     # violations gate unconditionally; --baseline is the one escape hatch
     # (it already filtered new_violations above) and --strict only names
     # the posture in the artifact
-    return 1 if (new_violations or not certify_ok) else 0
+    return 1 if (new_violations or not certify_ok or not crash_ok
+                 or stale_gate) else 0
 
 
 if __name__ == "__main__":
