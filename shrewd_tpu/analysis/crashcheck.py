@@ -106,15 +106,20 @@ def snapshot_tree(src: str, dst: str) -> None:
     shutil.copytree(src, dst)
     for root, _dirs, files in os.walk(dst):
         for name in files:
-            if name in NON_DURABLE or name.endswith(".tmp"):
+            if name in NON_DURABLE or name.endswith(".tmp") \
+                    or name.startswith("hb_"):
+                # heartbeats are unsynced liveness signals (their loss
+                # on crash IS the signal) — recovery must not read them
                 os.unlink(os.path.join(root, name))
 
 
-def tear_journal_tail(outdir: str, keep_fraction: float = 0.5) -> bool:
-    """Truncate the journal's LAST record mid-line — the byte prefix a
+def tear_journal_tail(outdir: str, keep_fraction: float = 0.5,
+                      jpath: str | None = None) -> bool:
+    """Truncate a journal's LAST record mid-line — the byte prefix a
     power loss during the append would leave.  Returns False when there
-    is no complete record to tear."""
-    jp = journal_path(outdir)
+    is no complete record to tear.  ``jpath`` overrides the default
+    fleet journal (the gateway sweep tears the gateway WAL instead)."""
+    jp = jpath if jpath is not None else journal_path(outdir)
     if not os.path.exists(jp) or os.path.getsize(jp) == 0:
         return False
     with open(jp, "rb") as f:
@@ -343,3 +348,180 @@ def _count_by(points, field: str) -> dict:
         key = getattr(pt, field)
         out[key] = out.get(key, 0) + 1
     return out
+
+
+# --------------------------------------------------------------------------
+# the gateway sweep (federation tier)
+# --------------------------------------------------------------------------
+#
+# The fleet sweep above proves one pod's WAL; this sweep proves the tier
+# over it: the GATEWAY's routing ledger.  The hazardous window is the
+# two-phase placement — route-decision journal, THEN the handoff
+# submission into the pod's spool, THEN the place-commitment journal —
+# where a kill must replay the journaled decision (place on the SAME
+# pod, exactly once) and never re-decide into a double placement.  The
+# recorder watches the whole federation root (a consistent snapshot
+# needs gateway + pods together) but enumerates crash points only at
+# gateway-WAL boundaries and at the handoff writes themselves.
+
+class GatewayRecorder(DurabilityRecorder):
+    """Snapshot the full federation tree, but make a crash point only
+    of gateway-owned durability boundaries (its WAL appends, its
+    snapshot renames, its spool) and of pod-spool handoff writes —
+    the seam the two-phase placement crosses."""
+
+    def __call__(self, event: str, path: str, seq=None, kind=None,
+                 **meta) -> None:
+        apath = os.path.abspath(path)
+        if not apath.startswith(self.outdir + os.sep):
+            return
+        rel = os.path.relpath(apath, self.outdir)
+        parts = rel.split(os.sep)
+        gateway_owned = parts[0] == "gateway"
+        handoff = (parts[0] == "pods" and len(parts) >= 4
+                   and parts[2] == "spool" and parts[3] == "pending")
+        if not (gateway_owned or handoff):
+            return
+        idx = len(self.points)
+        snap = os.path.join(self.points_dir, f"{idx:04d}")
+        snapshot_tree(self.outdir, snap)
+        self.points.append(CrashPoint(
+            index=idx, event=event, path=rel, seq=seq, kind=kind,
+            snapshot=snap))
+
+
+def _fed_tallies(fed, plans: dict) -> dict:
+    return {name: fed.tenant_tallies(name) for name in plans}
+
+
+def _placements(root: str, pod_names, tenants) -> dict:
+    """tenant -> pods whose spool holds its submission (the
+    double-placement probe: every tenant must appear on EXACTLY one
+    pod when no failover ran)."""
+    from shrewd_tpu.federation.gateway import find_spool_ticket
+
+    out = {}
+    for name in tenants:
+        out[name] = [p for p in pod_names if find_spool_ticket(
+            os.path.join(root, "pods", p, "spool"), name)]
+    return out
+
+
+def check_gateway_point(point: CrashPoint, scratch: str, plans: dict,
+                        pod_names, baseline: dict,
+                        torn: bool = False) -> dict:
+    """Re-execute federation recovery from one gateway crash point:
+    copy the snapshot, optionally tear the gateway WAL's last record,
+    ``Federation.recover()`` (gateway replay + placement repair; pods
+    replay their own WALs lazily), re-admit tenants the crash landed
+    before their accept record, serve to convergence — then assert
+    aggregate tallies bit-identical to the undisturbed run AND every
+    tenant placed on exactly one pod."""
+    from shrewd_tpu.federation.driver import Federation
+    from shrewd_tpu.federation.gateway import gateway_journal_path
+    from shrewd_tpu.service.queue import TenantSpec
+
+    shutil.copytree(point.snapshot, scratch)
+    if torn and not tear_journal_tail(
+            scratch, jpath=gateway_journal_path(
+                os.path.join(scratch, "gateway"))):
+        shutil.rmtree(scratch, ignore_errors=True)
+        return {**point.label(), "torn": True, "skipped": True,
+                "ok": True}
+    result = {**point.label(), "torn": torn, "ok": False}
+    try:
+        fed = Federation.recover(scratch, pod_names=tuple(pod_names))
+        for name, plan in plans.items():
+            if name not in fed.gateway.entries:
+                fed.gateway.admit(TenantSpec(name=name, plan=plan))
+        rc = fed.serve()
+        got = _fed_tallies(fed, plans)
+        placements = _placements(scratch, pod_names, sorted(plans))
+        result.update(
+            rc=rc,
+            identical=_tallies_equal(got, baseline),
+            placements=placements,
+            placed_once=all(len(v) == 1 for v in placements.values()),
+            statuses={n: e.status
+                      for n, e in fed.gateway.entries.items()},
+            recoveries=fed.gateway.recoveries)
+        result["ok"] = (rc == 0 and result["identical"]
+                        and result["placed_once"])
+    except Exception as e:  # noqa: BLE001 — a crash point that breaks
+        # recovery outright is the most important finding of all
+        result["error"] = f"{type(e).__name__}: {e}"
+    finally:
+        shutil.rmtree(scratch, ignore_errors=True)
+    return result
+
+
+def run_gateway_crashcheck(workdir: str, plans: dict | None = None,
+                           pod_names=("pod0", "pod1"), torn: bool = True,
+                           max_points: int | None = None) -> dict:
+    """The gateway-WAL sweep (see section comment).  Returns the
+    machine-readable report; ``report["ok"]`` is the gate bit."""
+    from shrewd_tpu.federation.driver import Federation
+    from shrewd_tpu.service.queue import TenantSpec
+
+    plans = plans if plans is not None else small_fleet_plans(
+        seeds=(3, 5))
+
+    def _run(root):
+        fed = Federation(root, pod_names=tuple(pod_names))
+        for name, plan in plans.items():
+            fed.submit(TenantSpec(name=name, plan=plan))
+        rc = fed.serve()
+        return fed, rc
+
+    # 1. the undisturbed reference federation
+    fed, rc = _run(os.path.join(workdir, "baseline"))
+    if rc != 0:
+        raise RuntimeError(f"gateway crashcheck baseline rc {rc}")
+    baseline = _fed_tallies(fed, plans)
+    # 2. the recorded run
+    rec_dir = os.path.join(workdir, "recorded")
+    points_dir = os.path.join(workdir, "points")
+    os.makedirs(points_dir, exist_ok=True)
+    with GatewayRecorder(rec_dir, points_dir) as recorder:
+        fed2, rc2 = _run(rec_dir)
+    if rc2 != 0 or not _tallies_equal(_fed_tallies(fed2, plans),
+                                      baseline):
+        raise RuntimeError(
+            "gateway crashcheck recorded run diverged from baseline — "
+            "the recorder must be observation-only")
+    points = recorder.points
+    dropped = 0
+    if max_points is not None and len(points) > max_points:
+        dropped = len(points) - max_points
+        points = points[:max_points]
+        debug.dprintf("Crashcheck", "bounded gateway sweep: checking "
+                      "%d of %d crash points", max_points,
+                      max_points + dropped)
+    # 3. exhaustive recovery re-execution from every gateway boundary
+    results = []
+    for pt in points:
+        scratch = os.path.join(workdir, f"gchk_{pt.index:04d}")
+        results.append(check_gateway_point(pt, scratch, plans,
+                                           pod_names, baseline))
+        if torn and pt.event == "append" \
+                and pt.path.startswith("gateway" + os.sep):
+            scratch = os.path.join(workdir, f"gchk_{pt.index:04d}_torn")
+            results.append(check_gateway_point(
+                pt, scratch, plans, pod_names, baseline, torn=True))
+    failures = [r for r in results if not r["ok"]]
+    return {
+        "tool": "crashcheck-gateway",
+        "tenants": sorted(plans),
+        "pods": list(pod_names),
+        "points": len(recorder.points),
+        "points_checked": len(points),
+        "points_dropped": dropped,
+        "checks": len(results),
+        "torn_checks": sum(1 for r in results if r["torn"]),
+        "events": [pt.label() for pt in recorder.points],
+        "boundaries_by_event": _count_by(recorder.points, "event"),
+        "baseline_digest": _tally_digest(
+            {n: baseline[n] for n in baseline}),
+        "failures": failures,
+        "ok": not failures and dropped == 0,
+    }

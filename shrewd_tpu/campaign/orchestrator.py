@@ -827,23 +827,18 @@ class Orchestrator:
         return k
 
     def _trials_needed(self, st: _State, camp: ShardedCampaign) -> float:
-        """Trials the stopping rule still plausibly needs: the min_trials
-        floor, extended — once data exists — by the half-width trajectory
-        estimate (Wilson hw ~∝ 1/√n at a stable p̂, so distance-to-target
-        is ~ n·((hw/target)² − 1)).  The single estimator behind the
-        adaptive sync interval AND the until-CI super-interval planner."""
-        need = float(self.plan.min_trials - st.trials)
-        if st.trials > 0:
-            vulnerable = int(st.tallies[C.OUTCOME_SDC] +
-                             st.tallies[C.OUTCOME_DUE])
-            hw = stopping.live_halfwidth(
-                vulnerable, st.trials, st.strata, camp.stratify,
-                self.plan.confidence)
-            target = float(self.plan.target_halfwidth)
-            if hw > target > 0:
-                need = max(need,
-                           st.trials * ((hw / target) ** 2 - 1.0))
-        return need
+        """Trials the stopping rule still plausibly needs — delegated to
+        ``stopping.eta_trials``, the ONE convergence-distance estimator
+        shared by the adaptive sync interval, the until-CI super-interval
+        planner, and the published per-tenant ETA the federation gateway
+        routes on (``obs/metrics``): the planners and the service tier
+        must never disagree about how far a campaign is from stopping."""
+        vulnerable = int(st.tallies[C.OUTCOME_SDC] +
+                         st.tallies[C.OUTCOME_DUE])
+        return stopping.eta_trials(
+            vulnerable, st.trials, st.strata, camp.stratify,
+            self.plan.confidence, self.plan.target_halfwidth,
+            self.plan.min_trials)
 
     def _until_ci_len(self, st: _State, camp: ShardedCampaign,
                       sp_name: str = "", structure: str = "") -> int:

@@ -63,6 +63,31 @@ weakest link:
                         to ``spool/bad/`` instead of raising out of the loop
 ======================  ====================================================
 
+**Federation-level kinds** target whole pods of the fleet-of-fleets
+(``shrewd_tpu/federation/``) — one gateway over N scheduler pods must
+survive any single pod's death, and the chaos DSL is how that claim is
+proven on a schedule:
+
+======================  ====================================================
+``kill_pod``            hard pod death at a pod fleet tick (``at_tick``) or
+                        a federation round ordinal (``at_round``); fires
+                        through the ``kill_action`` seam (the driver
+                        rescopes it to ``PodKilled`` so only the named pod
+                        dies) — the supervisor must declare the pod lost and
+                        the gateway must fail its tenants over from their
+                        namespaced checkpoints, bit-identically
+``partition_pod``       heartbeat suppression WITHOUT death for ``rounds``
+                        federation rounds starting at each ``at_round``:
+                        the pod keeps computing but stops beating, the
+                        supervisor declares it lost and fails over — when
+                        the partition heals, the gateway must reconcile the
+                        stale placement without double-counting the tenant
+======================  ====================================================
+
+Each kind's trigger vocabulary is validated per kind: a ``kill_pod``
+with ``at_batch`` (or any trigger key outside its vocabulary) is a plan
+error, not a silently-ignored fault.
+
 Every injected and survived fault is counted per kind; the orchestrator
 exposes the ledgers as the ``campaign.chaos.*`` stats group, so a chaos run
 is self-describing from its stats dump alone.
@@ -88,24 +113,30 @@ from shrewd_tpu.utils.config import ConfigObject, Param
 debug.register_flag("Chaos", "deterministic fault-injection harness")
 
 KINDS = ("wedge", "backend_error", "corrupt_tally", "torn_checkpoint",
-         "kill_worker", "kill_fleet", "torn_journal", "corrupt_submission")
+         "kill_worker", "kill_fleet", "torn_journal", "corrupt_submission",
+         "kill_pod", "partition_pod")
 
 #: kinds whose triggers are NOT batch coordinates (never armed by
-#: ``begin_batch``): checkpoint ordinals and the fleet-level seams
+#: ``begin_batch``): checkpoint ordinals and the fleet/federation seams
 _NON_BATCH_KINDS = ("torn_checkpoint", "kill_fleet", "torn_journal",
-                    "corrupt_submission")
+                    "corrupt_submission", "kill_pod", "partition_pod")
 
-#: trigger keys carrying id lists, by kind (fleet kinds + checkpoint);
-#: batch kinds use at_batch / sample / after_dispatches
+#: trigger keys carrying id lists, by kind (fleet/federation kinds +
+#: checkpoint); batch kinds use at_batch / sample / after_dispatches.
+#: These tuples are also each kind's FULL trigger vocabulary — any other
+#: ``_ID_KEYS`` key on a fault of that kind is a plan error (a
+#: ``kill_pod`` with ``at_batch`` would otherwise arm nothing, silently)
 _KIND_TRIGGERS = {
     "torn_checkpoint": ("at_ckpt",),
     "kill_fleet": ("at_tick", "at_journal"),
     "torn_journal": ("at_journal",),
     "corrupt_submission": ("at_submission",),
+    "kill_pod": ("at_tick", "at_round"),
+    "partition_pod": ("at_round",),
 }
 
 _ID_KEYS = ("at_batch", "at_ckpt", "at_tick", "at_journal",
-            "at_submission")
+            "at_submission", "at_round")
 
 KILL_DEFAULT_RC = 137
 
@@ -166,6 +197,16 @@ def _normalize(plan: dict) -> list[dict]:
             if not any(k in s for k in keys):
                 raise ChaosPlanError(
                     f"fault {i}: {kind} needs " + " / ".join(keys))
+            # per-kind trigger vocab: an id key outside this kind's
+            # vocabulary would silently never fire — reject it loudly
+            stray = [k for k in _ID_KEYS if k in s and k not in keys]
+            if stray:
+                raise ChaosPlanError(
+                    f"fault {i}: {kind} does not take {stray[0]!r} "
+                    f"(its trigger vocabulary is {'/'.join(keys)})")
+            if kind == "partition_pod" and int(s.get("rounds", 2)) < 1:
+                raise ChaosPlanError(
+                    f"fault {i}: partition_pod 'rounds' must be >= 1")
         elif "at_batch" not in s and "after_dispatches" not in s:
             raise ChaosPlanError(
                 f"fault {i}: {kind} needs at_batch / sample / "
@@ -413,6 +454,66 @@ class ChaosEngine:
             debug.dprintf("Chaos", "kill_fleet (tick=%s journal=%s)",
                           tick, journal_seq)
             self.kill_now(s.get("rc"))
+
+    # --- federation-level hook points (the fleet-of-fleets gateway) -----
+
+    def maybe_kill_pod(self, pod: str, tick: int | None = None,
+                       round: int | None = None) -> None:
+        """The federation's hard-kill seam: ``kill_pod`` fires when the
+        named pod reaches fleet tick ``at_tick`` or the federation
+        reaches round ``at_round`` — both deterministic federation
+        coordinates.  The driver installs a ``kill_action`` that raises
+        ``PodKilled`` so exactly one pod dies (the in-process analog of
+        SIGKILLing one pod's server; the pod's outdir is left dirty,
+        undrained — precisely what ``os._exit`` would leave)."""
+        for s in self.faults:
+            if s["kind"] != "kill_pod" or s["_fires_left"] <= 0:
+                continue
+            if s.get("pod") and s["pod"] != pod:
+                continue
+            hit = (tick is not None and tick in s.get("at_tick", ())) \
+                or (round is not None and round in s.get("at_round", ()))
+            if not hit:
+                continue
+            s["_fires_left"] -= 1
+            self._batch = (tick if tick is not None else round,
+                           "pod", pod)
+            self._fire("kill_pod", {"pod": pod, "tick": tick,
+                                    "round": round})
+            debug.dprintf("Chaos", "kill_pod %s (tick=%s round=%s)",
+                          pod, tick, round)
+            self.kill_now(s.get("rc"))
+
+    def partition_active(self, pod: str, round: int) -> bool:
+        """Federation hook: True while the named pod is scheduled to be
+        partitioned at this round (heartbeat suppression without death —
+        the pod keeps computing; the driver simply withholds its beats).
+        Each ``at_round`` window ``[r0, r0 + rounds)`` fires the ledger
+        once at activation; the heal is implicit when the window ends
+        and the driver reports ``note_survived`` once the federation
+        converges through it."""
+        active = False
+        for s in self.faults:
+            if s["kind"] != "partition_pod":
+                continue
+            if s.get("pod") and s["pod"] != pod:
+                continue
+            rounds = int(s.get("rounds", 2))
+            for r0 in s.get("at_round", ()):
+                if not (r0 <= round < r0 + rounds):
+                    continue
+                fired = s.setdefault("_partition_fired", [])
+                if r0 not in fired:
+                    if s["_fires_left"] <= 0:
+                        continue
+                    s["_fires_left"] -= 1
+                    fired.append(r0)
+                    self._batch = (round, "partition", pod)
+                    self._fire("partition_pod",
+                               {"pod": pod, "round": round,
+                                "rounds": rounds})
+                active = True
+        return active
 
     def take_torn_journal(self, seq: int) -> dict | None:
         """Journal hook: the spec when journal record ``seq`` is

@@ -1,0 +1,297 @@
+"""The federation driver: gateway + N pods + supervisor in one loop.
+
+``Federation`` composes the tier: it builds N pods (each a complete
+``CampaignScheduler`` deployment — own spool, own outdir, own WAL), a
+``Gateway`` routing over their published surfaces, and a
+``PodSupervisor`` watching their heartbeat leases; then ``serve()``
+round-robins every live pod through the scheduler's cooperative
+``step()`` seam, one quantum per federation round, single-threaded and
+deterministic.  Between rounds the driver runs the control plane:
+claim gateway submissions, renew pod leases (withheld while a
+``partition_pod`` chaos window is active), take supervisor verdicts
+(death → ``Gateway.pod_dead`` failover; resurrection →
+``Gateway.pod_heal`` + fencing evictions on the healed pod), advance
+migrations, and rebalance when one pod's ETA runs away.
+
+In-process pods are the harness posture, not a toy: a pod "hard
+killed" by ``kill_pod`` chaos simply stops being stepped and stops
+beating — its durable outdir (dirty WAL, namespaced checkpoints, stale
+heartbeat) is byte-for-byte what a SIGKILLed ``fleet.py --serve``
+process leaves, so the failover the driver proves is the one a
+multi-process deployment needs.  Bit-identity does the rest: every
+placement resumes from frozen-key checkpoints, so the federation's
+final tallies equal solo runs no matter which pods died, partitioned,
+or traded tenants mid-campaign (the ``tests/test_federation.py``
+pins).
+
+Rebalancing policy (deliberately simple, journaled like everything
+else): every ``rebalance_every`` rounds, if the hottest live pod's ETA
+mass exceeds ``rebalance_factor ×`` the coldest's and the hot pod
+serves more than one active tenant, the tenant with the largest
+remaining ETA migrates to the coldest pod — drain-here/recover-there,
+the same path failover uses.  A tenant is never migrated more than
+``max_epochs`` times (placement flapping caps itself).
+
+Import discipline: jax-free at module import.
+"""
+
+from __future__ import annotations
+
+from shrewd_tpu.federation.gateway import Gateway
+from shrewd_tpu.federation.pods import PodHandle, PodKilled, PodSupervisor
+from shrewd_tpu.service.queue import TenantSpec
+from shrewd_tpu.service.scheduler import IDLE
+from shrewd_tpu.utils import debug
+
+import os
+import time
+
+
+class Federation:
+    """One fleet-of-fleets (see module doc)."""
+
+    def __init__(self, root: str, pod_names=("pod0", "pod1", "pod2"),
+                 mesh=None, chaos=None, quantum: int = 1,
+                 expiry_rounds: int = 3, rebalance_every: int = 0,
+                 rebalance_factor: float = 4.0, max_epochs: int = 3,
+                 idle_exit: bool = True, poll_interval: float = 0.2,
+                 **sched_kw):
+        self.root = root
+        self.coord_dir = os.path.join(root, "coord")
+        self.pods = {
+            name: PodHandle(name, os.path.join(root, "pods", name),
+                            self.coord_dir, mesh=mesh, **sched_kw)
+            for name in pod_names}
+        self.gateway = Gateway(
+            os.path.join(root, "gateway"),
+            pods={n: p.port for n, p in self.pods.items()})
+        self.supervisor = PodSupervisor(self.coord_dir,
+                                        expiry_rounds=expiry_rounds)
+        self.chaos = chaos
+        self.quantum = max(1, int(quantum))
+        self.rebalance_every = int(rebalance_every)
+        self.rebalance_factor = float(rebalance_factor)
+        self.max_epochs = max(1, int(max_epochs))
+        self.idle_exit = idle_exit
+        self.poll_interval = float(poll_interval)
+        self.round = 0
+        self.idle_rounds = 0
+        self.migrations = 0
+        self.failovers = 0
+        self.fenced = 0
+
+    @classmethod
+    def recover(cls, root: str, pod_names=("pod0", "pod1", "pod2"),
+                **kw) -> "Federation":
+        """Rebuild a federation after ANY shutdown of the driver
+        process — the gateway replays its WAL (``Gateway.recover``,
+        repairing interrupted placements), and each pod's scheduler
+        replays its own WAL lazily the first time the serve loop builds
+        it (``PodHandle.build`` routes through
+        ``CampaignScheduler.recover``).  The whole tier restarts the
+        way it cold-starts: recovery IS the boot path."""
+        fed = cls(root, pod_names=pod_names, **kw)
+        # the freshly-built gateway is replaced by the recovered one
+        # (same outdir, same spool object — Gateway.__init__ is
+        # deliberately side-effect-free beyond mkdir, so the swap is
+        # cheap; the WAL opens lazily on first append)
+        fed.gateway = Gateway.recover(
+            os.path.join(root, "gateway"),
+            pods={n: p.port for n, p in fed.pods.items()},
+            spool=fed.gateway.spool)
+        return fed
+
+    # --- submissions -------------------------------------------------------
+
+    def submit(self, spec: TenantSpec) -> dict:
+        """Direct admission through the gateway (tests, the CLI's
+        --plans mode).  Spool/HTTP submissions go through
+        ``gateway.poll_spool`` inside the serve loop instead."""
+        return self.gateway.admit(spec)
+
+    # --- chaos seams -------------------------------------------------------
+
+    def _maybe_kill(self, pod: PodHandle) -> bool:
+        """Consult the kill_pod schedule for this pod at the current
+        (tick, round) coordinates; True when the pod just died.  The
+        kill_action raises ``PodKilled`` so exactly one pod dies — the
+        driver survives to supervise the failover, which is the point."""
+        if self.chaos is None or pod.dead:
+            return False
+        name = pod.name
+
+        def _kill(rc):
+            raise PodKilled(name, rc)
+
+        prev = self.chaos.kill_action
+        self.chaos.kill_action = _kill
+        try:
+            tick = pod.sched.ticks if pod.sched is not None else 0
+            self.chaos.maybe_kill_pod(name, tick=tick, round=self.round)
+        except PodKilled as e:
+            debug.dprintf("Federation", "%s", e)
+            pod.kill()
+            return True
+        finally:
+            self.chaos.kill_action = prev
+        return False
+
+    # --- the serve loop ----------------------------------------------------
+
+    def _step_pod(self, pod: PodHandle) -> None:
+        """One quantum of one pod: chaos-check at every scheduler tick
+        boundary (kill_pod at_tick must land between ticks, exactly
+        where a SIGKILL between run-loop iterations would), then step."""
+        for _ in range(self.quantum):
+            if self._maybe_kill(pod):
+                return
+            try:
+                rc = pod.step()
+            except PodKilled as e:
+                debug.dprintf("Federation", "%s", e)
+                pod.kill()
+                return
+            if rc is not None and rc is not IDLE:
+                return                   # pod's scheduler went terminal
+
+    def _supervise(self) -> None:
+        """Take the supervisor's lease verdicts: deaths fail over,
+        resurrections heal + fence."""
+        alive = self.supervisor.observe(sorted(self.pods))
+        for name, ok in alive.items():
+            if not ok and name not in self.gateway.dead_pods:
+                moved = self.gateway.pod_dead(name)
+                self.failovers += len(moved)
+            elif ok and name in self.gateway.dead_pods:
+                pod = self.pods[name]
+                stale = self.gateway.pod_heal(name)
+                # fence the healed pod: any tenant the ledger moved
+                # elsewhere while it was partitioned must stop being
+                # served here — its copy's tallies are bit-identical,
+                # but only the authoritative placement reports
+                if pod.sched is not None and not pod.dead:
+                    for tenant in stale:
+                        t = pod.sched.tenants.get(tenant)
+                        if t is not None and t.status in ("queued",
+                                                          "running"):
+                            pod.sched.evict(tenant, "fenced")
+                            self.fenced += 1
+
+    def _maybe_rebalance(self) -> None:
+        if not self.rebalance_every \
+                or self.round % self.rebalance_every:
+            return
+        live = self.gateway.live_pods()
+        if len(live) < 2:
+            return
+        loads = {n: self.gateway.pod_load(n) for n in live}
+        hot = max(live, key=lambda n: (loads[n]["score"], n))
+        cold = min(live, key=lambda n: (loads[n]["score"], n))
+        if hot == cold or loads[hot]["tenants"] < 2:
+            return
+        if loads[hot]["score"] < self.rebalance_factor \
+                * max(loads[cold]["score"], 1.0):
+            return
+        # the hot pod's ETA ran away: move its largest-REMAINING-ETA
+        # migratable tenant to the coldest pod (SLO-tightest first on
+        # ties — the tenant with the least slack gets the fresh pod).
+        # Remaining ETA is the LIVE per-tenant number the hot pod
+        # publishes — the admission-time estimate on the entry is a
+        # whole-plan + queue snapshot that never updates, and picking
+        # by it would migrate nearly-finished tenants
+        cands = [e for e in self.gateway.entries.values()
+                 if e.pod == hot and e.status == "placed"
+                 and e.epoch < self.max_epochs]
+        if not cands:
+            return
+        try:
+            from shrewd_tpu.obs import metrics as obs_metrics
+
+            rows = obs_metrics.read(self.pods[hot].outdir).get(
+                "tenants", {})
+        except (OSError, ValueError):
+            rows = {}
+
+        def remaining(e):
+            row = rows.get(e.spec.name) or {}
+            eta = row.get("eta_trials")
+            return float(eta) if eta is not None \
+                else float(e.eta_trials or 0.0)
+
+        pick = max(cands, key=lambda e: (
+            remaining(e), -(e.spec.slo_s or float("inf")),
+            e.spec.name))
+        if remaining(pick) <= 0:
+            return                       # nothing migratable is owed work
+        if self.gateway.migrate(pick.spec.name, cold, "eta-runaway"):
+            self.migrations += 1
+            pod = self.pods[hot]
+            if pod.sched is not None and not pod.dead:
+                pod.sched.evict(pick.spec.name, "migrate")
+
+    def serve(self, max_rounds: int = 100000) -> int:
+        """Drive the federation until every admitted tenant is done,
+        then drain the surviving pods to resumable checkpoints and
+        snapshot the gateway.  Returns 0 on convergence."""
+        while True:
+            self.round += 1
+            if self.round - self.idle_rounds > max_rounds:
+                # only WORKING rounds count against the runaway guard:
+                # a resident federation (idle_exit=False) polls an
+                # empty spool indefinitely, and idling is not failing
+                # to converge
+                raise RuntimeError(
+                    f"federation did not converge in {max_rounds} "
+                    f"working rounds: {self.gateway._by_status()}")
+            self.gateway.poll_spool()
+            for name in sorted(self.pods):
+                pod = self.pods[name]
+                if pod.dead:
+                    continue
+                if pod.sched is None:
+                    pod.build()
+                self._step_pod(pod)
+                pod.partitioned = (
+                    self.chaos is not None
+                    and self.chaos.partition_active(name, self.round))
+                if not pod.dead and not pod.partitioned:
+                    pod.beat()
+            self._supervise()
+            self.gateway.poll()
+            self._maybe_rebalance()
+            if not self.gateway.spool.pending() and (
+                    self.gateway.all_done()
+                    or not self.gateway.entries):
+                if self.idle_exit:
+                    break
+                self.idle_rounds += 1
+                time.sleep(self.poll_interval)
+        # converged: note chaos survivals (every injected pod fault the
+        # federation finished through), drain survivors, snapshot
+        if self.chaos is not None:
+            for kind in ("kill_pod", "partition_pod"):
+                done = self.chaos.injected.get(kind, 0) \
+                    - self.chaos.survived.get(kind, 0)
+                for _ in range(done):
+                    self.chaos.note_survived(kind)
+        for name in sorted(self.pods):
+            self.pods[name].drain()
+        self.gateway.shutdown()
+        debug.dprintf("Federation", "converged in %d rounds "
+                      "(%d failovers, %d migrations, %d fenced)",
+                      self.round, self.failovers, self.migrations,
+                      self.fenced)
+        return 0
+
+    # --- aggregate views ---------------------------------------------------
+
+    def results(self) -> dict:
+        return self.gateway.results()
+
+    def tenant_tallies(self, name: str) -> dict:
+        return self.gateway.tenant_tallies(name)
+
+    def counters(self) -> dict:
+        return {"rounds": self.round, "failovers": self.failovers,
+                "migrations": self.migrations, "fenced": self.fenced,
+                "dead_pods": sorted(self.gateway.dead_pods)}

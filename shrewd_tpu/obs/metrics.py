@@ -37,26 +37,41 @@ METRICS_PROM = "metrics.prom"
 _PROM_NS = "shrewd_fleet"
 
 
-def _halfwidths(orch) -> dict:
-    """Live half-width per (simpoint, structure) of one tenant's
-    orchestrator — the convergence-distance trajectory, computed by the
-    SAME estimator selection the stopping rule applies (post-stratified
-    when the strata history covers the trials, pooled Wilson otherwise)
-    so the published distance never disagrees with the rule that
-    decides stopping."""
+def _convergence(orch) -> tuple[dict, float]:
+    """``({lane: halfwidth}, eta_trials)`` of one tenant's orchestrator —
+    the convergence-distance trajectory, computed by the SAME estimator
+    selection the stopping rule applies (post-stratified when the strata
+    history covers the trials, pooled Wilson otherwise) so the published
+    distance never disagrees with the rule that decides stopping.
+    ``eta_trials`` sums ``stopping.eta_trials`` (the planner's own
+    trials-needed trajectory) over the tenant's lanes — the number the
+    federation gateway routes on: convergence distance, not
+    instantaneous throughput."""
     from shrewd_tpu.ops import classify as C
     from shrewd_tpu.parallel import stopping
 
-    out = {}
+    hws = {}
+    eta = 0.0
     for (sp, st), s in orch.state.items():
         if s.trials <= 0:
+            # an unstarted lane still owes its whole min_trials floor
+            eta += float(orch.plan.min_trials)
             continue
         vul = int(s.tallies[C.OUTCOME_SDC] + s.tallies[C.OUTCOME_DUE])
-        hw = stopping.live_halfwidth(vul, s.trials, s.strata,
-                                     orch.plan.stratify,
-                                     orch.plan.confidence)
-        out[f"{sp}/{st}"] = round(float(hw), 6)
-    return out
+        hws[f"{sp}/{st}"] = round(float(stopping.live_halfwidth(
+            vul, s.trials, s.strata, orch.plan.stratify,
+            orch.plan.confidence)), 6)
+        if not s.done and not s.converged:
+            # `done` and not `converged` = the lane hit its max_trials
+            # cap with the CI still wide: it will never run again, so
+            # it owes NO further trials — counting its (permanently
+            # positive) trajectory distance would leave phantom ETA
+            # mass on the pod and misroute the federation gateway
+            eta += stopping.eta_trials(
+                vul, s.trials, s.strata, orch.plan.stratify,
+                orch.plan.confidence, orch.plan.target_halfwidth,
+                orch.plan.min_trials)
+    return hws, eta
 
 
 def snapshot(sched) -> dict:
@@ -85,7 +100,18 @@ def snapshot(sched) -> dict:
             "rc": t.rc,
         }
         if t.orch is not None:
-            row["halfwidth"] = _halfwidths(t.orch)
+            hws, eta = _convergence(t.orch)
+            row["halfwidth"] = hws
+            # the half-width-trajectory ETA: trials still needed to
+            # reach the stopping rule's target, plus its projections
+            # onto scheduling quanta and wall seconds (the deadline-
+            # estimate inputs of the federation gateway)
+            row["eta_trials"] = round(eta, 1)
+            per_tick = t.trials / t.ticks if t.ticks > 0 else 0.0
+            row["eta_ticks"] = (round(eta / per_tick, 1)
+                                if per_tick > 0 else None)
+            row["eta_s"] = (round(eta / row["trials_per_s"], 2)
+                            if row["trials_per_s"] > 0 else None)
         tenants[name] = row
     cs = exec_cache.cache().stats()
     fleet = {
@@ -105,6 +131,8 @@ def snapshot(sched) -> dict:
                            if t.status == "quarantined"),
         "pruned": sum(1 for t in sched.tenants.values()
                       if t.status == "pruned"),
+        "evicted": sum(1 for t in sched.tenants.values()
+                       if t.status == "evicted"),
     }
     return {"schema": 1, "tick": sched.ticks, "wall_time": clock.now(),
             "tenants": tenants, "fleet": fleet}
@@ -160,7 +188,10 @@ def prometheus_text(snap: dict) -> str:
         trials="trials served", trials_per_s="serving rate",
         ticks="scheduling quanta", vtime="fair-share virtual time",
         queue_latency_s="submit-to-admission seconds",
-        failures="tick/elaboration exceptions")
+        failures="tick/elaboration exceptions",
+        eta_trials="half-width-trajectory trials still needed",
+        eta_ticks="scheduling quanta to projected convergence",
+        eta_s="seconds to projected convergence")
     for key, hp in families.items():
         first = True
         for name, row in tenants:

@@ -141,6 +141,27 @@ def strata_cover_trials(strata, trials: int) -> bool:
     return strata is not None and int(np.asarray(strata).sum()) == trials
 
 
+def eta_trials(vulnerable: int, trials: int, strata, stratify: bool,
+               confidence: float, target_halfwidth: float,
+               min_trials: int) -> float:
+    """Trials the stopping rule still plausibly needs — the half-width-
+    trajectory estimate (Wilson hw ~∝ 1/√n at a stable p̂, so distance-
+    to-target is ~ n·((hw/target)² − 1)), floored by ``min_trials``.
+    THE single convergence-distance estimator: the orchestrator's
+    adaptive sync interval and until-CI planner consume it, and
+    ``obs/metrics`` publishes it per tenant so the federation gateway
+    routes and estimates deadlines on the same number the stopping rule
+    would act on.  0.0 means the rule could stop now."""
+    need = float(min_trials - trials)
+    if trials > 0:
+        hw = live_halfwidth(vulnerable, trials, strata, stratify,
+                            confidence)
+        target = float(target_halfwidth)
+        if hw > target > 0:
+            need = max(need, trials * ((hw / target) ** 2 - 1.0))
+    return max(0.0, need)
+
+
 def live_halfwidth(vulnerable: int, trials: int, strata,
                    stratify: bool, confidence: float) -> float:
     """The half-width the live stopping rule actually tracks: the

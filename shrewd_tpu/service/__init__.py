@@ -37,9 +37,9 @@ tenant's orchestrator).
 from shrewd_tpu.service.journal import FleetJournal, is_dirty, journal_path
 from shrewd_tpu.service.queue import (LockHeld, ServerLock,
                                       SubmissionQueue, TenantSpec)
-from shrewd_tpu.service.scheduler import (CampaignScheduler, FleetKilled,
-                                          TenantKilled)
+from shrewd_tpu.service.scheduler import (IDLE, CampaignScheduler,
+                                          FleetKilled, TenantKilled)
 
-__all__ = ["CampaignScheduler", "FleetJournal", "FleetKilled", "LockHeld",
-           "ServerLock", "SubmissionQueue", "TenantKilled", "TenantSpec",
-           "is_dirty", "journal_path"]
+__all__ = ["CampaignScheduler", "FleetJournal", "FleetKilled", "IDLE",
+           "LockHeld", "ServerLock", "SubmissionQueue", "TenantKilled",
+           "TenantSpec", "is_dirty", "journal_path"]

@@ -70,7 +70,7 @@ class TenantSpec:
 
     def __init__(self, name: str, plan: dict, priority: int = 0,
                  weight: float = 1.0, quota_batches: int = 0,
-                 submitted_at: float = 0.0):
+                 submitted_at: float = 0.0, slo_s: float = 0.0):
         if not name:
             raise ValueError("tenant needs a non-empty name")
         if not float(weight) > 0:
@@ -78,12 +78,20 @@ class TenantSpec:
                              f"(got {weight})")
         if int(quota_batches) < 0:
             raise ValueError(f"tenant {name!r}: quota_batches must be >= 0")
+        if float(slo_s) < 0:
+            raise ValueError(f"tenant {name!r}: slo_s must be >= 0")
         self.name = str(name)
         self.plan = dict(plan)
         self.priority = int(priority)
         self.weight = float(weight)
         self.quota_batches = int(quota_batches)
         self.submitted_at = float(submitted_at)
+        #: completion SLO in seconds (0 = none): advisory — the
+        #: federation gateway compares it against its half-width-
+        #: trajectory deadline estimate at admission and when deciding
+        #: rebalancing migrations; schedulers never consume it (no
+        #: wall clock enters any scheduling decision)
+        self.slo_s = float(slo_s)
 
     def build_plan(self):
         from shrewd_tpu.campaign.plan import CampaignPlan
@@ -94,7 +102,8 @@ class TenantSpec:
         return {"name": self.name, "plan": dict(self.plan),
                 "priority": self.priority, "weight": self.weight,
                 "quota_batches": self.quota_batches,
-                "submitted_at": self.submitted_at}
+                "submitted_at": self.submitted_at,
+                "slo_s": self.slo_s}
 
     @classmethod
     def from_dict(cls, d: dict) -> "TenantSpec":
@@ -102,7 +111,8 @@ class TenantSpec:
                    priority=d.get("priority", 0),
                    weight=d.get("weight", 1.0),
                    quota_batches=d.get("quota_batches", 0),
-                   submitted_at=d.get("submitted_at", 0.0))
+                   submitted_at=d.get("submitted_at", 0.0),
+                   slo_s=d.get("slo_s", 0.0))
 
 
 class SubmissionQueue:

@@ -104,6 +104,11 @@ _MAX_ERRORS = 32
 
 POLICIES = ("fair", "priority")
 
+#: ``step()`` sentinel: nothing runnable but the fleet is resident
+#: (spool attached, ``idle_exit`` off) — the caller chooses whether to
+#: sleep (``run()``) or go serve another pod (the federation driver)
+IDLE = object()
+
 #: certify escalation order (the fleet's admission-time certification
 #: posture can tighten a tenant's plan, never loosen it)
 _CERTIFY_ORDER = {"off": 0, "warn": 1, "strict": 2}
@@ -158,6 +163,7 @@ class TenantState:
         self.retry_at = 0            # fleet tick gating the next retry
         self.errors: list[dict] = []  # exception ledger {tick, error}
         self.revoked = ""            # quota-revocation reason ("" = none)
+        self.evicted = ""            # migration-eviction reason ("" = none)
         self.rc: int | None = None
         self.queue_latency_s = 0.0   # submit → admission
         self.wall_s = 0.0            # admission → terminal
@@ -175,7 +181,8 @@ class TenantState:
                 "trials": self.trials, "batches": self.batches,
                 "ticks": self.ticks, "kills": self.kills,
                 "failures": self.failures, "errors": list(self.errors),
-                "revoked": self.revoked, "rc": self.rc,
+                "revoked": self.revoked, "evicted": self.evicted,
+                "rc": self.rc,
                 "queue_latency_s": round(self.queue_latency_s, 3),
                 "wall_s": round(self.wall_s, 3), "results": self.results}
 
@@ -325,6 +332,13 @@ class CampaignScheduler:
                         if t.status == "pruned"),
             "tenants whose remaining quota was revoked (Pareto-"
             "dominated scenario cells; partial results stay first-class)")
+        fg.evicted = statsmod.Formula(
+            "evicted",
+            lambda: sum(1 for t in self.tenants.values()
+                        if t.status == "evicted"),
+            "tenants released for migration (drained to their "
+            "namespaced checkpoints; a federation gateway recovers "
+            "them on another pod, bit-identically)")
         fg.tenant_failures = statsmod.Formula(
             "tenant_failures",
             lambda: {n: t.failures for n, t in self.tenants.items()
@@ -420,8 +434,14 @@ class CampaignScheduler:
         """Admit one tenant (direct or from the spool).  Names are the
         tenant identity — checkpoint namespace, stats key, chaos worker —
         so a duplicate is refused loudly rather than silently merging
-        two tenants' state."""
-        if spec.name in self.tenants:
+        two tenants' state.  The ONE exception: a terminal ``evicted``
+        tenant RELEASED its name — re-admission replaces the released
+        roster entry (the returning-migration case: a federation
+        gateway may place a tenant back on a pod it drained off
+        earlier; the fresh admission resumes from whatever namespaced
+        checkpoint the migration left)."""
+        existing = self.tenants.get(spec.name)
+        if existing is not None and existing.status != "evicted":
             raise ValueError(f"tenant {spec.name!r} already admitted")
         t = TenantState(spec, order=len(self.tenants), ticket=ticket)
         if spec.submitted_at:
@@ -606,6 +626,12 @@ class CampaignScheduler:
                 # must never cost a plan build
                 self._prune_queued(t)
                 continue
+            if t.status == "queued" and t.evicted:
+                # an eviction that outlived its tenant's start (journal
+                # replay re-queued it): release WITHOUT elaborating —
+                # the new placement owns it now
+                self._evict_queued(t)
+                continue
             if t.status == "queued" and t.retry_at <= self.ticks:
                 try:
                     self._start(t)
@@ -749,29 +775,74 @@ class CampaignScheduler:
             t.driver.request_drain()
         return True
 
-    def _prune_queued(self, t: TenantState) -> None:
-        """A revoked tenant that never started (or was re-queued by a
-        recovery) goes terminal WITHOUT elaboration — revocation must
-        not cost a plan build, and a plan that cannot elaborate must
-        still be prunable."""
+    # --- eviction (the migrate-out seam) ----------------------------------
+
+    def evict(self, tenant: str, reason: str = "") -> bool:
+        """Release a tenant for migration — the federation gateway's
+        drain-HERE half of drain-here/recover-there: the tenant drains
+        its in-flight batch to its namespaced resumable checkpoint and
+        goes terminal ``evicted`` ON THIS POD (excluded from fair share,
+        never re-run by this scheduler's resume/recover), while the
+        checkpoint stays behind for whoever recovers it elsewhere —
+        bit-identity makes the hand-off free.  The decision is journaled
+        BEFORE any state changes (GL201): a hard kill between the
+        decision and the drain replays the eviction exactly, so the
+        gateway can never find a tenant it released still being served.
+        Returns False when the tenant is already terminal, revoked or
+        evicted (idempotent)."""
+        t = self.tenants.get(tenant)
+        if t is None:
+            raise KeyError(f"unknown tenant {tenant!r}")
+        if t.evicted or t.revoked or t.status not in ("queued", "running"):
+            return False
+        reason = reason or "evicted"
+        self._jlog("evict", {"tenant": t.spec.name, "reason": reason,
+                             "fleet_tick": self.ticks})
+        t.evicted = reason
+        obs_trace.tracer().emit(
+            "tenant_evict", cat="fleet", tenant=t.spec.name,
+            reason=t.evicted, fleet_tick=self.ticks)
+        debug.dprintf("Fleet", "%s: evicted for migration (%s)",
+                      t.spec.name, t.evicted)
+        if t.status == "queued":
+            self._evict_queued(t)
+        else:
+            t.driver.request_drain()
+        return True
+
+    def _release_queued(self, t: TenantState, status: str,
+                        reason: str) -> None:
+        """A queued tenant goes terminal WITHOUT elaboration — the
+        shared tail of revocation (``pruned``) and eviction
+        (``evicted``): journal-first status record, done-doc with the
+        reason, durable snapshot.  Releasing must never cost a plan
+        build (a plan that cannot elaborate must still be releasable),
+        and an evicted tenant's (possibly absent) checkpoint is already
+        whatever the new placement will resume from."""
         wall_s = (obs_clock.monotonic() - t._t_admit) if t._t_admit \
             else 0.0
-        self._jlog("status", {"tenant": t.spec.name, "status": "pruned",
+        self._jlog("status", {"tenant": t.spec.name, "status": status,
                               "trials": t.trials, "batches": t.batches,
                               "wall_s": round(wall_s, 3),
                               "results": t.results})
-        t.status = "pruned"
+        t.status = status
         t.wall_s = wall_s
         obs_trace.tracer().emit(
-            "tenant_pruned", cat="fleet", tenant=t.spec.name,
-            trials=t.trials, reason=t.revoked)
+            f"tenant_{status}", cat="fleet", tenant=t.spec.name,
+            trials=t.trials, reason=reason)
         if self.queue is not None and t.ticket:
             self.queue.mark_done(t.ticket, {
-                "tenant": t.spec.name, "status": "pruned",
-                "reason": t.revoked, "trials": t.trials,
+                "tenant": t.spec.name, "status": status,
+                "reason": reason, "trials": t.trials,
                 "results": t.results})
         if self.outdir:
             self.checkpoint()
+
+    def _evict_queued(self, t: TenantState) -> None:
+        self._release_queued(t, "evicted", t.evicted)
+
+    def _prune_queued(self, t: TenantState) -> None:
+        self._release_queued(t, "pruned", t.revoked)
 
     def _pick(self, cands: list[TenantState]) -> TenantState:
         top = max(t.spec.priority for t in cands)
@@ -872,6 +943,13 @@ class CampaignScheduler:
             # drain (rc 0): the quota WAS withdrawn first, and the
             # Pareto artifact's decision list must match the statuses
             status = "pruned"
+        elif t.evicted and rc == Orchestrator.RC_PREEMPTED:
+            # the drain the eviction requested completed: released for
+            # migration, checkpoint left behind.  A campaign whose final
+            # in-flight batch happened to COMPLETE it during the drain
+            # (rc 0) stays "complete" — there is nothing left to
+            # migrate, and the gateway reads the status to decide
+            status = "evicted"
         elif rc == Orchestrator.RC_PREEMPTED:
             status = ("quota" if t.spec.quota_batches
                       and t.batches >= t.spec.quota_batches
@@ -912,6 +990,8 @@ class CampaignScheduler:
                 # submitter whose cell was pruned mid-run learns the
                 # dominator from its ticket too
                 done["reason"] = t.revoked
+            elif t.evicted:
+                done["reason"] = t.evicted
             self.queue.mark_done(t.ticket, done)
         debug.dprintf("Fleet", "%s: %s (rc=%s, %d trials, %d ticks)",
                       t.spec.name, t.status, t.rc, t.trials, t.ticks)
@@ -952,43 +1032,53 @@ class CampaignScheduler:
                                if s.strata is not None else None)}
         return out
 
-    def run(self) -> int:
-        """Drive the fleet: poll the spool, pick, tick, finalize — until
-        every tenant is terminal and (with ``idle_exit``) the spool is
-        empty, or a drain is requested.  Returns the fleet rc: 0 all
-        served, 3 when any tenant aborted (budget/integrity), 4 when the
-        fleet was drained (resumable)."""
-        while True:
-            if self._drain:
-                return self._drain_all()
-            if self.chaos is not None:
-                # kill_fleet at a tick ordinal: the hard kill lands at
-                # the instruction boundary between ticks — nothing
-                # drains, nothing checkpoints; the journal is the only
-                # survivor (which is the point)
-                self.chaos.maybe_kill_fleet(tick=self.ticks)
-            self._poll_queue()
-            cands = self._candidates()
-            if not cands:
-                if self._in_backoff():
-                    # a tenant waits out its retry backoff and nothing
-                    # else is runnable: consume an idle quantum — the
-                    # backoff is counted in fleet ticks, so idling must
-                    # advance them (deterministic, clock-free)
-                    self.ticks += 1
-                    continue
-                if self.queue is not None and not self.idle_exit:
-                    time.sleep(self.poll_interval)
-                    continue
-                break
-            t = self._pick(cands)
-            self.schedule_log.append(t.spec.name)
-            self.ticks += 1
-            self._tick_tenant(t)
-            self._maybe_compact()
-            self._publish_metrics()
-            if self.on_tick is not None:
-                self.on_tick(self)
+    def step(self) -> object:
+        """ONE scheduling quantum — the cooperative surface a federation
+        driver round-robins N pod schedulers through in a single
+        process (``shrewd_tpu/federation/``): every quantum runs to an
+        instruction boundary and hands control back, so pods interleave
+        deterministically without threads (bit-identity never depended
+        on scheduling anyway — frozen per-batch keys — but a
+        single-threaded round-robin makes the *schedule logs*
+        reproducible too).  Returns ``None`` after a quantum of
+        progress, ``IDLE`` when the fleet is resident-idle (spool
+        attached, ``idle_exit`` off, nothing runnable — the caller
+        decides whether to sleep or serve another pod), or the terminal
+        fleet rc (int)."""
+        if self._drain:
+            return self._drain_all()
+        if self.chaos is not None:
+            # kill_fleet at a tick ordinal: the hard kill lands at
+            # the instruction boundary between ticks — nothing
+            # drains, nothing checkpoints; the journal is the only
+            # survivor (which is the point)
+            self.chaos.maybe_kill_fleet(tick=self.ticks)
+        self._poll_queue()
+        cands = self._candidates()
+        if not cands:
+            if self._in_backoff():
+                # a tenant waits out its retry backoff and nothing
+                # else is runnable: consume an idle quantum — the
+                # backoff is counted in fleet ticks, so idling must
+                # advance them (deterministic, clock-free)
+                self.ticks += 1
+                return None
+            if self.queue is not None and not self.idle_exit:
+                return IDLE
+            return self._shutdown()
+        t = self._pick(cands)
+        self.schedule_log.append(t.spec.name)
+        self.ticks += 1
+        self._tick_tenant(t)
+        self._maybe_compact()
+        self._publish_metrics()
+        if self.on_tick is not None:
+            self.on_tick(self)
+        return None
+
+    def _shutdown(self) -> int:
+        """Every tenant terminal and the spool (if any) drained: persist
+        outputs + the shutdown journal record, report the fleet rc."""
         self.write_outputs()
         if self.outdir:
             self._jlog("shutdown", {"statuses": self._by_status()})
@@ -996,6 +1086,21 @@ class CampaignScheduler:
         if any(t.status == "aborted" for t in self.tenants.values()):
             return 3
         return 0
+
+    def run(self) -> int:
+        """Drive the fleet: poll the spool, pick, tick, finalize — until
+        every tenant is terminal and (with ``idle_exit``) the spool is
+        empty, or a drain is requested.  Exactly ``step()`` in a loop
+        (one code path — the federation's cooperative stepping cannot
+        drift from the resident loop).  Returns the fleet rc: 0 all
+        served, 3 when any tenant aborted (budget/integrity), 4 when the
+        fleet was drained (resumable)."""
+        while True:
+            rc = self.step()
+            if rc is IDLE:
+                time.sleep(self.poll_interval)
+            elif rc is not None:
+                return rc
 
     def _drain_all(self) -> int:
         """Graceful fleet preemption: every running tenant drains to a
@@ -1126,6 +1231,7 @@ class CampaignScheduler:
         t.failures = int(td.get("failures", 0))
         t.errors = list(td.get("errors") or [])
         t.revoked = str(td.get("revoked") or "")
+        t.evicted = str(td.get("evicted") or "")
         t.rc = td.get("rc")
         t.results = td.get("results")
         t.queue_latency_s = float(td.get("queue_latency_s", 0.0))
@@ -1156,7 +1262,10 @@ class CampaignScheduler:
             # story (an unlisted kind is a recovery gap, not noise)
             return
         if kind == "admit":
-            if r.get("tenant") not in self.tenants:
+            existing = self.tenants.get(r.get("tenant", ""))
+            if existing is None or existing.status == "evicted":
+                # a re-admission over a RELEASED (evicted) name replays
+                # as a replacement, mirroring admit()'s one exception
                 self._admit_from_dict({"spec": r["spec"],
                                        "order": r.get("order", 0),
                                        "ticket": r.get("ticket", ""),
@@ -1193,6 +1302,13 @@ class CampaignScheduler:
             # elaborating it — the journaled decision, not the drain,
             # is what makes prune-replay exact
             t.revoked = str(r.get("reason") or "revoked")
+            self.ticks = max(self.ticks, int(r.get("fleet_tick", 0)))
+        elif kind == "evict":
+            # like revoke: the DECISION is durable the instant it is
+            # made — a kill between the decision and the drain replays
+            # it here, and _candidates releases the re-queued tenant
+            # without elaboration (the new placement owns it)
+            t.evicted = str(r.get("reason") or "evicted")
             self.ticks = max(self.ticks, int(r.get("fleet_tick", 0)))
         elif kind == "status":
             t.status = r.get("status", t.status)
@@ -1272,7 +1388,7 @@ class CampaignScheduler:
                 #                        budget out of every crash
             elif (queue is not None and t.ticket
                     and t.status in ("complete", "aborted", "quota",
-                                     "quarantined", "pruned")
+                                     "quarantined", "pruned", "evicted")
                     and queue.done(t.ticket) is None):
                 # the kill landed between the terminal journal record
                 # and mark_done: the replayed state is authoritative, so
@@ -1285,6 +1401,8 @@ class CampaignScheduler:
                     "wall_s": round(t.wall_s, 3), "results": t.results}
                 if t.revoked:
                     done["reason"] = t.revoked
+                elif t.evicted:
+                    done["reason"] = t.evicted
                 queue.mark_done(t.ticket, done)
         sched._journal_floor = max(
             snap_seq + 1, (records[-1]["seq"] + 1) if records else 0)

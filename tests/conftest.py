@@ -116,6 +116,13 @@ QUICK_MODULES = {
     # pins), and the closed-loop correctness smoke belongs on every
     # push like the fleet layers it drives
     "test_scenario",
+    # federated fleet-of-fleets: chaos-DSL/supervisor/ETA units are
+    # sub-second; the failover/partition/migration bit-identity
+    # integrations and the bounded gateway-WAL crash sweep reuse the
+    # fleet tier's tiny-kernel compiles through the shared executable
+    # cache (~23 s total), and the survive-pod-death smoke belongs on
+    # every push for the same reason the fleet-survive smoke does
+    "test_federation",
 }
 QUICK_TESTS = {
     # one representative per subsystem (≈4-10 s each, compile-dominated)

@@ -1,0 +1,522 @@
+"""Federated fleet-of-fleets (shrewd_tpu/federation/): gateway routing,
+migration by bit-identity, pod-death failover, partition fencing, and
+the gateway-WAL crash sweep.
+
+The contract under test is the ISSUE acceptance criterion: a matrix of
+tenants across >=3 federated scheduler pods, under a chaos schedule
+that kills one pod and partitions another mid-campaign, completes with
+final tallies bit-identical to solo serial runs — each tenant counted
+exactly once, per the gateway's journaled routing ledger, never per
+whoever happened to compute.  Around that: the new chaos kinds'
+trigger-vocab validation, the published half-width-trajectory ETA the
+gateway routes on, the scheduler's cooperative step()/evict() seams,
+the two-phase placement's crash windows (swept exhaustively by
+``analysis/crashcheck.run_gateway_crashcheck``), and the thin HTTP
+front.
+"""
+
+import json
+import os
+import shutil
+import urllib.request
+
+import numpy as np
+import pytest
+
+from test_fleet import _plan, _solo_tallies
+
+from shrewd_tpu.analysis import crashcheck
+from shrewd_tpu.chaos import ChaosEngine, ChaosPlanError
+from shrewd_tpu.federation import (Federation, Gateway, GatewayHTTPFront,
+                                   PodSupervisor, find_spool_ticket)
+from shrewd_tpu.parallel import stopping
+from shrewd_tpu.service import CampaignScheduler, TenantSpec
+from shrewd_tpu.service.scheduler import IDLE
+
+
+def _spec(name, seed=3, n_batches=4, **kw):
+    return TenantSpec(name=name,
+                      plan=_plan(seed, n_batches=n_batches).to_dict(),
+                      **kw)
+
+
+def _assert_matches(fed, name, solo):
+    got = fed.tenant_tallies(name)
+    assert got.keys() == solo.keys()
+    for k, t in solo.items():
+        np.testing.assert_array_equal(got[k], t)
+
+
+# --- chaos DSL: federation kinds (jax-free units) ---------------------------
+
+def test_pod_chaos_kinds_validation():
+    # required trigger vocabulary
+    with pytest.raises(ChaosPlanError, match="at_tick / at_round"):
+        ChaosEngine({"faults": [{"kind": "kill_pod", "pod": "p0"}]})
+    with pytest.raises(ChaosPlanError, match="at_round"):
+        ChaosEngine({"faults": [{"kind": "partition_pod"}]})
+    # per-kind vocab: an id key outside the kind's vocabulary is a plan
+    # error, not a silently-dead trigger
+    with pytest.raises(ChaosPlanError, match="does not take 'at_batch'"):
+        ChaosEngine({"faults": [{"kind": "kill_pod", "at_tick": 1,
+                                 "at_batch": 2}]})
+    with pytest.raises(ChaosPlanError, match="does not take 'at_tick'"):
+        ChaosEngine({"faults": [{"kind": "partition_pod", "at_round": 1,
+                                 "at_tick": 2}]})
+    with pytest.raises(ChaosPlanError, match="does not take 'at_batch'"):
+        ChaosEngine({"faults": [{"kind": "kill_fleet", "at_tick": 1,
+                                 "at_batch": 0}]})
+    with pytest.raises(ChaosPlanError, match="rounds"):
+        ChaosEngine({"faults": [{"kind": "partition_pod", "at_round": 1,
+                                 "rounds": 0}]})
+
+
+def test_pod_chaos_hooks_fire_deterministically():
+    eng = ChaosEngine({"faults": [
+        {"kind": "kill_pod", "pod": "p0", "at_tick": 5},
+        {"kind": "partition_pod", "pod": "p1", "at_round": 2,
+         "rounds": 3}]})
+    killed = []
+    eng.kill_action = lambda rc: killed.append(rc)
+    eng.maybe_kill_pod("p1", tick=5)          # wrong pod: no fire
+    eng.maybe_kill_pod("p0", tick=4)          # wrong tick: no fire
+    assert not killed
+    eng.maybe_kill_pod("p0", tick=5)
+    assert killed == [137]
+    eng.maybe_kill_pod("p0", tick=5)          # consumed: fires once
+    assert killed == [137]
+    # partition window [2, 5): active rounds fire the ledger ONCE
+    assert not eng.partition_active("p1", 1)
+    assert eng.partition_active("p1", 2)
+    assert eng.partition_active("p1", 4)
+    assert not eng.partition_active("p1", 5)
+    assert not eng.partition_active("p0", 3)  # wrong pod
+    assert eng.injected == {"kill_pod": 1, "partition_pod": 1}
+    # federation kinds are never armed by batch arming
+    eng2 = ChaosEngine({"faults": [
+        {"kind": "kill_pod", "pod": "p", "at_tick": 0},
+        {"kind": "partition_pod", "pod": "p", "at_round": 0}]})
+    eng2.begin_batch(0)
+    assert eng2._armed == {}
+
+
+# --- the ETA estimator + its metrics publication ----------------------------
+
+def test_eta_trials_estimator():
+    # below the floor: the whole remaining min_trials is owed
+    assert stopping.eta_trials(0, 0, None, False, 0.95, 0.1, 500) == 500
+    # converged (hw <= target): nothing owed
+    hw = stopping.wilson(5, 4000, 0.95).halfwidth
+    assert hw < 0.05
+    assert stopping.eta_trials(5, 4000, None, False, 0.95, 0.05,
+                               100) == 0.0
+    # mid-trajectory: n*((hw/target)^2 - 1) dominates the floor
+    eta = stopping.eta_trials(50, 200, None, False, 0.95, 0.01, 100)
+    hw = stopping.wilson(50, 200, 0.95).halfwidth
+    assert eta == pytest.approx(200 * ((hw / 0.01) ** 2 - 1.0))
+
+
+def test_metrics_publish_eta(tmp_path):
+    outdir = str(tmp_path / "fleet")
+    seen = []
+
+    def grab(s):
+        from shrewd_tpu.obs import metrics as obs_metrics
+
+        try:
+            seen.append(obs_metrics.read(outdir))
+        except (OSError, ValueError):
+            pass
+
+    sched = CampaignScheduler(outdir=outdir, on_tick=grab)
+    sched.admit(TenantSpec(name="t", plan=_plan(3, n_batches=4,
+                                                ).to_dict()))
+    assert sched.run() == 0
+    rows = [s["tenants"]["t"] for s in seen
+            if "eta_trials" in s.get("tenants", {}).get("t", {})]
+    assert rows, "no mid-run snapshot carried the ETA"
+    # the published ETA is the convergence distance: monotonically
+    # non-increasing over this fixed-trials campaign, 0 by the end
+    etas = [r["eta_trials"] for r in rows]
+    assert all(a >= b for a, b in zip(etas, etas[1:]))
+    assert etas[-1] == 0.0
+    assert "eta_ticks" in rows[-1] and "eta_s" in rows[-1]
+    # Prometheus exposition carries the gauge family
+    from shrewd_tpu.obs import metrics as obs_metrics
+
+    prom = obs_metrics.prometheus_text(seen[-1])
+    assert "shrewd_fleet_tenant_eta_trials" in prom
+
+
+# --- scheduler seams: step() and evict() ------------------------------------
+
+def test_step_loop_is_exactly_run():
+    a = CampaignScheduler()
+    a.admit(_spec("x", 3))
+    a.admit(_spec("y", 5))
+    assert a.run() == 0
+    b = CampaignScheduler()
+    b.admit(_spec("x", 3))
+    b.admit(_spec("y", 5))
+    while True:
+        rc = b.step()
+        assert rc is not IDLE       # no spool: never resident-idle
+        if rc is not None:
+            break
+    assert rc == 0
+    assert a.schedule_log == b.schedule_log
+    for n in ("x", "y"):
+        for k, t in a.tenant_tallies(n).items():
+            np.testing.assert_array_equal(b.tenant_tallies(n)[k], t)
+
+
+def test_evict_drains_and_recovers_elsewhere_bit_identical(tmp_path):
+    solo = _solo_tallies(_plan(3, n_batches=6))
+    pod_a = str(tmp_path / "podA")
+    pod_b = str(tmp_path / "podB")
+    sched = CampaignScheduler(outdir=pod_a)
+    sched.admit(TenantSpec(name="m", plan=_plan(3,
+                                                n_batches=6).to_dict()))
+    steps = 0
+    while True:
+        rc = sched.step()
+        if isinstance(rc, int):
+            break
+        steps += 1
+        if steps == 3:
+            assert sched.evict("m", "rebalance") is True
+    t = sched.tenants["m"]
+    assert rc == 0 and t.status == "evicted" and t.evicted == "rebalance"
+    assert 0 < t.trials < 32 * 6    # genuinely mid-campaign
+    assert sched.evict("m") is False            # terminal: idempotent
+    with pytest.raises(KeyError):
+        sched.evict("nobody")
+    # migrate by bit-identity: the namespaced checkpoint moves, the
+    # campaign continues on another pod, tallies equal the solo run
+    os.makedirs(os.path.join(pod_b, "tenants"), exist_ok=True)
+    shutil.copytree(os.path.join(pod_a, "tenants", "m"),
+                    os.path.join(pod_b, "tenants", "m"))
+    sched_b = CampaignScheduler(outdir=pod_b)
+    sched_b.admit(TenantSpec(name="m", plan=_plan(3,
+                                                  n_batches=6).to_dict()))
+    assert sched_b.run() == 0
+    got = sched_b.tenant_tallies("m")
+    for k, v in solo.items():
+        np.testing.assert_array_equal(got[k], v)
+
+
+def test_evict_queued_releases_without_elaboration(tmp_path):
+    sched = CampaignScheduler(outdir=str(tmp_path))
+    # an unbuildable plan: release must not cost a plan build
+    sched.admit(TenantSpec(name="q", plan={"nonsense": True}))
+    assert sched.evict("q", "moved") is True
+    t = sched.tenants["q"]
+    assert t.status == "evicted" and t.orch is None and t.failures == 0
+
+
+def test_evict_decision_survives_hard_kill(tmp_path):
+    # the eviction is journaled before the drain: a hard kill between
+    # the two replays the decision — the recovered pod releases the
+    # tenant without ever elaborating it
+    outdir = str(tmp_path / "pod")
+    sched = CampaignScheduler(outdir=outdir)
+    sched.admit(TenantSpec(name="m", plan=_plan(3,
+                                                n_batches=6).to_dict()))
+    for _ in range(3):
+        sched.step()
+    assert sched.evict("m", "migrate") is True
+    # hard kill here: no drain, no checkpoint — abandon the scheduler
+    rec = CampaignScheduler.recover(outdir)
+    t = rec.tenants["m"]
+    assert t.evicted == "migrate" and t.status == "queued"
+    assert rec.run() == 0
+    assert t.status == "evicted" and t.orch is None
+
+
+# --- the pod supervisor (jax-free unit) -------------------------------------
+
+def test_pod_supervisor_round_counted_lease_expiry(tmp_path):
+    from shrewd_tpu.parallel.elastic import HeartbeatWriter
+
+    coord = str(tmp_path / "coord")
+    sup = PodSupervisor(coord, expiry_rounds=2)
+    hb = HeartbeatWriter(coord, "p0")
+    hb.beat()
+    assert sup.observe(["p0"])["p0"] is True
+    hb.beat()
+    assert sup.observe(["p0"])["p0"] is True
+    # beats stop: the lease expires after exactly expiry_rounds polls
+    assert sup.observe(["p0"])["p0"] is True     # stale poll 1
+    assert sup.observe(["p0"])["p0"] is False    # stale poll 2: expired
+    # beats resume: alive again on the next poll (the heal signal)
+    hb.beat()
+    assert sup.observe(["p0"])["p0"] is True
+    # a pod that never beat at all expires too
+    sup2 = PodSupervisor(coord, expiry_rounds=2)
+    sup2.observe(["ghost"])
+    assert sup2.observe(["ghost"])["ghost"] is False
+
+
+def test_tenant_spec_slo_roundtrip():
+    spec = TenantSpec(name="t", plan={"seed": 1}, slo_s=120.0)
+    assert TenantSpec.from_dict(spec.to_dict()).slo_s == 120.0
+    assert TenantSpec.from_dict({"name": "old", "plan": {}}).slo_s == 0.0
+    with pytest.raises(ValueError):
+        TenantSpec(name="t", plan={}, slo_s=-1.0)
+
+
+# --- the federation (gateway + pods + driver) -------------------------------
+
+def test_federation_routes_serves_bit_identical(tmp_path):
+    seeds = (3, 5, 7)
+    solos = {s: _solo_tallies(_plan(s, n_batches=4)) for s in seeds}
+    fed = Federation(str(tmp_path / "fed"), pod_names=("pod0", "pod1"))
+    for s in seeds:
+        doc = fed.submit(_spec(f"t{s}", s))
+        assert doc["pod"] in ("pod0", "pod1")
+        assert doc["eta_trials"] > 0
+    assert fed.serve() == 0
+    for s in seeds:
+        _assert_matches(fed, f"t{s}", solos[s])
+    # load routing spread the tenants over both pods
+    pods_used = {e.pod for e in fed.gateway.entries.values()}
+    assert pods_used == {"pod0", "pod1"}
+    # the routing ledger snapshot is durable + checksummed
+    from shrewd_tpu.resilience import load_json_verified
+
+    snap = load_json_verified(os.path.join(
+        str(tmp_path / "fed"), "gateway", "gateway_ckpt",
+        "gateway.json"))
+    assert {e["status"] for e in snap["entries"]} == {"done"}
+
+
+def test_federation_kill_pod_failover_bit_identical(tmp_path):
+    # the acceptance pin: a pod dies HARD mid-campaign (kill_pod chaos
+    # at a deterministic tick — no drain, dirty WAL, stale heartbeat),
+    # the supervisor's lease expires, the gateway fails its tenants
+    # over from their namespaced checkpoints, and every tenant's final
+    # tallies are bit-identical to its solo serial run
+    seeds = (3, 5, 7)
+    solos = {s: _solo_tallies(_plan(s, n_batches=6)) for s in seeds}
+    chaos = ChaosEngine({"faults": [
+        {"kind": "kill_pod", "pod": "pod0", "at_tick": 3}]})
+    fed = Federation(str(tmp_path / "fed"),
+                     pod_names=("pod0", "pod1", "pod2"),
+                     chaos=chaos, expiry_rounds=2)
+    for s in seeds:
+        fed.submit(TenantSpec(name=f"t{s}",
+                              plan=_plan(s, n_batches=6).to_dict()))
+    assert fed.serve() == 0
+    assert chaos.injected == {"kill_pod": 1}
+    assert chaos.survived == {"kill_pod": 1}
+    assert fed.gateway.dead_pods == {"pod0"}
+    assert fed.failovers >= 1
+    for s in seeds:
+        _assert_matches(fed, f"t{s}", solos[s])
+    # the failed-over tenant's history shows the move off the dead pod
+    moved = [e for e in fed.gateway.entries.values()
+             if any(h["pod"] == "pod0" for h in e.history)]
+    assert moved and all(e.pod != "pod0" for e in moved)
+
+
+def test_federation_partition_heals_without_duplicate(tmp_path):
+    # partition = heartbeat suppression WITHOUT death: the pod keeps
+    # computing, the supervisor declares it lost, the gateway fails
+    # over — then the partition heals and the stale placement is
+    # fenced.  Each tenant must be counted exactly once (the ledger
+    # decides who reports; the stale copy's tallies are bit-identical
+    # anyway, which is why fencing is safe at any point)
+    seeds = (3, 5)
+    solos = {s: _solo_tallies(_plan(s, n_batches=8)) for s in seeds}
+    chaos = ChaosEngine({"faults": [
+        {"kind": "partition_pod", "pod": "pod0", "at_round": 2,
+         "rounds": 4}]})
+    fed = Federation(str(tmp_path / "fed"), pod_names=("pod0", "pod1"),
+                     chaos=chaos, expiry_rounds=2)
+    for s in seeds:
+        fed.submit(TenantSpec(name=f"t{s}",
+                              plan=_plan(s, n_batches=8).to_dict()))
+    assert fed.serve() == 0
+    assert chaos.injected == {"partition_pod": 1}
+    assert "pod0" not in fed.gateway.dead_pods    # healed, not dead
+    assert fed.failovers >= 1
+    for s in seeds:
+        _assert_matches(fed, f"t{s}", solos[s])
+    # no duplicate accounting: every tenant reports from exactly one
+    # authoritative placement, and any stale copy on the healed pod
+    # was fenced (evicted) rather than adopted
+    for s in seeds:
+        e = fed.gateway.entries[f"t{s}"]
+        assert e.status == "done" and e.result is not None
+    if fed.fenced:
+        pod0 = fed.pods["pod0"].sched
+        stale = [t for t in (pod0.tenants.values() if pod0 else [])
+                 if t.status == "evicted"]
+        assert stale, "fencing reported but no evicted stale tenant"
+
+
+def test_federation_rebalances_on_eta_runaway(tmp_path):
+    # an aggressive rebalance posture (factor < 1) forces at least one
+    # drain-here/recover-there migration mid-campaign; tallies must
+    # stay bit-identical through it (migration is free by construction)
+    seeds = (3, 5, 7)
+    solos = {s: _solo_tallies(_plan(s, n_batches=6)) for s in seeds}
+    fed = Federation(str(tmp_path / "fed"), pod_names=("pod0", "pod1"),
+                     rebalance_every=2, rebalance_factor=0.5)
+    for s in seeds:
+        fed.submit(TenantSpec(name=f"t{s}",
+                              plan=_plan(s, n_batches=6).to_dict()))
+    assert fed.serve() == 0
+    assert fed.migrations >= 1
+    migrated = [e for e in fed.gateway.entries.values() if e.epoch > 1]
+    assert migrated
+    for s in seeds:
+        _assert_matches(fed, f"t{s}", solos[s])
+
+
+def test_gateway_recover_replays_route_without_double_place(tmp_path):
+    # the satellite's crash window made explicit: kill between the
+    # route-decision journal and the pod handoff — recovery must
+    # replay the journaled decision (same pod, one ticket), never
+    # re-decide into a second placement
+    solo = _solo_tallies(_plan(3, n_batches=4))
+    root = str(tmp_path / "fed")
+    fed = Federation(root, pod_names=("pod0", "pod1"))
+
+    class Boom(Exception):
+        pass
+
+    def explode(self, e):
+        raise Boom()
+
+    orig = Gateway._place
+    Gateway._place = explode
+    try:
+        with pytest.raises(Boom):
+            fed.submit(_spec("t3", 3))
+    finally:
+        Gateway._place = orig
+    e = fed.gateway.entries["t3"]
+    assert e.status == "routed" and not e.pod_ticket
+    decided = e.pod
+    ports = {n: p.port for n, p in fed.pods.items()}
+    for p in fed.pods.values():
+        assert find_spool_ticket(p.spool_dir, "t3") is None
+    # first recovery: replay the decision, place exactly once
+    gw2 = Gateway.recover(os.path.join(root, "gateway"), pods=ports)
+    assert gw2.recoveries == 1
+    assert gw2.entries["t3"].status == "placed"
+    assert gw2.entries["t3"].pod == decided
+    # second recovery (crash straight after the repair): still one
+    hits = [n for n, p in fed.pods.items()
+            if find_spool_ticket(p.spool_dir, "t3")]
+    assert hits == [decided]
+    gw3 = Gateway.recover(os.path.join(root, "gateway"), pods=ports)
+    hits = [n for n, p in fed.pods.items()
+            if find_spool_ticket(p.spool_dir, "t3")]
+    assert hits == [decided]
+    pending = os.listdir(os.path.join(
+        fed.pods[decided].spool_dir, "pending"))
+    assert len(pending) == 1
+    # the recovered gateway serves to completion, bit-identically
+    fed.gateway = gw3
+    assert fed.serve() == 0
+    _assert_matches(fed, "t3", solo)
+
+
+def test_gateway_recover_with_smaller_pod_set_fails_over(tmp_path):
+    # a recovery handed fewer pods than the snapshot knew (--recover
+    # --pods N after shrinking the deployment): entries on the
+    # now-unknown pod are orphans and must fail over to the recovered
+    # pod set, not crash recovery or strand silently
+    solo = _solo_tallies(_plan(3, n_batches=4))
+    root = str(tmp_path / "fed")
+    fed = Federation(root, pod_names=("pod0", "pod1", "pod2"))
+    fed.submit(_spec("t3", 3))
+    placed_on = fed.gateway.entries["t3"].pod
+    fed.gateway.checkpoint()    # durable ledger, then "lose" the pod
+    survivors = tuple(n for n in ("pod0", "pod1", "pod2")
+                      if n != placed_on)
+    fed2 = Federation.recover(root, pod_names=survivors)
+    e = fed2.gateway.entries["t3"]
+    assert e.pod in survivors and e.status == "placed"
+    assert any(h["reason"] == "failover" for h in e.history)
+    assert fed2.serve() == 0
+    _assert_matches(fed2, "t3", solo)
+
+
+def test_gateway_refused_placement_is_rerouted_not_adopted(tmp_path):
+    # a pod that refuses a placement (e.g. a healed partition's stale
+    # terminal copy still holds the roster slot) publishes a
+    # results-free "refused" done-doc: the gateway must re-place the
+    # tenant elsewhere, never adopt the refusal as the final result
+    from shrewd_tpu.service import SubmissionQueue
+
+    root = str(tmp_path / "fed")
+    fed = Federation(root, pod_names=("pod0", "pod1"))
+    fed.submit(_spec("t3", 3))
+    e = fed.gateway.entries["t3"]
+    first = e.pod
+    SubmissionQueue(fed.pods[first].spool_dir).mark_done(
+        e.pod_ticket, {"tenant": "t3", "status": "refused",
+                       "error": "tenant 't3' already admitted"})
+    fed.gateway.poll()
+    assert e.status == "placed" and e.pod != first
+    assert any(h["reason"] == "refused" for h in e.history)
+    assert fed.serve() == 0
+    _assert_matches(fed, "t3", _solo_tallies(_plan(3, n_batches=4)))
+
+
+def test_gateway_crashcheck_sweep(tmp_path):
+    # the exhaustive version of the window above: recover the whole
+    # federation from EVERY gateway-WAL durability boundary (+ torn
+    # variants of every gateway append) — bit-identical aggregate and
+    # single placement at each.  Bounded here; the CI smoke runs the
+    # full sweep
+    plans = crashcheck.small_fleet_plans(seeds=(3,), n_batches=2)
+    doc = crashcheck.run_gateway_crashcheck(
+        str(tmp_path / "sweep"), plans=plans, max_points=8)
+    assert doc["failures"] == []
+    assert doc["points_checked"] >= 5
+    assert doc["torn_checks"] >= 1
+    assert doc["boundaries_by_event"].get("append", 0) >= 3
+
+
+# --- the thin HTTP front ----------------------------------------------------
+
+def test_http_front_submit_and_status(tmp_path):
+    solo = _solo_tallies(_plan(3, n_batches=3))
+    root = str(tmp_path / "fed")
+    gw_dir = os.path.join(root, "gateway")
+    front = GatewayHTTPFront(gw_dir, port=0).start()
+    try:
+        base = f"http://127.0.0.1:{front.port}"
+        # health
+        with urllib.request.urlopen(f"{base}/healthz", timeout=10) as r:
+            assert json.load(r)["ok"] is True
+        # submit a tenant over the wire -> the gateway spool
+        spec = TenantSpec(name="web", plan=_plan(3, n_batches=3
+                                                 ).to_dict(), slo_s=600)
+        req = urllib.request.Request(
+            f"{base}/submit", data=json.dumps(spec.to_dict()).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=10) as r:
+            doc = json.load(r)
+        assert doc["tenant"] == "web" and doc["ticket"]
+        # a malformed submission is a 400, not a wedge
+        bad = urllib.request.Request(f"{base}/submit", data=b"{nope")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(bad, timeout=10)
+        assert ei.value.code == 400
+        # the federation claims the spooled submission and serves it
+        fed = Federation(root, pod_names=("pod0", "pod1"))
+        assert fed.serve() == 0
+        _assert_matches(fed, "web", solo)
+        assert fed.gateway.entries["web"].spec.slo_s == 600
+        # /status serves the persisted routing ledger
+        with urllib.request.urlopen(f"{base}/status", timeout=10) as r:
+            snap = json.load(r)
+        assert snap["entries"][0]["spec"]["name"] == "web"
+        assert snap["entries"][0]["status"] == "done"
+    finally:
+        front.stop()

@@ -600,8 +600,12 @@ def test_repo_journal_kinds_are_exhaustive():
         fls, cfg)
     assert dispatch is not None
     assert set(appended) == {
+        # the fleet scheduler's ledger
         "config", "admit", "status", "tick", "failure", "quarantine",
-        "tenant_kill", "revoke", "shutdown", "recover"}
+        "tenant_kill", "revoke", "evict", "shutdown", "recover",
+        # the federation gateway's routing ledger
+        "gw_config", "accept", "route", "place", "migrate",
+        "pod_dead", "pod_heal", "done", "gw_shutdown", "gw_recover"}
     assert set(appended) == handled
 
 

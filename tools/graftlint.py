@@ -140,6 +140,15 @@ def main(argv=None) -> int:
                          "boundary of a small real fleet")
     ap.add_argument("--crash-json", default=None, metavar="OUT",
                     help="write the crashcheck artifact (CRASH_r11.json)")
+    ap.add_argument("--gateway-crashcheck", action="store_true",
+                    help="also sweep the federation GATEWAY's WAL "
+                         "(analysis/crashcheck.py run_gateway_crashcheck)"
+                         ": recover a 2-pod federation from every "
+                         "gateway durability boundary — the "
+                         "route-decision-vs-pod-handoff window must "
+                         "replay, never double-place a tenant")
+    ap.add_argument("--gateway-crash-json", default=None, metavar="OUT",
+                    help="write the gateway sweep artifact")
     ap.add_argument("--root", default=REPO_ROOT,
                     help="repo root (default: the checkout this script "
                          "lives in)")
@@ -192,6 +201,27 @@ def main(argv=None) -> int:
                 f.write("\n")
             print(f"wrote {args.crash_json}")
 
+    if args.gateway_crashcheck:
+        import shutil
+        import tempfile
+
+        from shrewd_tpu.analysis.crashcheck import run_gateway_crashcheck
+
+        workdir = tempfile.mkdtemp(prefix="gwcrash_")
+        try:
+            gw_doc = run_gateway_crashcheck(workdir)
+        finally:
+            shutil.rmtree(workdir, ignore_errors=True)
+        crash_ok = crash_ok and gw_doc["ok"]
+        doc["gateway_crashcheck"] = {k: gw_doc[k] for k in (
+            "points", "checks", "torn_checks", "boundaries_by_event",
+            "ok")}
+        if args.gateway_crash_json:
+            with open(args.gateway_crash_json, "w") as f:
+                json.dump(gw_doc, f, indent=1)
+                f.write("\n")
+            print(f"wrote {args.gateway_crash_json}")
+
     new_violations = [f.to_dict() for f in report.violations]
     if args.baseline and os.path.exists(args.baseline):
         with open(args.baseline) as f:
@@ -229,6 +259,13 @@ def main(argv=None) -> int:
         print(f"crashcheck: {cc['checks']} recoveries from "
               f"{cc['points']} crash points ({cc['torn_checks']} torn) "
               f"-> {'bit-identical at every one' if cc['ok'] else 'FAILED'}")
+    if args.gateway_crashcheck:
+        gc = doc["gateway_crashcheck"]
+        print(f"gateway crashcheck: {gc['checks']} federation "
+              f"recoveries from {gc['points']} gateway boundaries "
+              f"({gc['torn_checks']} torn) -> "
+              + ("bit-identical, every tenant placed once"
+                 if gc["ok"] else "FAILED"))
     n_v, n_w = len(report.violations), len(report.waivers)
     print(f"graftlint: {n_v} violation(s) "
           f"({len(new_violations)} new), {len(report.warnings)} "

@@ -122,6 +122,10 @@ def _render_snapshot(snap: dict) -> str:
             f" ({row.get('trials_per_s')}/s) vtime={row.get('vtime')}"
             f" ticks={row.get('ticks')}"
             + (f" failures={row['failures']}" if row.get("failures") else "")
+            + (f" eta={row['eta_trials']:g}tr"
+               + (f"/{row['eta_s']:g}s" if row.get("eta_s") is not None
+                  else "")
+               if row.get("eta_trials") is not None else "")
             + hw_s)
     return "\n".join(lines)
 
