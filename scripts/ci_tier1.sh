@@ -416,6 +416,130 @@ print(f"federation smoke: 3 tenants x 3 pods, kill_pod+partition_pod -> "
       f"({sweep['checks']} recoveries) -> FED_r12.json")
 FED_SMOKE
 
+# Sharded-campaign gate (FATAL): ONE campaign (a NORTHSTAR structure,
+# 576 frozen-key trials) striped as shards: 3 across a 5-pod
+# federation under the merge-targeted chaos pair — kill_shard HARD-
+# kills the pod hosting one stripe mid-campaign and
+# partition_during_merge suppresses another pod's beats exactly while
+# a gateway fold is in flight (at_fold keys on the journaled fold
+# ordinal).  Both stripes must fail over, the healed pod must be
+# fenced, and the gateway's order-fixed merge fold must produce
+# tallies bit-identical to the solo run at >= 2.5x the solo busy time
+# of the hottest pod.  The gateway WAL of a SHARDED run is then
+# crash-swept at every durability boundary including each
+# shard_split / shard_fold / shard_converged append (+ torn variants)
+# with 0 divergent recoveries.  Results -> FED_SHARD_r13.json +
+# CRASH_r13.json.  FATAL: this is the PR-16 acceptance pin.
+timeout -k 10 560 env JAX_PLATFORMS=cpu python - <<'FED_SHARD_GATE' \
+  || { echo "FATAL: sharded-federation gate failed (merge fold diverged, chaos unsurvived, speedup < 2.5x, or a merge-ledger crash point did not recover bit-identically)"; exit 1; }
+import json, os, tempfile
+import numpy as np
+from shrewd_tpu.analysis import crashcheck
+from shrewd_tpu.campaign.orchestrator import Orchestrator
+from shrewd_tpu.campaign.plan import CampaignPlan, WorkloadSpec
+from shrewd_tpu.chaos import ChaosEngine
+from shrewd_tpu.federation import Federation
+from shrewd_tpu.service import TenantSpec
+from shrewd_tpu.trace.synth import WorkloadConfig
+
+def plan():
+    p = CampaignPlan(
+        simpoints=[WorkloadSpec(name="w0", workload=WorkloadConfig(
+            n=96, nphys=32, mem_words=64, working_set_words=32, seed=7))],
+        structures=["regfile"], batch_size=32,
+        target_halfwidth=0.2, max_trials=576, min_trials=576, seed=3)
+    p.integrity.canary_trials = 0
+    p.integrity.audit_rate = 0.0
+    p.resilience.backoff_base = 0.0
+    return p
+
+# warm the content-keyed exec cache so both runs measure pure serving
+# (keep the orchestrator alive: cache entries are owner-guarded), and
+# take its tallies as the ground-truth solo trajectory
+warm = Orchestrator(plan())
+warm_solo = {k: np.asarray(v.tallies)
+             for k, v in dict(list(warm.events())[-1][1]).items()}
+root = tempfile.mkdtemp(prefix="fed_shard_")
+# solo baseline: the same campaign unsharded on a one-pod federation —
+# same pod machinery, so busy_s is the like-for-like denominator
+solo_fed = Federation(os.path.join(root, "solo"), pod_names=("solo0",))
+solo_fed.submit(TenantSpec(name="camp", plan=plan().to_dict()))
+assert solo_fed.serve() == 0
+solo = solo_fed.tenant_tallies("camp")
+for k, t in warm_solo.items():
+    np.testing.assert_array_equal(solo[k], t)
+solo_busy = solo_fed.pods["solo0"].busy_s
+
+chaos = ChaosEngine({"faults": [
+    {"kind": "kill_shard", "shard": "camp+shard1", "at_round": 3},
+    {"kind": "partition_during_merge", "pod": "pod2", "at_fold": 2,
+     "rounds": 3}]})
+fed = Federation(os.path.join(root, "fed"),
+                 pod_names=tuple(f"pod{i}" for i in range(5)),
+                 chaos=chaos, expiry_rounds=2)
+doc = fed.submit(TenantSpec(name="camp", plan=plan().to_dict(), shards=3))
+assert fed.serve() == 0, "sharded federation did not converge"
+assert chaos.injected == {"kill_shard": 1,
+                          "partition_during_merge": 1}, chaos.injected
+assert chaos.survived == {"kill_shard": 1,
+                          "partition_during_merge": 1}, chaos.survived
+e = fed.gateway.entries["camp"]
+assert e.result["status"] == "complete" and e.result["converged"]
+got = fed.tenant_tallies("camp")
+assert got.keys() == solo.keys()
+for k, t in solo.items():
+    np.testing.assert_array_equal(got[k], np.asarray(t))
+busy = fed.counters()["busy_s"]
+hot = max(busy.values())
+speedup = solo_busy / hot
+assert speedup >= 2.5, (
+    f"sharded speedup {speedup:.2f}x < 2.5x "
+    f"(solo {solo_busy:.2f}s, hottest shard pod {hot:.2f}s)")
+
+# merge-ledger crash sweep: a sharded run recovered from every gateway
+# durability boundary, 0 divergent recoveries required
+sweep = crashcheck.run_gateway_crashcheck(
+    os.path.join(root, "sweep"),
+    plans=crashcheck.small_fleet_plans(seeds=(3,), n_batches=4),
+    pod_names=("pod0", "pod1"), shards={"t0": 2})
+assert sweep["ok"], sweep["failures"][:3]
+for kind in ("shard_split", "shard_fold", "shard_converged"):
+    assert sweep["boundaries_by_kind"].get(kind, 0) >= 1, \
+        f"sweep never crossed a {kind} boundary"
+with open("CRASH_r13.json", "w") as f:
+    json.dump(sweep, f, indent=1)
+    f.write("\n")
+with open("FED_SHARD_r13.json", "w") as f:
+    json.dump({
+        "plan": {"structure": "regfile", "trials": 576,
+                 "batch_size": 32, "shards": 3, "pods": 5},
+        "admission": {"shards": doc["shards"],
+                      "eta_trials": doc["eta_trials"],
+                      "deadline_s": doc["deadline_s"]},
+        "chaos": chaos.to_dict(),
+        "counters": fed.counters(),
+        "merged": {"status": e.result["status"],
+                   "converged": e.result["converged"],
+                   "trials": e.result["trials"],
+                   "folds": e.result["folds"],
+                   "shards": e.result["shards"]},
+        "solo_busy_s": round(solo_busy, 4),
+        "hottest_pod_busy_s": round(hot, 4),
+        "speedup_busy": round(speedup, 3),
+        "bit_identical_vs_solo": True,
+        "sharded_gateway_crashcheck": {k: sweep[k] for k in (
+            "points", "checks", "torn_checks",
+            "boundaries_by_kind", "ok")},
+    }, f, indent=1)
+    f.write("\n")
+print(f"sharded-federation gate: 3 shards x 5 pods, kill_shard + "
+      f"partition_during_merge -> {fed.failovers} failovers, "
+      f"{fed.fenced} fenced, {e.result['folds']} folds, merged "
+      f"bit-identical at {speedup:.2f}x; merge-ledger sweep "
+      f"{sweep['points']} boundaries ({sweep['checks']} recoveries, "
+      f"0 divergent) -> FED_SHARD_r13.json + CRASH_r13.json")
+FED_SHARD_GATE
+
 # Non-fatal bench smoke: bench.py --quick includes the serial-vs-
 # pipelined campaign-loop microbenchmark (now surfacing the PerfStats
 # overlap ledger — host/device-wait/device-step seconds, depth HWM),

@@ -363,6 +363,16 @@ def _count_by(points, field: str) -> dict:
 # recorder watches the whole federation root (a consistent snapshot
 # needs gateway + pods together) but enumerates crash points only at
 # gateway-WAL boundaries and at the handoff writes themselves.
+#
+# Sharded campaigns (``TenantSpec.shards > 1``) extend the crash
+# surface with the MERGE LEDGER: shard_split / shard_fold /
+# shard_converged records, each journaled before the gateway's fold
+# state mutates.  Passing ``shards=`` sweeps those boundaries too —
+# every ``shard_fold`` append (plus its torn-tail variant) becomes a
+# crash point, and the recovered federation must re-fold to merged
+# tallies bit-identical to the undisturbed run (run-to-cap plans: the
+# merged stopping rule can only revoke after every stripe is complete,
+# so the final merge is timing-independent).
 
 class GatewayRecorder(DurabilityRecorder):
     """Snapshot the full federation tree, but make a crash point only
@@ -408,19 +418,24 @@ def _placements(root: str, pod_names, tenants) -> dict:
 
 
 def check_gateway_point(point: CrashPoint, scratch: str, plans: dict,
-                        pod_names, baseline: dict,
-                        torn: bool = False) -> dict:
+                        pod_names, baseline: dict, torn: bool = False,
+                        shards: dict | None = None) -> dict:
     """Re-execute federation recovery from one gateway crash point:
     copy the snapshot, optionally tear the gateway WAL's last record,
-    ``Federation.recover()`` (gateway replay + placement repair; pods
-    replay their own WALs lazily), re-admit tenants the crash landed
-    before their accept record, serve to convergence — then assert
-    aggregate tallies bit-identical to the undisturbed run AND every
-    tenant placed on exactly one pod."""
+    ``Federation.recover()`` (gateway replay + placement repair +
+    merge-fold repair; pods replay their own WALs lazily), re-admit
+    tenants the crash landed before their accept record, serve to
+    convergence — then assert aggregate tallies bit-identical to the
+    undisturbed run AND every placed tenant on exactly one pod.  The
+    placement probe runs over the recovered LEDGER's placed entries: a
+    sharded parent never touches a pod spool (it splits at the
+    gateway) and a surplus shard pruned while queued never places —
+    neither may be held to the exactly-one-spool rule."""
     from shrewd_tpu.federation.driver import Federation
     from shrewd_tpu.federation.gateway import gateway_journal_path
     from shrewd_tpu.service.queue import TenantSpec
 
+    shards = shards or {}
     shutil.copytree(point.snapshot, scratch)
     if torn and not tear_journal_tail(
             scratch, jpath=gateway_journal_path(
@@ -433,10 +448,15 @@ def check_gateway_point(point: CrashPoint, scratch: str, plans: dict,
         fed = Federation.recover(scratch, pod_names=tuple(pod_names))
         for name, plan in plans.items():
             if name not in fed.gateway.entries:
-                fed.gateway.admit(TenantSpec(name=name, plan=plan))
+                fed.gateway.admit(TenantSpec(
+                    name=name, plan=plan,
+                    shards=int(shards.get(name, 1))))
         rc = fed.serve()
         got = _fed_tallies(fed, plans)
-        placements = _placements(scratch, pod_names, sorted(plans))
+        probe = sorted(
+            n for n, e in fed.gateway.entries.items()
+            if not e.shards and e.pod)
+        placements = _placements(scratch, pod_names, probe)
         result.update(
             rc=rc,
             identical=_tallies_equal(got, baseline),
@@ -457,19 +477,26 @@ def check_gateway_point(point: CrashPoint, scratch: str, plans: dict,
 
 def run_gateway_crashcheck(workdir: str, plans: dict | None = None,
                            pod_names=("pod0", "pod1"), torn: bool = True,
-                           max_points: int | None = None) -> dict:
-    """The gateway-WAL sweep (see section comment).  Returns the
+                           max_points: int | None = None,
+                           shards: dict | None = None) -> dict:
+    """The gateway-WAL sweep (see section comment).  ``shards`` maps
+    tenant name -> shard count (``TenantSpec.shards``): those tenants
+    run split across pods and the sweep covers the merge ledger's
+    durability boundaries — every ``shard_split`` / ``shard_fold`` /
+    ``shard_converged`` append plus torn-tail variants.  Returns the
     machine-readable report; ``report["ok"]`` is the gate bit."""
     from shrewd_tpu.federation.driver import Federation
     from shrewd_tpu.service.queue import TenantSpec
 
     plans = plans if plans is not None else small_fleet_plans(
         seeds=(3, 5))
+    shards = shards or {}
 
     def _run(root):
         fed = Federation(root, pod_names=tuple(pod_names))
         for name, plan in plans.items():
-            fed.submit(TenantSpec(name=name, plan=plan))
+            fed.submit(TenantSpec(name=name, plan=plan,
+                                  shards=int(shards.get(name, 1))))
         rc = fed.serve()
         return fed, rc
 
@@ -502,17 +529,20 @@ def run_gateway_crashcheck(workdir: str, plans: dict | None = None,
     for pt in points:
         scratch = os.path.join(workdir, f"gchk_{pt.index:04d}")
         results.append(check_gateway_point(pt, scratch, plans,
-                                           pod_names, baseline))
+                                           pod_names, baseline,
+                                           shards=shards))
         if torn and pt.event == "append" \
                 and pt.path.startswith("gateway" + os.sep):
             scratch = os.path.join(workdir, f"gchk_{pt.index:04d}_torn")
             results.append(check_gateway_point(
-                pt, scratch, plans, pod_names, baseline, torn=True))
+                pt, scratch, plans, pod_names, baseline, torn=True,
+                shards=shards))
     failures = [r for r in results if not r["ok"]]
     return {
         "tool": "crashcheck-gateway",
         "tenants": sorted(plans),
         "pods": list(pod_names),
+        "shards": {n: int(v) for n, v in sorted(shards.items())},
         "points": len(recorder.points),
         "points_checked": len(points),
         "points_dropped": dropped,
@@ -520,6 +550,7 @@ def run_gateway_crashcheck(workdir: str, plans: dict | None = None,
         "torn_checks": sum(1 for r in results if r["torn"]),
         "events": [pt.label() for pt in recorder.points],
         "boundaries_by_event": _count_by(recorder.points, "event"),
+        "boundaries_by_kind": _count_by(recorder.points, "kind"),
         "baseline_digest": _tally_digest(
             {n: baseline[n] for n in baseline}),
         "failures": failures,

@@ -252,6 +252,15 @@ class Orchestrator:
                 f"plan batch_size {plan.batch_size} is not divisible by "
                 f"the {self.mesh.size}-device mesh — rounded up to "
                 f"{self.batch_size}", RuntimeWarning, stacklevel=2)
+        # federated single-campaign sharding (plan.shard_index/shard_count):
+        # shard i of N serves the round-robin stripe {i, i+N, ...} of the
+        # PARENT campaign's batch-id space; this orchestrator's local
+        # batch ordinals map to global ids at the ONE key-derivation site
+        # (_compute_batch via _global_batch_id).  The plan's max_trials is
+        # already this shard's slice budget (the gateway scales it), so
+        # _ceiling_batches and the capped/ETA arithmetic hold unchanged.
+        self.shard_index = int(plan.shard_index)
+        self.shard_count = int(plan.shard_count)
         self._per_sp = [s for s in plan.structures if not _is_plan_level(s)]
         self._plan_level = [s for s in plan.structures if _is_plan_level(s)]
         self.state: dict[tuple[str, str], _State] = {
@@ -815,7 +824,7 @@ class Orchestrator:
         unless the engine already holds that batch in flight from
         dispatch-ahead."""
         k = int(self.pcfg.sync_every)
-        if (k <= 1 or self._elastic is not None
+        if (k <= 1 or self._elastic is not None or self.shard_count > 1
                 or not camp.supports_intervals):
             return 0
         k = max(1, min(k, self._ceiling_batches - st.next_batch))
@@ -858,7 +867,7 @@ class Orchestrator:
         (``pcfg.max_super_interval`` — integrity checks must keep gating
         cumulative deltas at a bounded cadence)."""
         if (not self.pcfg.until_ci or self._elastic is not None
-                or not camp.supports_intervals):
+                or self.shard_count > 1 or not camp.supports_intervals):
             return 0
         # the device loop counts trials and tallies in int32: every count
         # it can reach is bounded by ceiling_batches*batch_size, so gate
@@ -1246,6 +1255,18 @@ class Orchestrator:
                 lambda t, d=delta: t + d, times=1,
                 note=lambda: self.chaos.note_fired("corrupt_tally"))
 
+    def _global_batch_id(self, batch_id: int) -> int:
+        """Map this shard's local batch ordinal to its global id in the
+        parent campaign's batch-id space (round-robin stripe: shard i of
+        N serves {i, i+N, i+2N, ...}); the identity when unsharded.
+        Per-batch tallies are pure functions of their frozen per-batch
+        PRNG keys, so a shard re-dispatches exactly the batches the solo
+        run would have — the gateway's order-fixed fold of shard tallies
+        is bit-identical to the solo accumulation."""
+        if self.shard_count <= 1:
+            return batch_id
+        return self.shard_index + batch_id * self.shard_count
+
     def _compute_batch(self, sp_idx: int, sp_name: str, structure: str,
                        camp, sk, batch_id: int) -> dict:
         """Dispatch ONE batch through the integrity-checked resilience
@@ -1256,7 +1277,13 @@ class Orchestrator:
         Chaos hook point: faults armed for this batch fire here — the
         wedge inside the watchdog, per-tier BackendErrors inside the
         ladder, tally corruption inside the checked dispatcher, and the
-        worker kill at the boundary before any work."""
+        worker kill at the boundary before any work.
+
+        Sharded campaigns map the local ordinal to its GLOBAL batch id
+        up front: key derivation, chaos arming, integrity evidence, and
+        the published document all speak global coordinates, exactly as
+        the solo run would."""
+        batch_id = self._global_batch_id(batch_id)
         self._arm_chaos([batch_id], sp_name, structure)
         keys = prng.trial_keys(prng.batch_key(sk, batch_id),
                                self.batch_size)

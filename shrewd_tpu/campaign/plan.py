@@ -177,9 +177,25 @@ class CampaignPlan(ConfigObject):
     coherence_mem_words = Param(int, 256,
                                 "memory words behind the coherence stream",
                                 check=lambda v: v > 0)
+    # federated single-campaign sharding (federation/gateway.py): shard i
+    # of N serves the round-robin stripe {i, i+N, i+2N, ...} of the
+    # parent campaign's frozen batch-id space.  Per-batch tallies are
+    # pure functions of their frozen PRNG keys, so the gateway's
+    # order-fixed fold of shard tallies is bit-identical to the solo
+    # run.  shard_count == 1 (the default) is exactly the unsharded
+    # path — the identity mapping.
+    shard_index = Param(int, 0, "this shard's stripe offset in the "
+                        "parent campaign's batch-id space",
+                        check=lambda v: v >= 0)
+    shard_count = Param(int, 1, "round-robin stripe stride (1 = solo)",
+                        check=lambda v: v >= 1)
 
     def __init__(self, simpoints: list[SimPointSpec] | None = None, **kw):
         super().__init__(**kw)
+        if self.shard_index >= self.shard_count:
+            raise ValueError(
+                f"shard_index {self.shard_index} out of range for "
+                f"shard_count {self.shard_count}")
         self.simpoints: list[SimPointSpec] = list(simpoints or [])
         for sp in self.simpoints:
             if sp.name == COHERENCE_SP_NAME:

@@ -82,6 +82,19 @@ proven on a schedule:
                         supervisor declares it lost and fails over — when
                         the partition heals, the gateway must reconcile the
                         stale placement without double-counting the tenant
+``kill_shard``          ``kill_pod`` addressed by SUB-TENANT: the fault
+                        names one shard of a sharded campaign
+                        (``<parent>+shardN``) and kills whatever pod hosts
+                        it when that pod reaches ``at_tick`` / the
+                        federation reaches ``at_round`` — the fault follows
+                        the shard through failover instead of naming a pod
+                        that may no longer serve it
+``partition_during_merge``  heartbeat suppression addressed by MERGE
+                        PROGRESS: the window opens at the first round where
+                        the gateway's cumulative ``shard_fold`` ordinal
+                        reaches ``at_fold`` and holds for ``rounds`` rounds
+                        — the partition lands mid-merge no matter how many
+                        rounds the shards needed to produce that fold
 ======================  ====================================================
 
 Each kind's trigger vocabulary is validated per kind: a ``kill_pod``
@@ -114,12 +127,14 @@ debug.register_flag("Chaos", "deterministic fault-injection harness")
 
 KINDS = ("wedge", "backend_error", "corrupt_tally", "torn_checkpoint",
          "kill_worker", "kill_fleet", "torn_journal", "corrupt_submission",
-         "kill_pod", "partition_pod")
+         "kill_pod", "partition_pod", "kill_shard",
+         "partition_during_merge")
 
 #: kinds whose triggers are NOT batch coordinates (never armed by
 #: ``begin_batch``): checkpoint ordinals and the fleet/federation seams
 _NON_BATCH_KINDS = ("torn_checkpoint", "kill_fleet", "torn_journal",
-                    "corrupt_submission", "kill_pod", "partition_pod")
+                    "corrupt_submission", "kill_pod", "partition_pod",
+                    "kill_shard", "partition_during_merge")
 
 #: trigger keys carrying id lists, by kind (fleet/federation kinds +
 #: checkpoint); batch kinds use at_batch / sample / after_dispatches.
@@ -133,10 +148,12 @@ _KIND_TRIGGERS = {
     "corrupt_submission": ("at_submission",),
     "kill_pod": ("at_tick", "at_round"),
     "partition_pod": ("at_round",),
+    "kill_shard": ("at_tick", "at_round"),
+    "partition_during_merge": ("at_fold",),
 }
 
 _ID_KEYS = ("at_batch", "at_ckpt", "at_tick", "at_journal",
-            "at_submission", "at_round")
+            "at_submission", "at_round", "at_fold")
 
 KILL_DEFAULT_RC = 137
 
@@ -204,9 +221,10 @@ def _normalize(plan: dict) -> list[dict]:
                 raise ChaosPlanError(
                     f"fault {i}: {kind} does not take {stray[0]!r} "
                     f"(its trigger vocabulary is {'/'.join(keys)})")
-            if kind == "partition_pod" and int(s.get("rounds", 2)) < 1:
+            if kind in ("partition_pod", "partition_during_merge") \
+                    and int(s.get("rounds", 2)) < 1:
                 raise ChaosPlanError(
-                    f"fault {i}: partition_pod 'rounds' must be >= 1")
+                    f"fault {i}: {kind} 'rounds' must be >= 1")
         elif "at_batch" not in s and "after_dispatches" not in s:
             raise ChaosPlanError(
                 f"fault {i}: {kind} needs at_batch / sample / "
@@ -513,6 +531,69 @@ class ChaosEngine:
                                {"pod": pod, "round": round,
                                 "rounds": rounds})
                 active = True
+        return active
+
+    def maybe_kill_shard(self, shard: str, tick: int | None = None,
+                         round: int | None = None) -> None:
+        """Sharded-campaign kill seam: ``kill_shard`` names one
+        SUB-TENANT of a sharded campaign (``<parent>+shardN``) and
+        fires when the pod hosting it reaches fleet tick ``at_tick``
+        or the federation reaches round ``at_round``.  The driver
+        consults this per shard child placed on the pod it is about to
+        step and kills THAT pod — addressing the fault by shard means
+        it follows the sub-tenant through failover instead of naming a
+        pod that may no longer host it."""
+        for s in self.faults:
+            if s["kind"] != "kill_shard" or s["_fires_left"] <= 0:
+                continue
+            if s.get("shard") and s["shard"] != shard:
+                continue
+            hit = (tick is not None and tick in s.get("at_tick", ())) \
+                or (round is not None and round in s.get("at_round", ()))
+            if not hit:
+                continue
+            s["_fires_left"] -= 1
+            self._batch = (tick if tick is not None else round,
+                           "shard", shard)
+            self._fire("kill_shard", {"shard": shard, "tick": tick,
+                                      "round": round})
+            debug.dprintf("Chaos", "kill_shard %s (tick=%s round=%s)",
+                          shard, tick, round)
+            self.kill_now(s.get("rc"))
+
+    def partition_merge_active(self, pod: str, folds: int,
+                               round: int) -> bool:
+        """Merge-progress partition hook: True while the named pod is
+        inside a ``partition_during_merge`` window.  The trigger
+        coordinate is the gateway's cumulative merge-fold ordinal
+        (``at_fold``: the count of journaled ``shard_fold`` records) —
+        the window OPENS at the first federation round where ``folds``
+        reaches ``at_fold`` and stays active for ``rounds`` rounds, so
+        the partition lands exactly while a sharded campaign's merge
+        is in flight no matter how many rounds the shards needed to
+        produce that fold.  Deterministic like every other trigger:
+        fold ordinals are journaled WAL appends, never a clock."""
+        active = False
+        for s in self.faults:
+            if s["kind"] != "partition_during_merge":
+                continue
+            if s.get("pod") and s["pod"] != pod:
+                continue
+            rounds = int(s.get("rounds", 2))
+            for f0 in s.get("at_fold", ()):
+                started = s.setdefault("_merge_started", {})
+                r0 = started.get(f0)
+                if r0 is None:
+                    if folds < f0 or s["_fires_left"] <= 0:
+                        continue
+                    s["_fires_left"] -= 1
+                    started[f0] = r0 = round
+                    self._batch = (round, "partition_merge", pod)
+                    self._fire("partition_during_merge",
+                               {"pod": pod, "fold": folds,
+                                "round": round, "rounds": rounds})
+                if r0 <= round < r0 + rounds:
+                    active = True
         return active
 
     def take_torn_journal(self, seq: int) -> dict | None:

@@ -11,7 +11,10 @@ claim gateway submissions, renew pod leases (withheld while a
 ``partition_pod`` chaos window is active), take supervisor verdicts
 (death → ``Gateway.pod_dead`` failover; resurrection →
 ``Gateway.pod_heal`` + fencing evictions on the healed pod), advance
-migrations, and rebalance when one pod's ETA runs away.
+migrations, execute the quota revocations the gateway's sharded-merge
+fold decides (``Gateway.shard_revocations`` →
+``CampaignScheduler.revoke_quota``, idempotent and re-derived from the
+ledger every round), and rebalance when one pod's ETA runs away.
 
 In-process pods are the harness posture, not a toy: a pod "hard
 killed" by ``kill_pod`` chaos simply stops being stepped and stops
@@ -79,6 +82,7 @@ class Federation:
         self.migrations = 0
         self.failovers = 0
         self.fenced = 0
+        self.revoked = 0             # shard-convergence quota revocations
 
     @classmethod
     def recover(cls, root: str, pod_names=("pod0", "pod1", "pod2"),
@@ -128,6 +132,15 @@ class Federation:
         try:
             tick = pod.sched.ticks if pod.sched is not None else 0
             self.chaos.maybe_kill_pod(name, tick=tick, round=self.round)
+            # kill_shard: the schedule names a SUB-TENANT of a sharded
+            # campaign; the fault kills whatever pod currently hosts it
+            # — consult it for every shard child placed here so the
+            # fault follows the shard through failover
+            for e in self.gateway.entries.values():
+                if e.shard_of and e.pod == name \
+                        and e.status in ("routed", "placed"):
+                    self.chaos.maybe_kill_shard(
+                        e.spec.name, tick=tick, round=self.round)
         except PodKilled as e:
             debug.dprintf("Federation", "%s", e)
             pod.kill()
@@ -251,13 +264,29 @@ class Federation:
                 if pod.sched is None:
                     pod.build()
                 self._step_pod(pod)
-                pod.partitioned = (
-                    self.chaos is not None
-                    and self.chaos.partition_active(name, self.round))
+                pod.partitioned = self.chaos is not None and (
+                    self.chaos.partition_active(name, self.round)
+                    or self.chaos.partition_merge_active(
+                        name, self.gateway.folds, self.round))
                 if not pod.dead and not pod.partitioned:
                     pod.beat()
             self._supervise()
             self.gateway.poll()
+            # shard convergence revocation: the gateway only decides
+            # (journaled shard_converged + the stateless revocation
+            # list); executing the revoke on each pod's scheduler is
+            # the driver's job — same division of authority as
+            # migration evictions.  revoke_quota is idempotent and the
+            # list is re-derived from the ledger every poll, so a
+            # revocation missed while a pod was dead or partitioned is
+            # simply retried next round.
+            for child, pod_name in self.gateway.shard_revocations():
+                pod = self.pods.get(pod_name)
+                if pod is None or pod.dead or pod.partitioned \
+                        or pod.sched is None:
+                    continue
+                if pod.sched.revoke_quota(child, "shard-converged"):
+                    self.revoked += 1
             self._maybe_rebalance()
             if not self.gateway.spool.pending() and (
                     self.gateway.all_done()
@@ -269,7 +298,8 @@ class Federation:
         # converged: note chaos survivals (every injected pod fault the
         # federation finished through), drain survivors, snapshot
         if self.chaos is not None:
-            for kind in ("kill_pod", "partition_pod"):
+            for kind in ("kill_pod", "partition_pod", "kill_shard",
+                         "partition_during_merge"):
                 done = self.chaos.injected.get(kind, 0) \
                     - self.chaos.survived.get(kind, 0)
                 for _ in range(done):
@@ -294,4 +324,7 @@ class Federation:
     def counters(self) -> dict:
         return {"rounds": self.round, "failovers": self.failovers,
                 "migrations": self.migrations, "fenced": self.fenced,
+                "revoked": self.revoked,
+                "busy_s": {n: round(self.pods[n].busy_s, 4)
+                           for n in sorted(self.pods)},
                 "dead_pods": sorted(self.gateway.dead_pods)}

@@ -162,6 +162,19 @@ class RouteEntry:
         self.eta_trials = None       # pod ETA mass at admission
         self.result = None           # the pod's done-doc
         self.history: list[dict] = []  # [{pod, reason, epoch}]
+        # single-campaign sharding (spec.shards > 1): a PARENT entry
+        # holds the merge ledger (never placed on any pod itself — its
+        # status is "sharded" until the merged campaign completes); a
+        # CHILD (sub-tenant) entry carries its parent's name and its
+        # stripe index and is otherwise an ordinary routed tenant —
+        # migration, failover and fencing need no shard-specific mode
+        self.shard_of = ""           # child: parent tenant name
+        self.shard_index = -1        # child: round-robin stripe offset
+        self.shards: list[str] = []  # parent: children in stripe order
+        self.fold_shards: dict = {}  # parent: last folded shard reports
+        self.fold_merged: dict = {}  # parent: last merged lane state
+        self.fold_seq = 0            # parent: shard_fold records so far
+        self.converged = False       # parent: merged stopping rule fired
 
     def to_dict(self) -> dict:
         return {"spec": self.spec.to_dict(), "order": self.order,
@@ -171,7 +184,14 @@ class RouteEntry:
                 "migrate_to": self.migrate_to,
                 "deadline_s": self.deadline_s,
                 "eta_trials": self.eta_trials,
-                "result": self.result, "history": list(self.history)}
+                "result": self.result, "history": list(self.history),
+                "shard_of": self.shard_of,
+                "shard_index": self.shard_index,
+                "shards": list(self.shards),
+                "fold_shards": dict(self.fold_shards),
+                "fold_merged": dict(self.fold_merged),
+                "fold_seq": self.fold_seq,
+                "converged": self.converged}
 
     @classmethod
     def from_dict(cls, d: dict) -> "RouteEntry":
@@ -188,6 +208,13 @@ class RouteEntry:
         e.eta_trials = d.get("eta_trials")
         e.result = d.get("result")
         e.history = list(d.get("history") or [])
+        e.shard_of = str(d.get("shard_of") or "")
+        e.shard_index = int(d.get("shard_index", -1))
+        e.shards = list(d.get("shards") or [])
+        e.fold_shards = dict(d.get("fold_shards") or {})
+        e.fold_merged = dict(d.get("fold_merged") or {})
+        e.fold_seq = int(d.get("fold_seq", 0))
+        e.converged = bool(d.get("converged", False))
         return e
 
 
@@ -336,6 +363,14 @@ class Gateway:
         spec's SLO looks feasible against it."""
         if spec.name in self.entries:
             raise ValueError(f"tenant {spec.name!r} already admitted")
+        if spec.shards > 1:
+            # collision check BEFORE the accept record becomes durable:
+            # a refused admission must leave no zombie ledger entry
+            for nm in self._shard_names(spec):
+                if nm in self.entries:
+                    raise ValueError(
+                        f"tenant {spec.name!r}: sub-tenant name {nm!r} "
+                        "already admitted")
         e = RouteEntry(spec, order=len(self.entries), ticket=ticket)
         self._jlog("accept", {"tenant": spec.name,
                               "spec": spec.to_dict(), "ticket": ticket,
@@ -344,6 +379,24 @@ class Gateway:
         obs_trace.tracer().emit(
             "gw_accept", cat="federation", tenant=spec.name,
             order=e.order, slo_s=spec.slo_s)
+        if spec.shards > 1:
+            self._shard_split(e)
+            self._maybe_compact()
+            kids = [self.entries[n] for n in e.shards]
+            dls = [c.deadline_s for c in kids if c.deadline_s is not None]
+            # the sharded campaign finishes when its LAST shard does;
+            # each shard's deadline already reflects only its slice of
+            # the batch space (est_trials on the scaled sub-plan), so
+            # the max is the N-way-parallel finish estimate — not the
+            # solo trajectory overstated by N
+            deadline = max(dls) if dls and len(dls) == len(kids) else None
+            doc = {"tenant": spec.name, "pod": "", "ticket": "",
+                   "shards": list(e.shards), "deadline_s": deadline,
+                   "eta_trials": sum(c.eta_trials or 0.0 for c in kids),
+                   "slo_s": spec.slo_s}
+            doc["slo_ok"] = (None if not spec.slo_s or deadline is None
+                             else deadline <= spec.slo_s)
+            return doc
         loads = self.pod_loads()
         pod = self._pick_pod(loads=loads)
         self._route_to(e, pod, reason="admit", loads=loads)
@@ -482,7 +535,324 @@ class Gateway:
                                reason="refused", from_pod=e.from_pod)
             else:
                 self._mark_done(e, doc)
+        for e in list(self.entries.values()):
+            if e.status == "sharded":
+                self._advance_shards(e)
         self._maybe_compact()
+
+    # --- single-campaign sharding (the merge fold) --------------------------
+    #
+    # One tenant with ``shards: N`` splits into N journaled sub-tenants,
+    # each serving the round-robin stripe {i, i+N, ...} of the parent's
+    # frozen batch-id space (plan.shard_index/shard_count — the
+    # orchestrator re-dispatches on the same frozen per-batch PRNG
+    # keys).  The gateway folds the shards' per-stratum tallies with an
+    # ORDER-FIXED merge (ascending shard index — the psum-vs-shard
+    # invariant integrity.py checks per batch, lifted one level), so
+    # the merged trajectory is bit-identical to the solo run.  Every
+    # fold transition is journaled BEFORE the merge ledger mutates
+    # (shard_split / shard_fold / shard_converged — the same GL201 WAL
+    # contract as routing), which is what makes a mid-merge pod kill
+    # replayable from the gateway WAL: crashcheck sweeps every fold
+    # boundary like every placement boundary.
+
+    def _shard_names(self, spec: TenantSpec) -> list[str]:
+        n, _ceiling, _bs = self._shard_geometry(spec)
+        return [f"{spec.name}+shard{i}" for i in range(n)]
+
+    def _shard_geometry(self, spec: TenantSpec) -> tuple[int, int, int]:
+        """(effective shard count, parent ceiling batches, batch size):
+        the shard count is clamped to the parent's batch ceiling — a
+        stripe with no batch ids would be a zero-work sub-tenant."""
+        plan = spec.plan or {}
+        bs = int(plan.get("batch_size") or 4096)
+        ceiling = max(1, -(-int(est_trials(spec)) // bs))
+        return min(int(spec.shards), ceiling), ceiling, bs
+
+    def _shard_specs(self, spec: TenantSpec) -> list[TenantSpec]:
+        """Derive the sub-tenant specs: shard i of N gets the stripe
+        {i, i+N, ...} below the parent ceiling, and its plan's
+        min/max_trials are BOTH set to the stripe's trial budget — a
+        shard must never self-converge early (the stopping rule runs on
+        the MERGED trajectory at the gateway), and its published ETA /
+        admission deadline then reflect exactly its share of the
+        remaining batch space instead of overstating the sharded
+        campaign's finish time by N×."""
+        n, ceiling, bs = self._shard_geometry(spec)
+        quota = int(spec.quota_batches or 0)
+        out = []
+        for i in range(n):
+            p = dict(spec.plan)
+            p["shard_index"] = i
+            p["shard_count"] = n
+            slice_batches = (ceiling - i + n - 1) // n
+            p["max_trials"] = p["min_trials"] = slice_batches * bs
+            out.append(TenantSpec(
+                name=f"{spec.name}+shard{i}", plan=p,
+                priority=spec.priority, weight=spec.weight,
+                quota_batches=((quota - i + n - 1) // n if quota else 0),
+                submitted_at=spec.submitted_at, slo_s=0.0, shards=1))
+        return out
+
+    def _shard_split(self, e: RouteEntry) -> None:
+        """Journal the split decision, then create + place the
+        sub-tenants.  The record carries the full child specs so replay
+        reconstructs the exact same stripes without re-deriving
+        anything; placement itself goes through the ordinary journaled
+        route→handoff→place protocol per child."""
+        specs = self._shard_specs(e.spec)
+        names = [s.name for s in specs]
+        self._jlog("shard_split", {"tenant": e.spec.name,
+                                   "shards": names,
+                                   "specs": [s.to_dict() for s in specs]})
+        e.status = "sharded"
+        e.shards = names
+        for i, s in enumerate(specs):
+            if s.name in self.entries:
+                continue             # replayed split already built it
+            c = RouteEntry(s, order=len(self.entries))
+            c.shard_of = e.spec.name
+            c.shard_index = i
+            self.entries[s.name] = c
+        obs_trace.tracer().emit(
+            "gw_shard_split", cat="federation", tenant=e.spec.name,
+            shards=len(names))
+        debug.dprintf("Federation", "%s split into %d shards",
+                      e.spec.name, len(names))
+        self._place_shards(e)
+
+    def _place_shards(self, e: RouteEntry) -> int:
+        """Place every still-queued sub-tenant on a live pod hosting no
+        LIVE sibling (distinct pods — the point of sharding is stripe
+        parallelism).  With more shards than free pods the surplus
+        stays queued at the gateway ("accepted", no pod) and lands here
+        again when a sibling finishes — admission never fails on
+        shards > pods.  Failover is deliberately NOT held to the
+        distinct-pod rule (liveness over spread): only this initial/
+        backfill placement is."""
+        placed = 0
+        kids = [self.entries[n] for n in e.shards if n in self.entries]
+        for c in kids:
+            if c.status != "accepted":
+                continue
+            busy = {k.pod for k in kids
+                    if k is not c and k.pod
+                    and k.status in ("routed", "placed", "draining")}
+            cands = [p for p in self.live_pods() if p not in busy]
+            if not cands:
+                continue
+            loads = self.pod_loads()
+            pod = min(cands, key=lambda n: (loads[n]["score"], n))
+            self._route_to(c, pod, reason="shard", loads=loads)
+            placed += 1
+        return placed
+
+    def _shard_report(self, c: RouteEntry, last: dict | None) -> dict:
+        """One sub-tenant's freshest per-lane cumulative counts: the
+        final done-doc when terminal (authoritative), else the hosting
+        pod's published metrics row (``lanes`` — the same live numbers
+        the pod's own stopping rule reads).  Monotone against the last
+        folded report: a shard recovered from pod death resumes from
+        its last checkpoint, which may trail its last published
+        metrics — the fold keeps the deeper prefix (any cumulative
+        snapshot of a frozen-key stripe is exact; deeper is simply
+        closer to done)."""
+        def total(lanes: dict) -> int:
+            return sum(int(v.get("trials") or 0) for v in lanes.values())
+
+        if c.result is not None:
+            res = c.result.get("results") or {}
+            lanes = {lane: {"tallies": row["tallies"],
+                            "trials": row["trials"],
+                            "strata": row.get("strata")}
+                     for lane, row in res.items()}
+            if lanes or not last:
+                return lanes
+            return dict(last)
+        row = None
+        if c.pod and c.pod in self.pods:
+            try:
+                from shrewd_tpu.obs import metrics as obs_metrics
+
+                snap = obs_metrics.read(self.pods[c.pod].outdir)
+                row = (snap.get("tenants") or {}).get(c.spec.name)
+            except (OSError, ValueError):
+                row = None
+        lanes = dict((row or {}).get("lanes") or {})
+        if last and total(last) > total(lanes):
+            return dict(last)
+        return lanes
+
+    def _merged_fold(self, e: RouteEntry, lanes_by_shard: dict) -> dict:
+        """The order-fixed merge + merged stopping evaluation, with the
+        PARENT plan's precision target (``stopping.merged_fold`` — the
+        same rule selection the solo campaign's convergence check
+        applies; lazy import keeps this module jax-free at import)."""
+        from shrewd_tpu.parallel import stopping
+
+        plan = e.spec.plan or {}
+        return stopping.merged_fold(
+            lanes_by_shard, bool(plan.get("stratify")),
+            float(plan.get("confidence") or 0.95),
+            float(plan.get("target_halfwidth") or 0.01),
+            int(plan.get("min_trials") or 0))
+
+    def _expected_lanes(self, plan: dict) -> int:
+        """Lane count of the merged campaign (simpoints × per-simpoint
+        structures + plan-level coherence tiers) — the merged stopping
+        rule may only revoke shard quota once EVERY lane's merged CI is
+        tight, so a lane no shard has started yet must block
+        convergence, not be invisible to it."""
+        sps = len(plan.get("simpoints") or [])
+        per_sp = [s for s in plan.get("structures") or []
+                  if s.split(":", 1)[0] not in ("mesi", "noc")]
+        plan_level = [s for s in plan.get("structures") or []
+                      if s.split(":", 1)[0] in ("mesi", "noc")]
+        return sps * len(per_sp) + len(plan_level)
+
+    def _advance_shards(self, e: RouteEntry) -> None:
+        """One merge-fold pass for one sharded parent: backfill queued
+        shards, fold the freshest per-shard cumulative tallies
+        (journaled BEFORE the merge ledger mutates), evaluate the
+        merged stopping rule, and finalize the parent when every shard
+        is terminal.  Idempotent per poll — a fold with no new trials
+        journals nothing."""
+        if e.status != "sharded":
+            return
+        self._place_shards(e)
+        kids = [self.entries[n] for n in e.shards if n in self.entries]
+        reports = {c.spec.name: self._shard_report(
+            c, e.fold_shards.get(c.spec.name)) for c in kids}
+        merged = self._merged_fold(
+            e, {c.shard_index: reports[c.spec.name] for c in kids})
+        prev = sum(int(m.get("trials") or 0)
+                   for m in e.fold_merged.values())
+        cur = sum(int(m.get("trials") or 0) for m in merged.values())
+        if cur > prev or e.fold_seq == 0:
+            self._jlog("shard_fold", {"tenant": e.spec.name,
+                                      "fold": e.fold_seq + 1,
+                                      "shards": reports,
+                                      "merged": merged})
+            e.fold_shards = reports
+            e.fold_merged = merged
+            e.fold_seq += 1
+            obs_trace.tracer().emit(
+                "gw_shard_fold", cat="federation", tenant=e.spec.name,
+                fold=e.fold_seq, trials=cur)
+            debug.dprintf("Federation", "%s fold %d: %d merged trials",
+                          e.spec.name, e.fold_seq, cur)
+        if not e.converged:
+            m = e.fold_merged
+            if m and len(m) >= self._expected_lanes(e.spec.plan or {}) \
+                    and all(v.get("converged") for v in m.values()):
+                # the merged trajectory satisfies the until-CI stopping
+                # rule on every lane: journal the verdict, then revoke
+                # what remains.  Late-arriving shard trials past this
+                # fold stay honest — they are valid frozen-key trials
+                # the final merge simply includes, exactly like the
+                # pipelined engine's honest late stop.
+                self._jlog("shard_converged", {"tenant": e.spec.name,
+                                               "fold": e.fold_seq})
+                e.converged = True
+                obs_trace.tracer().emit(
+                    "gw_shard_converged", cat="federation",
+                    tenant=e.spec.name, fold=e.fold_seq, trials=cur)
+                debug.dprintf("Federation",
+                              "%s converged at fold %d (%d trials)",
+                              e.spec.name, e.fold_seq, cur)
+        if e.converged:
+            for c in kids:
+                if c.status == "accepted":
+                    # a queued surplus shard never reached any pod: its
+                    # revocation is a pure gateway decision
+                    self._mark_done(c, {
+                        "tenant": c.spec.name, "status": "pruned",
+                        "rc": 4, "trials": 0, "batches": 0,
+                        "results": {}, "reason": "shard-converged"})
+        if kids and all(c.status in TERMINAL for c in kids):
+            self._finalize_shards(e, kids)
+
+    def shard_revocations(self) -> list[tuple[str, str]]:
+        """[(sub-tenant, pod)] whose remaining quota must be revoked on
+        the hosting pod — the merged trajectory converged.  The driver
+        executes these through the pods' journaled ``revoke_quota``
+        seam; the list is re-derived from the ledger every poll, so a
+        crash between the verdict and any revocation replays to the
+        same pending set (pod-side revoke_quota is idempotent)."""
+        out = []
+        for e in self.entries.values():
+            if e.status != "sharded" or not e.converged:
+                continue
+            for n in e.shards:
+                c = self.entries.get(n)
+                if c is not None and c.status in ("routed", "placed") \
+                        and c.pod and c.pod not in self.dead_pods:
+                    out.append((n, c.pod))
+        return out
+
+    @property
+    def folds(self) -> int:
+        """Total shard_fold records across every sharded tenant — the
+        deterministic merge-progress ordinal chaos triggers key on
+        (``partition_during_merge``'s ``at_fold``)."""
+        return sum(e.fold_seq for e in self.entries.values())
+
+    def _finalize_shards(self, e: RouteEntry, kids: list) -> None:
+        """Every shard terminal: build the parent's merged done-doc
+        from each shard's DEEPEST exact evidence — its final done-doc
+        or, when deeper, its last journaled fold (order-fixed merge of
+        complete runs and revocation-pruned partials — both
+        first-class: a pruned shard's tallies are exact cumulative
+        counts over its consumed stripe prefix) and mark the parent
+        done through the ordinary journaled completion path.  The fold
+        ledger can legitimately be AHEAD of a shard's final result: a
+        crash after a ``shard_fold`` record became durable rolls the
+        pod back to older checkpoints, and the replayed convergence
+        verdict then prunes the resumed shard before it recomputes
+        trials the WAL already folded — the journaled fold is exact
+        durable evidence of that deeper prefix, so the final merge
+        keeps it (bit-identity to the undisturbed run is exactly this
+        monotone rule, the one ``_shard_report`` applies live)."""
+        def total(lanes: dict) -> int:
+            return sum(int(v.get("trials") or 0) for v in lanes.values())
+
+        lanes_by_shard = {}
+        for c in kids:
+            res = (c.result or {}).get("results") or {}
+            lanes = {lane: {"tallies": row["tallies"],
+                            "trials": row["trials"],
+                            "strata": row.get("strata")}
+                     for lane, row in res.items()}
+            last = e.fold_shards.get(c.spec.name)
+            if last and total(last) > total(lanes):
+                lanes = dict(last)
+            lanes_by_shard[c.shard_index] = lanes
+        merged = self._merged_fold(e, lanes_by_shard)
+        results = {lane: {"tallies": m["tallies"], "trials": m["trials"],
+                          "avf": m["avf"], "converged": m["converged"],
+                          "strata": m["strata"]}
+                   for lane, m in merged.items()}
+        bad = [c for c in kids if (c.result or {}).get("status")
+               not in ("complete", "pruned")]
+        doc = {
+            "tenant": e.spec.name,
+            "status": ("complete" if not bad
+                       else str((bad[0].result or {}).get("status"))),
+            "rc": (0 if not bad else (bad[0].result or {}).get("rc")),
+            "trials": sum(int(m["trials"]) for m in merged.values()),
+            "batches": sum(int((c.result or {}).get("batches") or 0)
+                           for c in kids),
+            "wall_s": max([float((c.result or {}).get("wall_s") or 0.0)
+                           for c in kids] or [0.0]),
+            "results": results,
+            "shards": {c.spec.name: {
+                "status": (c.result or {}).get("status"),
+                "trials": (c.result or {}).get("trials"),
+                "pod": c.pod} for c in kids},
+            "folds": e.fold_seq,
+            "converged": e.converged,
+        }
+        self._mark_done(e, doc)
 
     # --- migration / failover ----------------------------------------------
 
@@ -671,6 +1041,20 @@ class Gateway:
                                ticket=r.get("ticket", ""))
                 self.entries[e.spec.name] = e
             return
+        if kind == "shard_split":
+            e = self.entries.get(r.get("tenant", ""))
+            if e is not None:
+                e.status = "sharded"
+                e.shards = list(r.get("shards") or [])
+            for i, sd in enumerate(r.get("specs") or []):
+                if sd.get("name") in self.entries:
+                    continue
+                c = RouteEntry(TenantSpec.from_dict(sd),
+                               order=len(self.entries))
+                c.shard_of = str(r.get("tenant") or "")
+                c.shard_index = i
+                self.entries[c.spec.name] = c
+            return
         e = self.entries.get(r.get("tenant", ""))
         if e is None:
             return
@@ -691,6 +1075,15 @@ class Gateway:
         elif kind == "migrate":
             e.migrate_to = str(r.get("to") or "")
             e.status = "draining"
+        elif kind == "shard_fold":
+            # the record IS the fold (journaled before the ledger
+            # mutated): replay restores the exact merge trajectory,
+            # which is what makes a mid-merge kill replayable
+            e.fold_shards = dict(r.get("shards") or {})
+            e.fold_merged = dict(r.get("merged") or {})
+            e.fold_seq = int(r.get("fold", e.fold_seq + 1))
+        elif kind == "shard_converged":
+            e.converged = True
         elif kind == "done":
             e.result = r.get("result")
             e.status = "done"
@@ -707,9 +1100,18 @@ class Gateway:
           handoff landed (repair the ``place`` record), absent means
           re-submit to the journaled pod.  Never a second pod.
         - stranded on a dead pod: re-run the failover pass (idempotent).
+        - sharded parents: an ``accepted`` parent means the accept
+          became durable but the split didn't — perform it now; queued
+          sub-tenants are placed by the fold pass (the distinct-pod
+          rule), never by the plain accepted clause.
         """
         for e in list(self.entries.values()):
             if e.status == "accepted":
+                if e.spec.shards > 1:
+                    self._shard_split(e)
+                    continue
+                if e.shard_of:
+                    continue     # queued surplus shard: the fold pass
                 self._route_to(e, self._pick_pod(), reason="admit")
             elif e.status == "routed" and e.pod in self.pods:
                 # a decided pod no longer in the recovered pod set is
@@ -726,6 +1128,13 @@ class Gateway:
                 else:
                     self._place(e)
         self._failover_stranded()
+        for e in list(self.entries.values()):
+            if e.status == "sharded":
+                # finish any merge the crash interrupted (idempotent:
+                # a fold with no new trials journals nothing, and an
+                # already-journaled convergence only re-derives the
+                # pending revocation set)
+                self._advance_shards(e)
 
     def _live_ticket(self, e: RouteEntry) -> str | None:
         """The decided pod's LIVE ticket for this tenant, or None when

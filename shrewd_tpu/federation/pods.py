@@ -83,6 +83,11 @@ class PodHandle:
         self.dead = False            # kill_pod fired (stepping stops)
         self.partitioned = False     # beats suppressed, still computing
         self.steps = 0
+        # cumulative seconds inside step() (obs.clock — observability
+        # only, GL106: the sharded-speedup evidence compares the solo
+        # run's busy time against the hottest shard pod's; it never
+        # feeds a scheduling decision)
+        self.busy_s = 0.0
 
     @property
     def port(self) -> PodPort:
@@ -104,8 +109,14 @@ class PodHandle:
 
     def step(self):
         """One cooperative scheduler quantum (``None`` / ``IDLE`` / rc)."""
+        from shrewd_tpu.obs import clock as obs_clock
+
         self.steps += 1
-        return self.build().step()
+        t0 = obs_clock.monotonic()
+        try:
+            return self.build().step()
+        finally:
+            self.busy_s += obs_clock.monotonic() - t0
 
     def beat(self) -> None:
         """Renew this pod's liveness lease (atomic heartbeat write).

@@ -37,28 +37,41 @@ METRICS_PROM = "metrics.prom"
 _PROM_NS = "shrewd_fleet"
 
 
-def _convergence(orch) -> tuple[dict, float]:
-    """``({lane: halfwidth}, eta_trials)`` of one tenant's orchestrator —
-    the convergence-distance trajectory, computed by the SAME estimator
-    selection the stopping rule applies (post-stratified when the strata
-    history covers the trials, pooled Wilson otherwise) so the published
-    distance never disagrees with the rule that decides stopping.
-    ``eta_trials`` sums ``stopping.eta_trials`` (the planner's own
-    trials-needed trajectory) over the tenant's lanes — the number the
-    federation gateway routes on: convergence distance, not
-    instantaneous throughput."""
+def _convergence(orch) -> tuple[dict, float, dict]:
+    """``({lane: halfwidth}, eta_trials, {lane: cumulative counts})`` of
+    one tenant's orchestrator — the convergence-distance trajectory,
+    computed by the SAME estimator selection the stopping rule applies
+    (post-stratified when the strata history covers the trials, pooled
+    Wilson otherwise) so the published distance never disagrees with the
+    rule that decides stopping.  ``eta_trials`` sums
+    ``stopping.eta_trials`` (the planner's own trials-needed trajectory)
+    over the tenant's lanes — the number the federation gateway routes
+    on: convergence distance, not instantaneous throughput.  The third
+    element is the per-lane cumulative {tallies, trials, strata} counts
+    — the live numbers the gateway's sharded-merge fold sums across
+    sub-tenants (``stopping.merged_fold``)."""
     from shrewd_tpu.ops import classify as C
     from shrewd_tpu.parallel import stopping
 
     hws = {}
     eta = 0.0
+    lanes = {}
     for (sp, st), s in orch.state.items():
+        lane = f"{sp}/{st}"
+        lanes[lane] = {
+            "tallies": [int(x) for x in s.tallies],
+            "trials": int(s.trials),
+            "strata": (None if s.strata is None else
+                       [[int(x) for x in row] for row in s.strata]),
+        }
         if s.trials <= 0:
             # an unstarted lane still owes its whole min_trials floor
-            eta += float(orch.plan.min_trials)
+            # (bounded by the cap — a sharded sub-tenant's slice may be
+            # smaller than the parent's min_trials floor)
+            eta += float(min(orch.plan.min_trials, orch.plan.max_trials))
             continue
         vul = int(s.tallies[C.OUTCOME_SDC] + s.tallies[C.OUTCOME_DUE])
-        hws[f"{sp}/{st}"] = round(float(stopping.live_halfwidth(
+        hws[lane] = round(float(stopping.live_halfwidth(
             vul, s.trials, s.strata, orch.plan.stratify,
             orch.plan.confidence)), 6)
         if not s.done and not s.converged:
@@ -66,12 +79,20 @@ def _convergence(orch) -> tuple[dict, float]:
             # cap with the CI still wide: it will never run again, so
             # it owes NO further trials — counting its (permanently
             # positive) trajectory distance would leave phantom ETA
-            # mass on the pod and misroute the federation gateway
-            eta += stopping.eta_trials(
-                vul, s.trials, s.strata, orch.plan.stratify,
-                orch.plan.confidence, orch.plan.target_halfwidth,
-                orch.plan.min_trials)
-    return hws, eta
+            # mass on the pod and misroute the federation gateway.
+            # The live trajectory distance is clamped at the remaining
+            # max_trials budget for the same reason: trials past the
+            # cap will never be served, and for a sharded sub-tenant
+            # the remaining budget IS its share of the remaining batch
+            # space — an unclamped trajectory would overstate a sharded
+            # campaign's finish time by the shard count.
+            eta += min(
+                stopping.eta_trials(
+                    vul, s.trials, s.strata, orch.plan.stratify,
+                    orch.plan.confidence, orch.plan.target_halfwidth,
+                    orch.plan.min_trials),
+                max(0.0, float(orch.plan.max_trials) - s.trials))
+    return hws, eta, lanes
 
 
 def snapshot(sched) -> dict:
@@ -100,8 +121,12 @@ def snapshot(sched) -> dict:
             "rc": t.rc,
         }
         if t.orch is not None:
-            hws, eta = _convergence(t.orch)
+            hws, eta, lanes = _convergence(t.orch)
             row["halfwidth"] = hws
+            # per-lane cumulative counts: the gateway's sharded-merge
+            # fold consumes these live (stopping.merged_fold) — tallies
+            # are a few ints per lane, so the snapshot stays small
+            row["lanes"] = lanes
             # the half-width-trajectory ETA: trials still needed to
             # reach the stopping rule's target, plus its projections
             # onto scheduling quanta and wall seconds (the deadline-

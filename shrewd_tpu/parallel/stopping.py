@@ -177,6 +177,61 @@ def live_halfwidth(vulnerable: int, trials: int, strata,
     return wilson(vulnerable, trials, confidence).halfwidth
 
 
+def merged_fold(lanes_by_shard, stratify: bool, confidence: float,
+                target_halfwidth: float, min_trials: int) -> dict:
+    """Order-fixed fold of per-shard cumulative lane reports into the
+    merged campaign trajectory (the federation gateway's single-campaign
+    sharding merge, ``federation/gateway.py``).
+
+    ``lanes_by_shard`` maps shard index → {lane: {"tallies", "trials",
+    "strata"}} where each report is that shard's CUMULATIVE count over
+    its round-robin stripe of the parent's frozen batch-id space.  The
+    fold sums in ascending shard index — int64 tally addition is exact,
+    so the fixed order is what makes the recorded merge trajectory
+    deterministic under WAL replay (the psum-vs-shard invariant
+    ``integrity.py`` checks per batch, lifted to the fleet level).
+    Because shard i of N serves global ids {i, i+N, ...}, a balanced
+    fold (every shard r batches deep) covers exactly the solo prefix
+    {0..rN−1}: the merged tallies are bit-identical to the solo
+    accumulation at the same trial count.
+
+    Returns {lane: {"tallies": [...], "trials", "strata", "halfwidth",
+    "converged"}} — JSON-ready, evaluated with the SAME rule selection
+    as ``live_halfwidth`` so the merged stopping decision is the one the
+    solo campaign's convergence check would have made."""
+    merged: dict = {}
+    has_strata: dict = {}
+    for idx in sorted(lanes_by_shard):
+        for lane, rep in lanes_by_shard[idx].items():
+            m = merged.setdefault(lane, {"tallies": None, "trials": 0,
+                                         "strata": None})
+            t = np.asarray(rep["tallies"], dtype=np.int64)
+            m["tallies"] = t if m["tallies"] is None else m["tallies"] + t
+            m["trials"] += int(rep["trials"])
+            s = rep.get("strata")
+            if s is None:
+                has_strata[lane] = False
+            elif has_strata.setdefault(lane, True):
+                sa = np.asarray(s, dtype=np.int64)
+                m["strata"] = (sa if m["strata"] is None
+                               else m["strata"] + sa)
+    for lane, m in merged.items():
+        strata = (m["strata"].tolist()
+                  if has_strata.get(lane) and m["strata"] is not None
+                  else None)
+        vul = int(m["tallies"][C.OUTCOME_SDC]
+                  + m["tallies"][C.OUTCOME_DUE])
+        hw = live_halfwidth(vul, m["trials"], strata, stratify, confidence)
+        m["tallies"] = m["tallies"].tolist()
+        m["strata"] = strata
+        m["avf"] = vul / max(m["trials"], 1)
+        m["halfwidth"] = hw
+        m["converged"] = bool(m["trials"] > 0
+                              and m["trials"] >= min_trials
+                              and hw <= float(target_halfwidth))
+    return merged
+
+
 # --------------------------------------------------------------------------
 # device mirrors (the device-resident run-until-CI step)
 # --------------------------------------------------------------------------

@@ -70,7 +70,8 @@ class TenantSpec:
 
     def __init__(self, name: str, plan: dict, priority: int = 0,
                  weight: float = 1.0, quota_batches: int = 0,
-                 submitted_at: float = 0.0, slo_s: float = 0.0):
+                 submitted_at: float = 0.0, slo_s: float = 0.0,
+                 shards: int = 1):
         if not name:
             raise ValueError("tenant needs a non-empty name")
         if not float(weight) > 0:
@@ -80,6 +81,8 @@ class TenantSpec:
             raise ValueError(f"tenant {name!r}: quota_batches must be >= 0")
         if float(slo_s) < 0:
             raise ValueError(f"tenant {name!r}: slo_s must be >= 0")
+        if int(shards) < 1:
+            raise ValueError(f"tenant {name!r}: shards must be >= 1")
         self.name = str(name)
         self.plan = dict(plan)
         self.priority = int(priority)
@@ -92,6 +95,14 @@ class TenantSpec:
         #: rebalancing migrations; schedulers never consume it (no
         #: wall clock enters any scheduling decision)
         self.slo_s = float(slo_s)
+        #: single-campaign sharding degree (federation/gateway.py): the
+        #: gateway splits the plan's frozen batch-id space round-robin
+        #: across ``shards`` journaled sub-tenants on distinct pods and
+        #: folds their tallies bit-identically to the solo run; 1 (the
+        #: default) is byte-for-byte the unsharded path.  Plain pod
+        #: schedulers ignore the field — sub-tenant specs always carry
+        #: shards=1 (the split happens once, at the gateway).
+        self.shards = int(shards)
 
     def build_plan(self):
         from shrewd_tpu.campaign.plan import CampaignPlan
@@ -103,7 +114,7 @@ class TenantSpec:
                 "priority": self.priority, "weight": self.weight,
                 "quota_batches": self.quota_batches,
                 "submitted_at": self.submitted_at,
-                "slo_s": self.slo_s}
+                "slo_s": self.slo_s, "shards": self.shards}
 
     @classmethod
     def from_dict(cls, d: dict) -> "TenantSpec":
@@ -112,7 +123,8 @@ class TenantSpec:
                    weight=d.get("weight", 1.0),
                    quota_batches=d.get("quota_batches", 0),
                    submitted_at=d.get("submitted_at", 0.0),
-                   slo_s=d.get("slo_s", 0.0))
+                   slo_s=d.get("slo_s", 0.0),
+                   shards=d.get("shards", 1))
 
 
 class SubmissionQueue:

@@ -1023,10 +1023,21 @@ class CampaignScheduler:
                     "converged": bool(r.converged),
                     "strata": strata_of(sp, st)}
         else:
+            # partial cumulative state (preempted / pruned mid-run):
+            # the tallies are exact counts over the consumed batch
+            # prefix, so the AVF is exact too — a revocation-pruned
+            # shard's done-doc is first-class provenance in the
+            # gateway's sharded merge, never a null to be re-derived
+            from shrewd_tpu.ops import classify as C
+
             for (sp, st), s in t.orch.state.items():
+                vul = int(s.tallies[C.OUTCOME_SDC]
+                          + s.tallies[C.OUTCOME_DUE])
                 out[f"{sp}/{st}"] = {
                     "tallies": s.tallies.tolist(),
-                    "trials": int(s.trials), "avf": None,
+                    "trials": int(s.trials),
+                    "avf": (vul / int(s.trials) if s.trials > 0
+                            else None),
                     "converged": bool(s.converged),
                     "strata": (s.strata.tolist()
                                if s.strata is not None else None)}

@@ -482,6 +482,214 @@ def test_gateway_crashcheck_sweep(tmp_path):
     assert doc["boundaries_by_event"].get("append", 0) >= 3
 
 
+# --- single-campaign sharding (the merge fold) ------------------------------
+
+def test_shard_chaos_kinds_validation():
+    # required trigger vocabulary
+    with pytest.raises(ChaosPlanError, match="at_tick / at_round"):
+        ChaosEngine({"faults": [{"kind": "kill_shard",
+                                 "shard": "t+shard0"}]})
+    with pytest.raises(ChaosPlanError, match="at_fold"):
+        ChaosEngine({"faults": [{"kind": "partition_during_merge"}]})
+    # per-kind vocab: an id key outside the kind's vocabulary is a plan
+    # error, not a silently-dead trigger
+    with pytest.raises(ChaosPlanError, match="does not take 'at_batch'"):
+        ChaosEngine({"faults": [{"kind": "kill_shard", "at_tick": 1,
+                                 "at_batch": 2}]})
+    with pytest.raises(ChaosPlanError, match="does not take 'at_round'"):
+        ChaosEngine({"faults": [{"kind": "partition_during_merge",
+                                 "at_fold": 1, "at_round": 2}]})
+    with pytest.raises(ChaosPlanError, match="rounds"):
+        ChaosEngine({"faults": [{"kind": "partition_during_merge",
+                                 "at_fold": 1, "rounds": 0}]})
+
+
+def test_shard_chaos_hooks_fire_deterministically():
+    eng = ChaosEngine({"faults": [
+        {"kind": "kill_shard", "shard": "camp+shard1", "at_round": 3},
+        {"kind": "partition_during_merge", "pod": "p1", "at_fold": 2,
+         "rounds": 3}]})
+    killed = []
+    eng.kill_action = lambda rc: killed.append(rc)
+    eng.maybe_kill_shard("camp+shard0", round=3)   # wrong shard: no fire
+    eng.maybe_kill_shard("camp+shard1", round=2)   # wrong round: no fire
+    assert not killed
+    eng.maybe_kill_shard("camp+shard1", round=3)
+    assert killed == [137]
+    eng.maybe_kill_shard("camp+shard1", round=3)   # consumed: fires once
+    assert killed == [137]
+    # merge partition: inert until the journaled fold ordinal reaches
+    # at_fold, then a round-counted window [r0, r0+rounds) on the pod
+    assert not eng.partition_merge_active("p1", folds=1, round=4)
+    assert eng.partition_merge_active("p1", folds=2, round=5)   # opens
+    assert eng.partition_merge_active("p1", folds=7, round=7)
+    assert not eng.partition_merge_active("p1", folds=7, round=8)
+    assert not eng.partition_merge_active("p0", folds=9, round=6)
+    assert eng.injected == {"kill_shard": 1,
+                            "partition_during_merge": 1}
+    # federation kinds are never armed by batch arming
+    eng2 = ChaosEngine({"faults": [
+        {"kind": "kill_shard", "shard": "s", "at_round": 0},
+        {"kind": "partition_during_merge", "pod": "p", "at_fold": 0}]})
+    eng2.begin_batch(0)
+    assert eng2._armed == {}
+
+
+def test_federation_sharded_campaign_bit_identical(tmp_path):
+    # the tentpole pin: ONE campaign striped across three pods
+    # (shards: 3 — round-robin partition of the frozen batch-id
+    # space), merged at the gateway with the order-fixed fold, final
+    # tallies bit-identical to the solo serial run
+    plan = _plan(3, n_batches=6)
+    solo = _solo_tallies(plan)
+    fed = Federation(str(tmp_path / "fed"),
+                     pod_names=("pod0", "pod1", "pod2"))
+    doc = fed.submit(TenantSpec(name="camp", plan=plan.to_dict(),
+                                shards=3))
+    # admission reports the split, and the ETA is the campaign's own
+    # trial budget — not overstated by N× (each shard owes its slice)
+    assert doc["shards"] == [f"camp+shard{i}" for i in range(3)]
+    assert doc["eta_trials"] == pytest.approx(192.0)
+    assert fed.serve() == 0
+    e = fed.gateway.entries["camp"]
+    assert e.status == "done" and e.converged
+    assert e.result["status"] == "complete" and e.result["rc"] == 0
+    assert e.result["trials"] == 192 and e.result["folds"] >= 1
+    _assert_matches(fed, "camp", solo)
+    # the stripes ran on three DISTINCT pods
+    kids = [fed.gateway.entries[n] for n in e.shards]
+    assert len({c.history[0]["pod"] for c in kids}) == 3
+    # convergence revoked every shard's remaining quota through the
+    # journaled seam; no orphan sub-tenants linger in any pod ledger
+    for pod in fed.pods.values():
+        if pod.sched is None:
+            continue
+        assert not [t for t in pod.sched.tenants.values()
+                    if t.status in ("queued", "running")]
+    # the speedup evidence the CI artifact pins: per-pod busy seconds
+    assert set(fed.counters()["busy_s"]) == {"pod0", "pod1", "pod2"}
+
+
+def test_federation_shards_one_is_unsharded(tmp_path):
+    # degenerate shards: 1 — byte-for-byte the unsharded path: same
+    # ledger shape, same WAL record kinds, no "+shard" sub-tenants
+    from shrewd_tpu.federation.gateway import gateway_journal_path
+    from shrewd_tpu.service.journal import FleetJournal
+
+    plan = _plan(3, n_batches=4)
+    solo = _solo_tallies(plan)
+    kinds = {}
+    for tag, spec in (("sharded1", TenantSpec(name="t", plan=plan.to_dict(),
+                                              shards=1)),
+                      ("plain", TenantSpec(name="t", plan=plan.to_dict()))):
+        root = str(tmp_path / tag)
+        fed = Federation(root, pod_names=("pod0", "pod1"))
+        fed.submit(spec)
+        assert fed.serve() == 0
+        _assert_matches(fed, "t", solo)
+        assert list(fed.gateway.entries) == ["t"]
+        e = fed.gateway.entries["t"]
+        assert e.shards == [] and e.fold_seq == 0
+        records, _torn, _valid = FleetJournal.replay_path(
+            gateway_journal_path(os.path.join(root, "gateway")))
+        kinds[tag] = [r["kind"] for r in records]
+    assert kinds["sharded1"] == kinds["plain"]
+    assert "shard_split" not in kinds["sharded1"]
+
+
+def test_federation_shards_exceed_pods_queue_surplus(tmp_path):
+    # shards > pods: the surplus stays queued at the gateway (never
+    # refused) and backfills as siblings finish; the merge still folds
+    # every stripe and stays bit-identical
+    plan = _plan(3, n_batches=4)
+    solo = _solo_tallies(plan)
+    fed = Federation(str(tmp_path / "fed"), pod_names=("pod0", "pod1"))
+    doc = fed.submit(TenantSpec(name="camp", plan=plan.to_dict(),
+                                shards=4))
+    assert len(doc["shards"]) == 4
+    e = fed.gateway.entries["camp"]
+    kids = [fed.gateway.entries[n] for n in e.shards]
+    assert len([c for c in kids if c.status == "placed"]) == 2
+    assert len([c for c in kids if c.status == "accepted"]) == 2
+    assert fed.serve() == 0
+    assert all(c.status == "done" for c in kids)
+    assert e.result["trials"] == 128
+    _assert_matches(fed, "camp", solo)
+    for pod in fed.pods.values():
+        if pod.sched is None:
+            continue
+        assert not [t for t in pod.sched.tenants.values()
+                    if t.status in ("queued", "running")]
+
+
+def test_federation_kill_shard_failover_bit_identical(tmp_path):
+    # shard death is not a new failure mode: kill_shard addresses the
+    # pod by the SUB-TENANT it hosts, the supervisor's lease expires,
+    # and the stripe fails over drain-here/recover-there exactly like
+    # any tenant (PR-13 machinery) — merged tallies stay bit-identical
+    plan = _plan(3, n_batches=6)
+    solo = _solo_tallies(plan)
+    chaos = ChaosEngine({"faults": [
+        {"kind": "kill_shard", "shard": "camp+shard1", "at_round": 2}]})
+    fed = Federation(str(tmp_path / "fed"),
+                     pod_names=("pod0", "pod1", "pod2"),
+                     chaos=chaos, expiry_rounds=2)
+    fed.submit(TenantSpec(name="camp", plan=plan.to_dict(), shards=3))
+    assert fed.serve() == 0
+    assert chaos.injected == {"kill_shard": 1}
+    assert chaos.survived == {"kill_shard": 1}
+    assert len(fed.gateway.dead_pods) == 1
+    assert fed.failovers >= 1
+    _assert_matches(fed, "camp", solo)
+    # the killed stripe moved off the dead pod and finished elsewhere
+    dead = next(iter(fed.gateway.dead_pods))
+    c = fed.gateway.entries["camp+shard1"]
+    assert any(h["pod"] == dead for h in c.history)
+    assert c.pod != dead and c.status == "done"
+
+
+def test_federation_partition_during_merge_bit_identical(tmp_path):
+    # a pod partitions exactly while the merge is in flight (at_fold
+    # keys on the journaled fold ordinal): its stripe fails over, the
+    # partition heals, the stale placement is fenced — and the merged
+    # trajectory still folds to the solo tallies (enough batches per
+    # stripe that the campaign outlives the window and sees the heal)
+    plan = _plan(3, n_batches=9)
+    solo = _solo_tallies(plan)
+    chaos = ChaosEngine({"faults": [
+        {"kind": "partition_during_merge", "pod": "pod2", "at_fold": 1,
+         "rounds": 3}]})
+    fed = Federation(str(tmp_path / "fed"),
+                     pod_names=("pod0", "pod1", "pod2"),
+                     chaos=chaos, expiry_rounds=2)
+    fed.submit(TenantSpec(name="camp", plan=plan.to_dict(), shards=3))
+    assert fed.serve() == 0
+    assert chaos.injected == {"partition_during_merge": 1}
+    assert chaos.survived == {"partition_during_merge": 1}
+    assert "pod2" not in fed.gateway.dead_pods    # healed, not dead
+    e = fed.gateway.entries["camp"]
+    assert e.result["converged"] is True
+    _assert_matches(fed, "camp", solo)
+
+
+def test_gateway_sharded_crashcheck_sweep(tmp_path):
+    # the merge-ledger durability pin: recover the federation from
+    # EVERY gateway-WAL boundary of a SHARDED run — including each
+    # shard_split / shard_fold / shard_converged append and its torn
+    # variant — and require bit-identical merged tallies at each
+    plans = crashcheck.small_fleet_plans(seeds=(3,), n_batches=4)
+    doc = crashcheck.run_gateway_crashcheck(
+        str(tmp_path / "sweep"), plans=plans,
+        pod_names=("pod0", "pod1"), shards={"t0": 2})
+    assert doc["ok"] is True and doc["failures"] == []
+    assert doc["shards"] == {"t0": 2}
+    by_kind = doc["boundaries_by_kind"]
+    assert by_kind.get("shard_split", 0) >= 1
+    assert by_kind.get("shard_fold", 0) >= 1
+    assert by_kind.get("shard_converged", 0) >= 1
+    assert doc["torn_checks"] >= 3
+
+
 # --- the thin HTTP front ----------------------------------------------------
 
 def test_http_front_submit_and_status(tmp_path):

@@ -605,7 +605,9 @@ def test_repo_journal_kinds_are_exhaustive():
         "tenant_kill", "revoke", "evict", "shutdown", "recover",
         # the federation gateway's routing ledger
         "gw_config", "accept", "route", "place", "migrate",
-        "pod_dead", "pod_heal", "done", "gw_shutdown", "gw_recover"}
+        "pod_dead", "pod_heal", "done", "gw_shutdown", "gw_recover",
+        # the gateway's sharded-merge ledger (single-campaign sharding)
+        "shard_split", "shard_fold", "shard_converged"}
     assert set(appended) == handled
 
 
