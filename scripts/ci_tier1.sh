@@ -540,6 +540,128 @@ print(f"sharded-federation gate: 3 shards x 5 pods, kill_shard + "
       f"0 divergent) -> FED_SHARD_r13.json + CRASH_r13.json")
 FED_SHARD_GATE
 
+# Streaming-ingest gate (FATAL): binary in, Pareto out.  A raw
+# workload ELF (workloads/sort.c built by the ingest toolchain) is
+# POSTed over the HTTP front as a binary-carrying TenantSpec; the
+# federation claims it from the spool, runs the journaled ingest
+# pipeline (capture -> lift -> liveness -> simpoint -> window) into
+# the federation's digest-keyed artifact store, and serves the
+# campaign to completion.  The tallies must be BIT-IDENTICAL to the
+# same store windows submitted as a pre-lifted plan, and a
+# resubmission of the same (binary, axes) over the same store must
+# warm-start with 0 lifts / 0 captures.  The federation is then
+# crash-swept across the ENTIRE ingest/store durability surface —
+# every ingest-WAL append and artifact-store rename, plus torn-WAL-
+# tail and payload-rot variants — with 0 divergent recoveries.
+# Results -> INGEST_r14.json.  FATAL: this is the PR-17 acceptance
+# pin.  Skipped (non-fatally) when the host toolchain is absent.
+if command -v gcc >/dev/null && command -v objdump >/dev/null; then
+timeout -k 10 560 env JAX_PLATFORMS=cpu python - <<'INGEST_GATE' \
+  || { echo "FATAL: streaming-ingest gate failed (binary path diverged from plan path, resubmission re-lifted, or an ingest/store crash point did not recover bit-identically)"; exit 1; }
+import base64, json, os, tempfile, urllib.request
+import numpy as np
+from shrewd_tpu.analysis import crashcheck
+from shrewd_tpu.federation import Federation, GatewayHTTPFront
+from shrewd_tpu.ingest import ArtifactStore, IngestPipeline, data_digest
+from shrewd_tpu.ingest import hostdiff
+from shrewd_tpu.service import TenantSpec
+
+AXES = {"interval": 1500, "k": 2, "max_steps": 20000}
+PLAN = {"structures": ["regfile"], "batch_size": 16, "max_trials": 32,
+        "min_trials": 32, "target_halfwidth": 0.5, "seed": 3}
+
+data = open(hostdiff.build_tools("workloads/sort.c").workload, "rb").read()
+digest = data_digest(data)
+bin_kw = {"binary_b64": base64.b64encode(data).decode(),
+          "binary_digest": digest, "ingest": AXES}
+root = tempfile.mkdtemp(prefix="ingest_gate_")
+
+def lifts(fed):
+    pods = [p.sched for p in fed.pods.values() if p.sched is not None]
+    return (sum(s.ingest_captures for s in pods),
+            sum(s.ingest_lifts for s in pods))
+
+# binary in, over the wire: POST /submit -> spool -> ingest -> campaign
+front = GatewayHTTPFront(os.path.join(root, "gateway"), port=0).start()
+try:
+    spec = TenantSpec(name="bin0", plan=PLAN, **bin_kw)
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{front.port}/submit",
+        data=json.dumps(spec.to_dict()).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=30) as r:
+        assert json.load(r)["tenant"] == "bin0"
+finally:
+    front.stop()
+fed = Federation(root, pod_names=("pod0", "pod1"))
+assert fed.serve() == 0, "binary-in federation did not converge"
+bt = fed.tenant_tallies("bin0")
+cold_captures, cold_lifts = lifts(fed)
+assert cold_captures == 1 and cold_lifts >= 2, (cold_captures, cold_lifts)
+
+# the pre-lifted plan path over the SAME store windows
+store = ArtifactStore(os.path.join(root, "store"))
+probe = IngestPipeline(os.path.join(root, "probe"), store, digest,
+                       axes=AXES)
+probe.run()
+assert (probe.captures, probe.lifts) == (0, 0), "probe was not warm"
+fed2 = Federation(os.path.join(root, "planfed"), pod_names=("pod0",))
+fed2.submit(TenantSpec(name="plan0", plan=probe.resolved_plan(PLAN)))
+assert fed2.serve() == 0
+pt = fed2.tenant_tallies("plan0")
+assert bt.keys() == pt.keys() and len(bt) > 0
+for k in bt:
+    np.testing.assert_array_equal(np.asarray(bt[k]), np.asarray(pt[k]))
+
+# resubmission of the same (binary, axes) against the same store:
+# a pure O(1) warm start — zero captures, zero lifts, same tallies
+fed3 = Federation(os.path.join(root, "refed"), pod_names=("pod0",),
+                  store_dir=os.path.join(root, "store"))
+fed3.submit(TenantSpec(name="bin1", plan=PLAN, **bin_kw))
+assert fed3.serve() == 0
+assert lifts(fed3) == (0, 0), f"resubmission re-ingested: {lifts(fed3)}"
+rt = fed3.tenant_tallies("bin1")
+for k in bt:
+    np.testing.assert_array_equal(np.asarray(bt[k]), np.asarray(rt[k]))
+
+# the full ingest/store durability surface, exhaustively: every
+# ingest-WAL append + artifact-store rename (+ torn/rot variants)
+sweep = crashcheck.run_gateway_crashcheck(
+    os.path.join(root, "sweep"),
+    plans={"b0": dict(PLAN, batch_size=8, max_trials=8, min_trials=8)},
+    binaries={"b0": bin_kw},
+    point_filter=lambda pt: (pt.kind or "").startswith(("ingest",
+                                                        "store")))
+assert sweep["ok"], sweep["failures"][:3]
+bk = sweep["boundaries_by_kind"]
+assert bk.get("ingest_stage", 0) >= 5 and bk.get("ingest_done", 0) >= 1
+assert bk.get("store_payload", 0) >= 4, bk
+with open("INGEST_r14.json", "w") as f:
+    json.dump({
+        "binary": {"workload": "workloads/sort.c", "sha256": digest,
+                   "bytes": len(data)},
+        "axes": AXES,
+        "cold": {"captures": cold_captures, "lifts": cold_lifts,
+                 "windows": len(bt) // len(PLAN["structures"])},
+        "bit_identical_vs_plan_path": True,
+        "resubmit": {"captures": 0, "lifts": 0,
+                     "bit_identical": True},
+        "ingest_crashcheck": {k: sweep[k] for k in (
+            "points", "points_selected", "points_checked", "checks",
+            "torn_checks", "boundaries_by_kind", "ok")},
+    }, f, indent=1)
+    f.write("\n")
+print(f"streaming-ingest gate: sort.c ({len(data)} bytes) over HTTP -> "
+      f"{cold_captures} capture / {cold_lifts} lifts / "
+      f"{len(bt)} cells, bit-identical to the plan path; resubmit "
+      f"warm-started at 0 lifts; ingest/store sweep "
+      f"{sweep['points_checked']} boundaries ({sweep['checks']} "
+      f"recoveries, 0 divergent) -> INGEST_r14.json")
+INGEST_GATE
+else
+  echo "WARNING: streaming-ingest gate skipped (no host toolchain)"
+fi
+
 # Non-fatal bench smoke: bench.py --quick includes the serial-vs-
 # pipelined campaign-loop microbenchmark (now surfacing the PerfStats
 # overlap ledger — host/device-wait/device-step seconds, depth HWM),

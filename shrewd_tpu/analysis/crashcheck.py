@@ -107,9 +107,16 @@ def snapshot_tree(src: str, dst: str) -> None:
     for root, _dirs, files in os.walk(dst):
         for name in files:
             if name in NON_DURABLE or name.endswith(".tmp") \
+                    or ".tmp." in name or name == ".lock" \
                     or name.startswith("hb_"):
                 # heartbeats are unsynced liveness signals (their loss
-                # on crash IS the signal) — recovery must not read them
+                # on crash IS the signal) — recovery must not read
+                # them; ``.tmp.``-infixed scratch (artifact-store
+                # staging, ingest scratch captures) is pre-rename and
+                # non-durable by construction; a ``.lock`` is the
+                # store's single-flight guard, which a crash orphans
+                # and the reaper must handle WITHOUT the file
+                # surviving into the snapshot as live state
                 os.unlink(os.path.join(root, name))
 
 
@@ -390,7 +397,16 @@ class GatewayRecorder(DurabilityRecorder):
         gateway_owned = parts[0] == "gateway"
         handoff = (parts[0] == "pods" and len(parts) >= 4
                    and parts[2] == "spool" and parts[3] == "pending")
-        if not (gateway_owned or handoff):
+        # the streaming-ingest crash surface: the federation-shared
+        # artifact store (payload/doc renames at <root>/store) and each
+        # tenant's per-pod ingest WAL (appends at
+        # pods/<pod>/tenants/<t>/ingest/) — recovery from any of these
+        # must resume the pipeline mid-flight from the last durable
+        # stage with bit-identical downstream windows
+        store_owned = parts[0] == "store"
+        ingest_wal = (parts[0] == "pods" and "tenants" in parts
+                      and "ingest" in parts)
+        if not (gateway_owned or handoff or store_owned or ingest_wal):
             return
         idx = len(self.points)
         snap = os.path.join(self.points_dir, f"{idx:04d}")
@@ -419,7 +435,9 @@ def _placements(root: str, pod_names, tenants) -> dict:
 
 def check_gateway_point(point: CrashPoint, scratch: str, plans: dict,
                         pod_names, baseline: dict, torn: bool = False,
-                        shards: dict | None = None) -> dict:
+                        shards: dict | None = None,
+                        binaries: dict | None = None,
+                        tear: tuple | None = None) -> dict:
     """Re-execute federation recovery from one gateway crash point:
     copy the snapshot, optionally tear the gateway WAL's last record,
     ``Federation.recover()`` (gateway replay + placement repair +
@@ -436,6 +454,7 @@ def check_gateway_point(point: CrashPoint, scratch: str, plans: dict,
     from shrewd_tpu.service.queue import TenantSpec
 
     shards = shards or {}
+    binaries = binaries or {}
     shutil.copytree(point.snapshot, scratch)
     if torn and not tear_journal_tail(
             scratch, jpath=gateway_journal_path(
@@ -443,14 +462,35 @@ def check_gateway_point(point: CrashPoint, scratch: str, plans: dict,
         shutil.rmtree(scratch, ignore_errors=True)
         return {**point.label(), "torn": True, "skipped": True,
                 "ok": True}
-    result = {**point.label(), "torn": torn, "ok": False}
+    if tear is not None:
+        # the ingest crash surface's damage variants: a torn ingest-WAL
+        # tail (power loss mid-append) or a torn store payload (the
+        # rename landed, the content didn't survive) — recovery must
+        # fall back to the previous durable stage / re-lift, never
+        # diverge
+        from shrewd_tpu.chaos import tear_file
+
+        mode, rel = tear
+        tgt = os.path.join(scratch, rel)
+        ok_tear = os.path.exists(tgt) and os.path.getsize(tgt) > 0 and (
+            tear_journal_tail(scratch, jpath=tgt) if mode == "journal"
+            else (tear_file(tgt, 0.5) or True))
+        if not ok_tear:
+            shutil.rmtree(scratch, ignore_errors=True)
+            return {**point.label(), "torn": True, "tear": list(tear),
+                    "skipped": True, "ok": True}
+    result = {**point.label(), "torn": torn or tear is not None,
+              "ok": False}
+    if tear is not None:
+        result["tear"] = list(tear)
     try:
         fed = Federation.recover(scratch, pod_names=tuple(pod_names))
         for name, plan in plans.items():
             if name not in fed.gateway.entries:
                 fed.gateway.admit(TenantSpec(
                     name=name, plan=plan,
-                    shards=int(shards.get(name, 1))))
+                    shards=int(shards.get(name, 1)),
+                    **binaries.get(name, {})))
         rc = fed.serve()
         got = _fed_tallies(fed, plans)
         probe = sorted(
@@ -478,25 +518,41 @@ def check_gateway_point(point: CrashPoint, scratch: str, plans: dict,
 def run_gateway_crashcheck(workdir: str, plans: dict | None = None,
                            pod_names=("pod0", "pod1"), torn: bool = True,
                            max_points: int | None = None,
-                           shards: dict | None = None) -> dict:
+                           shards: dict | None = None,
+                           binaries: dict | None = None,
+                           point_filter=None) -> dict:
     """The gateway-WAL sweep (see section comment).  ``shards`` maps
     tenant name -> shard count (``TenantSpec.shards``): those tenants
     run split across pods and the sweep covers the merge ledger's
     durability boundaries — every ``shard_split`` / ``shard_fold`` /
-    ``shard_converged`` append plus torn-tail variants.  Returns the
-    machine-readable report; ``report["ok"]`` is the gate bit."""
+    ``shard_converged`` append plus torn-tail variants.  ``binaries``
+    maps tenant name -> ``{binary_b64, binary_digest, ingest}``
+    TenantSpec fields: those tenants submit a RAW BINARY and the sweep
+    grows the streaming-ingest crash surface — every ingest-WAL append
+    and artifact-store rename becomes a crash point, ingest-WAL appends
+    get torn-tail variants, and store-payload renames get
+    torn-payload variants (recovery must resume mid-pipeline from the
+    last durable stage / silently re-lift, with final tallies
+    bit-identical to the undisturbed run).  ``point_filter`` (a
+    ``CrashPoint -> bool`` callable) narrows the sweep to a chosen
+    surface — e.g. only ingest-WAL appends and store renames — so a
+    test can exhaustively cover ONE seam in bounded time; ``ok`` then
+    certifies every selected point.  Returns the machine-readable
+    report; ``report["ok"]`` is the gate bit."""
     from shrewd_tpu.federation.driver import Federation
     from shrewd_tpu.service.queue import TenantSpec
 
     plans = plans if plans is not None else small_fleet_plans(
         seeds=(3, 5))
     shards = shards or {}
+    binaries = binaries or {}
 
     def _run(root):
         fed = Federation(root, pod_names=tuple(pod_names))
         for name, plan in plans.items():
             fed.submit(TenantSpec(name=name, plan=plan,
-                                  shards=int(shards.get(name, 1))))
+                                  shards=int(shards.get(name, 1)),
+                                  **binaries.get(name, {})))
         rc = fed.serve()
         return fed, rc
 
@@ -517,6 +573,9 @@ def run_gateway_crashcheck(workdir: str, plans: dict | None = None,
             "gateway crashcheck recorded run diverged from baseline — "
             "the recorder must be observation-only")
     points = recorder.points
+    if point_filter is not None:
+        points = [pt for pt in points if point_filter(pt)]
+    selected = len(points)
     dropped = 0
     if max_points is not None and len(points) > max_points:
         dropped = len(points) - max_points
@@ -530,20 +589,43 @@ def run_gateway_crashcheck(workdir: str, plans: dict | None = None,
         scratch = os.path.join(workdir, f"gchk_{pt.index:04d}")
         results.append(check_gateway_point(pt, scratch, plans,
                                            pod_names, baseline,
-                                           shards=shards))
+                                           shards=shards,
+                                           binaries=binaries))
         if torn and pt.event == "append" \
                 and pt.path.startswith("gateway" + os.sep):
             scratch = os.path.join(workdir, f"gchk_{pt.index:04d}_torn")
             results.append(check_gateway_point(
                 pt, scratch, plans, pod_names, baseline, torn=True,
-                shards=shards))
+                shards=shards, binaries=binaries))
+        if torn and pt.event == "append" \
+                and pt.path.endswith(os.sep + "ingest.jsonl"):
+            # torn ingest-WAL tail: the stage record's append lost its
+            # last line — recovery replays the shorter WAL and re-runs
+            # from the previous durable stage
+            scratch = os.path.join(workdir, f"gchk_{pt.index:04d}_torn")
+            results.append(check_gateway_point(
+                pt, scratch, plans, pod_names, baseline,
+                shards=shards, binaries=binaries,
+                tear=("journal", pt.path)))
+        if torn and pt.event == "rename" \
+                and pt.kind == "store_payload":
+            # torn store payload: the artifact's rename is durable but
+            # its bytes are not — get_doc's sha re-verification must
+            # read it as a miss and the pipeline must re-lift
+            scratch = os.path.join(workdir, f"gchk_{pt.index:04d}_rot")
+            results.append(check_gateway_point(
+                pt, scratch, plans, pod_names, baseline,
+                shards=shards, binaries=binaries,
+                tear=("file", pt.path)))
     failures = [r for r in results if not r["ok"]]
     return {
         "tool": "crashcheck-gateway",
         "tenants": sorted(plans),
         "pods": list(pod_names),
         "shards": {n: int(v) for n, v in sorted(shards.items())},
+        "binaries": sorted(binaries),
         "points": len(recorder.points),
+        "points_selected": selected,
         "points_checked": len(points),
         "points_dropped": dropped,
         "checks": len(results),

@@ -128,13 +128,14 @@ debug.register_flag("Chaos", "deterministic fault-injection harness")
 KINDS = ("wedge", "backend_error", "corrupt_tally", "torn_checkpoint",
          "kill_worker", "kill_fleet", "torn_journal", "corrupt_submission",
          "kill_pod", "partition_pod", "kill_shard",
-         "partition_during_merge")
+         "partition_during_merge", "corrupt_binary", "kill_during_lift")
 
 #: kinds whose triggers are NOT batch coordinates (never armed by
 #: ``begin_batch``): checkpoint ordinals and the fleet/federation seams
 _NON_BATCH_KINDS = ("torn_checkpoint", "kill_fleet", "torn_journal",
                     "corrupt_submission", "kill_pod", "partition_pod",
-                    "kill_shard", "partition_during_merge")
+                    "kill_shard", "partition_during_merge",
+                    "corrupt_binary", "kill_during_lift")
 
 #: trigger keys carrying id lists, by kind (fleet/federation kinds +
 #: checkpoint); batch kinds use at_batch / sample / after_dispatches.
@@ -150,10 +151,12 @@ _KIND_TRIGGERS = {
     "partition_pod": ("at_round",),
     "kill_shard": ("at_tick", "at_round"),
     "partition_during_merge": ("at_fold",),
+    "corrupt_binary": ("at_stage",),
+    "kill_during_lift": ("at_stage",),
 }
 
 _ID_KEYS = ("at_batch", "at_ckpt", "at_tick", "at_journal",
-            "at_submission", "at_round", "at_fold")
+            "at_submission", "at_round", "at_fold", "at_stage")
 
 KILL_DEFAULT_RC = 137
 
@@ -626,6 +629,46 @@ class ChaosEngine:
                 return s
         return None
 
+    # --- ingest-pipeline hook points (the journaled streaming ingest) ---
+
+    def take_corrupt_binary(self, stage: int) -> dict | None:
+        """Ingest hook: called at each journaled stage ordinal the
+        pipeline is about to COMPUTE (cached stages never consult it —
+        a warm start has no bytes in flight to rot); returns the spec
+        when this ordinal is scheduled to checksum-rot the submitted
+        binary in the artifact store.  The pipeline then rots the
+        stored ELF itself (``rot_file``) so its per-stage digest
+        re-verification deterministically lands the submission in
+        quarantine at exactly this stage."""
+        for s in self.faults:
+            if s["kind"] != "corrupt_binary" or s["_fires_left"] <= 0:
+                continue
+            if stage in s.get("at_stage", ()):
+                s["_fires_left"] -= 1
+                self._batch = (stage, "ingest", "")
+                self._fire("corrupt_binary", {"stage": stage})
+                debug.dprintf("Chaos", "corrupt_binary (stage=%d)", stage)
+                return s
+        return None
+
+    def maybe_kill_during_lift(self, stage: int) -> None:
+        """Ingest hard-kill seam: ``kill_during_lift`` fires when the
+        pipeline reaches stage ordinal ``at_stage`` with real work to do
+        (the same compute-only consultation as ``take_corrupt_binary``)
+        — the stage's WAL record has NOT landed yet, so recovery must
+        resume from the previous durable stage and re-lift to
+        bit-identical windows."""
+        for s in self.faults:
+            if s["kind"] != "kill_during_lift" or s["_fires_left"] <= 0:
+                continue
+            if stage not in s.get("at_stage", ()):
+                continue
+            s["_fires_left"] -= 1
+            self._batch = (stage, "ingest", "")
+            self._fire("kill_during_lift", {"stage": stage})
+            debug.dprintf("Chaos", "kill_during_lift (stage=%d)", stage)
+            self.kill_now(s.get("rc"))
+
     def take_wedge(self, timeout: float) -> dict | None:
         """Watchdog hook: ``{"fn": wedged, "deadline": s}`` (consumed once
         per armed count), or None.  Only meaningful under a positive
@@ -714,6 +757,18 @@ def tear_file(path: str, keep_fraction: float = 0.5) -> None:
     size = os.path.getsize(path)
     with open(path, "r+b") as f:
         f.truncate(max(int(size * keep_fraction), 1))
+
+
+def rot_file(path: str) -> None:
+    """Corrupt a file the way silent bit-rot would: same length, one
+    byte flipped — content-digest verification (not truncation checks)
+    must catch it.  This is the ``corrupt_binary`` injection: the rotted
+    ELF no longer hashes to its store address, which is poison, not a
+    cache miss."""
+    with open(path, "r+b") as f:
+        b = f.read(1)
+        f.seek(0)
+        f.write(bytes([b[0] ^ 0xFF]) if b else b"\xff")
 
 
 def corrupt_json_checksum(path: str) -> None:

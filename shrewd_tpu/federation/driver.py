@@ -61,6 +61,11 @@ class Federation:
                  **sched_kw):
         self.root = root
         self.coord_dir = os.path.join(root, "coord")
+        # ONE digest-keyed artifact store for the whole federation: a
+        # binary ingested on any pod warm-starts in O(1) on every other
+        # (failover/migration re-runs the tenant's ingest pipeline
+        # against the same store, so re-placement costs zero lifts)
+        sched_kw.setdefault("store_dir", os.path.join(root, "store"))
         self.pods = {
             name: PodHandle(name, os.path.join(root, "pods", name),
                             self.coord_dir, mesh=mesh, **sched_kw)

@@ -13,7 +13,18 @@ Endpoints::
 
     POST /submit     body = TenantSpec JSON (optionally with "slo_s")
                      → 200 {"ticket": ..., "tenant": ...}; the gateway
-                     claims it on its next poll and routes it
+                     claims it on its next poll and routes it.
+                     A RAW BINARY is a valid submission: carry
+                     ``binary_b64`` + ``binary_digest`` (sha256 of the
+                     decoded bytes) + optional ``ingest`` axes, with
+                     ``plan`` holding only scenario axes — the serving
+                     pod runs the journaled ingest pipeline
+                     (capture→lift→liveness→simpoint→window) against
+                     the federation's digest-keyed artifact store and
+                     the campaign starts from the lifted windows; a
+                     poisoned payload (digest mismatch, unparseable
+                     ELF, lift divergence) lands in durable quarantine
+                     with evidence, never a pod death
     GET  /status     → the gateway's persisted snapshot (routing
                      ledger: per-tenant placement/epoch/deadline)
     GET  /healthz    → 200 {"ok": true}

@@ -11,13 +11,18 @@ kernel's initial-state arrays.
 from shrewd_tpu.ingest.cpt import (ArchSnapshot, CheckpointIn, CheckpointOut,
                                    load_arch_snapshot, snapshot_from_capture,
                                    write_arch_snapshot)
+from shrewd_tpu.ingest.pipeline import (DEFAULT_AXES, STAGES, IngestPipeline,
+                                        IngestQuarantine, normalize_axes)
+from shrewd_tpu.ingest.store import ArtifactStore, axes_key, data_digest
 from shrewd_tpu.ingest.configfile import load_config_ini, load_config_json
 from shrewd_tpu.ingest.statsfile import load_stats_txt
 from shrewd_tpu.ingest.warm import (window_from_snapshot,
                                     window_from_snapshot_lifted)
 
 __all__ = [
-    "ArchSnapshot", "CheckpointIn", "CheckpointOut",
+    "ArchSnapshot", "ArtifactStore", "CheckpointIn", "CheckpointOut",
+    "DEFAULT_AXES", "IngestPipeline", "IngestQuarantine", "STAGES",
+    "axes_key", "data_digest", "normalize_axes",
     "load_arch_snapshot", "snapshot_from_capture", "write_arch_snapshot",
     "load_config_ini", "load_config_json", "load_stats_txt",
     "window_from_snapshot", "window_from_snapshot_lifted",

@@ -52,6 +52,51 @@ class BuildPaths(NamedTuple):
     end: int
 
 
+def _build(out: Path, cmd: list[str]) -> None:
+    """mtime-idempotent compile: skip when the output is newer than every
+    source in the command line."""
+    src_mtimes = [Path(c).stat().st_mtime for c in cmd if
+                  c.endswith((".c", ".cc"))]
+    if out.exists() and all(out.stat().st_mtime >= m for m in src_mtimes):
+        return
+    subprocess.run(cmd + ["-o", str(out)], check=True,
+                   capture_output=True, text=True)
+
+
+def build_tracer(build_dir: Path | None = None) -> Path:
+    """Compile the ptrace capture tool alone (idempotent) — the entry
+    the ingest pipeline uses for SUBMITTED binaries, which arrive as ELF
+    bytes with no workload source to build."""
+    bd = build_dir or (REPO / "tests" / "_build")
+    bd.mkdir(parents=True, exist_ok=True)
+    tracer = bd / "nativetrace"
+    _build(tracer, ["g++", "-O2", "-std=c++17",
+                    str(REPO / "tools" / "nativetrace.cc")])
+    return tracer
+
+
+def elf_markers(binary) -> tuple[int, int]:
+    """``(kernel_begin, kernel_end)`` marker addresses via ``nm``.
+
+    Raises ``ValueError`` when the file is not a parseable ELF or lacks
+    the marker symbols — the ingest pipeline's unparseable-submission
+    quarantine trigger, kept loud and typed so the capture stage can
+    tell poison (quarantine) from environment trouble (retry)."""
+    try:
+        nm = subprocess.run(["nm", str(binary)], check=True,
+                            capture_output=True, text=True).stdout
+    except (OSError, subprocess.CalledProcessError) as e:
+        detail = getattr(e, "stderr", "") or str(e)
+        raise ValueError(
+            f"{binary}: not a parseable ELF ({detail.strip()})")
+    syms = {p[2]: int(p[0], 16) for p in
+            (ln.split() for ln in nm.splitlines()) if len(p) == 3}
+    if "kernel_begin" not in syms or "kernel_end" not in syms:
+        raise ValueError(
+            f"{binary}: no kernel_begin/kernel_end marker symbols")
+    return syms["kernel_begin"], syms["kernel_end"]
+
+
 def build_tools(workload_c: str = "workloads/sort.c",
                 build_dir: Path | None = None) -> BuildPaths:
     """Compile the guest workload and both ptrace tools (idempotent)."""
@@ -59,28 +104,13 @@ def build_tools(workload_c: str = "workloads/sort.c",
     bd.mkdir(parents=True, exist_ok=True)
     wl_src = REPO / workload_c
     wl = bd / wl_src.stem
-    tracer = bd / "nativetrace"
     sfi = bd / "hostsfi"
-
-    def _build(out: Path, cmd: list[str]) -> None:
-        src_mtimes = [Path(c).stat().st_mtime for c in cmd if
-                      c.endswith((".c", ".cc"))]
-        if out.exists() and all(out.stat().st_mtime >= m for m in src_mtimes):
-            return
-        subprocess.run(cmd + ["-o", str(out)], check=True,
-                       capture_output=True, text=True)
-
     _build(wl, ["gcc", "-O1", "-static", "-fno-pie", "-no-pie", str(wl_src)])
-    _build(tracer, ["g++", "-O2", "-std=c++17",
-                    str(REPO / "tools" / "nativetrace.cc")])
+    tracer = build_tracer(bd)
     _build(sfi, ["g++", "-O2", "-std=c++17",
                  str(REPO / "tools" / "hostsfi.cc")])
-    nm = subprocess.run(["nm", str(wl)], check=True, capture_output=True,
-                        text=True).stdout
-    syms = {p[2]: int(p[0], 16) for p in
-            (ln.split() for ln in nm.splitlines()) if len(p) == 3}
-    return BuildPaths(wl, tracer, sfi, syms["kernel_begin"],
-                      syms["kernel_end"])
+    begin, end = elf_markers(wl)
+    return BuildPaths(wl, tracer, sfi, begin, end)
 
 
 def _capture(paths: BuildPaths, suffix: str, consume,

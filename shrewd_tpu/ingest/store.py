@@ -1,0 +1,259 @@
+"""Content-digest-keyed artifact store for the streaming ingest pipeline.
+
+Every artifact the ingest pipeline produces — the raw capture, the lifted
+full-window trace, liveness masks, BBV/SimPoint clusters, per-window
+traces and their boundary goldens — is keyed by the CONTENT digest of the
+submitted binary plus a canonical hash of the ingest axes (interval, k,
+seed, max_steps).  A previously-seen ``(digest, axes)`` pair therefore
+starts its campaign in O(1) from the shared store: no capture, no lift,
+no emulation — the terminal ``plan`` document points at window traces
+that are already durable.
+
+Durability discipline (the same contract the WAL tier certifies):
+
+- JSON documents go through ``resilience.write_json_atomic`` (tmp +
+  fsync + rename + dir-fsync) and carry content checksums;
+- binary payloads (captures, ``.npz`` windows) are committed via the
+  same tmp/fsync/rename/dir-fsync sequence, and each owning document
+  records the payload's sha256 — ``get_doc`` re-verifies every byte it
+  vouches for, so a torn or rotted payload reads as a MISS (re-lift),
+  never as silent corruption;
+- a missing/torn/checksum-failed document is also just a miss.  The
+  store never quarantines: "this artifact is unusable, recompute it" is
+  a cache decision.  "this BINARY is not what its digest claims" is
+  poison, and that verdict belongs to the pipeline/queue tier.
+
+Single-flight: two concurrent submissions of the same ``(digest, axes)``
+share one lift through an O_EXCL lock file under the object directory
+(the ``ServerLock`` discipline: pid-stamped, stale locks reaped).  The
+loser waits, then finds the winner's artifacts and warm-starts.
+
+Import discipline: jax-free (pure host-side file work; the pipeline
+that fills the store owns the heavy lifter/emulator imports).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import time
+
+from shrewd_tpu import resilience as resil
+from shrewd_tpu.utils import debug
+
+debug.register_flag("Ingest", "streaming ingest pipeline / artifact store")
+
+#: lock files held by THIS process (``_SingleFlight`` bookkeeping): a
+#: lock on disk stamped with our pid but absent here is the residue of a
+#: chaos kill that unwound without releasing — stale, reap it
+_HELD: set = set()
+
+
+def data_digest(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()
+
+
+def file_digest(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def axes_key(axes: dict | None) -> str:
+    """Canonical short key for an ingest-axes dict (sorted-key JSON →
+    sha256 prefix): the second half of the store address."""
+    blob = json.dumps(dict(axes or {}), sort_keys=True, default=str)
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+class _SingleFlight:
+    """O_EXCL pid-stamped lock on one ``(digest, axes)`` object dir.
+
+    Same reaping posture as ``service.queue.ServerLock``: a dead-pid or
+    unreadable lock is stale and reaped; additionally a lock stamped
+    with OUR pid that this process does not hold in ``_HELD`` is the
+    residue of an in-process chaos kill (the raising ``kill_action``
+    unwound past the release) and is reaped the same way."""
+
+    def __init__(self, path: str, timeout_s: float = 120.0):
+        self.path = path
+        self.timeout_s = timeout_s
+        self._owned = False
+
+    def _stale(self) -> bool:
+        try:
+            with open(self.path) as f:
+                pid = int(f.read().strip() or "0")
+        except (OSError, ValueError):
+            return True
+        if pid == os.getpid():
+            return self.path not in _HELD
+        if pid <= 0:
+            return True
+        try:
+            os.kill(pid, 0)
+        except ProcessLookupError:
+            return True
+        except OSError:
+            return False
+        return False
+
+    def __enter__(self) -> "_SingleFlight":
+        deadline = time.monotonic() + self.timeout_s
+        while True:
+            try:
+                fd = os.open(self.path,
+                             os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            except FileExistsError:
+                if self._stale():
+                    try:
+                        os.unlink(self.path)
+                    except OSError:
+                        pass
+                    continue
+                if time.monotonic() > deadline:
+                    raise RuntimeError(
+                        f"{self.path}: single-flight lock held past "
+                        f"{self.timeout_s}s")
+                time.sleep(0.02)
+                continue
+            try:
+                os.write(fd, f"{os.getpid()}\n".encode())
+            finally:
+                os.close(fd)
+            self._owned = True
+            _HELD.add(self.path)
+            return self
+
+    def __exit__(self, *exc) -> None:
+        if not self._owned:
+            return
+        _HELD.discard(self.path)
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+        self._owned = False
+
+
+class ArtifactStore:
+    """The digest-keyed store (see module doc).
+
+    Layout::
+
+        <root>/bin/<sha256>.elf                  submitted binaries
+        <root>/obj/<sha256>/<axes>/<name>.json   checksummed stage docs
+        <root>/obj/<sha256>/<axes>/<file>        payloads (sha in doc)
+        <root>/obj/<sha256>/<axes>/.lock         single-flight guard
+    """
+
+    def __init__(self, root: str):
+        self.root = os.path.abspath(root)
+        self.bin_dir = os.path.join(self.root, "bin")
+        self.obj_root = os.path.join(self.root, "obj")
+        os.makedirs(self.bin_dir, exist_ok=True)
+        os.makedirs(self.obj_root, exist_ok=True)
+
+    # --- submitted binaries ----------------------------------------------
+
+    def binary_path(self, digest: str) -> str:
+        return os.path.join(self.bin_dir, f"{digest}.elf")
+
+    def put_binary(self, data: bytes) -> str:
+        """Content-address one submitted binary; idempotent (a second
+        submission of the same bytes is a no-op hit)."""
+        digest = data_digest(data)
+        path = self.binary_path(digest)
+        if os.path.exists(path):
+            return digest
+        tmp = path + f".tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.chmod(tmp, 0o755)
+        os.replace(tmp, path)
+        resil.fsync_dir(self.bin_dir)
+        resil.notify_durability("rename", path, kind="store_binary")
+        debug.dprintf("Ingest", "stored binary %s (%d bytes)",
+                      digest[:12], len(data))
+        return digest
+
+    def verify_binary(self, digest: str) -> bool:
+        """Does the stored binary still hash to its address?  False is
+        POISON (rot/tamper), not a cache miss — the caller quarantines."""
+        path = self.binary_path(digest)
+        try:
+            return file_digest(path) == digest
+        except OSError:
+            return False
+
+    # --- object directories ----------------------------------------------
+
+    def obj_dir(self, digest: str, key: str) -> str:
+        d = os.path.join(self.obj_root, digest, key)
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    def payload_path(self, digest: str, key: str, filename: str) -> str:
+        return os.path.join(self.obj_dir(digest, key), filename)
+
+    def commit_payload(self, tmp_path: str, digest: str, key: str,
+                       filename: str) -> str:
+        """Durably move a finished scratch file into the store (fsync →
+        rename → dir-fsync) and return its sha256 for the owning doc."""
+        sha = file_digest(tmp_path)
+        final = self.payload_path(digest, key, filename)
+        with open(tmp_path, "rb") as f:
+            os.fsync(f.fileno())
+        os.replace(tmp_path, final)
+        resil.fsync_dir(os.path.dirname(final))
+        resil.notify_durability("rename", final, kind="store_payload")
+        return sha
+
+    def write_payload(self, digest: str, key: str, filename: str,
+                      data: bytes) -> str:
+        tmp = self.payload_path(digest, key, filename) \
+            + f".tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        return self.commit_payload(tmp, digest, key, filename)
+
+    # --- documents --------------------------------------------------------
+
+    def put_doc(self, digest: str, key: str, name: str,
+                doc: dict) -> None:
+        """Persist one stage document (checksummed, atomic).  ``doc``
+        may carry ``payloads: {filename: sha256}`` — ``get_doc``
+        re-verifies each before vouching for the document."""
+        resil.write_json_atomic(
+            os.path.join(self.obj_dir(digest, key), f"{name}.json"),
+            dict(doc))
+
+    def get_doc(self, digest: str, key: str, name: str) -> dict | None:
+        """Load + verify one stage document AND every payload it
+        records.  ANY failure — missing file, torn JSON, checksum
+        mismatch, rotted payload — is a miss (None): the pipeline
+        recomputes, it never trusts a damaged artifact."""
+        path = os.path.join(self.obj_root, digest, key, f"{name}.json")
+        try:
+            doc = resil.load_json_verified(path)
+        except (OSError, ValueError):
+            return None
+        for filename, sha in (doc.get("payloads") or {}).items():
+            ppath = os.path.join(self.obj_root, digest, key, filename)
+            try:
+                if file_digest(ppath) != sha:
+                    debug.dprintf("Ingest", "payload %s rotted — miss",
+                                  filename)
+                    return None
+            except OSError:
+                return None
+        return doc
+
+    def lock(self, digest: str, key: str) -> _SingleFlight:
+        return _SingleFlight(
+            os.path.join(self.obj_dir(digest, key), ".lock"))

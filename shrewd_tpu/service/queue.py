@@ -71,9 +71,18 @@ class TenantSpec:
     def __init__(self, name: str, plan: dict, priority: int = 0,
                  weight: float = 1.0, quota_batches: int = 0,
                  submitted_at: float = 0.0, slo_s: float = 0.0,
-                 shards: int = 1):
+                 shards: int = 1, binary_b64: str = "",
+                 binary_digest: str = "", ingest: dict | None = None):
         if not name:
             raise ValueError("tenant needs a non-empty name")
+        if bool(binary_b64) != bool(binary_digest):
+            raise ValueError(
+                f"tenant {name!r}: binary_b64 and binary_digest come "
+                f"together (a payload without its claimed digest — or a "
+                f"digest with no payload — cannot be verified)")
+        if ingest and not binary_digest:
+            raise ValueError(f"tenant {name!r}: ingest axes only apply "
+                             f"to a binary-carrying submission")
         if not float(weight) > 0:
             raise ValueError(f"tenant {name!r}: weight must be > 0 "
                              f"(got {weight})")
@@ -103,6 +112,45 @@ class TenantSpec:
         #: schedulers ignore the field — sub-tenant specs always carry
         #: shards=1 (the split happens once, at the gateway).
         self.shards = int(shards)
+        #: binary-in submission (the streaming-ingest path,
+        #: ingest/pipeline.py): the raw workload ELF rides the spec
+        #: base64-encoded with its claimed sha256.  ``plan`` then
+        #: carries only scenario axes (structures, trial counts, seed)
+        #: — the scheduler fills ``simpoints`` from the artifact store
+        #: after the journaled ingest pipeline runs.  ``ingest`` is the
+        #: optional ingest-axes dict (interval/k/seed/...), normalized
+        #: and digest-keyed by the pipeline.
+        self.binary_b64 = str(binary_b64)
+        self.binary_digest = str(binary_digest)
+        self.ingest = dict(ingest) if ingest else None
+
+    def binary_bytes(self) -> bytes:
+        """Decode the carried binary (raises ValueError on bad base64)."""
+        import base64
+        import binascii
+
+        try:
+            return base64.b64decode(self.binary_b64, validate=True)
+        except (binascii.Error, ValueError) as e:
+            raise ValueError(f"tenant {self.name!r}: binary_b64 does "
+                             f"not decode: {e}")
+
+    def verify_binary(self) -> bytes:
+        """Decode AND verify the carried binary against its claimed
+        digest; raises ValueError on any mismatch.  A spec whose payload
+        no longer hashes to its digest is deterministically poisoned
+        (rot or tamper in the spool) — ``claim()`` routes that to
+        ``bad/`` exactly like a checksum-failed document."""
+        import hashlib
+
+        data = self.binary_bytes()
+        got = hashlib.sha256(data).hexdigest()
+        if got != self.binary_digest:
+            raise ValueError(
+                f"tenant {self.name!r}: binary digest mismatch "
+                f"(claimed {self.binary_digest[:12]}, payload hashes "
+                f"to {got[:12]}) — poisoned submission")
+        return data
 
     def build_plan(self):
         from shrewd_tpu.campaign.plan import CampaignPlan
@@ -110,11 +158,19 @@ class TenantSpec:
         return CampaignPlan.from_dict(self.plan)
 
     def to_dict(self) -> dict:
-        return {"name": self.name, "plan": dict(self.plan),
-                "priority": self.priority, "weight": self.weight,
-                "quota_batches": self.quota_batches,
-                "submitted_at": self.submitted_at,
-                "slo_s": self.slo_s, "shards": self.shards}
+        d = {"name": self.name, "plan": dict(self.plan),
+             "priority": self.priority, "weight": self.weight,
+             "quota_batches": self.quota_batches,
+             "submitted_at": self.submitted_at,
+             "slo_s": self.slo_s, "shards": self.shards}
+        # binary fields ride only when set, so plan-only submission
+        # documents stay byte-identical to pre-ingest releases
+        if self.binary_digest:
+            d["binary_b64"] = self.binary_b64
+            d["binary_digest"] = self.binary_digest
+            if self.ingest is not None:
+                d["ingest"] = dict(self.ingest)
+        return d
 
     @classmethod
     def from_dict(cls, d: dict) -> "TenantSpec":
@@ -124,7 +180,10 @@ class TenantSpec:
                    quota_batches=d.get("quota_batches", 0),
                    submitted_at=d.get("submitted_at", 0.0),
                    slo_s=d.get("slo_s", 0.0),
-                   shards=d.get("shards", 1))
+                   shards=d.get("shards", 1),
+                   binary_b64=d.get("binary_b64", ""),
+                   binary_digest=d.get("binary_digest", ""),
+                   ingest=d.get("ingest"))
 
 
 class SubmissionQueue:
@@ -217,6 +276,11 @@ class SubmissionQueue:
                     raise ValueError("checksum mismatch "
                                      "(corrupt submission)")
                 spec = TenantSpec.from_dict(doc)
+                if spec.binary_digest:
+                    # the PR-8 checksum split, applied to the payload: a
+                    # binary that no longer hashes to its claimed digest
+                    # is poison (bad/ + reason), never an in-flight skip
+                    spec.verify_binary()
             except Exception as e:  # noqa: BLE001 — a complete-but-
                 # poisoned document is deterministically bad; quarantine
                 # it so the spool keeps serving

@@ -202,7 +202,7 @@ class CampaignScheduler:
                  idle_exit: bool = True, poll_interval: float = 0.2,
                  on_tick=None, chaos=None, retry_budget: int = 3,
                  backoff_ticks: int = 2, tick_timeout: float = 0.0,
-                 compact_every: int = 64):
+                 compact_every: int = 64, store_dir: str | None = None):
         if policy not in POLICIES:
             raise ValueError(f"unknown policy {policy!r} (one of {POLICIES})")
         if certify and certify not in _CERTIFY_ORDER:
@@ -231,8 +231,16 @@ class CampaignScheduler:
         #: the whole scheduler loop
         self.tick_timeout = float(tick_timeout)
         self.compact_every = max(1, int(compact_every))
+        #: digest-keyed artifact-store root for binary-in submissions
+        #: (ingest/store.py); None = ``<outdir>/store``.  The federation
+        #: threads ONE root through every pod so a binary ingested on
+        #: pod0 warm-starts in O(1) on pod1 after a migration/failover.
+        self.store_dir = store_dir
+        self._store = None
         self.recoveries = 0           # hard-kill recoveries survived
         self.journal_torn = 0         # torn journal records dropped
+        self.ingest_lifts = 0         # windows lifted for binary tenants
+        self.ingest_captures = 0      # host captures run for binary tenants
         self.tenants: dict[str, TenantState] = {}
         self.schedule_log: list[str] = []    # tenant name per tick
         self.ticks = 0
@@ -265,6 +273,21 @@ class CampaignScheduler:
 
             self._mesh = make_mesh()
         return self._mesh
+
+    @property
+    def store(self):
+        """The artifact store for binary-in submissions, built lazily
+        (plan-only fleets never touch it)."""
+        if self._store is None:
+            from shrewd_tpu.ingest.store import ArtifactStore
+
+            root = self.store_dir or (os.path.join(self.outdir, "store")
+                                      if self.outdir else None)
+            if root is None:
+                raise RuntimeError("binary-in submission needs a "
+                                   "store_dir (or an outdir)")
+            self._store = ArtifactStore(root)
+        return self._store
 
     def _build_stats(self) -> None:
         """``campaign.fleet.*`` — the multi-tenant ledger: who ran, how
@@ -326,6 +349,13 @@ class CampaignScheduler:
             lambda: sum(1 for t in self.tenants.values()
                         if t.status == "quarantined"),
             "poison tenants parked in durable quarantine")
+        fg.ingest_lifts = statsmod.Formula(
+            "ingest_lifts", lambda: self.ingest_lifts,
+            "windows lifted for binary-in submissions (0 on a "
+            "digest-store warm start)")
+        fg.ingest_captures = statsmod.Formula(
+            "ingest_captures", lambda: self.ingest_captures,
+            "host captures run for binary-in submissions")
         fg.pruned = statsmod.Formula(
             "pruned",
             lambda: sum(1 for t in self.tenants.values()
@@ -474,7 +504,17 @@ class CampaignScheduler:
         rescoped to the tenant."""
         from shrewd_tpu.campaign.orchestrator import Orchestrator
 
-        plan = t.spec.build_plan()
+        if t.spec.binary_digest:
+            # binary-in submission: run (or resume, or warm-start) the
+            # journaled ingest pipeline first; the resolved plan is an
+            # ordinary pre-lifted plan pointing at store-resident
+            # windows.  IngestQuarantine propagates to _note_failure,
+            # which quarantines immediately (deterministic poison).
+            from shrewd_tpu.campaign.plan import CampaignPlan
+
+            plan = CampaignPlan.from_dict(self._ingest_plan(t))
+        else:
+            plan = t.spec.build_plan()
         if self.certify and (_CERTIFY_ORDER[self.certify]
                              > _CERTIFY_ORDER.get(plan.analysis.certify, 0)):
             # admission-time certification: the fleet's posture tightens
@@ -520,6 +560,30 @@ class CampaignScheduler:
         if t._t_admit is None:
             t._t_admit = obs_clock.monotonic()
         self._rebalance()
+
+    def _ingest_plan(self, t: TenantState) -> dict:
+        """Binary → plan via the journaled streaming pipeline
+        (ingest/pipeline.py).  The pipeline's WAL lives in the tenant's
+        namespace (``tenants/<name>/ingest/``) so it rides checkpoint
+        copies across migration/failover; artifacts land in the SHARED
+        digest-keyed store, so a re-submission of the same binary —
+        here or on any pod over the same store — warm-starts in O(1)
+        with zero lifts."""
+        from shrewd_tpu.ingest.pipeline import IngestPipeline
+
+        data = t.spec.verify_binary()    # ValueError on poisoned spec
+        digest = self.store.put_binary(data)
+        outdir = self.tenant_outdir(t.spec.name)
+        if outdir is None:
+            raise RuntimeError("binary-in submission needs an outdir "
+                               "(the ingest WAL is per-tenant state)")
+        pipe = IngestPipeline(os.path.join(outdir, "ingest"),
+                              self.store, digest, axes=t.spec.ingest,
+                              chaos=self.chaos)
+        pipe.run()
+        self.ingest_lifts += pipe.lifts
+        self.ingest_captures += pipe.captures
+        return pipe.resolved_plan(t.spec.plan)
 
     def _scope_chaos(self, t: TenantState, engine=None) -> None:
         """Rescope a tenant's chaos engine to the fleet: the engine's
@@ -635,6 +699,12 @@ class CampaignScheduler:
             if t.status == "queued" and t.retry_at <= self.ticks:
                 try:
                     self._start(t)
+                except FleetKilled:
+                    # a fleet-scoped chaos kill (kill_during_lift fires
+                    # inside the ingest pipeline) is the whole process
+                    # dying, not one tenant failing — it must NOT be
+                    # swallowed into the retry/quarantine ledger
+                    raise
                 except Exception as e:  # noqa: BLE001 — tenant isolation:
                     # a plan that fails to elaborate (malformed dict,
                     # missing trace file, bad config) is THAT tenant's
@@ -659,10 +729,19 @@ class CampaignScheduler:
         journaled BEFORE any ledger mutates (GL201): a kill inside the
         append leaves the in-memory state untouched and the record
         absent — never a half-applied failure."""
+        from shrewd_tpu.ingest.pipeline import IngestQuarantine
+
         entry = {"tick": self.ticks,
                  "error": f"{type(err).__name__}: {err}"}
         failures = t.failures + 1
         errors = (t.errors + [entry])[-_MAX_ERRORS:]
+        if isinstance(err, IngestQuarantine):
+            # an ingest poison verdict is deterministic — the binary
+            # cannot heal, so retrying would re-run the whole capture
+            # just to fail identically; quarantine NOW with the stage
+            # evidence (the pipeline journaled its own verdict first)
+            self._quarantine(t, failures, errors)
+            return
         if failures > self.retry_budget:
             self._quarantine(t, failures, errors)
             return

@@ -123,6 +123,15 @@ QUICK_MODULES = {
     # cache (~23 s total), and the survive-pod-death smoke belongs on
     # every push for the same reason the fleet-survive smoke does
     "test_federation",
+    # streaming ingest: store/axes/spool/chaos-vocab units are
+    # sub-second; the pipeline integrations (dedup O(1) + byte-identity,
+    # torn-doc re-lift, single-flight, quarantine verdicts, kill-during-
+    # lift resume) are tracer-dominated (~30 s, no jax), and the
+    # binary-path-vs-plan-path bit-identity e2e + bounded ingest-surface
+    # crash sweep (~105 s) are the acceptance pins for the binary-in
+    # submission path — the crash-safety smoke belongs on every push
+    # like the fleet/federation smokes it extends
+    "test_ingest_pipeline",
 }
 QUICK_TESTS = {
     # one representative per subsystem (≈4-10 s each, compile-dominated)

@@ -607,7 +607,9 @@ def test_repo_journal_kinds_are_exhaustive():
         "gw_config", "accept", "route", "place", "migrate",
         "pod_dead", "pod_heal", "done", "gw_shutdown", "gw_recover",
         # the gateway's sharded-merge ledger (single-campaign sharding)
-        "shard_split", "shard_fold", "shard_converged"}
+        "shard_split", "shard_fold", "shard_converged",
+        # the streaming-ingest pipeline's per-tenant WAL
+        "ingest_stage", "ingest_done", "ingest_quarantine"}
     assert set(appended) == handled
 
 

@@ -1,0 +1,489 @@
+"""Streaming ingest: binary in, campaign-ready plan out — crash-safe.
+
+The PR-17 pins: the digest-keyed artifact store's semantics (dedup hit
+is O(1) and byte-identical to a cold lift, torn/rotted artifacts read
+as misses and re-lift, two concurrent submissions share one lift), the
+journaled pipeline's quarantine verdicts (unparseable ELF, digest rot,
+lift divergence — all durable, all evidence-carrying), the new chaos
+kinds (``corrupt_binary`` / ``kill_during_lift`` with ``at_stage``
+vocab), the spool's poisoned-binary split, and the service-tier e2e: a
+raw binary submitted as a ``TenantSpec`` runs to final tallies
+bit-identical to the same windows via the pre-lifted plan path, a
+resubmission warm-starts with zero lifts, a poisoned binary
+quarantines while its co-resident tenant finishes untouched, and the
+federation crashcheck sweep recovers bit-identically from ingest-WAL
+and artifact-store boundaries (+ torn variants).
+"""
+
+import base64
+import json
+import os
+import shutil
+import threading
+
+import pytest
+
+from shrewd_tpu.chaos import ChaosEngine, ChaosPlanError, rot_file, tear_file
+from shrewd_tpu.ingest.pipeline import (DEFAULT_AXES, STAGES, IngestPipeline,
+                                        IngestQuarantine, normalize_axes)
+from shrewd_tpu.ingest.store import ArtifactStore, axes_key, data_digest
+from shrewd_tpu.service.journal import FleetJournal
+from shrewd_tpu.service.queue import SubmissionQueue, TenantSpec
+
+needs_toolchain = pytest.mark.skipif(
+    shutil.which("gcc") is None or shutil.which("objdump") is None
+    or shutil.which("nm") is None,
+    reason="host toolchain required")
+
+AXES = {"interval": 1500, "k": 2, "max_steps": 20000}
+
+
+def _b64(data: bytes) -> str:
+    return base64.b64encode(data).decode()
+
+
+def _sort_binary():
+    from shrewd_tpu.ingest import hostdiff as hd
+
+    paths = hd.build_tools("workloads/sort.c")
+    return open(paths.workload, "rb").read()
+
+
+def _window_bytes(store: ArtifactStore, digest: str, key: str,
+                  plan: dict) -> dict:
+    return {e["file"]: open(store.payload_path(digest, key,
+                                               e["file"]), "rb").read()
+            for e in plan["simpoints"]}
+
+
+# --- axes / store units (no toolchain, no jax compiles) ---------------------
+
+def test_axes_normalize_and_key():
+    assert normalize_axes(None) == DEFAULT_AXES
+    # {} and explicit defaults must share one store address
+    assert axes_key(normalize_axes({})) == axes_key(
+        normalize_axes(dict(DEFAULT_AXES)))
+    assert axes_key(normalize_axes({"k": 5})) != axes_key(
+        normalize_axes({}))
+    with pytest.raises(ValueError, match="unknown ingest axes"):
+        normalize_axes({"interval": 10, "bogus": 1})
+
+
+def test_store_binary_content_addressing(tmp_path):
+    store = ArtifactStore(str(tmp_path / "store"))
+    data = b"\x7fELF fake payload"
+    digest = store.put_binary(data)
+    assert digest == data_digest(data)
+    assert store.put_binary(data) == digest          # idempotent
+    assert store.verify_binary(digest)
+    assert open(store.binary_path(digest), "rb").read() == data
+    # rot = poison: verify says no, and the bytes stay rotted (no
+    # silent self-heal — healing would hide the tamper)
+    rot_file(store.binary_path(digest))
+    assert not store.verify_binary(digest)
+    assert not store.verify_binary("0" * 64)         # absent = unverifiable
+
+
+def test_store_doc_verifies_every_payload(tmp_path):
+    store = ArtifactStore(str(tmp_path / "store"))
+    digest, key = "d" * 64, "k" * 16
+    sha = store.write_payload(digest, key, "w.bin", b"window bytes")
+    store.put_doc(digest, key, "stage", {"n": 1,
+                                         "payloads": {"w.bin": sha}})
+    assert store.get_doc(digest, key, "stage")["n"] == 1
+    # rotted payload → the whole doc is a MISS, never a partial hit
+    rot_file(store.payload_path(digest, key, "w.bin"))
+    assert store.get_doc(digest, key, "stage") is None
+    # torn doc → miss too
+    store.write_payload(digest, key, "w.bin", b"window bytes")
+    store.put_doc(digest, key, "stage", {"n": 2,
+                                         "payloads": {"w.bin": sha}})
+    assert store.get_doc(digest, key, "stage")["n"] == 2
+    tear_file(os.path.join(store.obj_dir(digest, key), "stage.json"), 0.4)
+    assert store.get_doc(digest, key, "stage") is None
+    assert store.get_doc(digest, key, "absent") is None
+
+
+def test_single_flight_lock_reaps_stale(tmp_path):
+    store = ArtifactStore(str(tmp_path / "store"))
+    lock = store.lock("a" * 64, "b" * 16)
+    # a dead-pid lock is stale and reaped without waiting
+    os.makedirs(os.path.dirname(lock.path), exist_ok=True)
+    with open(lock.path, "w") as f:
+        f.write("999999999\n")
+    with store.lock("a" * 64, "b" * 16):
+        pass
+    # our own pid but NOT held by this process (the residue of an
+    # in-process chaos kill that unwound past the release) is stale too
+    with open(lock.path, "w") as f:
+        f.write(f"{os.getpid()}\n")
+    with store.lock("a" * 64, "b" * 16):
+        pass
+    assert not os.path.exists(lock.path)
+
+
+# --- chaos kinds ------------------------------------------------------------
+
+def test_ingest_chaos_kind_vocab():
+    with pytest.raises(ChaosPlanError, match="corrupt_binary needs "
+                                             "at_stage"):
+        ChaosEngine({"faults": [{"kind": "corrupt_binary"}]})
+    with pytest.raises(ChaosPlanError, match="does not take 'at_batch'"):
+        ChaosEngine({"faults": [{"kind": "kill_during_lift",
+                                 "at_stage": [1], "at_batch": [0]}]})
+    eng = ChaosEngine({"faults": [
+        {"kind": "corrupt_binary", "at_stage": [1]},
+        {"kind": "kill_during_lift", "at_stage": [3]}]})
+    assert eng.take_corrupt_binary(0) is None
+    assert eng.take_corrupt_binary(1) is not None
+    assert eng.take_corrupt_binary(1) is None        # consumed
+    fired = []
+    eng.kill_action = lambda rc: fired.append(rc)
+    eng.maybe_kill_during_lift(2)
+    assert fired == []
+    eng.maybe_kill_during_lift(3)
+    assert fired == [137]
+
+
+# --- TenantSpec binary fields / poisoned spool ------------------------------
+
+def test_tenant_spec_binary_roundtrip_and_validation():
+    data = b"\x7fELF payload"
+    spec = TenantSpec(name="b", plan={"seed": 1}, binary_b64=_b64(data),
+                      binary_digest=data_digest(data),
+                      ingest={"k": 2})
+    back = TenantSpec.from_dict(spec.to_dict())
+    assert back.verify_binary() == data
+    assert back.ingest == {"k": 2}
+    # plan-only specs stay byte-stable (no binary keys in the doc)
+    assert "binary_b64" not in TenantSpec(name="p", plan={}).to_dict()
+    with pytest.raises(ValueError, match="come together"):
+        TenantSpec(name="b", plan={}, binary_b64=_b64(data))
+    with pytest.raises(ValueError, match="come together"):
+        TenantSpec(name="b", plan={}, binary_digest="0" * 64)
+    with pytest.raises(ValueError, match="ingest axes"):
+        TenantSpec(name="b", plan={}, ingest={"k": 2})
+    with pytest.raises(ValueError, match="digest mismatch"):
+        TenantSpec(name="b", plan={}, binary_b64=_b64(data),
+                   binary_digest="0" * 64).verify_binary()
+    with pytest.raises(ValueError, match="does not decode"):
+        TenantSpec(name="b", plan={}, binary_b64="!!!",
+                   binary_digest="0" * 64).binary_bytes()
+
+
+def test_claim_quarantines_digest_mismatched_binary(tmp_path):
+    q = SubmissionQueue(str(tmp_path / "spool"))
+    data = b"\x7fELF payload"
+    good = TenantSpec(name="ok", plan={"seed": 1}, binary_b64=_b64(data),
+                      binary_digest=data_digest(data))
+    bad = TenantSpec(name="evil", plan={"seed": 1}, binary_b64=_b64(data),
+                     binary_digest="0" * 64)
+    t_good = q.submit(good)
+    t_bad = q.submit(bad)
+    claimed = q.claim()
+    # the poisoned payload goes to bad/ with evidence; the good one is
+    # claimed normally — the spool never wedges on poison
+    assert [t for t, _ in claimed] == [t_good]
+    assert os.path.exists(os.path.join(q.bad_dir, t_bad))
+    assert q.bad_count() == 1
+    reason = json.load(open(os.path.join(q.bad_dir, t_bad + ".reason")))
+    assert "digest mismatch" in reason["error"]
+
+
+# --- quarantine verdicts (toolchain, no jax compiles) -----------------------
+
+@needs_toolchain
+def test_unparseable_elf_quarantines_durably(tmp_path):
+    store = ArtifactStore(str(tmp_path / "store"))
+    digest = store.put_binary(b"this is not an ELF")
+    pipe = IngestPipeline(str(tmp_path / "t" / "ingest"), store, digest)
+    with pytest.raises(IngestQuarantine) as ei:
+        pipe.run()
+    assert ei.value.stage == "capture"
+    # the verdict is durable: a fresh pipeline over the same WAL replays
+    # straight back into quarantine without re-running anything
+    pipe2 = IngestPipeline(str(tmp_path / "t" / "ingest"), store, digest)
+    assert pipe2.quarantine_rec is not None
+    with pytest.raises(IngestQuarantine):
+        pipe2.run()
+    assert pipe2.captures == 0 and pipe2.lifts == 0
+    kinds = [r["kind"] for r in FleetJournal.replay_path(
+        str(tmp_path / "t" / "ingest" / "ingest.jsonl"))[0]]
+    assert "ingest_quarantine" in kinds
+
+
+@needs_toolchain
+def test_lift_divergence_floor_quarantines(tmp_path):
+    # min_lift_rate above 1.0 makes ANY lift a divergence verdict — the
+    # deterministic stand-in for a real host-oracle mismatch
+    store = ArtifactStore(str(tmp_path / "store"))
+    digest = store.put_binary(_sort_binary())
+    pipe = IngestPipeline(str(tmp_path / "t" / "ingest"), store, digest,
+                          axes={**AXES, "min_lift_rate": 1.01})
+    with pytest.raises(IngestQuarantine, match="divergence"):
+        pipe.run()
+    assert pipe.quarantine_rec["stage"] == "lift"
+
+
+@needs_toolchain
+def test_corrupt_binary_chaos_quarantines_at_stage(tmp_path):
+    store = ArtifactStore(str(tmp_path / "store"))
+    digest = store.put_binary(_sort_binary())
+    eng = ChaosEngine({"faults": [{"kind": "corrupt_binary",
+                                   "at_stage": [1]}]})
+    pipe = IngestPipeline(str(tmp_path / "t" / "ingest"), store, digest,
+                          axes=AXES, chaos=eng)
+    with pytest.raises(IngestQuarantine, match="no longer hashes") as ei:
+        pipe.run()
+    # deterministically at the scheduled ordinal: capture (stage 0)
+    # completed and is durable; lift (stage 1) found the rot
+    assert ei.value.stage == "lift"
+    assert store.get_doc(digest, pipe.key, "capture") is not None
+    assert not store.verify_binary(digest)
+
+
+# --- digest-store semantics (toolchain, no jax compiles) --------------------
+
+@needs_toolchain
+def test_dedup_hit_is_o1_and_byte_identical(tmp_path):
+    data = _sort_binary()
+    store = ArtifactStore(str(tmp_path / "store"))
+    digest = store.put_binary(data)
+    cold = IngestPipeline(str(tmp_path / "a" / "ingest"), store, digest,
+                          axes=AXES)
+    plan = cold.run()
+    assert cold.captures == 1
+    assert cold.lifts == 1 + len(plan["simpoints"])  # full + windows
+    # warm start: a different tenant, same (digest, axes) — zero work
+    warm = IngestPipeline(str(tmp_path / "b" / "ingest"), store, digest,
+                          axes=AXES)
+    plan2 = warm.run()
+    assert (warm.captures, warm.lifts) == (0, 0)
+    assert plan2 == plan
+    # the warm tenant's WAL is self-contained evidence of the cache hit
+    recs = FleetJournal.replay_path(
+        str(tmp_path / "b" / "ingest" / "ingest.jsonl"))[0]
+    assert [r["kind"] for r in recs] == \
+        ["ingest_stage"] * len(STAGES) + ["ingest_done"]
+    assert all(r["cached"] for r in recs if r["kind"] == "ingest_stage")
+    # byte-identity: re-lifting the SAME stored capture in a fresh
+    # store reproduces every window bit-for-bit (the downstream stages
+    # are deterministic functions of the capture)
+    store2 = ArtifactStore(str(tmp_path / "store2"))
+    d2 = store2.put_binary(data)
+    cap = open(store.payload_path(digest, cold.key, "capture.bin"),
+               "rb").read()
+    store2.write_payload(d2, cold.key, "capture.bin",
+                         cap)
+    cdoc = store.get_doc(digest, cold.key, "capture")
+    store2.put_doc(d2, cold.key, "capture", cdoc)
+    redo = IngestPipeline(str(tmp_path / "c" / "ingest"), store2, d2,
+                          axes=AXES)
+    plan3 = redo.run()
+    assert redo.captures == 0        # the seeded capture was reused
+    assert _window_bytes(store2, d2, redo.key, plan3) == \
+        _window_bytes(store, digest, cold.key, plan)
+
+
+@needs_toolchain
+def test_torn_store_doc_falls_back_to_relift(tmp_path):
+    data = _sort_binary()
+    store = ArtifactStore(str(tmp_path / "store"))
+    digest = store.put_binary(data)
+    cold = IngestPipeline(str(tmp_path / "a" / "ingest"), store, digest,
+                          axes=AXES)
+    plan = cold.run()
+    golden = _window_bytes(store, digest, cold.key, plan)
+    # tear the terminal plan doc AND the window stage doc: the probe
+    # misses, the stage re-verifies as incomplete, and the pipeline
+    # silently re-lifts — a damaged ARTIFACT is a cache decision,
+    # never a quarantine
+    tear_file(os.path.join(store.obj_dir(digest, cold.key),
+                           "plan.json"), 0.4)
+    tear_file(os.path.join(store.obj_dir(digest, cold.key),
+                           "window.json"), 0.4)
+    redo = IngestPipeline(str(tmp_path / "b" / "ingest"), store, digest,
+                          axes=AXES)
+    plan2 = redo.run()
+    assert redo.lifts == len(plan["simpoints"])   # windows only
+    assert redo.captures == 0
+    assert plan2["simpoints"] == plan["simpoints"]
+    assert _window_bytes(store, digest, cold.key, plan2) == golden
+
+
+@needs_toolchain
+def test_concurrent_submissions_share_one_lift(tmp_path):
+    data = _sort_binary()
+    store = ArtifactStore(str(tmp_path / "store"))
+    digest = store.put_binary(data)
+    pipes = [IngestPipeline(str(tmp_path / f"t{i}" / "ingest"), store,
+                            digest, axes=AXES) for i in range(2)]
+    plans, errs = [None, None], []
+
+    def _run(i):
+        try:
+            plans[i] = pipes[i].run()
+        except Exception as e:  # noqa: BLE001 — surface in the test
+            errs.append(e)
+
+    threads = [threading.Thread(target=_run, args=(i,)) for i in (0, 1)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    assert plans[0] == plans[1]
+    # single-flight: exactly ONE pipeline did the cold work; the loser
+    # waited on the lock and warm-started from the winner's artifacts
+    total = [(p.captures, p.lifts) for p in pipes]
+    assert sorted(total) == [(0, 0),
+                             (1, 1 + len(plans[0]["simpoints"]))]
+
+
+@needs_toolchain
+def test_kill_during_lift_resumes_from_durable_stage(tmp_path):
+    data = _sort_binary()
+    store = ArtifactStore(str(tmp_path / "store"))
+    digest = store.put_binary(data)
+
+    class Killed(Exception):
+        pass
+
+    eng = ChaosEngine({"faults": [{"kind": "kill_during_lift",
+                                   "at_stage": [1]}]})
+    eng.kill_action = lambda rc: (_ for _ in ()).throw(Killed())
+    wal_dir = str(tmp_path / "t" / "ingest")
+    pipe = IngestPipeline(wal_dir, store, digest, axes=AXES, chaos=eng)
+    with pytest.raises(Killed):
+        pipe.run()
+    # capture landed durably (WAL + store) before the kill at stage 1
+    recs = FleetJournal.replay_path(
+        os.path.join(wal_dir, "ingest.jsonl"))[0]
+    assert [r["kind"] for r in recs] == ["ingest_stage"]
+    assert recs[0]["stage"] == "capture"
+    # recovery resumes mid-pipeline: no re-capture, windows complete
+    redo = IngestPipeline(wal_dir, store, digest, axes=AXES)
+    plan = redo.run()
+    assert redo.captures == 0
+    assert redo.lifts == 1 + len(plan["simpoints"])
+    assert store.get_doc(digest, redo.key, "plan") is not None
+
+
+# --- service-tier e2e (jax campaigns) ---------------------------------------
+
+def _scenario_plan(**kw):
+    plan = {"structures": ["regfile"], "batch_size": 16, "max_trials": 32,
+            "min_trials": 32, "target_halfwidth": 0.5, "seed": 3}
+    plan.update(kw)
+    return plan
+
+
+@needs_toolchain
+def test_binary_tenant_bit_identical_to_plan_path(tmp_path):
+    import numpy as np
+
+    from shrewd_tpu.service.scheduler import CampaignScheduler
+
+    data = _sort_binary()
+    digest = data_digest(data)
+    store_dir = str(tmp_path / "store")
+    sched = CampaignScheduler(outdir=str(tmp_path / "fleet"),
+                              store_dir=store_dir)
+    sched.admit(TenantSpec(name="bin0", plan=_scenario_plan(),
+                           binary_b64=_b64(data), binary_digest=digest,
+                           ingest=AXES))
+    assert sched.run() == 0
+    assert sched.tenants["bin0"].status == "complete"
+    assert sched.ingest_captures == 1 and sched.ingest_lifts >= 2
+    bt = sched.tenant_tallies("bin0")
+
+    # the pre-lifted plan path over the SAME store windows
+    pipe = IngestPipeline(str(tmp_path / "probe"),
+                          ArtifactStore(store_dir), digest, axes=AXES)
+    pipe.run()
+    assert pipe.lifts == 0                      # pure warm start
+    sched2 = CampaignScheduler(outdir=str(tmp_path / "fleet2"))
+    sched2.admit(TenantSpec(name="plan0",
+                            plan=pipe.resolved_plan(_scenario_plan())))
+    assert sched2.run() == 0
+    pt = sched2.tenant_tallies("plan0")
+    assert bt.keys() == pt.keys() and len(bt) > 0
+    for k in bt:
+        np.testing.assert_array_equal(np.asarray(bt[k]),
+                                      np.asarray(pt[k]))
+
+    # resubmission over the same store: zero ingest work
+    sched3 = CampaignScheduler(outdir=str(tmp_path / "fleet3"),
+                               store_dir=store_dir)
+    sched3.admit(TenantSpec(name="bin1", plan=_scenario_plan(),
+                            binary_b64=_b64(data), binary_digest=digest,
+                            ingest=AXES))
+    assert sched3.run() == 0
+    assert (sched3.ingest_captures, sched3.ingest_lifts) == (0, 0)
+
+
+@needs_toolchain
+def test_poisoned_binary_quarantines_coresident_finishes(tmp_path):
+    from test_fleet import _plan, _solo_tallies, _assert_tenant_matches
+
+    from shrewd_tpu.service.scheduler import CampaignScheduler
+
+    data = _sort_binary()
+    digest = data_digest(data)
+    # corrupt_binary chaos rots the stored ELF at stage ordinal 1: the
+    # submission deterministically quarantines (digest re-verify at the
+    # lift stage) while the co-resident plan tenant finishes untouched
+    eng = ChaosEngine({"faults": [{"kind": "corrupt_binary",
+                                   "at_stage": [1]}]})
+    plan = _plan(3, n_batches=2)
+    solo = _solo_tallies(plan)
+    sched = CampaignScheduler(outdir=str(tmp_path / "fleet"), chaos=eng)
+    sched.admit(TenantSpec(name="good", plan=plan.to_dict()))
+    sched.admit(TenantSpec(name="evil", plan=_scenario_plan(),
+                           binary_b64=_b64(data), binary_digest=digest,
+                           ingest=AXES))
+    assert sched.run() == 0
+    assert sched.tenants["good"].status == "complete"
+    assert sched.tenants["evil"].status == "quarantined"
+    # one elaboration failure, zero retries: poison never burns budget
+    assert sched.tenants["evil"].failures == 1
+    assert "no longer hashes" in sched.tenants["evil"].results["error"]
+    _assert_tenant_matches(sched, "good", solo)
+    # the quarantine evidence doc is durable in the tenant's namespace
+    qdoc = json.load(open(os.path.join(
+        sched.tenant_outdir("evil"), "quarantine.json")))
+    assert qdoc["failures"] == 1
+    # and the pipeline's own WAL carries the journaled verdict
+    recs = FleetJournal.replay_path(os.path.join(
+        sched.tenant_outdir("evil"), "ingest", "ingest.jsonl"))[0]
+    assert any(r["kind"] == "ingest_quarantine" for r in recs)
+
+
+@needs_toolchain
+def test_ingest_crashcheck_sweep_bounded(tmp_path):
+    # recover the federation from ingest-WAL appends and artifact-store
+    # renames (+ torn-tail / torn-payload variants) — bit-identical
+    # final tallies at every boundary.  Bounded to the ingest surface
+    # here; the CI gate records the fuller sweep in INGEST_r14.json
+    from shrewd_tpu.analysis import crashcheck
+
+    data = _sort_binary()
+    binaries = {"b0": {"binary_b64": _b64(data),
+                       "binary_digest": data_digest(data),
+                       "ingest": AXES}}
+    doc = crashcheck.run_gateway_crashcheck(
+        str(tmp_path / "sweep"),
+        plans={"b0": _scenario_plan(batch_size=8, max_trials=8,
+                                    min_trials=8)},
+        binaries=binaries, max_points=4,
+        point_filter=lambda pt: (pt.kind or "").startswith(("ingest",
+                                                            "store")))
+    assert doc["failures"] == []
+    assert doc["binaries"] == ["b0"]
+    assert doc["points_selected"] >= 8       # the full ingest surface
+    assert doc["points_checked"] == 4
+    assert doc["torn_checks"] >= 1
+    by_kind = doc["boundaries_by_kind"]
+    assert by_kind.get("ingest_stage", 0) >= len(STAGES)
+    assert by_kind.get("ingest_done", 0) >= 1
+    assert by_kind.get("store_payload", 0) >= 4
