@@ -662,6 +662,148 @@ else
   echo "WARNING: streaming-ingest gate skipped (no host toolchain)"
 fi
 
+# Elastic-pool Pareto gate (FATAL): the NORTHSTAR matrix through the
+# federation, autoscaled and chaos-proven.  A 100-cell scenario matrix
+# (5 targets x 4 protection schemes x 5 thermal envelopes) runs twice:
+# once through the solo resident scheduler, once through a federated
+# pod pool that starts at its 3-pod static floor, autoscales out under
+# the matrix's admission pressure (journaled pool_scale_up records),
+# and contracts back to the floor through the retire-via-migration
+# path (pool_retire_begin fences the pod, the drain rides the ordinary
+# migration machinery, pool_retire_done completes) — while pod-level
+# chaos HARD-kills one freshly scaled pod the moment the driver first
+# steps it (kill_new_pod @ scale 1) and another mid-retire-drain
+# (kill_during_retire @ scale 5; both addressed by the journaled scale
+# ordinal, never a clock).  The Pareto front must be BIT-IDENTICAL to
+# the solo run's (scheme-mates share frozen PRNG keys on measurement
+# coordinates; prune timing may differ across pool schedules, the
+# front cannot).  The gateway WAL of an autoscaled run is then
+# crash-swept from every pool scale-event boundary (every
+# pool_scale_up / pool_retire_begin / pool_retire_done append + torn
+# variants), recovery re-executed WITHOUT an autoscaler attached, with
+# 0 divergent recoveries.  Results -> PARETO_FED_r15.json.  FATAL:
+# this is the PR-18 acceptance pin.
+timeout -k 10 560 env JAX_PLATFORMS=cpu python - <<'PARETO_FED_GATE' \
+  || { echo "FATAL: elastic-pool Pareto gate failed (front diverged from solo, pool chaos unsurvived, pool did not return to its floor, or a pool-boundary crash point did not recover bit-identically)"; exit 1; }
+import json, os, tempfile
+from shrewd_tpu.analysis import crashcheck
+from shrewd_tpu.chaos import ChaosEngine
+from shrewd_tpu.federation import Autoscaler
+from shrewd_tpu.scenario import (FederatedScenarioRunner, ScenarioMatrix,
+                                 ScenarioRunner, pareto)
+
+def matrix():
+    return ScenarioMatrix(
+        tag="r15", seed=3,
+        workloads=[{"name": "wl", "simpoints": [{
+            "type": "WorkloadSpec", "name": "w0",
+            "workload": {"n": 96, "nphys": 32, "mem_words": 64,
+                         "working_set_words": 32, "seed": 7}}]}],
+        targets=["regfile", "rob", "iq", "lsq", "fu"],
+        schemes=[{"name": "none"},
+                 {"name": "parity", "detect": 1.0, "area": 1.03},
+                 {"name": "ecc", "detect": 0.5, "correct": 0.5,
+                  "area": 1.12},
+                 {"name": "dmr", "detect": 1.0, "area": 2.0,
+                  "weight": 0.2}],
+        thermal=[{"name": "t60", "temperature_c": 60.0},
+                 {"name": "t71", "temperature_c": 71.0},
+                 {"name": "t85", "temperature_c": 85.0},
+                 {"name": "t95", "temperature_c": 95.0},
+                 {"name": "t105", "temperature_c": 105.0}],
+        base={"batch_size": 16, "max_trials": 32, "min_trials": 32,
+              "target_halfwidth": 0.5,
+              "integrity": {"canary_trials": 0, "audit_rate": 0.0},
+              "resilience": {"backoff_base": 0.0}})
+
+cells = matrix().expand()
+assert len(cells) >= 100, f"matrix shrank to {len(cells)} cells"
+root = tempfile.mkdtemp(prefix="pareto_fed_")
+
+# the single-scheduler reference front
+solo = ScenarioRunner(matrix(), os.path.join(root, "solo"),
+                      pareto_every=4)
+assert solo.serve() == 0, "solo matrix did not complete"
+sdoc = json.load(open(pareto.artifact_path(
+    os.path.join(root, "solo"), "r15")))
+
+# the same matrix through the autoscaled, chaos-ridden pod pool
+chaos = ChaosEngine({"faults": [
+    {"kind": "kill_new_pod", "at_scale": [1]},
+    {"kind": "kill_during_retire", "at_scale": [5]},
+]})
+auto = Autoscaler(min_pods=3, max_pods=6, up_trials=256.0,
+                  down_trials=64.0, cooldown_rounds=1)
+runner = FederatedScenarioRunner(matrix(), os.path.join(root, "fed"),
+                                 pod_names=("pod0", "pod1", "pod2"),
+                                 pareto_every=4, autoscale=auto,
+                                 chaos=chaos, expiry_rounds=2)
+assert runner.serve() == 0, "federated matrix did not complete"
+fed, gw = runner.fed, runner.fed.gateway
+assert chaos.injected == {"kill_new_pod": 1,
+                          "kill_during_retire": 1}, chaos.injected
+assert chaos.survived == chaos.injected, chaos.survived
+assert fed.scale_ups >= 1 and fed.retired == fed.scale_ups
+assert sorted(gw.pods) == ["pod0", "pod1", "pod2"], "pool not at floor"
+assert not gw.retiring and not gw.scaled_pods
+for pod, rec in gw.retires.items():
+    assert rec["done_round"] is not None, (pod, rec)
+fdoc = json.load(open(pareto.artifact_path(
+    os.path.join(root, "fed"), "r15")))
+
+# front equality: converged rows only; the per-group "cells" key is
+# PROVENANCE (which scheme-mate supplied the profile may differ across
+# schedules) — everything the front decides on must be bit-identical
+def front(doc):
+    return {g: {k: v for k, v in r.items() if k != "cells"}
+            for g, r in doc["search"].items()}
+assert front(fdoc) == front(sdoc), "federated front diverged from solo"
+assert fdoc["search"], "empty design search"
+
+# the pool-boundary crash sweep: every scale-event WAL append, plain +
+# torn, recovered without an autoscaler — 0 divergent recoveries
+pool_kinds = ("pool_scale_up", "pool_retire_begin", "pool_retire_done")
+sweep = crashcheck.run_gateway_crashcheck(
+    os.path.join(root, "sweep"),
+    crashcheck.small_fleet_plans(seeds=(3, 5), n_batches=2),
+    pod_names=("pod0",),
+    autoscale=lambda: Autoscaler(min_pods=1, max_pods=2,
+                                 up_trials=64.0, down_trials=16.0,
+                                 cooldown_rounds=1),
+    point_filter=lambda pt: pt.kind in pool_kinds)
+assert sweep["ok"], sweep["failures"][:3]
+for kind in pool_kinds:
+    assert sweep["boundaries_by_kind"].get(kind, 0) >= 1, \
+        f"sweep never crossed a {kind} boundary"
+
+with open("PARETO_FED_r15.json", "w") as f:
+    json.dump({
+        "matrix": {"tag": "r15", "cells": len(cells),
+                   "targets": 5, "schemes": 4, "thermal": 5},
+        "pool": {"floor": 3, "max": 6,
+                 "scale_ups": fed.scale_ups, "retired": fed.retired,
+                 "scale_seq": gw.scale_seq,
+                 "retires": gw.retires},
+        "chaos": chaos.to_dict(),
+        "front_bit_identical_vs_solo": True,
+        "fronts": {g: [[p["area"], p["sdc_rate"]]
+                       for p in r["pareto"]]
+                   for g, r in fdoc["search"].items()},
+        "decisions": {"solo": len(sdoc["decisions"]),
+                      "federated": len(fdoc["decisions"])},
+        "pool_crashcheck": {k: sweep[k] for k in (
+            "points", "points_selected", "points_checked", "checks",
+            "torn_checks", "boundaries_by_kind", "autoscaled", "ok")},
+    }, f, indent=1)
+    f.write("\n")
+print(f"elastic-pool Pareto gate: {len(cells)} cells, pool 3 -> "
+      f"{3 + fed.scale_ups} -> 3 under kill_new_pod + "
+      f"kill_during_retire, front bit-identical to solo "
+      f"({len(fdoc['search'])} groups); pool sweep "
+      f"{sweep['points_checked']} boundaries ({sweep['checks']} "
+      f"recoveries, 0 divergent) -> PARETO_FED_r15.json")
+PARETO_FED_GATE
+
 # Non-fatal bench smoke: bench.py --quick includes the serial-vs-
 # pipelined campaign-loop microbenchmark (now surfacing the PerfStats
 # overlap ledger — host/device-wait/device-step seconds, depth HWM),

@@ -47,8 +47,9 @@ from shrewd_tpu.utils import debug
 
 #: files written without fsync — scrubbed from every crash-point
 #: snapshot (a real crash may lose them; recovery must not need them)
-NON_DURABLE = ("metrics.json", "metrics.prom", "trace.json",
-               "fleet_stats.txt", "fleet_stats.json", "flightrec.json")
+NON_DURABLE = ("metrics.json", "metrics.prom", "pool.json", "pool.prom",
+               "trace.json", "fleet_stats.txt", "fleet_stats.json",
+               "flightrec.json")
 
 
 @dataclass
@@ -421,15 +422,39 @@ def _fed_tallies(fed, plans: dict) -> dict:
 
 
 def _placements(root: str, pod_names, tenants) -> dict:
-    """tenant -> pods whose spool holds its submission (the
+    """tenant -> pods whose spool holds a LIVE submission for it (the
     double-placement probe: every tenant must appear on EXACTLY one
-    pod when no failover ran)."""
+    pod).  Live means pending/claimed or terminal with a real result —
+    a migration (including a pool retire's drain) legitimately leaves
+    an ``evicted`` done-doc behind on the source pod, which is history,
+    not a placement.  ``pod_names`` is extended with whatever pod
+    directories exist on disk so autoscaled pods are probed too."""
     from shrewd_tpu.federation.gateway import find_spool_ticket
+    from shrewd_tpu.service.queue import SubmissionQueue
 
+    pods_root = os.path.join(root, "pods")
+    try:
+        all_pods = sorted(set(pod_names) | set(os.listdir(pods_root)))
+    except OSError:
+        all_pods = sorted(set(pod_names))
     out = {}
     for name in tenants:
-        out[name] = [p for p in pod_names if find_spool_ticket(
-            os.path.join(root, "pods", p, "spool"), name)]
+        hosts = []
+        for p in all_pods:
+            spool = os.path.join(pods_root, p, "spool")
+            hit = find_spool_ticket(spool, name)
+            if hit is None:
+                continue
+            sub, ticket = hit
+            if sub == "bad":
+                continue
+            if sub == "done":
+                doc = SubmissionQueue(spool).done(ticket)
+                if doc is None or doc.get("status") in ("evicted",
+                                                        "refused"):
+                    continue
+            hosts.append(p)
+        out[name] = hosts
     return out
 
 
@@ -520,7 +545,8 @@ def run_gateway_crashcheck(workdir: str, plans: dict | None = None,
                            max_points: int | None = None,
                            shards: dict | None = None,
                            binaries: dict | None = None,
-                           point_filter=None) -> dict:
+                           point_filter=None,
+                           autoscale=None) -> dict:
     """The gateway-WAL sweep (see section comment).  ``shards`` maps
     tenant name -> shard count (``TenantSpec.shards``): those tenants
     run split across pods and the sweep covers the merge ledger's
@@ -537,7 +563,15 @@ def run_gateway_crashcheck(workdir: str, plans: dict | None = None,
     ``CrashPoint -> bool`` callable) narrows the sweep to a chosen
     surface — e.g. only ingest-WAL appends and store renames — so a
     test can exhaustively cover ONE seam in bounded time; ``ok`` then
-    certifies every selected point.  Returns the machine-readable
+    certifies every selected point.  ``autoscale`` is a ZERO-ARG
+    FACTORY returning a fresh ``Autoscaler`` (the controller carries
+    cooldown state, so baseline and recorded runs each need their own):
+    the sweep then covers the elastic-pool crash surface — every
+    ``pool_scale_up`` / ``pool_retire_begin`` / ``pool_retire_done``
+    append plus torn-tail variants.  Recovery re-executes WITHOUT an
+    autoscaler attached: the journaled ledger alone must carry every
+    pending pool transition to completion (the driver reconciles;
+    deciding was already durable).  Returns the machine-readable
     report; ``report["ok"]`` is the gate bit."""
     from shrewd_tpu.federation.driver import Federation
     from shrewd_tpu.service.queue import TenantSpec
@@ -548,7 +582,8 @@ def run_gateway_crashcheck(workdir: str, plans: dict | None = None,
     binaries = binaries or {}
 
     def _run(root):
-        fed = Federation(root, pod_names=tuple(pod_names))
+        fed = Federation(root, pod_names=tuple(pod_names),
+                         autoscale=autoscale() if autoscale else None)
         for name, plan in plans.items():
             fed.submit(TenantSpec(name=name, plan=plan,
                                   shards=int(shards.get(name, 1)),
@@ -624,6 +659,7 @@ def run_gateway_crashcheck(workdir: str, plans: dict | None = None,
         "pods": list(pod_names),
         "shards": {n: int(v) for n, v in sorted(shards.items())},
         "binaries": sorted(binaries),
+        "autoscaled": autoscale is not None,
         "points": len(recorder.points),
         "points_selected": selected,
         "points_checked": len(points),

@@ -95,6 +95,19 @@ proven on a schedule:
                         reaches ``at_fold`` and holds for ``rounds`` rounds
                         — the partition lands mid-merge no matter how many
                         rounds the shards needed to produce that fold
+``kill_during_retire``  hard pod death addressed by the gateway's journaled
+                        SCALE ORDINAL (``at_scale``): fires while the driver
+                        is draining a retiring pod whose
+                        ``pool_retire_begin`` record carries that ordinal —
+                        the retire window is deterministically targetable no
+                        matter which round the autoscaler decided in; lease
+                        expiry + the journaled retire must finish the job
+``kill_new_pod``        hard pod death addressed by the scale ordinal of a
+                        ``pool_scale_up`` record (``at_scale``): the freshly
+                        spawned pod dies on its first service step — the
+                        gateway must fail its tenants over exactly as for
+                        any dead pod, and recovery must not resurrect the
+                        pod into a double placement
 ======================  ====================================================
 
 Each kind's trigger vocabulary is validated per kind: a ``kill_pod``
@@ -128,14 +141,16 @@ debug.register_flag("Chaos", "deterministic fault-injection harness")
 KINDS = ("wedge", "backend_error", "corrupt_tally", "torn_checkpoint",
          "kill_worker", "kill_fleet", "torn_journal", "corrupt_submission",
          "kill_pod", "partition_pod", "kill_shard",
-         "partition_during_merge", "corrupt_binary", "kill_during_lift")
+         "partition_during_merge", "corrupt_binary", "kill_during_lift",
+         "kill_during_retire", "kill_new_pod")
 
 #: kinds whose triggers are NOT batch coordinates (never armed by
 #: ``begin_batch``): checkpoint ordinals and the fleet/federation seams
 _NON_BATCH_KINDS = ("torn_checkpoint", "kill_fleet", "torn_journal",
                     "corrupt_submission", "kill_pod", "partition_pod",
                     "kill_shard", "partition_during_merge",
-                    "corrupt_binary", "kill_during_lift")
+                    "corrupt_binary", "kill_during_lift",
+                    "kill_during_retire", "kill_new_pod")
 
 #: trigger keys carrying id lists, by kind (fleet/federation kinds +
 #: checkpoint); batch kinds use at_batch / sample / after_dispatches.
@@ -153,10 +168,13 @@ _KIND_TRIGGERS = {
     "partition_during_merge": ("at_fold",),
     "corrupt_binary": ("at_stage",),
     "kill_during_lift": ("at_stage",),
+    "kill_during_retire": ("at_scale",),
+    "kill_new_pod": ("at_scale",),
 }
 
 _ID_KEYS = ("at_batch", "at_ckpt", "at_tick", "at_journal",
-            "at_submission", "at_round", "at_fold", "at_stage")
+            "at_submission", "at_round", "at_fold", "at_stage",
+            "at_scale")
 
 KILL_DEFAULT_RC = 137
 
@@ -598,6 +616,49 @@ class ChaosEngine:
                 if r0 <= round < r0 + rounds:
                     active = True
         return active
+
+    def maybe_kill_during_retire(self, pod: str, scale: int) -> None:
+        """Pool-retirement kill seam: ``kill_during_retire`` is addressed
+        by the gateway's journaled scale ordinal (``at_scale``: the
+        ``scale`` field of the ``pool_retire_begin`` record) and fires
+        while the driver is draining the retiring pod — after the retire
+        is journaled, before ``pool_retire_done`` lands.  An optional
+        ``pod`` filter narrows it further.  The window is deterministic
+        no matter which round the autoscaler decided in: the ordinal is
+        a WAL append, never a clock."""
+        for s in self.faults:
+            if s["kind"] != "kill_during_retire" or s["_fires_left"] <= 0:
+                continue
+            if s.get("pod") and s["pod"] != pod:
+                continue
+            if scale not in s.get("at_scale", ()):
+                continue
+            s["_fires_left"] -= 1
+            self._batch = (scale, "retire", pod)
+            self._fire("kill_during_retire", {"pod": pod, "scale": scale})
+            debug.dprintf("Chaos", "kill_during_retire %s (scale=%d)",
+                          pod, scale)
+            self.kill_now(s.get("rc"))
+
+    def maybe_kill_new_pod(self, pod: str, scale: int) -> None:
+        """Scale-up kill seam: ``kill_new_pod`` is addressed by the scale
+        ordinal of a ``pool_scale_up`` record (``at_scale``) and fires
+        when the driver first steps the freshly spawned pod — the
+        narrowest window where a new pod can die with placements already
+        journaled onto it.  Optional ``pod`` filter as elsewhere."""
+        for s in self.faults:
+            if s["kind"] != "kill_new_pod" or s["_fires_left"] <= 0:
+                continue
+            if s.get("pod") and s["pod"] != pod:
+                continue
+            if scale not in s.get("at_scale", ()):
+                continue
+            s["_fires_left"] -= 1
+            self._batch = (scale, "scale", pod)
+            self._fire("kill_new_pod", {"pod": pod, "scale": scale})
+            debug.dprintf("Chaos", "kill_new_pod %s (scale=%d)",
+                          pod, scale)
+            self.kill_now(s.get("rc"))
 
     def take_torn_journal(self, seq: int) -> dict | None:
         """Journal hook: the spec when journal record ``seq`` is

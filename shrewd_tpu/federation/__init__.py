@@ -22,6 +22,11 @@ free operation.
   outdir + coord-dir heartbeat lease) and ``PodSupervisor``
   (round-counted lease expiry over ``parallel/elastic.py`` heartbeats
   — a deterministic failure detector);
+- ``autoscale.py``  — ``Autoscaler``: the journaled pool control loop
+  (ETA mass + unplaced backlog + SLO-miss pressure in, GL201-certified
+  ``pool_scale_up`` / ``pool_retire_begin`` / ``pool_retire_done`` WAL
+  records out; retirement rides the ordinary drain-here/recover-there
+  migration path, so every pool decision replays from the WAL alone);
 - ``driver.py``     — ``Federation``: the single-threaded round-robin
   over the pods' cooperative ``CampaignScheduler.step()`` seam, chaos
   integration (``kill_pod`` / ``partition_pod``), failover, healing
@@ -39,6 +44,7 @@ Import discipline: jax-free at package import (jax enters inside the
 pods' schedulers).
 """
 
+from shrewd_tpu.federation.autoscale import Autoscaler
 from shrewd_tpu.federation.driver import Federation
 from shrewd_tpu.federation.gateway import (Gateway, RouteEntry,
                                            copy_tenant_checkpoint,
@@ -49,7 +55,8 @@ from shrewd_tpu.federation.http_front import GatewayHTTPFront
 from shrewd_tpu.federation.pods import (PodHandle, PodKilled, PodPort,
                                         PodSupervisor)
 
-__all__ = ["Federation", "Gateway", "GatewayHTTPFront", "PodHandle",
+__all__ = ["Autoscaler", "Federation", "Gateway", "GatewayHTTPFront",
+           "PodHandle",
            "PodKilled", "PodPort", "PodSupervisor", "RouteEntry",
            "copy_tenant_checkpoint", "find_spool_ticket",
            "gateway_journal_path", "gateway_snap_path"]

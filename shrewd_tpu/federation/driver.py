@@ -40,7 +40,7 @@ Import discipline: jax-free at module import.
 
 from __future__ import annotations
 
-from shrewd_tpu.federation.gateway import Gateway
+from shrewd_tpu.federation.gateway import Gateway, TERMINAL
 from shrewd_tpu.federation.pods import PodHandle, PodKilled, PodSupervisor
 from shrewd_tpu.service.queue import TenantSpec
 from shrewd_tpu.service.scheduler import IDLE
@@ -54,7 +54,8 @@ class Federation:
     """One fleet-of-fleets (see module doc)."""
 
     def __init__(self, root: str, pod_names=("pod0", "pod1", "pod2"),
-                 mesh=None, chaos=None, quantum: int = 1,
+                 mesh=None, chaos=None, autoscale=None, on_round=None,
+                 quantum: int = 1,
                  expiry_rounds: int = 3, rebalance_every: int = 0,
                  rebalance_factor: float = 4.0, max_epochs: int = 3,
                  idle_exit: bool = True, poll_interval: float = 0.2,
@@ -64,8 +65,15 @@ class Federation:
         # ONE digest-keyed artifact store for the whole federation: a
         # binary ingested on any pod warm-starts in O(1) on every other
         # (failover/migration re-runs the tenant's ingest pipeline
-        # against the same store, so re-placement costs zero lifts)
+        # against the same store, so re-placement costs zero lifts) —
+        # and, since PR 18, one persistent executable cache: every pod
+        # enables jax's on-disk compilation cache at the store's exec/
+        # kind, so scheme-/thermal-mates dedupe compiles ACROSS pods
         sched_kw.setdefault("store_dir", os.path.join(root, "store"))
+        # kept for pool reconciliation: journaled scale-ups spawn their
+        # PodHandles with the same posture as the static pods
+        self.mesh = mesh
+        self.sched_kw = dict(sched_kw)
         self.pods = {
             name: PodHandle(name, os.path.join(root, "pods", name),
                             self.coord_dir, mesh=mesh, **sched_kw)
@@ -76,6 +84,11 @@ class Federation:
         self.supervisor = PodSupervisor(self.coord_dir,
                                         expiry_rounds=expiry_rounds)
         self.chaos = chaos
+        self.autoscale = autoscale   # federation/autoscale.Autoscaler
+        #: supervisor hook called once per round with the federation
+        #: (the scenario runner's Pareto fold rides here) — callers own
+        #: their own exception posture, same as the scheduler's on_tick
+        self.on_round = on_round
         self.quantum = max(1, int(quantum))
         self.rebalance_every = int(rebalance_every)
         self.rebalance_factor = float(rebalance_factor)
@@ -88,6 +101,8 @@ class Federation:
         self.failovers = 0
         self.fenced = 0
         self.revoked = 0             # shard-convergence quota revocations
+        self.scale_ups = 0           # pods added by pool autoscaling
+        self.retired = 0             # pool retires completed
 
     @classmethod
     def recover(cls, root: str, pod_names=("pod0", "pod1", "pod2"),
@@ -137,6 +152,13 @@ class Federation:
         try:
             tick = pod.sched.ticks if pod.sched is not None else 0
             self.chaos.maybe_kill_pod(name, tick=tick, round=self.round)
+            # kill_new_pod: addressed by the journaled scale ordinal of
+            # this pod's pool_scale_up record — consulted every step but
+            # single-fire, so it lands on the fresh pod's FIRST quantum
+            # no matter which round the autoscaler decided in
+            scale = self.gateway.scaled_pods.get(name)
+            if scale is not None:
+                self.chaos.maybe_kill_new_pod(name, scale)
             # kill_shard: the schedule names a SUB-TENANT of a sharded
             # campaign; the fault kills whatever pod currently hosts it
             # — consult it for every shard child placed here so the
@@ -153,6 +175,101 @@ class Federation:
         finally:
             self.chaos.kill_action = prev
         return False
+
+    # --- the elastic pool --------------------------------------------------
+
+    def _drive_pool(self) -> None:
+        """Reconcile pod processes to the gateway's journaled pool
+        ledger — the WAL decides, this loop obeys.  Four passes, all
+        idempotent per round:
+
+        - let the autoscaler (when attached) journal at most one new
+          decision;
+        - spawn a ``PodHandle`` for every journaled scaled-up pod that
+          has none yet (recovery lands here too: a ``pool_scale_up``
+          replayed from the WAL gets its pod process back);
+        - drive every pending retire: migrate non-terminal tenants off
+          the fenced pod through the ordinary drain-here/recover-there
+          path, and journal ``pool_retire_done`` once nothing
+          non-terminal remains (a DEAD retiring pod needs no drain —
+          lease expiry already failed its tenants over, which is what
+          makes a hung retire safe);
+        - drop handles for pods the ledger no longer owns.
+
+        On convergence the elastic headroom is drained back to the
+        static floor: every remaining autoscaled pod is retired, so a
+        3→N federation always finishes at 3 — the pool's steady state
+        is the hand-built one, and the WAL shows the full round trip."""
+        gw = self.gateway
+        if self.autoscale is not None:
+            d = self.autoscale.tick(gw, self.round)
+            if d is not None and d["action"] == "scale_up":
+                self.scale_ups += 1
+        if not gw.spool.pending() and gw.entries and gw.all_done():
+            for name in sorted(gw.scaled_pods):
+                if name in gw.retiring:
+                    continue
+                try:
+                    gw.pool_retire_begin(name, reason="converged",
+                                         round=self.round)
+                except (ValueError, RuntimeError):
+                    break
+        for name in sorted(gw.pods):
+            if name not in self.pods:
+                self.pods[name] = PodHandle(
+                    name, os.path.join(self.root, "pods", name),
+                    self.coord_dir, mesh=self.mesh, **self.sched_kw)
+        for name in sorted(gw.retiring):
+            pod = self.pods.get(name)
+            rec = gw.retires.get(name) or {}
+            scale = int(rec.get("scale") or 0)
+            if self.chaos is not None and pod is not None \
+                    and not pod.dead:
+                # the retire window is deterministically targetable:
+                # kill_during_retire addresses this retire's journaled
+                # scale ordinal, scoped to kill exactly this pod
+                def _kill(rc, _n=name):
+                    raise PodKilled(_n, rc)
+
+                prev = self.chaos.kill_action
+                self.chaos.kill_action = _kill
+                try:
+                    self.chaos.maybe_kill_during_retire(name, scale)
+                except PodKilled as e:
+                    debug.dprintf("Federation", "%s", e)
+                    pod.kill()
+                finally:
+                    self.chaos.kill_action = prev
+            live_here = [e for e in gw.entries.values()
+                         if e.pod == name and e.status not in TERMINAL]
+            if not live_here:
+                if pod is not None and name not in gw.dead_pods:
+                    pod.drain()
+                gw.pool_retire_done(name, round=self.round)
+                self.retired += 1
+                continue
+            if pod is None or pod.dead or name in gw.dead_pods:
+                continue             # lease expiry moves the tenants
+            for e in live_here:
+                if e.status == "placed":
+                    try:
+                        target = gw._pick_pod(
+                            exclude=(name,), avoid=gw._sibling_pods(e))
+                    except RuntimeError:
+                        break        # no live target: wait for one
+                    gw.migrate(e.spec.name, target, "retire")
+                if e.status == "draining" and pod.sched is not None \
+                        and e.spec.name in pod.sched.tenants:
+                    pod.sched.evict(e.spec.name, "retire")
+        for name in list(self.pods):
+            if name not in gw.pods:
+                self.pods.pop(name)
+        try:
+            from shrewd_tpu.obs import metrics as obs_metrics
+
+            obs_metrics.publish_pool(gw.outdir, gw.pool_status())
+        except Exception:  # noqa: BLE001 — observability is best-effort
+            pass
 
     # --- the serve loop ----------------------------------------------------
 
@@ -262,6 +379,7 @@ class Federation:
                     f"federation did not converge in {max_rounds} "
                     f"working rounds: {self.gateway._by_status()}")
             self.gateway.poll_spool()
+            self._drive_pool()
             for name in sorted(self.pods):
                 pod = self.pods[name]
                 if pod.dead:
@@ -293,9 +411,14 @@ class Federation:
                 if pod.sched.revoke_quota(child, "shard-converged"):
                     self.revoked += 1
             self._maybe_rebalance()
+            if self.on_round is not None:
+                self.on_round(self)
             if not self.gateway.spool.pending() and (
                     self.gateway.all_done()
                     or not self.gateway.entries):
+                if self.gateway.retiring or (self.gateway.entries
+                                             and self.gateway.scaled_pods):
+                    continue         # pool transitions still settling
                 if self.idle_exit:
                     break
                 self.idle_rounds += 1
@@ -304,7 +427,8 @@ class Federation:
         # federation finished through), drain survivors, snapshot
         if self.chaos is not None:
             for kind in ("kill_pod", "partition_pod", "kill_shard",
-                         "partition_during_merge"):
+                         "partition_during_merge", "kill_during_retire",
+                         "kill_new_pod"):
                 done = self.chaos.injected.get(kind, 0) \
                     - self.chaos.survived.get(kind, 0)
                 for _ in range(done):
@@ -330,6 +454,7 @@ class Federation:
         return {"rounds": self.round, "failovers": self.failovers,
                 "migrations": self.migrations, "fenced": self.fenced,
                 "revoked": self.revoked,
+                "scale_ups": self.scale_ups, "retired": self.retired,
                 "busy_s": {n: round(self.pods[n].busy_s, 4)
                            for n in sorted(self.pods)},
                 "dead_pods": sorted(self.gateway.dead_pods)}

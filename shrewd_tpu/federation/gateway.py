@@ -242,6 +242,16 @@ class Gateway:
         self.compact_every = max(1, int(compact_every))
         self.entries: dict[str, RouteEntry] = {}
         self.dead_pods: set[str] = set()
+        # --- the elastic pool ledger (autoscaling) ---
+        # every pool transition is journaled BEFORE any pod is touched
+        # (pool_scale_up / pool_retire_begin / pool_retire_done), so the
+        # pool membership below is pure WAL-derived state: recovery
+        # replays it, the obs gauges read it, and nothing else may be a
+        # second source of truth for what pods exist
+        self.retiring: set[str] = set()      # retire begun, not done
+        self.scale_seq = 0                   # journaled scale ordinal
+        self.scaled_pods: dict[str, int] = {}  # autoscaled pod -> ordinal
+        self.retires: dict[str, dict] = {}   # pod -> retire bookkeeping
         self.recoveries = 0
         self.journal_torn = 0
         self._journal: FleetJournal | None = None
@@ -282,7 +292,13 @@ class Gateway:
     # --- load / routing policy --------------------------------------------
 
     def live_pods(self) -> list[str]:
-        return [n for n in sorted(self.pods) if n not in self.dead_pods]
+        """Pods eligible to receive placements.  A RETIRING pod is
+        fenced out the instant ``pool_retire_begin`` lands — it may
+        keep beating while it drains (a hung retire may beat for a long
+        time), but no placement decision can ever choose it again: the
+        journaled retire IS the fence, not the lease."""
+        return [n for n in sorted(self.pods)
+                if n not in self.dead_pods and n not in self.retiring]
 
     def pod_load(self, name: str) -> dict:
         """One pod's live load, read from its published ``metrics.json``
@@ -329,18 +345,24 @@ class Gateway:
                  if ld["trials_per_s"] > 0 and not ld["dead"]]
         return sum(rates) / len(rates) if rates else 0.0
 
-    def _pick_pod(self, exclude=(), loads: dict | None = None) -> str:
+    def _pick_pod(self, exclude=(), loads: dict | None = None,
+                  avoid=()) -> str:
         """The routing decision: the live pod carrying the least ETA
         mass (score = published ETA + unplaced backlog), ties broken by
         name — reproducible given the same published metrics.
         ``loads`` lets a caller that already read the pods' metrics
-        reuse them (one read per placement, not one per question)."""
+        reuse them (one read per placement, not one per question).
+        ``avoid`` is a SOFT preference (``exclude`` is hard): candidates
+        outside it win when any exist, but when every live pod is
+        avoided the pick falls back to the full set — liveness over
+        spread."""
         cands = [n for n in self.live_pods() if n not in exclude]
         if not cands:
             raise RuntimeError("no live pod to route to")
+        preferred = [n for n in cands if n not in avoid] or cands
         if loads is None:
             loads = {n: self.pod_load(n) for n in cands}
-        return min(cands, key=lambda n: (loads[n]["score"], n))
+        return min(preferred, key=lambda n: (loads[n]["score"], n))
 
     def _migration_target(self, e: RouteEntry) -> str:
         """Where a drained tenant goes: the journaled ``migrate``
@@ -349,7 +371,8 @@ class Gateway:
         and the source drain completing, and a placement on a dead pod
         would strand the tenant forever."""
         if e.migrate_to and e.migrate_to in self.pods \
-                and e.migrate_to not in self.dead_pods:
+                and e.migrate_to not in self.dead_pods \
+                and e.migrate_to not in self.retiring:
             return e.migrate_to
         return self._pick_pod(exclude=(e.pod,))
 
@@ -866,7 +889,8 @@ class Gateway:
         if e is None:
             raise KeyError(f"unknown tenant {tenant!r}")
         if e.status != "placed" or to_pod not in self.pods \
-                or to_pod in self.dead_pods or to_pod == e.pod:
+                or to_pod in self.dead_pods or to_pod in self.retiring \
+                or to_pod == e.pod:
             return False
         self._jlog("migrate", {"tenant": tenant, "from": e.pod,
                                "to": to_pod,
@@ -919,11 +943,29 @@ class Gateway:
             # backlog to its target, so stranded tenants spread across
             # survivors instead of piling onto one snapshot's minimum
             loads = self.pod_loads()
-            target = self._pick_pod(exclude=(e.pod,), loads=loads)
+            target = self._pick_pod(exclude=(e.pod,), loads=loads,
+                                    avoid=self._sibling_pods(e))
             self._route_to(e, target, reason="failover",
                            from_pod=e.pod, loads=loads)
             moved.append(e.spec.name)
         return moved
+
+    def _sibling_pods(self, e: RouteEntry) -> set[str]:
+        """Pods already hosting a LIVE sibling shard of this entry's
+        parent (empty for unsharded tenants): the stripe-aware failover
+        preference.  Initial shard placement enforces distinct pods
+        hard; failover only PREFERS them (soft ``avoid``) — a shard
+        must land somewhere even when every survivor hosts a sibling."""
+        if not e.shard_of:
+            return set()
+        parent = self.entries.get(e.shard_of)
+        if parent is None:
+            return set()
+        return {c.pod for n in parent.shards
+                if n != e.spec.name
+                and (c := self.entries.get(n)) is not None
+                and c.pod
+                and c.status in ("routed", "placed", "draining")}
 
     def pod_heal(self, pod: str) -> list[str]:
         """A dead-declared pod resumed beating (a partition healed, not
@@ -946,6 +988,131 @@ class Gateway:
                                     for h in e.history):
                 stale.append(e.spec.name)
         return stale
+
+    # --- the elastic pool (autoscaling transitions) -------------------------
+    #
+    # The gateway's pool membership is itself WAL state: an autoscaler
+    # (federation/autoscale.py) DECIDES scale events, but the decision
+    # only exists once its record is durable — ``pool_scale_up`` before
+    # any pod directory is touched, ``pool_retire_begin`` before any
+    # tenant is drained, ``pool_retire_done`` after the last one left.
+    # Retirement rides the ordinary drain-here/recover-there migration
+    # path (the federation driver migrates every non-terminal tenant off
+    # the fenced pod), and a hung retire is safe because the fence is
+    # the journaled record, not the pod's cooperation: ``live_pods``
+    # excludes retiring pods, so a retiring pod that beats one last time
+    # can never be re-placed onto, and lease expiry (``pod_dead``) moves
+    # its tenants if it dies mid-drain.  Recovery replays the pool
+    # ledger like every routing decision — the driver reconciles pod
+    # processes to it, never the other way around.
+
+    def _pool_port(self, name: str) -> PodPort:
+        """The canonical pod layout for an autoscaled pod — derived
+        from the federation root (the gateway outdir's parent), never
+        journaled as an absolute path: pool records must replay after
+        the whole tree is relocated (crashcheck copies snapshots into
+        scratch roots)."""
+        root = os.path.join(os.path.dirname(self.outdir), "pods", name)
+        return PodPort(name, os.path.join(root, "spool"),
+                       os.path.join(root, "out"))
+
+    def pool_scale_up(self, reason: str = "", pressure: dict | None = None,
+                      round: int | None = None) -> str:
+        """Journal one scale-up decision and add the new pod to the
+        pool.  The pod's name derives from the scale ordinal
+        (``auto<n>`` — never reused), its layout from ``_pool_port``;
+        the record carries the pressure evidence so every autoscaling
+        decision is auditable from the WAL alone.  Returns the new pod
+        name; the driver spawns the actual ``PodHandle`` by reconciling
+        against the ledger."""
+        scale = self.scale_seq + 1
+        name = f"auto{scale}"
+        if name in self.pods:
+            raise ValueError(f"pool pod {name!r} already exists")
+        self._jlog("pool_scale_up", {"pod": name, "scale": scale,
+                                     "reason": reason,
+                                     "pressure": dict(pressure or {}),
+                                     "round": round})
+        self.scale_seq = scale
+        self.scaled_pods[name] = scale
+        self.pods[name] = self._pool_port(name)
+        obs_trace.tracer().emit(
+            "gw_pool_scale_up", cat="federation", pod=name, scale=scale,
+            reason=reason)
+        debug.dprintf("Federation", "pool scale-up -> %s (scale=%d, %s)",
+                      name, scale, reason)
+        self._maybe_compact()
+        return name
+
+    def pool_retire_begin(self, pod: str, reason: str = "",
+                          round: int | None = None) -> int:
+        """Journal one retire decision and fence the pod out of every
+        future placement.  The pod keeps serving what it already holds;
+        the driver drains it through the journaled migration path and
+        calls ``pool_retire_done`` when nothing non-terminal remains.
+        Returns the retire's scale ordinal (the chaos trigger
+        coordinate for ``kill_during_retire``)."""
+        if pod not in self.pods or pod in self.retiring:
+            raise ValueError(f"pod {pod!r} not retirable")
+        if not [n for n in self.live_pods() if n != pod]:
+            raise RuntimeError(
+                f"refusing to retire {pod!r}: no live pod would remain")
+        scale = self.scale_seq + 1
+        self._jlog("pool_retire_begin", {"pod": pod, "scale": scale,
+                                         "reason": reason,
+                                         "round": round})
+        self.scale_seq = scale
+        self.retiring.add(pod)
+        self.retires[pod] = {"scale": scale, "begin_round": round,
+                             "done_round": None}
+        obs_trace.tracer().emit(
+            "gw_pool_retire_begin", cat="federation", pod=pod,
+            scale=scale, reason=reason)
+        debug.dprintf("Federation", "pool retire begin: %s (scale=%d, %s)",
+                      pod, scale, reason)
+        return scale
+
+    def pool_retire_done(self, pod: str, round: int | None = None) -> None:
+        """Journal the retire's completion and drop the pod from the
+        pool.  Idempotent (a replayed completion is a no-op); the pod's
+        durable tree stays on disk — done-docs already adopted live in
+        the routing ledger, and the tree is evidence, not state."""
+        if pod not in self.retiring:
+            return
+        rec = self.retires.get(pod) or {}
+        self._jlog("pool_retire_done", {"pod": pod,
+                                        "scale": rec.get("scale"),
+                                        "round": round})
+        self.retiring.discard(pod)
+        rec["done_round"] = round
+        self.retires[pod] = rec
+        self.pods.pop(pod, None)
+        self.dead_pods.discard(pod)
+        self.scaled_pods.pop(pod, None)
+        obs_trace.tracer().emit(
+            "gw_pool_retire_done", cat="federation", pod=pod,
+            scale=rec.get("scale"))
+        debug.dprintf("Federation", "pool retire done: %s", pod)
+        self._maybe_compact()
+
+    def pool_status(self) -> dict:
+        """The pool ledger's observable view — pure WAL-derived state
+        (the obs gauges and the HTTP front read THIS, never a second
+        count of pod processes).  ``retire_drain_rounds`` is the
+        per-pod drain duration in federation rounds (in-flight retires
+        report their duration so far as None until done)."""
+        drains = {}
+        for pod, rec in sorted(self.retires.items()):
+            b, d = rec.get("begin_round"), rec.get("done_round")
+            drains[pod] = (d - b if d is not None and b is not None
+                           else None)
+        return {"size": len(self.pods),
+                "live": len(self.live_pods()),
+                "retiring": sorted(self.retiring),
+                "pending_scale_decisions": len(self.retiring),
+                "scale_seq": self.scale_seq,
+                "scaled_pods": dict(self.scaled_pods),
+                "retire_drain_rounds": drains}
 
     # --- aggregate results -------------------------------------------------
 
@@ -982,6 +1149,7 @@ class Gateway:
                             "history": list(e.history)}
                         for n, e in sorted(self.entries.items())},
             "dead_pods": sorted(self.dead_pods),
+            "pool": self.pool_status(),
             "recoveries": self.recoveries,
         }
 
@@ -997,6 +1165,11 @@ class Gateway:
         doc = {"version": GATEWAY_CKPT_VERSION,
                "pods": sorted(self.pods),
                "dead_pods": sorted(self.dead_pods),
+               "retiring": sorted(self.retiring),
+               "scale_seq": self.scale_seq,
+               "scaled_pods": dict(self.scaled_pods),
+               "retires": {p: dict(rec)
+                           for p, rec in self.retires.items()},
                "recoveries": self.recoveries,
                "compact_every": self.compact_every,
                "journal_seq": (self._journal.next_seq - 1
@@ -1033,6 +1206,33 @@ class Gateway:
             return
         if kind == "pod_heal":
             self.dead_pods.discard(str(r.get("pod")))
+            return
+        if kind == "pool_scale_up":
+            name = str(r.get("pod"))
+            self.scale_seq = max(self.scale_seq, int(r.get("scale", 0)))
+            self.scaled_pods[name] = int(r.get("scale", 0))
+            if name not in self.pods:
+                # ports are re-derived from the relocatable layout, not
+                # the record: the snapshot tree may have moved
+                self.pods[name] = self._pool_port(name)
+            return
+        if kind == "pool_retire_begin":
+            pod = str(r.get("pod"))
+            self.scale_seq = max(self.scale_seq, int(r.get("scale", 0)))
+            self.retiring.add(pod)
+            self.retires[pod] = {"scale": int(r.get("scale", 0)),
+                                 "begin_round": r.get("round"),
+                                 "done_round": None}
+            return
+        if kind == "pool_retire_done":
+            pod = str(r.get("pod"))
+            self.retiring.discard(pod)
+            rec = self.retires.setdefault(
+                pod, {"scale": r.get("scale"), "begin_round": None})
+            rec["done_round"] = r.get("round")
+            self.pods.pop(pod, None)
+            self.dead_pods.discard(pod)
+            self.scaled_pods.pop(pod, None)
             return
         if kind == "accept":
             if r.get("tenant") not in self.entries:
@@ -1191,11 +1391,29 @@ class Gateway:
         if snap:
             gw.recoveries = int(snap.get("recoveries", 0))
             gw.dead_pods = set(snap.get("dead_pods") or [])
+            gw.retiring = set(snap.get("retiring") or [])
+            gw.scale_seq = int(snap.get("scale_seq", 0))
+            gw.scaled_pods = {k: int(v) for k, v in
+                              (snap.get("scaled_pods") or {}).items()}
+            gw.retires = {k: dict(v) for k, v in
+                          (snap.get("retires") or {}).items()}
             for ed in sorted(snap["entries"], key=lambda d: d["order"]):
                 e = RouteEntry.from_dict(ed)
                 gw.entries[e.spec.name] = e
         for r in fresh:
             gw._apply_record(r)
+        # reconcile the pod map to the replayed pool ledger: snapshot-
+        # restored scaled pods get their relocatable ports back, and a
+        # completed retire drops its pod even when the caller's static
+        # pod set still names it — the WAL, not the constructor
+        # argument, owns pool membership
+        for name in gw.scaled_pods:
+            if name not in gw.pods:
+                gw.pods[name] = gw._pool_port(name)
+        for pod in gw.retires:
+            if pod not in gw.retiring:
+                gw.pods.pop(pod, None)
+                gw.scaled_pods.pop(pod, None)
         gw._journal_floor = max(
             snap_seq + 1, (records[-1]["seq"] + 1) if records else 0)
         gw._open_journal()

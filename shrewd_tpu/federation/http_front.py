@@ -26,7 +26,12 @@ Endpoints::
                      ELF, lift divergence) lands in durable quarantine
                      with evidence, never a pod death
     GET  /status     → the gateway's persisted snapshot (routing
-                     ledger: per-tenant placement/epoch/deadline)
+                     ledger: per-tenant placement/epoch/deadline,
+                     plus the elastic pool ledger: scale_seq,
+                     retiring set, scaled pods, retire history)
+    GET  /pool       → the published pool surface (``pool.json``:
+                     size/live/retiring/scale_seq/drain durations,
+                     derived from journaled records each round)
     GET  /healthz    → 200 {"ok": true}
 
 No TLS, no auth — a localhost service front for harness and
@@ -44,6 +49,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from shrewd_tpu import resilience as resil
 from shrewd_tpu.federation.gateway import gateway_snap_path
+from shrewd_tpu.obs import metrics as obs_metrics
 from shrewd_tpu.service.queue import SubmissionQueue, TenantSpec
 from shrewd_tpu.utils import debug
 
@@ -88,6 +94,12 @@ class GatewayHTTPFront:
                             gateway_snap_path(front.outdir)))
                     except (OSError, ValueError):
                         self._reply(404, {"error": "no gateway snapshot"})
+                elif self.path == "/pool":
+                    try:
+                        self._reply(200, obs_metrics.read_pool(
+                            front.outdir))
+                    except (OSError, ValueError):
+                        self._reply(404, {"error": "no pool surface"})
                 else:
                     self._reply(404, {"error": f"unknown path "
                                                f"{self.path}"})

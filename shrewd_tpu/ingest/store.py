@@ -148,6 +148,8 @@ class ArtifactStore:
         <root>/obj/<sha256>/<axes>/<name>.json   checksummed stage docs
         <root>/obj/<sha256>/<axes>/<file>        payloads (sha in doc)
         <root>/obj/<sha256>/<axes>/.lock         single-flight guard
+        <root>/exec/                             jax persistent
+                                                 compilation cache
     """
 
     def __init__(self, root: str):
@@ -156,6 +158,23 @@ class ArtifactStore:
         self.obj_root = os.path.join(self.root, "obj")
         os.makedirs(self.bin_dir, exist_ok=True)
         os.makedirs(self.obj_root, exist_ok=True)
+
+    def exec_dir(self) -> str:
+        """The cross-pod COMPILE-REUSE artifact kind: a directory for
+        jax's persistent compilation cache
+        (``exec_cache.enable_persistent_cache``), living beside the
+        ingest objects so a federation that threads one store root
+        through every pod also shares every compiled step — a cell
+        compiled on pod0 is a cache hit on the pod an autoscaler spawned
+        ten rounds later.  jax keys entries by content fingerprint of
+        the computation + compile options + backend, so the store needs
+        no extra addressing discipline here; entries are moved into
+        place atomically by jax itself and a torn/absent entry is just a
+        miss (recompile), never corruption — the same posture as every
+        other artifact kind above."""
+        d = os.path.join(self.root, "exec")
+        os.makedirs(d, exist_ok=True)
+        return d
 
     # --- submitted binaries ----------------------------------------------
 

@@ -33,6 +33,11 @@ from shrewd_tpu.obs import clock
 METRICS_JSON = "metrics.json"
 METRICS_PROM = "metrics.prom"
 
+#: the federation gateway's pool-ledger surfaces (published under the
+#: GATEWAY outdir, not a pod's — pool membership is gateway state)
+POOL_JSON = "pool.json"
+POOL_PROM = "pool.prom"
+
 #: exposition prefix — one namespace for every gauge this module emits
 _PROM_NS = "shrewd_fleet"
 
@@ -261,9 +266,82 @@ def publish(outdir: str, sched) -> dict:
     return snap
 
 
+def pool_prometheus_text(pool: dict) -> str:
+    """Prometheus exposition of the gateway's pool ledger
+    (``Gateway.pool_status()`` — pure WAL-derived state: the gauges
+    below are a rendering of the journaled ``pool_scale_up`` /
+    ``pool_retire_begin`` / ``pool_retire_done`` records, never a
+    second count of pod processes)."""
+    lines = []
+
+    def gauge(name: str, value, labels: dict | None = None,
+              help_: str = ""):
+        full = f"{_PROM_NS}_{name}"
+        if help_:
+            lines.append(f"# HELP {full} {help_}")
+            lines.append(f"# TYPE {full} gauge")
+        lab = ""
+        if labels:
+            body = ",".join(f'{k}="{_label_escape(v)}"'
+                            for k, v in sorted(labels.items()))
+            lab = "{" + body + "}"
+        try:
+            v = float(value)
+        except (TypeError, ValueError):
+            return
+        lines.append(f"{full}{lab} {v:g}")
+
+    gauge("pool_size", pool.get("size", 0),
+          help_="pods in the gateway's journaled pool ledger")
+    gauge("pool_live", pool.get("live", 0),
+          help_="pods eligible for placement (not dead, not retiring)")
+    gauge("pool_pending_scale_decisions",
+          pool.get("pending_scale_decisions", 0),
+          help_="journaled pool transitions not yet completed "
+                "(retires begun without a pool_retire_done)")
+    gauge("pool_scale_seq", pool.get("scale_seq", 0),
+          help_="journaled scale ordinal (pool WAL records so far)")
+    first = True
+    for pod, rounds in sorted(
+            (pool.get("retire_drain_rounds") or {}).items()):
+        gauge("pool_retire_drain_rounds", rounds, {"pod": pod},
+              help_="federation rounds from pool_retire_begin to "
+                    "pool_retire_done" if first else "")
+        first = False
+    return "\n".join(lines) + "\n"
+
+
+def publish_pool(outdir: str, pool: dict) -> None:
+    """Write the pool ledger's observability surfaces (rename-atomic,
+    deliberately unsynced like ``publish`` — recovery replays the
+    gateway WAL, never these files)."""
+    import json
+
+    os.makedirs(outdir, exist_ok=True)
+    tmp = os.path.join(outdir, POOL_JSON + ".tmp")
+    with open(tmp, "w") as f:
+        # graftlint: allow-raw-write -- per-round pool snapshot: atomic
+        # rename, deliberately unsynced (overwritten next round; crash
+        # recovery replays the gateway WAL, never this file)
+        json.dump(pool, f, default=str)
+    os.replace(tmp, os.path.join(outdir, POOL_JSON))
+    tmp = os.path.join(outdir, POOL_PROM + ".tmp")
+    with open(tmp, "w") as f:
+        f.write(pool_prometheus_text(pool))
+    os.replace(tmp, os.path.join(outdir, POOL_PROM))
+
+
 def read(outdir: str) -> dict:
     """Load the latest snapshot (``tools/obs.py --tail``)."""
     import json
 
     with open(os.path.join(outdir, METRICS_JSON)) as f:
+        return json.load(f)
+
+
+def read_pool(outdir: str) -> dict:
+    """Load the latest pool-ledger surface (``GET /pool``)."""
+    import json
+
+    with open(os.path.join(outdir, POOL_JSON)) as f:
         return json.load(f)

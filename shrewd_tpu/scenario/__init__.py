@@ -24,6 +24,7 @@ from shrewd_tpu.scenario.pareto import (PARETO_SCHEMA, artifact,
                                         design_search, dominates,
                                         prune_decisions, write_artifact)
 from shrewd_tpu.scenario.runner import (MATRIX_DOC, PRUNE_REASON,
+                                        FederatedScenarioRunner,
                                         ScenarioRunner)
 
 __all__ = [
@@ -31,5 +32,6 @@ __all__ = [
     "ScenarioMatrix", "cell_seed",
     "PARETO_SCHEMA", "artifact", "artifact_path", "cell_point",
     "design_search", "dominates", "prune_decisions", "write_artifact",
-    "MATRIX_DOC", "PRUNE_REASON", "ScenarioRunner",
+    "MATRIX_DOC", "PRUNE_REASON", "FederatedScenarioRunner",
+    "ScenarioRunner",
 ]

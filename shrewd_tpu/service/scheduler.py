@@ -267,10 +267,21 @@ class CampaignScheduler:
     def mesh(self):
         """The fleet's ONE mesh, built lazily (jax enters here): every
         tenant's campaigns shard over the same devices, which is what
-        makes their executables cache-interchangeable."""
+        makes their executables cache-interchangeable.  A fleet wired
+        to a SHARED artifact store (``store_dir`` — the federation
+        threads one root through every pod) also points jax's
+        persistent compilation cache at the store's exec-cache kind, so
+        compile reuse crosses pod-process boundaries: a step compiled
+        on any pod is a disk hit on every other, including pods an
+        autoscaler spawns later.  Best-effort by contract — an old jax
+        without the knobs degrades to in-process caching."""
         if self._mesh is None:
             from shrewd_tpu.parallel.mesh import make_mesh
 
+            if self.store_dir:
+                from shrewd_tpu.parallel import exec_cache
+
+                exec_cache.enable_persistent_cache(self.store.exec_dir())
             self._mesh = make_mesh()
         return self._mesh
 

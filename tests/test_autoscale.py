@@ -1,0 +1,444 @@
+"""Elastic pod pool (shrewd_tpu/federation/autoscale.py + the gateway
+pool ledger): journaled scale-up/retire decisions, retire fencing,
+pool-level chaos, and the pool-boundary crash sweep.
+
+The contract under test is the ISSUE acceptance criterion: the pool
+only ever changes through GL201-certified WAL kinds (``pool_scale_up``
+/ ``pool_retire_begin`` / ``pool_retire_done`` journaled BEFORE any
+pod is touched), a retiring pod is fenced out of every placement the
+instant its retire lands (the journaled retire IS the fence — a hung
+retire may keep heartbeating forever and still never win a placement),
+retirement drains through the ordinary migration path, and an
+autoscaled 3-at-the-floor pool serves the same submissions to
+bit-identical tallies as a solo run.  Around that: the ``at_scale``
+chaos kinds' trigger-vocab validation and deterministic firing, the
+pressure-score control loop's thresholds/cooldown/victim policy, the
+WAL-derived obs surfaces (``pool.json`` / ``pool.prom`` / ``GET
+/pool``), the cross-pod compile-reuse artifact kind, and the
+exhaustive pool-boundary recovery sweep
+(``analysis/crashcheck.run_gateway_crashcheck(autoscale=...)``).
+"""
+
+import json
+import os
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from test_fleet import _plan, _solo_tallies
+
+from shrewd_tpu.analysis import crashcheck
+from shrewd_tpu.chaos import ChaosEngine, ChaosPlanError
+from shrewd_tpu.federation import (Autoscaler, Federation, Gateway,
+                                   GatewayHTTPFront)
+from shrewd_tpu.federation.gateway import gateway_journal_path
+from shrewd_tpu.obs import metrics as obs_metrics
+from shrewd_tpu.service import TenantSpec
+from shrewd_tpu.service.journal import FleetJournal
+
+
+def _spec(name, seed=3, n_batches=4, **kw):
+    return TenantSpec(name=name,
+                      plan=_plan(seed, n_batches=n_batches).to_dict(),
+                      **kw)
+
+
+def _assert_matches(fed, name, solo):
+    got = fed.tenant_tallies(name)
+    assert got.keys() == solo.keys()
+    for k, t in solo.items():
+        np.testing.assert_array_equal(got[k], t)
+
+
+# --- chaos DSL: pool kinds (jax-free units) ---------------------------------
+
+def test_pool_chaos_kinds_validation():
+    # at_scale is the WHOLE trigger vocabulary for the pool kinds: a
+    # fault that would silently never fire is a plan error, loudly
+    with pytest.raises(ChaosPlanError, match="needs at_scale"):
+        ChaosEngine({"faults": [{"kind": "kill_during_retire"}]})
+    with pytest.raises(ChaosPlanError, match="needs at_scale"):
+        ChaosEngine({"faults": [{"kind": "kill_new_pod"}]})
+    with pytest.raises(ChaosPlanError, match="does not take 'at_tick'"):
+        ChaosEngine({"faults": [
+            {"kind": "kill_new_pod", "at_scale": [1], "at_tick": [1]}]})
+    with pytest.raises(ChaosPlanError, match="does not take 'at_round'"):
+        ChaosEngine({"faults": [
+            {"kind": "kill_during_retire", "at_scale": [1],
+             "at_round": [2]}]})
+    # and at_scale belongs to the pool kinds alone
+    with pytest.raises(ChaosPlanError, match="does not take 'at_scale'"):
+        ChaosEngine({"faults": [
+            {"kind": "kill_pod", "at_tick": [1], "at_scale": [1]}]})
+
+
+def test_pool_chaos_hooks_fire_deterministically():
+    # the trigger coordinate is the gateway's journaled scale ordinal —
+    # a WAL append, never a clock: wrong ordinal / wrong pod filter
+    # never fire, the right one fires exactly once
+    eng = ChaosEngine({"faults": [
+        {"kind": "kill_during_retire", "at_scale": [3], "pod": "auto1"},
+        {"kind": "kill_new_pod", "at_scale": [2]},
+    ]})
+    fired = []
+    eng.kill_action = lambda rc=None: fired.append(rc)
+    eng.maybe_kill_during_retire("auto1", 2)        # wrong ordinal
+    eng.maybe_kill_during_retire("pod0", 3)         # wrong pod filter
+    assert "kill_during_retire" not in eng.injected
+    eng.maybe_kill_during_retire("auto1", 3)
+    assert eng.injected == {"kill_during_retire": 1} and len(fired) == 1
+    eng.maybe_kill_during_retire("auto1", 3)        # consumed: once
+    assert eng.injected == {"kill_during_retire": 1}
+    eng.maybe_kill_new_pod("auto2", 1)              # wrong ordinal
+    assert "kill_new_pod" not in eng.injected
+    eng.maybe_kill_new_pod("auto2", 2)
+    assert eng.injected["kill_new_pod"] == 1 and len(fired) == 2
+
+
+# --- the journaled pool ledger ----------------------------------------------
+
+def test_gateway_pool_ledger_journaled_and_recoverable(tmp_path):
+    # every pool transition is a WAL record BEFORE the in-memory pool
+    # is trusted, auto pod names derive from the never-reused scale
+    # ordinal, and recovery mid-retire reconstructs the exact ledger —
+    # scaled pod ports re-derived, fence still up
+    root = str(tmp_path / "fed")
+    fed = Federation(root, pod_names=("pod0", "pod1"))
+    gw = fed.gateway
+    name = gw.pool_scale_up(reason="pressure",
+                            pressure={"score": 9000.0}, round=4)
+    assert name == "auto1" and gw.scale_seq == 1
+    assert gw.scaled_pods == {"auto1": 1}
+    assert "auto1" in gw.pods and "auto1" in gw.live_pods()
+    recs, _, _ = FleetJournal.replay_path(gateway_journal_path(gw.outdir))
+    up = [r for r in recs if r["kind"] == "pool_scale_up"]
+    assert len(up) == 1 and up[0]["pod"] == "auto1"
+    assert up[0]["scale"] == 1
+    assert up[0]["pressure"]["score"] == 9000.0           # auditable
+    # the retire consumes the NEXT ordinal off the same sequence
+    scale = gw.pool_retire_begin("auto1", reason="idle", round=7)
+    assert scale == 2 and gw.scale_seq == 2
+    assert "auto1" in gw.retiring and "auto1" not in gw.live_pods()
+    st = gw.pool_status()
+    assert st["pending_scale_decisions"] == 1 and st["size"] == 3
+    assert st["retire_drain_rounds"] == {"auto1": None}   # in flight
+    with pytest.raises(ValueError):
+        gw.pool_retire_begin("auto1")                     # already retiring
+    with pytest.raises(ValueError):
+        gw.pool_retire_begin("nope")                      # unknown pod
+    # crash here: recovery replays the ledger — pool intact, fence up
+    ports = {n: p.port for n, p in fed.pods.items()}
+    gw2 = Gateway.recover(gw.outdir, pods=ports)
+    assert gw2.scale_seq == 2 and gw2.scaled_pods == {"auto1": 1}
+    assert "auto1" in gw2.pods and "auto1" in gw2.retiring
+    assert gw2.retires["auto1"]["scale"] == 2
+    assert "auto1" not in gw2.live_pods()
+    # completion drops the pod; the retire history is durable evidence
+    gw2.pool_retire_done("auto1", round=9)
+    assert "auto1" not in gw2.pods and not gw2.retiring
+    assert gw2.retires["auto1"]["done_round"] == 9
+    gw2.pool_retire_done("auto1", round=10)               # idempotent
+    assert gw2.retires["auto1"]["done_round"] == 9
+    assert gw2.pool_status()["retire_drain_rounds"] == {"auto1": 2}
+    # the next scale-up never reuses the ordinal or the name
+    assert gw2.pool_scale_up(reason="again") == "auto3"
+
+
+def test_gateway_refuses_retire_that_empties_pool(tmp_path):
+    fed = Federation(str(tmp_path / "fed"), pod_names=("pod0",))
+    with pytest.raises(RuntimeError, match="no live pod would remain"):
+        fed.gateway.pool_retire_begin("pod0")
+
+
+# --- retire fencing: the lease-expiry race (satellite) ----------------------
+
+def test_retiring_pod_heartbeat_cannot_win_placement(tmp_path):
+    # the race the satellite pins: a pod keeps heartbeating AFTER its
+    # pool_retire_begin landed (a hung retire holds a fresh lease for a
+    # long time) — the journaled retire is the fence, not the lease, so
+    # no new admission, pick, or migration may ever land on it
+    solo3 = _solo_tallies(_plan(3, n_batches=2))
+    solo5 = _solo_tallies(_plan(5, n_batches=2))
+    root = str(tmp_path / "fed")
+    fed = Federation(root, pod_names=("pod0", "pod1"))
+    gw = fed.gateway
+    fed.submit(_spec("t3", 3, n_batches=2))
+    victim = gw.entries["t3"].pod
+    other = [n for n in ("pod0", "pod1") if n != victim][0]
+    gw.pool_retire_begin(victim, reason="test", round=1)
+    fed.pods[victim].beat()                       # the lease stays fresh
+    assert victim not in gw.live_pods()           # ...the fence holds
+    assert gw._pick_pod() == other
+    fed.submit(_spec("t5", 5, n_batches=2))       # new admission: fenced
+    assert gw.entries["t5"].pod == other
+    assert gw.migrate("t5", victim, "test") is False   # no back-migration
+    # WAL evidence: the fence was journaled before t5's route decision
+    recs, _, _ = FleetJournal.replay_path(gateway_journal_path(gw.outdir))
+    kinds = [(r["kind"], r.get("tenant") or r.get("pod")) for r in recs]
+    assert kinds.index(("pool_retire_begin", victim)) \
+        < kinds.index(("route", "t5"))
+    # the drain completes through the ordinary migration path and the
+    # whole campaign still folds bit-identically
+    assert fed.serve() == 0
+    assert fed.retired == 1
+    assert gw.retires[victim]["done_round"] is not None
+    assert gw.entries["t3"].pod == other
+    assert any(h["reason"] == "migrate" and h["pod"] == other
+               for h in gw.entries["t3"].history)
+    _assert_matches(fed, "t3", solo3)
+    _assert_matches(fed, "t5", solo5)
+
+
+# --- the pressure control loop (jax-free unit) ------------------------------
+
+class _FakeGW:
+    """A duck-typed gateway exposing exactly the decision surface the
+    Autoscaler reads (live pods + their published loads) and the two
+    journaling seams it is allowed to call."""
+
+    def __init__(self, live, scores):
+        self._live = list(live)
+        self.scores = dict(scores)
+        self.entries = {}
+        self.retiring = set()
+        self.scaled_pods = {n: i + 1 for i, n in enumerate(self._live)
+                            if n.startswith("auto")}
+        self.ups, self.downs = [], []
+
+    def live_pods(self):
+        return sorted(self._live)
+
+    def pod_load(self, name):
+        return {"score": self.scores[name]}
+
+    def pool_scale_up(self, reason="", pressure=None, round=None):
+        name = f"auto{len(self.ups) + 1}"
+        self.ups.append((name, round, pressure))
+        self._live.append(name)
+        self.scores[name] = 0.0
+        self.scaled_pods[name] = len(self.ups)
+        return name
+
+    def pool_retire_begin(self, pod, reason="", round=None):
+        self.downs.append((pod, round))
+        self._live.remove(pod)
+        self.retiring.add(pod)
+        return 99
+
+
+def test_autoscaler_thresholds_cooldown_and_victim_policy():
+    gw = _FakeGW(["pod0", "pod1"], {"pod0": 9000.0, "pod1": 7000.0})
+    auto = Autoscaler(min_pods=1, max_pods=4, up_trials=1000.0,
+                      down_trials=100.0, cooldown_rounds=2)
+    d = auto.tick(gw, 0)
+    assert d["action"] == "scale_up" and d["pod"] == "auto1"
+    assert gw.ups[0][2]["score"] == 8000.0        # evidence rides along
+    assert auto.tick(gw, 1) is None               # cooldown window
+    d = auto.tick(gw, 2)                          # still hot: grow again
+    assert d["action"] == "scale_up" and d["pod"] == "auto2"
+    gw.scores.update({n: 9000.0 for n in gw.scores})
+    assert auto.tick(gw, 4) is None               # at max_pods: capped
+    # pressure collapses: the coldest AUTOSCALED pod retires first,
+    # even when a static pod is colder — the pool contracts to its
+    # static floor before any hand-built pod is considered
+    gw.scores.update({"pod0": 0.0, "pod1": 50.0,
+                      "auto1": 30.0, "auto2": 10.0})
+    d = auto.tick(gw, 6)
+    assert d["action"] == "retire" and d["pod"] == "auto2"
+    # one retire at a time: the pending drain blocks the next decision
+    assert auto.tick(gw, 8) is None
+    gw.retiring.clear()
+    assert auto.tick(gw, 10)["pod"] == "auto1"
+    gw.retiring.clear()
+    del gw.scaled_pods["auto1"], gw.scaled_pods["auto2"]
+    d = auto.tick(gw, 12)                         # floor-bound: pod0 is
+    assert d["pod"] == "pod0"                     # coldest, pool > min
+    gw.retiring.clear()
+    assert auto.tick(gw, 14) is None              # at min_pods: held
+
+
+def test_autoscaler_pressure_reads_unplaced_backlog(tmp_path):
+    # the backlog signal: accepted-but-unplaced entries add their
+    # estimated trials to the score even before any pod publishes load
+    fed = Federation(str(tmp_path / "fed"), pod_names=("pod0",))
+    gw = fed.gateway
+    fed.submit(_spec("t3", 3, n_batches=2))
+    auto = Autoscaler()
+    p = auto.pressure(gw)
+    assert p["live"] == 1 and p["unplaced"] == 0
+    assert p["score"] > 0          # the placed entry's backlog counts
+    e = gw.entries["t3"]
+    e.status, e.pod = "accepted", ""        # rewind to pre-route
+    p2 = auto.pressure(gw)
+    assert p2["unplaced"] == 1 and p2["backlog_trials"] > 0
+
+
+# --- the elastic pool end-to-end --------------------------------------------
+
+def test_federation_autoscaled_pool_grows_and_contracts(tmp_path):
+    # the headline: one static pod, pressure forks the pool out to its
+    # cap, convergence drains it back to the floor — every transition
+    # journaled, every tenant bit-identical to solo, the obs surface a
+    # pure rendering of the WAL-derived ledger
+    seeds = (3, 5, 7, 11)
+    solo = {s: _solo_tallies(_plan(s, n_batches=2)) for s in seeds}
+    root = str(tmp_path / "fed")
+    auto = Autoscaler(min_pods=1, max_pods=3, up_trials=64.0,
+                      down_trials=16.0, cooldown_rounds=1)
+    fed = Federation(root, pod_names=("pod0",), autoscale=auto)
+    for s in seeds:
+        fed.submit(_spec(f"t{s}", s, n_batches=2))
+    assert fed.serve() == 0
+    gw = fed.gateway
+    assert fed.scale_ups >= 1                  # pressure forked the pool
+    assert fed.retired == fed.scale_ups        # ...and it contracted back
+    assert sorted(gw.pods) == ["pod0"] and not gw.scaled_pods
+    assert not gw.retiring
+    st = gw.pool_status()
+    assert st["scale_seq"] == fed.scale_ups + fed.retired
+    assert st["pending_scale_decisions"] == 0
+    # the retire history is durable evidence of the full cycle
+    assert len(gw.retires) == fed.retired
+    for pod, rec in gw.retires.items():
+        assert pod.startswith("auto")
+        assert rec["done_round"] is not None
+    for s in seeds:
+        _assert_matches(fed, f"t{s}", solo[s])
+    # the obs pool surface is the WAL-derived ledger, round-fresh
+    pool = obs_metrics.read_pool(gw.outdir)
+    assert pool["scale_seq"] == st["scale_seq"]
+    assert pool["retiring"] == []
+    prom = open(os.path.join(gw.outdir, "pool.prom")).read()
+    assert f"shrewd_fleet_pool_scale_seq {st['scale_seq']}" in prom
+
+
+def test_federation_pool_chaos_killed_pods_survived(tmp_path):
+    # kill_new_pod fells auto1 the moment the driver first steps it
+    # (placements already journaled onto it); kill_during_retire fells
+    # the first retiring pod mid-drain — both addressed by the
+    # journaled scale ordinal, both survived to bit-identical tallies
+    seeds = (3, 5, 7, 11)
+    solo = {s: _solo_tallies(_plan(s, n_batches=2)) for s in seeds}
+    chaos = ChaosEngine({"faults": [
+        {"kind": "kill_new_pod", "at_scale": [1]},
+        {"kind": "kill_during_retire", "at_scale": [4]},
+    ]})
+    auto = Autoscaler(min_pods=1, max_pods=3, up_trials=64.0,
+                      down_trials=16.0, cooldown_rounds=1)
+    fed = Federation(str(tmp_path / "fed"), pod_names=("pod0",),
+                     autoscale=auto, chaos=chaos, expiry_rounds=2)
+    for s in seeds:
+        fed.submit(_spec(f"t{s}", s, n_batches=2))
+    assert fed.serve() == 0
+    assert chaos.injected == {"kill_new_pod": 1, "kill_during_retire": 1}
+    assert chaos.survived == {"kill_new_pod": 1, "kill_during_retire": 1}
+    gw = fed.gateway
+    assert sorted(gw.pods) == ["pod0"] and not gw.retiring
+    for pod, rec in gw.retires.items():
+        assert rec["done_round"] is not None
+    for s in seeds:
+        _assert_matches(fed, f"t{s}", solo[s])
+
+
+def test_federation_recover_mid_retire_completes_transition(tmp_path):
+    # crash after pool_retire_begin, recover WITHOUT an autoscaler:
+    # completing the transition is the driver's job — the journaled
+    # ledger alone must drain the pod and land pool_retire_done
+    solo = _solo_tallies(_plan(3, n_batches=2))
+    root = str(tmp_path / "fed")
+    fed = Federation(root, pod_names=("pod0", "pod1"))
+    fed.submit(_spec("t3", 3, n_batches=2))
+    victim = fed.gateway.entries["t3"].pod
+    fed.gateway.pool_retire_begin(victim, reason="test", round=0)
+    fed.gateway.checkpoint()                  # durable ledger, then die
+    fed2 = Federation.recover(root, pod_names=("pod0", "pod1"))
+    assert victim in fed2.gateway.retiring
+    assert fed2.serve() == 0
+    assert not fed2.gateway.retiring
+    assert fed2.gateway.retires[victim]["done_round"] is not None
+    assert fed2.gateway.entries["t3"].pod != victim
+    _assert_matches(fed2, "t3", solo)
+
+
+# --- the pool-boundary crash sweep ------------------------------------------
+
+def test_gateway_autoscaled_pool_boundary_crashcheck(tmp_path):
+    # the CI gate in miniature: recovery re-executed from EVERY pool
+    # WAL append (plain + torn tail), autoscaler detached on recovery,
+    # zero divergent recoveries
+    pool_kinds = ("pool_scale_up", "pool_retire_begin",
+                  "pool_retire_done")
+    doc = crashcheck.run_gateway_crashcheck(
+        str(tmp_path / "cc"),
+        crashcheck.small_fleet_plans(seeds=(3, 5), n_batches=2),
+        pod_names=("pod0",),
+        autoscale=lambda: Autoscaler(min_pods=1, max_pods=2,
+                                     up_trials=64.0, down_trials=16.0,
+                                     cooldown_rounds=1),
+        point_filter=lambda pt: pt.kind in pool_kinds)
+    assert doc["autoscaled"] is True
+    assert doc["failures"] == [] and doc["ok"] is True
+    for kind in pool_kinds:
+        assert doc["boundaries_by_kind"].get(kind, 0) >= 1
+
+
+# --- obs + HTTP surfaces ----------------------------------------------------
+
+def test_pool_obs_surfaces_roundtrip(tmp_path):
+    pool = {"size": 3, "live": 2, "retiring": ["auto1"],
+            "pending_scale_decisions": 1, "scale_seq": 3,
+            "scaled_pods": {"auto1": 1},
+            "retire_drain_rounds": {"auto1": None, "auto2": 2}}
+    obs_metrics.publish_pool(str(tmp_path), pool)
+    assert obs_metrics.read_pool(str(tmp_path)) == pool
+    text = (tmp_path / "pool.prom").read_text()
+    assert "shrewd_fleet_pool_size 3" in text
+    assert "shrewd_fleet_pool_live 2" in text
+    assert "shrewd_fleet_pool_pending_scale_decisions 1" in text
+    assert "shrewd_fleet_pool_scale_seq 3" in text
+    assert 'shrewd_fleet_pool_retire_drain_rounds{pod="auto2"} 2' in text
+    # an in-flight drain has no duration yet: no gauge, not a NaN
+    assert 'pod="auto1"' not in text
+
+
+def test_http_front_pool_endpoint(tmp_path):
+    gw_dir = str(tmp_path / "gateway")
+    front = GatewayHTTPFront(gw_dir, port=0).start()
+    try:
+        base = f"http://127.0.0.1:{front.port}"
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"{base}/pool", timeout=10)
+        assert ei.value.code == 404               # no surface published
+        obs_metrics.publish_pool(gw_dir, {"size": 2, "live": 2,
+                                          "scale_seq": 1})
+        with urllib.request.urlopen(f"{base}/pool", timeout=10) as r:
+            doc = json.load(r)
+        assert doc["size"] == 2 and doc["scale_seq"] == 1
+    finally:
+        front.stop()
+
+
+# --- cross-pod compile reuse (satellite) ------------------------------------
+
+def test_store_exec_dir_is_an_artifact_kind(tmp_path):
+    from shrewd_tpu.ingest.store import ArtifactStore
+    st = ArtifactStore(str(tmp_path / "store"))
+    d = st.exec_dir()
+    assert os.path.isdir(d)
+    assert d == os.path.join(st.root, "exec")
+    assert st.exec_dir() == d                     # idempotent
+
+
+def test_scheduler_enables_cross_pod_compile_cache(tmp_path):
+    # a store-backed scheduler points jax's persistent compilation
+    # cache at the store's exec/ kind — one digest-keyed cache root
+    # shared by every pod of the federation
+    from shrewd_tpu.service.scheduler import CampaignScheduler
+    sched = CampaignScheduler(outdir=str(tmp_path / "pod"),
+                              store_dir=str(tmp_path / "store"))
+    _ = sched.mesh
+    import jax
+    assert jax.config.jax_compilation_cache_dir \
+        == os.path.join(str(tmp_path / "store"), "exec")

@@ -648,6 +648,40 @@ def test_federation_kill_shard_failover_bit_identical(tmp_path):
     assert c.pod != dead and c.status == "done"
 
 
+def test_shard_failover_prefers_pod_without_siblings(tmp_path):
+    # stripe-aware failover placement, the choice pinned: the stranded
+    # stripe prefers the pod NOT hosting a sibling shard even when that
+    # pod carries MORE load — losing one more pod must not take out two
+    # stripes of the same campaign (soft preference: _sibling_pods is
+    # an ``avoid``, so a stripe still lands when every survivor hosts
+    # a sibling)
+    root = str(tmp_path / "fed")
+    fed = Federation(root, pod_names=("pod0", "pod1", "pod2", "pod3"))
+    fed.submit(TenantSpec(name="camp",
+                          plan=_plan(3, n_batches=6).to_dict(), shards=3))
+    gw = fed.gateway
+    hosts = {gw.entries[f"camp+shard{i}"].pod for i in range(3)}
+    assert len(hosts) == 3                    # distinct pods, hard rule
+    spare = next(n for n in ("pod0", "pod1", "pod2", "pod3")
+                 if n not in hosts)
+    # load the sibling-free pod ABOVE the shard hosts: a purely
+    # load-based pick would now choose a sibling host instead
+    fed.submit(_spec("filler", 5, n_batches=6))
+    assert gw.entries["filler"].pod == spare
+    victim = gw.entries["camp+shard1"].pod
+    gw.pod_dead(victim)
+    e = gw.entries["camp+shard1"]
+    assert e.pod == spare                     # spread beats load
+    assert any(h["reason"] == "failover" for h in e.history)
+    # and with no sibling-free pod left, liveness wins over spread:
+    # the next death still places its stripe on a sibling host
+    victim2 = gw.entries["camp+shard0"].pod
+    gw.pod_dead(victim2)
+    e0 = gw.entries["camp+shard0"]
+    assert e0.pod in {n for n in ("pod0", "pod1", "pod2", "pod3")
+                      if n not in (victim, victim2)}
+
+
 def test_federation_partition_during_merge_bit_identical(tmp_path):
     # a pod partitions exactly while the merge is in flight (at_fold
     # keys on the journaled fold ordinal): its stripe fails over, the

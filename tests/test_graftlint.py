@@ -608,6 +608,8 @@ def test_repo_journal_kinds_are_exhaustive():
         "pod_dead", "pod_heal", "done", "gw_shutdown", "gw_recover",
         # the gateway's sharded-merge ledger (single-campaign sharding)
         "shard_split", "shard_fold", "shard_converged",
+        # the gateway's elastic-pool ledger (journaled autoscaling)
+        "pool_scale_up", "pool_retire_begin", "pool_retire_done",
         # the streaming-ingest pipeline's per-tenant WAL
         "ingest_stage", "ingest_done", "ingest_quarantine"}
     assert set(appended) == handled
