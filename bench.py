@@ -44,6 +44,7 @@ import os
 import statistics
 import subprocess
 import sys
+import tempfile
 import time
 from functools import partial
 
@@ -390,6 +391,322 @@ def _load_pinned_baseline(n_uops: int) -> float | None:
         # discard a completed accelerator measurement at the last step
         log(f"pinned baseline unreadable ({type(e).__name__}) — ignoring")
     return None
+
+
+# --------------------------------------------------------------------------
+# --window-scale: SimPoint-scale chunked replay (4k → 26.2M µops)
+# --------------------------------------------------------------------------
+
+WINDOW_SCALE_SIZES = (4096, 131072, 5338673, 26220818)
+WINDOW_SCALE_OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "WINDOW_SCALE_r16.json")
+# r4 measured 934 trials/s at 131k (TPU dense) vs 22.56 at 65.5k (CPU
+# dense) → ~20.7× per lane-step; the chunk kernels are the same vmapped
+# lane family, so the ratio transfers lane-step for lane-step
+# (WINDOW_SCALE_r05 "tpu_projection").
+TPU_PER_LANE_RATIO = 20.7
+
+
+def _median_rate(fn, trials: int, reps: int):
+    """Median trials/sec over ``reps`` calls of ``fn`` → (rate, last
+    result).  The first (compile-warm) call is the caller's problem."""
+    rates, last = [], None
+    for _ in range(max(reps, 1)):
+        t0 = time.monotonic()
+        last = fn()
+        rates.append(trials / (time.monotonic() - t0))
+    return statistics.median(rates), last
+
+
+def run_window_scale(args) -> None:
+    """The --window-scale arm: chunked fast-path trials/sec at SimPoint
+    window scales {4k, 131k, 5.3M, 26.2M µops}, measured on the pinned
+    platform with the preprocessed-window store in the loop.
+
+    Discipline per size (the order is the contract):
+      1. cold preprocess into the ArtifactStore (timed — the native
+         boundary pass; WINDOW_SCALE_r05 spent 5301 s here on 26.2M),
+      2. warm-start pin: registry cleared, the window must come back
+         from the store with ZERO re-preprocessing (builds delta 0) or
+         the run aborts,
+      3. FATAL bit-identity gate: fast-engine outcomes vs the
+         exact-chunked reference on the same keys — a mismatch raises
+         before ANY rate is reported,
+      4. timed fast-engine rate (median of reps), then the same batch
+         through the resilient dispatcher + integrity layer (canaries /
+         tally invariants / audit where the reference kernels are
+         affordable; invariants+quarantine at >1M µops — the canary and
+         audit references are full-window hybrid replays, exactly the
+         cost the chunked engines remove),
+      5. Pallas-engine parity+rate at 4k (interpret mode off-TPU —
+         semantics, not the Mosaic fast path) and the dense baseline
+         at 4k (the regime dense still reaches on CPU).
+
+    Results merge into --out (default WINDOW_SCALE_r16.json) after each
+    size, so staged runs (--sizes 4096,131072 then --sizes 26220818)
+    accumulate into one artifact."""
+    import jax
+
+    jax.config.update("jax_platforms", args.platform or "cpu")
+    import numpy as np
+
+    from shrewd_tpu import native
+    from shrewd_tpu import resilience as resil
+    from shrewd_tpu.ingest.store import ArtifactStore
+    from shrewd_tpu.integrity import (IntegrityConfig, IntegrityMonitor,
+                                      checked_dispatcher_for)
+    from shrewd_tpu.models.o3 import O3Config
+    from shrewd_tpu.ops import window as Wmod
+    from shrewd_tpu.ops.chunked import ChunkedCampaign, preprocess_window
+    from shrewd_tpu.ops.trial import TrialKernel
+    from shrewd_tpu.parallel.campaign import ShardedCampaign
+    from shrewd_tpu.parallel.mesh import make_mesh
+    from shrewd_tpu.utils import prng
+
+    def jclean(v):
+        if isinstance(v, (np.integer,)):
+            return int(v)
+        if isinstance(v, (np.floating,)):
+            return float(v)
+        return v
+
+    out_path = args.out or WINDOW_SCALE_OUT
+    sizes = ([int(s) for s in args.sizes.split(",")] if args.sizes
+             else list(WINDOW_SCALE_SIZES))
+    store_root = args.store or os.path.join(tempfile.gettempdir(),
+                                            "shrewd_wstore_bench")
+    store = ArtifactStore(store_root)
+    mesh = make_mesh()
+    platform = jax.devices()[0].platform
+
+    doc = {}
+    if os.path.exists(out_path):
+        try:
+            with open(out_path) as f:
+                doc = json.load(f)
+        except Exception:  # noqa: BLE001 — a torn partial never blocks a run
+            doc = {}
+    doc.update({"metric": "window_scale_chunked_replay",
+                "platform": platform, "store": store_root,
+                "fast_engine": "taint", "reference_engine": "exact"})
+    doc.setdefault("sizes", {})
+    doc["dense_cpu_r4"] = {
+        "4096": 297.09, "65546": 22.56, "524288": 5.26,
+        "note": "r4-measured dense rates (WINDOW_SCALE_r05); dense at "
+                ">131k is the regime chunked replay replaces"}
+
+    def flush():
+        with open(out_path, "w") as f:
+            json.dump(doc, f, indent=1)
+            f.write("\n")
+
+    for n in sizes:
+        big = n > 1_000_000
+        chunk = min(65536, n)
+        horizon = 2 if big else None
+        log(f"window-scale: n={n} chunk={chunk} horizon={horizon}")
+        trace = native.generate_trace(seed=16, n=n, nphys=256,
+                                      mem_words=4096,
+                                      working_set_words=1024)
+        kernel = TrialKernel(trace, O3Config())
+
+        # 1+2: cold preprocess into the store, then the warm-start pin
+        Wmod.clear_registry()
+        t0 = time.monotonic()
+        win = preprocess_window(kernel, chunk, store=store)
+        cold_s = time.monotonic() - t0
+        cold_source = win.source
+        Wmod.clear_registry()
+        builds0 = Wmod.STATS["builds"]
+        t0 = time.monotonic()
+        win = preprocess_window(kernel, chunk, store=store)
+        warm_s = time.monotonic() - t0
+        if Wmod.STATS["builds"] != builds0 or win.source != "store":
+            raise RuntimeError(
+                f"warm-start pin violated at n={n}: source={win.source}, "
+                f"builds delta {Wmod.STATS['builds'] - builds0} (expected "
+                "a store hit with zero re-preprocessing)")
+        log(f"window-scale: n={n} preprocess cold={cold_s:.2f}s "
+            f"({cold_source}) warm={warm_s:.3f}s")
+
+        exact = ChunkedCampaign(kernel, chunk=chunk, carry_horizon=horizon,
+                                engine="exact", window=win)
+        fast = ChunkedCampaign(kernel, chunk=chunk, carry_horizon=horizon,
+                               engine="taint", window=win)
+
+        # 3: FATAL bit-identity gate — no rate is reported past a mismatch
+        n_chk = 16 if big else 64
+        structures = ["regfile"] if big else ["regfile", "fu"]
+        chk = prng.trial_keys(prng.campaign_key(161), n_chk)
+        for st in structures:
+            of = np.asarray(fast.outcomes_from_keys(chk, st))
+            oe = np.asarray(exact.outcomes_from_keys(chk, st))
+            if not np.array_equal(of, oe):
+                raise RuntimeError(
+                    f"bit-identity violated at n={n} structure={st}: "
+                    f"fast {of.tolist()} != exact {oe.tolist()} — "
+                    "refusing to report a rate")
+        log(f"window-scale: n={n} bit-identity ok ({n_chk} trials × "
+            f"{structures})")
+
+        # 4a: timed fast-engine rate
+        batch = args.batch or (128 if big else 512)
+        keys = prng.trial_keys(prng.campaign_key(163), batch)
+        t0 = time.monotonic()
+        out0 = np.asarray(fast.outcomes_from_keys(keys, "regfile"))
+        first_s = time.monotonic() - t0
+        reps = max(args.reps, 2)
+        rate, outl = _median_rate(
+            lambda: np.asarray(fast.outcomes_from_keys(keys, "regfile")),
+            batch, reps)
+        if not np.array_equal(outl, out0):
+            raise RuntimeError(f"non-deterministic outcomes at n={n}")
+        tally = np.bincount(out0, minlength=4).tolist()
+
+        # 4b: the same batch under resilient dispatch + integrity
+        camp = ShardedCampaign(kernel, mesh, "regfile", chunked=fast)
+        rcfg = resil.ResilienceConfig()
+        rcfg.backoff_base = rcfg.backoff_max = 0.0
+        if big:
+            posture = "invariants+quarantine"
+            icfg = IntegrityConfig(canary_trials=0, audit_rate=0.0)
+        else:
+            posture = "canaries+invariants+audit"
+            icfg = IntegrityConfig(canary_trials=2, audit_rate=0.25)
+        mon = IntegrityMonitor(icfg)
+        skey = prng.structure_key(
+            prng.simpoint_key(prng.campaign_key(7), 0), 0)
+        cd = checked_dispatcher_for(
+            resil.dispatcher_for_campaign(camp, rcfg), camp, mon,
+            f"ws{n}", "regfile", structure_key=skey)
+        cd.tally_batch(keys, batch_id=0)          # warm: canaries fire here
+        irate, ires = _median_rate(
+            partial(cd.tally_batch, keys, batch_id=1), batch, reps)
+        if mon.canary_failures or mon.invariant_violations \
+                or mon.quarantined:
+            raise RuntimeError(
+                f"integrity layer tripped at n={n}: "
+                f"canary_failures={mon.canary_failures} "
+                f"invariant_violations={mon.invariant_violations} "
+                f"quarantined={mon.quarantined}")
+        itally = np.asarray(ires.tally).tolist()
+        if itally != tally:
+            raise RuntimeError(
+                f"integrity-path tally diverged at n={n}: "
+                f"{itally} != {tally}")
+
+        entry = {
+            "chunk": chunk, "chunks": fast.C, "carry_horizon": horizon,
+            "preprocess": {
+                "cold_seconds": round(cold_s, 3),
+                "cold_source": cold_source,
+                "warm_load_seconds": round(warm_s, 3),
+                "warm_builds_delta": 0, "warm_source": "store",
+                "relifts": 0},
+            "bit_identity": {"trials": n_chk, "structures": structures,
+                             "ok": True, "fatal": True},
+            "chunked_fast": {
+                "engine": "taint", "trials_per_sec": round(rate, 2),
+                "batch": batch, "reps": reps,
+                "first_call_seconds": round(first_s, 2),
+                "tally": tally,
+                "resolution": {k: jclean(v)
+                               for k, v in (fast.last_stats or {}).items()}},
+            "chunked_fast_integrity": {
+                "trials_per_sec": round(irate, 2), "posture": posture,
+                "canary_failures": mon.canary_failures,
+                "invariant_violations": mon.invariant_violations,
+                "audit_batches": mon.audit_batches,
+                "quarantined": mon.quarantined,
+                "tally_matches_raw": True},
+        }
+
+        # 5: Pallas-engine parity + rate, and the dense baseline (4k only)
+        if n <= 4096:
+            kp = TrialKernel(trace, O3Config(pallas="on"))
+            fp = ChunkedCampaign(kp, chunk=chunk, carry_horizon=horizon,
+                                 engine="pallas", window=win)
+            pk = prng.trial_keys(prng.campaign_key(167), 16)
+            t0 = time.monotonic()
+            po = np.asarray(fp.outcomes_from_keys(pk, "regfile"))
+            p_s = time.monotonic() - t0
+            pe = np.asarray(exact.outcomes_from_keys(pk, "regfile"))
+            if not np.array_equal(po, pe):
+                raise RuntimeError(
+                    f"pallas bit-identity violated at n={n}: "
+                    f"{po.tolist()} != {pe.tolist()}")
+            entry["chunked_pallas"] = {
+                "trials_per_sec": round(16 / p_s, 3), "trials": 16,
+                "mode": "interpret" if fp._interpret else "compiled",
+                "bit_identity_ok": True,
+                "note": "interpret mode pins semantics off-TPU, not the "
+                        "Mosaic fast path — the on-device rate is the "
+                        "compiled arm (see tpu_projection)"}
+
+            dense_camp = ShardedCampaign(kernel, mesh, "regfile")
+            dense_camp.tally_batch(keys)          # compile warm
+            drate, _ = _median_rate(lambda: dense_camp.tally_batch(keys),
+                                    batch, reps)
+            entry["dense"] = {"trials_per_sec": round(drate, 2),
+                              "batch": batch}
+
+        doc["sizes"][str(n)] = entry
+        flush()
+        log(f"window-scale: n={n} fast={rate:.2f}/s "
+            f"integrity={irate:.2f}/s → {out_path}")
+
+    biggest = max(int(k) for k in doc["sizes"])
+    bent = doc["sizes"][str(biggest)]
+    if biggest > 1_000_000 and platform not in ("tpu", "axon"):
+        r = bent["chunked_fast"]["trials_per_sec"]
+        ri = bent["chunked_fast_integrity"]["trials_per_sec"]
+        doc["tpu_projection"] = {
+            "method": "rate_tpu ≈ rate_cpu × per-lane-step ratio; r4 "
+                      "measured 934 trials/s at 131k (TPU dense) vs "
+                      "22.56 at 65.5k (CPU dense) → ~20.7×; the chunk "
+                      "kernels are the same vmapped lane family "
+                      "(WINDOW_SCALE_r05)",
+            "ratio": TPU_PER_LANE_RATIO,
+            "cpu_measured_trials_per_sec": r,
+            "cpu_measured_integrity_trials_per_sec": ri,
+            "projected_trials_per_sec": round(r * TPU_PER_LANE_RATIO, 1),
+            "projected_integrity_trials_per_sec":
+                round(ri * TPU_PER_LANE_RATIO, 1),
+            "at_uops": biggest,
+            "meets_100_trials_per_sec":
+                bool(ri * TPU_PER_LANE_RATIO >= 100.0),
+        }
+    doc["notes"] = [
+        "bit-identity vs the exact-chunked reference is asserted FATALLY "
+        "before any rate is reported (RuntimeError on mismatch); "
+        "fast==exact==dense outcome parity is pinned by "
+        "tests/test_chunked.py and tests/test_chunked_fast.py",
+        "warm-start pin: a second campaign over a stored window "
+        "re-preprocesses nothing (builds delta 0, mmap'd load) — "
+        "enforced fatally, recorded per size under 'preprocess'",
+        "setup: the native C++ boundary pass (ops/chunked.py) replaced "
+        "the jax golden-chunk scan — WINDOW_SCALE_r05 spent 5301 s "
+        "preprocessing the 26.2M-µop window; see cold_seconds here",
+        "integrity posture at >1M µops is invariants+quarantine: the "
+        "constructed-canary and audit reference kernels are full-window "
+        "hybrid replays (integrity.py), exactly the cost the chunked "
+        "engines remove; chunked canary/audit references are a ROADMAP "
+        "follow-up",
+    ]
+    if platform not in ("tpu", "axon"):
+        doc["notes"].insert(0, (
+            "CPU-measured rates — no TPU was reachable (bench.py --probe "
+            "tunnel discipline); the tpu_projection block applies the "
+            "r4-measured 20.7× per-lane-step ratio and is labeled as such"))
+    flush()
+    print(json.dumps({
+        "metric": "window_scale_chunked_replay", "platform": platform,
+        "out": out_path,
+        "trials_per_sec": {k: v["chunked_fast"]["trials_per_sec"]
+                           for k, v in doc["sizes"].items()},
+        "integrity_trials_per_sec":
+            {k: v["chunked_fast_integrity"]["trials_per_sec"]
+             for k, v in doc["sizes"].items()}}))
 
 
 # --------------------------------------------------------------------------
@@ -1011,6 +1328,17 @@ def main() -> None:
     ap.add_argument("--pin-baseline", action="store_true",
                     help="measure the serial baseline (≥5 reps/median) and "
                          "write BASELINE_MEASURED.json")
+    ap.add_argument("--window-scale", action="store_true",
+                    help="measure chunked fast-path rates at SimPoint "
+                         "window scales (4k → 26.2M µops) and write "
+                         "WINDOW_SCALE_r16.json")
+    ap.add_argument("--sizes", type=str, default=None,
+                    help="comma-separated window sizes (window-scale arm)")
+    ap.add_argument("--out", type=str, default=None,
+                    help="output JSON path (window-scale arm)")
+    ap.add_argument("--store", type=str, default=None,
+                    help="ArtifactStore root for preprocessed windows "
+                         "(window-scale arm; default: a tmp-dir store)")
     ap.add_argument("--platform", type=str, default=None,
                     help="jax platform to pin (worker mode)")
     args = ap.parse_args()
@@ -1020,6 +1348,9 @@ def main() -> None:
         return
     if args.pin_baseline:
         run_pin_baseline(args)
+        return
+    if args.window_scale:
+        run_window_scale(args)
         return
     if args.worker:
         run_worker(args)

@@ -814,4 +814,62 @@ PARETO_FED_GATE
 timeout -k 10 560 env JAX_PLATFORMS=cpu python bench.py --quick > BENCH_r09.json \
   || echo "WARNING: bench smoke failed (non-fatal)"
 
+# Non-fatal chunked-replay smoke: a small window split across 2 chunks
+# through the SimPoint-scale fast path (ops/chunked.py) — fast-engine
+# outcomes asserted bit-identical to the exact-chunked reference, and
+# the content-addressed window store's warm start asserted to
+# re-preprocess NOTHING (builds delta 0, mmap'd load, zero re-lifts).
+# Records CHUNKED_SMOKE_r16.json.  Never affects the pass/fail status.
+timeout -k 10 300 env JAX_PLATFORMS=cpu python - <<'CHUNKED_SMOKE' \
+  || echo "WARNING: chunked smoke failed (non-fatal)"
+import json, tempfile, time
+import numpy as np
+from shrewd_tpu.ingest.store import ArtifactStore
+from shrewd_tpu.models.o3 import O3Config
+from shrewd_tpu.ops import window as W
+from shrewd_tpu.ops.chunked import ChunkedCampaign, preprocess_window
+from shrewd_tpu.ops.trial import TrialKernel
+from shrewd_tpu.trace.synth import WorkloadConfig, generate
+from shrewd_tpu.utils import prng
+
+t = generate(WorkloadConfig(n=512, nphys=32, mem_words=64,
+                            working_set_words=32, seed=16))
+kernel = TrialKernel(t, O3Config())
+store = ArtifactStore(tempfile.mkdtemp(prefix="chunked_smoke_"))
+
+W.clear_registry()
+win = preprocess_window(kernel, 256, store=store)   # 2 chunks
+assert win.C == 2 and win.source == "built", (win.C, win.source)
+
+# warm start: a second campaign over the stored window re-lifts and
+# re-preprocesses nothing
+W.clear_registry()
+builds0 = W.STATS["builds"]
+win2 = preprocess_window(kernel, 256, store=store)
+assert win2.source == "store" and W.STATS["builds"] == builds0, \
+    (win2.source, W.STATS["builds"] - builds0)
+
+keys = prng.trial_keys(prng.campaign_key(16), 64)
+exact = ChunkedCampaign(kernel, chunk=256, engine="exact", window=win2)
+fast = ChunkedCampaign(kernel, chunk=256, engine="taint", window=win2)
+t0 = time.monotonic()
+of = np.asarray(fast.outcomes_from_keys(keys, "regfile"))
+dt = time.monotonic() - t0
+oe = np.asarray(exact.outcomes_from_keys(keys, "regfile"))
+assert np.array_equal(of, oe), "fast-vs-exact bit-identity violated"
+
+doc = {"metric": "chunked_smoke", "n_uops": 512, "chunks": 2,
+       "engines": ["taint", "exact"], "bit_identical": True,
+       "warm_start": {"builds_delta": 0, "source": "store",
+                      "relifts": 0},
+       "fast_trials_per_sec": round(64 / dt, 2),
+       "tally": np.bincount(of, minlength=4).tolist(),
+       "resolution": {k: int(v) for k, v in fast.last_stats.items()
+                      if isinstance(v, (int, np.integer))}}
+with open("CHUNKED_SMOKE_r16.json", "w") as f:
+    json.dump(doc, f, indent=1); f.write("\n")
+print(f"chunked smoke: 2-chunk fast path bit-identical to exact, "
+      f"warm start re-preprocessed nothing -> CHUNKED_SMOKE_r16.json")
+CHUNKED_SMOKE
+
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

@@ -64,6 +64,8 @@ DEFAULT_AXES = {
     "seed": 0,               # SimPoint k-means seed
     "min_lift_rate": 0.25,   # lift-divergence quarantine floor
     "max_workers": 4,        # parallel window lifts
+    "preprocess": False,     # terminal chunk-preprocess stage (see below)
+    "chunk": 65536,          # chunk length S for the preprocess stage
 }
 
 
@@ -178,6 +180,17 @@ class IngestPipeline:
         (its payload table covers every window trace)."""
         return self.store.get_doc(self.digest, self.key, "plan")
 
+    def _stage_list(self) -> tuple:
+        """The journaled stage order for THIS run's axes: the optional
+        terminal ``preprocess`` stage (chunk-window preprocessing for the
+        chunked replay engines) rides the same WAL/store certification as
+        the five base stages — same ``ingest_stage`` journal kind, same
+        doc-verified resume — so GL201/GL202 hold for it with no new
+        record kinds."""
+        if self.axes.get("preprocess"):
+            return STAGES + ("preprocess",)
+        return STAGES
+
     # --- the run loop -----------------------------------------------------
 
     def run(self) -> dict:
@@ -203,7 +216,7 @@ class IngestPipeline:
                     return self.plan_doc
         # warm start — journal the cache hit so the tenant's WAL is
         # self-contained evidence of where its windows came from
-        for ordinal, stage in enumerate(STAGES):
+        for ordinal, stage in enumerate(self._stage_list()):
             self._jlog("ingest_stage", {"stage": stage,
                                         "ordinal": ordinal,
                                         "cached": True})
@@ -216,7 +229,7 @@ class IngestPipeline:
 
     def _run_stages(self) -> None:
         try:
-            for ordinal, stage in enumerate(STAGES):
+            for ordinal, stage in enumerate(self._stage_list()):
                 if stage in self.stage_done and self._stage_ok(stage):
                     continue          # resumed past a durable stage
                 cached = self._stage_ok(stage)
@@ -467,6 +480,41 @@ class IngestPipeline:
             self.lifts += 1
         self.store.put_doc(self.digest, self.key, "window", {
             "simpoints": sims, "payloads": dict(payloads)})
+
+    def _stage_preprocess(self) -> None:
+        """Optional terminal stage (axes ``preprocess=True``): build the
+        chunked engines' preprocessed window (ops/window.py — NOP-padded
+        SoA chunk arrays + golden boundary states at chunk length
+        ``axes['chunk']``) for every lifted window and persist it
+        content-addressed under the WINDOW TRACE's digest.  Campaigns and
+        federated pods then open it mmap'd in O(1) — zero lifts, zero
+        re-preprocessing — with chunks materializing lazily as the wave
+        driver touches them.  The stage document records each window's
+        (trace digest, S) store address; the heavyweight array payloads
+        live under the trace digest so two binaries lifting to the same
+        window share one copy."""
+        from shrewd_tpu.ops.chunked import preprocess_window
+        from shrewd_tpu.ops.trial import TrialKernel
+        from shrewd_tpu.trace import format as tf
+
+        wdoc = self.store.get_doc(self.digest, self.key, "window")
+        if wdoc is None:
+            raise RuntimeError("preprocess stage reached with no durable "
+                               "window artifact")
+        S = int(self.axes["chunk"])
+        entries = []
+        for e in wdoc["simpoints"]:
+            path = self.store.payload_path(self.digest, self.key,
+                                           e["file"])
+            trace, _meta = tf.load(path)
+            win = preprocess_window(TrialKernel(trace), S,
+                                    store=self.store)
+            entries.append({"name": e["name"], "file": e["file"],
+                            "trace_digest": win.trace_digest,
+                            "S": int(win.S), "C": int(win.C),
+                            "uops": int(win.n)})
+        self.store.put_doc(self.digest, self.key, "preprocess", {
+            "chunk": S, "windows": entries})
 
     def _build_plan_doc(self) -> dict:
         wdoc = self.store.get_doc(self.digest, self.key, "window")

@@ -273,6 +273,57 @@ class ArtifactStore:
                 return None
         return doc
 
+    # --- array artifacts ---------------------------------------------------
+    #
+    # Generic SoA-array kind (the preprocessed chunk windows, ops/window.py):
+    # one checksummed document owning one ``.npy`` payload per named array.
+    # numpy is imported inside the methods — the module stays import-light
+    # for the jax-free service tier.
+
+    def put_arrays(self, digest: str, key: str, name: str, arrays: dict,
+                   meta: dict | None = None) -> dict:
+        """Persist ``{field: ndarray}`` as ``<name>.<field>.npy`` payloads
+        plus the owning ``<name>.json`` doc (payload shas recorded, so
+        ``get_doc``/``get_arrays`` re-verify every byte).  Returns the doc."""
+        import numpy as np
+
+        payloads = {}
+        for field_name, arr in arrays.items():
+            filename = f"{name}.{field_name}.npy"
+            tmp = self.payload_path(digest, key, filename) \
+                + f".tmp.{os.getpid()}"
+            with open(tmp, "wb") as f:
+                np.save(f, np.ascontiguousarray(arr))
+            payloads[filename] = self.commit_payload(
+                tmp, digest, key, filename)
+        doc = dict(meta or {})
+        doc["fields"] = sorted(arrays)
+        doc["payloads"] = payloads
+        self.put_doc(digest, key, name, doc)
+        debug.dprintf("Ingest", "stored %d arrays under %s/%s/%s",
+                      len(arrays), digest[:12], key, name)
+        return doc
+
+    def get_arrays(self, digest: str, key: str, name: str,
+                   mmap: bool = True):
+        """Load one array artifact → ``(doc, {field: ndarray})`` or None
+        (miss).  ``mmap=True`` maps payloads read-only — chunk windows
+        materialize lazily, so a 26M-µop window opens in O(1)."""
+        import numpy as np
+
+        doc = self.get_doc(digest, key, name)
+        if doc is None:
+            return None
+        arrays = {}
+        for field_name in doc.get("fields") or []:
+            path = self.payload_path(digest, key, f"{name}.{field_name}.npy")
+            try:
+                arrays[field_name] = np.load(
+                    path, mmap_mode="r" if mmap else None)
+            except (OSError, ValueError):
+                return None
+        return doc, arrays
+
     def lock(self, digest: str, key: str) -> _SingleFlight:
         return _SingleFlight(
             os.path.join(self.obj_dir(digest, key), ".lock"))

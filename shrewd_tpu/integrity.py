@@ -367,10 +367,14 @@ class AuditOracle:
                 from shrewd_tpu.ops.chunked import ChunkedCampaign
 
                 # a chunk length that never divides the window exercises
-                # the ragged-tail path (n % chunk != 0) for free
+                # the ragged-tail path (n % chunk != 0) for free; pin the
+                # EXACT engine — the primary is the taint-family hybrid
+                # driver, so a deviation-set chunk engine would share its
+                # kernel with the side under audit
                 n = int(self.kernel.trace.n)
                 chunk = max(n // 2 - 1, 1)
-                self._chunked = ChunkedCampaign(self.kernel, chunk=chunk)
+                self._chunked = ChunkedCampaign(self.kernel, chunk=chunk,
+                                                engine="exact")
             return self._chunked.outcomes_of_faults(faults)
         if self.alternate == "dense":
             return np.asarray(self.kernel.run_batch(faults))

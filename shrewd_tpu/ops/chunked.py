@@ -9,7 +9,11 @@ checkpoint + sampled regions (SimPoint, 30B-inst windows,
 1. **Golden boundary states.**  One fault-free pass over the window,
    chunk by chunk (size S), recording the architectural state (regs +
    memory image) at every chunk boundary — the analog of the reference's
-   in-window checkpoints.
+   in-window checkpoints.  This pass plus the NOP-padded SoA chunk
+   layout is the *preprocessed window* (ops/window.py): computed once,
+   shared process-wide through a registry and across pods through the
+   content-addressed ArtifactStore, so the second campaign over a
+   stored window performs 0 lifts and 0 re-preprocessing.
 2. **Landing-chunk start.**  A trial's fault lands at a known µop; until
    then its state IS the golden state, so the trial starts from the
    golden boundary of its landing chunk and never replays the prefix.
@@ -21,20 +25,44 @@ checkpoint + sampled regions (SimPoint, 30B-inst windows,
    all trials resolve in their landing chunk, so per-trial cost ≈ S µops
    instead of n.
 
+Three chunk ENGINES share that driver:
+
+- ``exact``  — the dense replay kernel per chunk, full (reg + mem) state
+  carried per lane.  The reference strategy; per-lane state is
+  nphys + mem_words words, which caps the wave width B.
+- ``taint``  — the deviation-set kernel per chunk (ops/taint.py
+  ``taint_chunk``): cross-chunk per-trial state is the k-entry deviation
+  set (the reg/mem boundary *delta*), so B scales to thousands of lanes
+  and boundary convergence is an O(k) compare instead of O(state).
+  Escape/overflow lanes fall back to the exact engine per trial —
+  outcomes stay bit-identical to exact (= dense) everywhere.
+- ``pallas`` — the same deviation-set semantics inside the Pallas TPU
+  kernel (ops/pallas_taint.py ``taint_chunk_pallas``): window chunks
+  stream HBM-side through double-buffered BlockSpec grids, deviation
+  sets live in VMEM, and the carried sets enter/leave as (k, B) arrays.
+
+Carry-horizon early exit (``carry_horizon``) rides INSIDE the fast-chunk
+executable: a lane still divergent past the horizon is relabeled SDC
+(masked→SDC / DUE→SDC only — the conservative direction) without paying
+for the remaining chunks, bit-for-bit the relabeling the exact driver
+applies host-side.
+
 Outcome parity: for identical keys, outcomes equal the dense
 full-window kernel's bit-for-bit (tests/test_chunked.py) — this is an
 execution strategy, not an approximation.
 
-The chunk kernel is ONE jitted executable reused for every chunk
-(chunk start is a traced scalar; ``lax.dynamic_slice`` extracts the
-static-size window), so compile cost is constant in window length —
-the other half of the r4 scaling problem (the 524k-µop dense kernel
-spent 217s compiling).
+The chunk kernels are jitted executables reused for every chunk (the
+exact engine dynamic-slices a device-resident padded trace; the fast
+engines take per-chunk host VIEWS of the preprocessed layout as
+arguments), so compile cost is constant in window length — the other
+half of the r4 scaling problem (the 524k-µop dense kernel spent 217s
+compiling).
 """
 
 from __future__ import annotations
 
 from functools import partial
+from types import SimpleNamespace
 from typing import NamedTuple
 
 import jax
@@ -42,16 +70,26 @@ import jax.numpy as jnp
 import numpy as np
 
 from shrewd_tpu.isa import uops as U
-from shrewd_tpu.models.o3 import KIND_REGFILE, Fault
+from shrewd_tpu.models.o3 import KIND_LATCH_OP, KIND_REGFILE, Fault
 from shrewd_tpu.ops import classify as C
-from shrewd_tpu.ops.replay import MemMap, ReplayResult, TraceArrays, replay
+from shrewd_tpu.ops import window as W
+from shrewd_tpu.ops.replay import (MemMap, ReplayResult, TraceArrays, _alu,
+                                   replay)
+from shrewd_tpu.ops.taint import (EMPTY, GoldenRecord, setup_scan,
+                                  taint_chunk)
 
 i32 = jnp.int32
 u32 = jnp.uint32
 
+ENGINES = ("exact", "taint", "pallas")
+
+#: sentinel horizon when carry_horizon is None (never reached: ages are
+#: bounded by the chunk count)
+_NO_HORIZON = 1 << 30
+
 
 class _Carry(NamedTuple):
-    """Unresolved trials between chunks (device arrays, lane-packed)."""
+    """Unresolved exact-engine trials between chunks (device arrays)."""
 
     reg: jax.Array       # u32[K, nphys]
     mem: jax.Array       # u32[K, mem_words]
@@ -60,18 +98,181 @@ class _Carry(NamedTuple):
     age: np.ndarray      # int64[K] chunks carried so far (host)
 
 
+# --------------------------------------------------------------------------
+# window preprocessing (registry- and store-backed; ops/window.py holds the
+# container — the build lives here to keep window.py jax-free at import)
+# --------------------------------------------------------------------------
+
+def _slice_chunk(S: int, memmap, tr_pad, cov_pad, mm_cluster, start):
+    sl = partial(jax.lax.dynamic_slice_in_dim, start_index=start,
+                 slice_size=S)
+    tr = TraceArrays(*(sl(a) for a in tr_pad))
+    cov = sl(cov_pad)
+    mm = None
+    if memmap is not None:
+        mm = memmap._replace(uop_cluster=sl(mm_cluster))
+    return tr, cov, mm
+
+
+def _build_golden_chunk(kernel, S: int):
+    """One-chunk golden replay executable, content-keyed through the
+    process-wide cache so every campaign/preprocess over the same trace
+    and S shares one compile."""
+    from shrewd_tpu.parallel import exec_cache
+
+    memmap = kernel.memmap
+
+    def body(tr_pad, cov_pad, mm_cluster, reg, mem, fault, start):
+        tr, cov, mm = _slice_chunk(S, memmap, tr_pad, cov_pad, mm_cluster,
+                                   start)
+        return replay(tr, reg, mem, fault, cov, memmap=mm,
+                      index_offset=start)
+
+    return exec_cache.cache().get(
+        exec_cache.step_key(kernel, None, "", kind="golden_chunk", S=S),
+        owner=kernel, build=lambda: jax.jit(body))
+
+
+#: tests force the jax fallback path by monkeypatching this off
+NATIVE_BOUNDARY = True
+
+
+def _native_boundary_pass(win: W.PreprocessedWindow) -> bool:
+    """Fill ``gb_reg``/``gb_mem`` via the serial C++ golden kernel, chunk
+    by chunk with the previous boundary as the init state — ~1e9 µops/s
+    against the jax chunk scan's ~5e3/s on this host, which is what turns
+    WINDOW_SCALE_r05's 5301 s setup for the 26.2M-µop window into
+    seconds.  Returns False (caller falls back to the jax pass) when the
+    native library is unavailable; bit-identity of the two passes is
+    pinned by tests/test_chunked_fast.py and, transitively, by every
+    chunked-vs-dense parity test (the boundaries feed classification)."""
+    if not NATIVE_BOUNDARY:
+        return False
+    try:
+        from shrewd_tpu import native
+        native.lib()
+    except Exception:  # noqa: BLE001 — no compiler / no make: jax pass
+        return False
+    view = SimpleNamespace(n=win.S, nphys=win.nphys,
+                           mem_words=win.mem_words)
+    for c in range(win.C):
+        lo, hi = c * win.S, (c + 1) * win.S
+        for f in W.TRACE_FIELDS:
+            setattr(view, f, win.tr[f][lo:hi])
+        view.init_reg = win.gb_reg[c]
+        view.init_mem = win.gb_mem[c]
+        reg, mem = native.golden_replay(view)
+        win.gb_reg[c + 1] = reg
+        win.gb_mem[c + 1] = mem
+    return True
+
+
+def _build_window(kernel, S: int, digest: str) -> W.PreprocessedWindow:
+    """Pad the trace into the SoA chunk layout (once — the hot loop then
+    slices zero-copy views) and run the golden boundary pass."""
+    trace = kernel.trace
+    n = int(trace.n)
+    C_ = (n + S - 1) // S
+    pad = C_ * S - n
+    tr = kernel.tr
+
+    def padded(a, fill=0):
+        a = np.asarray(a)
+        return np.concatenate(
+            [a, np.full(pad, fill, a.dtype)]) if pad else a
+
+    tr_host = {
+        "opcode": padded(tr.opcode, U.NOP), "dst": padded(tr.dst),
+        "src1": padded(tr.src1), "src2": padded(tr.src2),
+        "imm": padded(np.asarray(tr.imm, np.uint32)),
+        "taken": padded(tr.taken),
+    }
+    nphys = int(trace.init_reg.shape[0])
+    mem_words = int(trace.init_mem.shape[0])
+    gb_reg = np.empty((C_ + 1, nphys), np.uint32)
+    gb_mem = np.empty((C_ + 1, mem_words), np.uint32)
+    win = W.PreprocessedWindow(
+        n=n, S=S, nphys=nphys, mem_words=mem_words, trace_digest=digest,
+        tr=tr_host, gb_reg=gb_reg, gb_mem=gb_mem, memmap=kernel.memmap,
+        mm_cluster_pad=(padded(np.asarray(kernel.memmap.uop_cluster), -1)
+                        if kernel.memmap is not None else None))
+
+    gb_reg[0] = np.asarray(trace.init_reg, np.uint32)
+    gb_mem[0] = np.asarray(trace.init_mem, np.uint32)
+    # coverage is inert under the null fault (detection is gated on the
+    # fault kind/µop), so the boundary pass streams zeros and the window
+    # stays config-independent — one preprocessed copy serves every
+    # shadow-coverage configuration.  Memmap-free windows take the native
+    # pass (the C++ kernel has no VA-space memmap semantics).
+    if kernel.memmap is not None or not _native_boundary_pass(win):
+        golden_fn = _build_golden_chunk(kernel, S)
+        cov_zero = jnp.zeros(C_ * S, jnp.float32)
+        reg = jnp.asarray(trace.init_reg, u32)
+        mem = jnp.asarray(trace.init_mem, u32)
+        null = Fault(kind=i32(0), cycle=i32(-1), entry=i32(-1),
+                     bit=i32(0), shadow_u=jnp.float32(1.0))
+        for c in range(C_):
+            r = golden_fn(win.tr_dev, cov_zero, win.mm_cluster_dev, reg,
+                          mem, null, i32(c * S))
+            reg, mem = r.reg, r.mem
+            gb_reg[c + 1] = np.asarray(reg)
+            gb_mem[c + 1] = np.asarray(mem)
+    W.STATS["builds"] += 1
+    return win
+
+
+def preprocess_window(kernel, chunk: int,
+                      store=None) -> W.PreprocessedWindow:
+    """The preprocessed window for ``(kernel.trace, chunk)`` — registry
+    hit, then store hit (mmap'd, O(1) for a 26M-µop window), then build
+    (and back-fill both).  Store persistence is single-flighted on the
+    ``(digest, S)`` object dir so concurrent pods share one build."""
+    from shrewd_tpu.parallel import exec_cache
+
+    n = int(kernel.trace.n)
+    S = int(min(chunk, n))
+    digest = exec_cache.trace_digest(kernel.trace)
+    win = W.lookup(digest, S, kernel.memmap)
+    if win is not None:
+        return win
+    if store is not None and kernel.memmap is None:
+        from shrewd_tpu.ingest.store import axes_key
+
+        key = axes_key(W.store_axes(S))
+        with store.lock(digest, key):
+            win = W.load_from_store(store, digest, S)
+            if win is None:
+                win = _build_window(kernel, S, digest)
+                W.save_to_store(store, win)
+        return W.register(win)
+    return W.register(_build_window(kernel, S, digest))
+
+
+# --------------------------------------------------------------------------
+# the campaign
+# --------------------------------------------------------------------------
+
 class ChunkedCampaign:
     """Chunked execution strategy over a TrialKernel's trace/config.
 
     ``kernel`` supplies the trace, fault samplers, shadow coverage and
     golden final state; this class adds the boundary-state pass and the
     wave driver.  ``chunk`` is the chunk length in µops; ``max_batch``
-    caps device lanes per kernel call (default: sized so the batch's
-    memory images stay under ~256 MB)."""
+    caps device lanes per kernel call (exact engine default: sized so
+    the batch's memory images stay under ~256 MB; fast engines default
+    to 4096 — their per-lane state is k entries, not the memory image).
+
+    ``engine`` selects the per-chunk kernel (module doc): ``"exact"``,
+    ``"taint"``, ``"pallas"``, or ``"auto"`` (pallas where the Pallas
+    fast path is enabled, taint elsewhere, exact for dense-kernel
+    configs and VA-space memmap traces).  All engines produce
+    bit-identical outcomes.  ``store`` (optional ArtifactStore) backs
+    the preprocessed window; ``window`` injects a prebuilt one."""
 
     def __init__(self, kernel, chunk: int = 65536,
                  max_batch: int | None = None,
-                 carry_horizon: int | None = None):
+                 carry_horizon: int | None = None,
+                 engine: str = "auto", store=None, window=None):
         """``carry_horizon`` (optional): classify a trial that stays
         divergent-but-live for more than this many chunks as SDC without
         replaying the rest of the window.  The only relabelings this can
@@ -91,62 +292,59 @@ class ChunkedCampaign:
         self.C = (self.n + self.S - 1) // self.S
         self.nphys = int(trace.init_reg.shape[0])
         self.mem_words = int(trace.init_mem.shape[0])
+
+        if engine == "auto":
+            if kernel.memmap is not None \
+                    or kernel.cfg.replay_kernel == "dense":
+                engine = "exact"
+            elif kernel._pallas_enabled():
+                engine = "pallas"
+            else:
+                engine = "taint"
+        if engine not in ENGINES:
+            raise ValueError(f"unknown chunk engine {engine!r}")
+        if engine != "exact" and kernel.memmap is not None:
+            raise ValueError(
+                "fast chunked engines carry deviation sets, not memory "
+                "images, and cannot replay VA-space memmap traces — use "
+                "engine='exact'")
+        self.engine = engine
+        self._interpret = (engine == "pallas"
+                           and jax.devices()[0].platform
+                           not in ("tpu", "axon"))
+
         if max_batch is None:
-            budget = (1 << 28) // max(self.mem_words * 4, 1)
-            max_batch = int(np.clip(1 << int(np.log2(max(budget, 8))),
-                                    8, 1024))
+            if engine == "exact":
+                budget = (1 << 28) // max(self.mem_words * 4, 1)
+                max_batch = int(np.clip(
+                    1 << int(np.log2(max(budget, 8))), 8, 1024))
+            else:
+                max_batch = 4096
         self.B = max_batch
         self.last_stats: dict | None = None   # set by outcomes_from_keys
 
-        pad = self.C * self.S - self.n
-        tr = kernel.tr
-
-        def padded(a, fill=0):
-            a = np.asarray(a)
-            return jnp.asarray(np.concatenate(
-                [a, np.full(pad, fill, a.dtype)]) if pad else a)
-
-        self.tr_pad = TraceArrays(
-            opcode=padded(tr.opcode, U.NOP), dst=padded(tr.dst),
-            src1=padded(tr.src1), src2=padded(tr.src2),
-            imm=padded(np.asarray(tr.imm, np.uint32)),
-            taken=padded(tr.taken))
-        self.cov_pad = padded(np.asarray(kernel.shadow_cov, np.float32))
+        # preprocessed window: NOP-padded SoA layout + golden boundaries,
+        # shared through the registry/store (ops/window.py) — the audit
+        # alternate and warm/timed bench pairs skip the boundary pass
+        self.window = window if window is not None else preprocess_window(
+            kernel, self.S, store=store)
+        assert self.window.n == self.n and self.window.S == self.S
+        self.gb_reg = self.window.gb_reg       # host (C+1, nphys) u32
+        self.gb_mem = self.window.gb_mem       # host (C+1, mem_words) u32
         self.memmap = kernel.memmap
-        # placeholder when no memmap: _big_args passes ONE cached buffer
-        # (a fresh per-call alloc would be pure waste)
-        self.mm_cluster_pad = (padded(np.asarray(self.memmap.uop_cluster),
-                                      -1)
-                               if self.memmap is not None
-                               else jnp.zeros(1, i32))
 
-        # chunk kernels shared through the executable cache — built before
-        # the golden boundary pass below first dispatches one
-        self._golden_chunk_fn = self._chunk_jit(
-            "golden_chunk", lambda: jax.jit(self._golden_chunk_body))
-        self._trial_chunk_fn = self._chunk_jit(
-            "trial_chunk", lambda: jax.jit(self._trial_chunk_body))
+        pad = self.C * self.S - self.n
+        cov = np.asarray(kernel.shadow_cov, np.float32)
+        self.cov_pad_host = (np.concatenate(
+            [cov, np.zeros(pad, np.float32)]) if pad else cov)
 
-        # golden boundary states (host: C+1 × state; device transfers are
-        # one boundary image per chunk step)
-        self.gb_reg = np.empty((self.C + 1, self.nphys), np.uint32)
-        self.gb_mem = np.empty((self.C + 1, self.mem_words), np.uint32)
-        reg = jnp.asarray(trace.init_reg, u32)
-        mem = jnp.asarray(trace.init_mem, u32)
-        self.gb_reg[0] = np.asarray(reg)
-        self.gb_mem[0] = np.asarray(mem)
-        null = Fault(kind=i32(0), cycle=i32(-1), entry=i32(-1),
-                     bit=i32(0), shadow_u=jnp.float32(1.0))
-        for c in range(self.C):
-            r = self._golden_chunk(reg, mem, null, i32(c * self.S))
-            reg, mem = r.reg, r.mem
-            self.gb_reg[c + 1] = np.asarray(reg)
-            self.gb_mem[c + 1] = np.asarray(mem)
         self.golden_final = ReplayResult(
             reg=jnp.asarray(self.gb_reg[self.C]),
             mem=jnp.asarray(self.gb_mem[self.C]),
             detected=jnp.asarray(False), trapped=jnp.asarray(False),
             diverged=jnp.asarray(False))
+
+        self._exact_ready = False
 
     # ---- chunk kernels ---------------------------------------------------
     #
@@ -155,50 +353,46 @@ class ChunkedCampaign:
     # embedded in the jaxpr as a constant, and at SimPoint scale that
     # means hundreds of MB of literals per compile (the r4 524k dense
     # kernel's 217 s compile was exactly this).  As arguments they are
-    # device buffers referenced by the executable.
+    # device buffers referenced by the executable — ONE executable serves
+    # any window length.
+
+    def _ensure_exact(self):
+        """Exact-engine device state, built lazily: fast-engine campaigns
+        over a stored window never upload the full padded trace unless a
+        lane actually falls back."""
+        if self._exact_ready:
+            return
+        self.tr_pad = self.window.tr_dev
+        self.cov_pad = jnp.asarray(self.cov_pad_host)
+        self.mm_cluster_pad = self.window.mm_cluster_dev
+        self._trial_chunk_fn = self._chunk_jit(
+            "trial_chunk", lambda: jax.jit(self._trial_chunk_body))
+        self._exact_ready = True
 
     def _big_args(self):
         return self.tr_pad, self.cov_pad, self.mm_cluster_pad
 
-    def _slice_chunk(self, tr_pad, cov_pad, mm_cluster, start):
-        sl = partial(jax.lax.dynamic_slice_in_dim, start_index=start,
-                     slice_size=self.S)
-        tr = TraceArrays(*(sl(a) for a in tr_pad))
-        cov = sl(cov_pad)
-        mm = None
-        if self.memmap is not None:
-            mm = self.memmap._replace(uop_cluster=sl(mm_cluster))
-        return tr, cov, mm
-
-    def _chunk_jit(self, kind: str, build):
+    def _chunk_jit(self, kind: str, build, **flags):
         """Chunk kernels through the process-wide executable cache
         (parallel/exec_cache.py), keyed by the kernel's content
-        fingerprint + chunk length.  The old ``partial(jax.jit,
-        static_argnums=0)`` methods were keyed by *instance*: every
-        ChunkedCampaign over the same trace — the integrity layer's audit
-        alternate, a re-built orchestrator, bench warm-up/timed pairs —
-        re-traced and re-compiled identical chunk programs."""
+        fingerprint + chunk length (+ engine flags).  The old
+        ``partial(jax.jit, static_argnums=0)`` methods were keyed by
+        *instance*: every ChunkedCampaign over the same trace — the
+        integrity layer's audit alternate, a re-built orchestrator, bench
+        warm-up/timed pairs — re-traced and re-compiled identical chunk
+        programs."""
         from shrewd_tpu.parallel import exec_cache
 
         return exec_cache.cache().get(
             exec_cache.step_key(self.kernel, None, "", kind=kind,
-                                S=self.S),
+                                S=self.S, **flags),
             owner=self.kernel, build=build)
-
-    def _golden_chunk_body(self, tr_pad, cov_pad, mm_cluster, reg, mem,
-                           fault, start):
-        tr, cov, mm = self._slice_chunk(tr_pad, cov_pad, mm_cluster, start)
-        return replay(tr, reg, mem, fault, cov, memmap=mm,
-                      index_offset=start)
-
-    def _golden_chunk(self, reg, mem, fault, start):
-        return self._golden_chunk_fn(*self._big_args(), reg, mem,
-                                     fault, start)
 
     def _trial_chunk_body(self, tr_pad, cov_pad, mm_cluster, reg_b, mem_b,
                           fault_b, start, gb_reg, gb_mem):
         """One chunk for B lanes → (reg', mem', det, trap, div, eq)."""
-        tr, cov, mm = self._slice_chunk(tr_pad, cov_pad, mm_cluster, start)
+        tr, cov, mm = _slice_chunk(self.S, self.memmap, tr_pad, cov_pad,
+                                   mm_cluster, start)
 
         def one(reg, mem, fault):
             r = replay(tr, reg, mem, fault, cov, memmap=mm,
@@ -212,6 +406,96 @@ class ChunkedCampaign:
         return self._trial_chunk_fn(*self._big_args(), reg_b, mem_b,
                                     fault_b, start, gb_reg, gb_mem)
 
+    # ---- fast-chunk kernel (taint / pallas engines) ------------------------
+
+    def _fast_chunk_body(self, op, dst, src1, src2, imm, taken, cov,
+                         reg0, mem0, gb_r1, gb_m1, kind, cycle, entry,
+                         bit, shadow_u, tags0, vals0, ages, horizon, *,
+                         may_latch, is_last):
+        """One chunk for B lanes on the deviation-set kernels →
+        ``(code, frz, conv, tags, vals)``.
+
+        code ≥ 0: final outcome class; -1: carry to the next chunk;
+        -2: escape/overflow → per-trial exact fallback; -3: carry-horizon
+        SDC relabel (counted separately so ``horizon_sdc`` stays exact).
+        Fault coordinates arrive pre-localized to this chunk (carried
+        lanes' go negative — no fault phase re-fires); ``tags0``/``vals0``
+        are the carried deviation sets; boundary convergence, end
+        classification AND the horizon early-exit all run in-graph, so
+        one executable resolves a whole wave with no host round-trip."""
+        kernel = self.kernel
+        cfg = kernel.cfg
+        k = int(cfg.taint_k)
+        tr = TraceArrays(opcode=op, dst=dst, src1=src1, src2=src2,
+                         imm=imm, taken=taken)
+        fault_b = Fault(kind=kind, cycle=cycle, entry=entry, bit=bit,
+                        shadow_u=shadow_u)
+        reg0 = reg0.astype(u32)
+        mem0 = mem0.astype(u32)
+        gb_r1 = gb_r1.astype(u32)
+        gb_m1 = gb_m1.astype(u32)
+        gold = _record_chunk(tr, reg0, mem0, gb_r1, gb_m1)
+        setup = setup_scan(tr, reg0, mem0, fault_b)
+
+        if self.engine == "pallas":
+            from shrewd_tpu.ops.pallas_taint import taint_chunk_pallas
+
+            det, trap, div, esc, ovf, tags_t, vals_t = taint_chunk_pallas(
+                gold, op, dst, src1, src2, imm, taken, cov, fault_b,
+                *setup, jnp.transpose(tags0), jnp.transpose(vals0),
+                k=k, may_latch=may_latch,
+                b_tile=int(cfg.pallas_b_tile),
+                u_steps=int(cfg.pallas_u_steps),
+                interpret=self._interpret)
+            tags = jnp.transpose(tags_t)
+            vals = jnp.transpose(vals_t)
+        else:
+            def one(fault, t0, v0, su3):
+                tags, vals, _live, det, trap, div, esc, ovf = taint_chunk(
+                    gold, tr, fault, cov, t0, v0, k=k, setup=su3)
+                return tags, vals, det, trap, div, esc, ovf
+
+            tags, vals, det, trap, div, esc, ovf = jax.vmap(one)(
+                fault_b, tags0, vals0, setup)
+
+        frz = det | trap | div
+        fb = esc | ovf
+        boundary = jnp.concatenate([gb_r1, gb_m1])
+        ent = tags != EMPTY
+        safe = jnp.where(ent, tags, 0)
+        diff_full = ent & (vals != boundary[safe])
+        conv = ~diff_full.any(axis=1)
+        if is_last:
+            # end-of-window classification, identical to taint_replay's
+            if cfg.compare_regs:
+                state_diff = ~conv
+            else:
+                state_diff = (diff_full
+                              & (tags >= i32(self.nphys))).any(axis=1)
+            out_surv = jnp.where(state_diff, i32(C.OUTCOME_SDC),
+                                 i32(C.OUTCOME_MASKED))
+        else:
+            out_surv = jnp.where(conv, i32(C.OUTCOME_MASKED), i32(-1))
+            # carry-horizon early exit INSIDE the executable: still
+            # divergent past the horizon → SDC (masked→SDC / DUE→SDC
+            # relabel only; same semantics as the exact driver's)
+            over = (out_surv == i32(-1)) & (ages + 1 > horizon)
+            out_surv = jnp.where(over, i32(-3), out_surv)
+        code = jnp.where(
+            fb, i32(-2),
+            jnp.where(det, i32(C.OUTCOME_DETECTED),
+                      jnp.where(trap, i32(C.OUTCOME_DUE),
+                                jnp.where(div, i32(C.OUTCOME_SDC),
+                                          out_surv))))
+        return code, frz, conv, tags, vals
+
+    def _fast_fn(self, may_latch: bool, is_last: bool):
+        return self._chunk_jit(
+            "fast_chunk",
+            lambda: jax.jit(partial(self._fast_chunk_body,
+                                    may_latch=may_latch, is_last=is_last)),
+            engine=self.engine, ml=may_latch, last=is_last)
+
     # ---- driver ----------------------------------------------------------
 
     def lane_width(self, n_trials: int) -> int:
@@ -222,6 +506,23 @@ class ChunkedCampaign:
         kernel must warm at the SAME bucket they will time."""
         return int(min(self.B,
                        1 << int(np.ceil(np.log2(max(n_trials, 8))))))
+
+    def _fast_lane_width(self, n_trials: int) -> int:
+        """Occupancy-aware wave width for BOTH drivers.  Every wave call
+        scans a FULL ``B × S`` lane grid (padding included), so at
+        many-chunk scale sizing B to the campaign is catastrophic: 512
+        trials over C=401 chunks at horizon 2 average ~4 live lanes per
+        chunk — B=512 would pad 401 calls to 512 lanes each, ~100× the
+        real lane-steps.  Size B to the EXPECTED per-chunk occupancy
+        instead: each trial is live in at most span = horizon+1 chunks
+        (C when exact), so the mean wave carries ceil(n_trials·span/C)
+        lanes.  Chunks drawing more than B lanes just run extra waves —
+        the carry-slice loop already handles it, and outcomes are
+        B-invariant (pinned by tests/test_chunked*.py)."""
+        span = (self.C if self.carry_horizon is None
+                else min(self.carry_horizon + 1, self.C))
+        per_wave = -(-n_trials * span // self.C)
+        return min(self.lane_width(n_trials), self.lane_width(per_wave))
 
     def outcomes_from_keys(self, keys: jax.Array, structure: str
                            ) -> np.ndarray:
@@ -236,10 +537,8 @@ class ChunkedCampaign:
         outcome is known by construction, audit re-runs of sampled faults)
         through the chunked strategy without inventing keys that would
         sample them."""
-        kernel = self.kernel
         f_host = {k: np.asarray(v) for k, v in faults._asdict().items()}
         n_tr = f_host["cycle"].shape[0]
-        B = self.lane_width(n_tr)
         # the fault's landing µop: REGFILE flips at `cycle`, every other
         # kind applies at µop `entry` (ops/replay.py step phases 1-2)
         landing = np.where(f_host["kind"] == KIND_REGFILE,
@@ -256,15 +555,175 @@ class ChunkedCampaign:
         outcomes[oow] = C.OUTCOME_MASKED
         land_chunk = np.clip(landing, 0, self.n - 1) // self.S
         land_chunk[oow] = -1          # never scheduled into a wave
-
-        null_leaves = dict(kind=0, cycle=-1, entry=-1, bit=0, shadow_u=1.0)
-        carry: _Carry | None = None
         # observability: how the campaign resolved (self.last_stats)
         st = {"waves": 0, "lanes_run": 0, "resolved_frozen": 0,
               "resolved_eq": 0, "carried": 0, "resolved_at_end": 0,
               "chunk_replays": 0, "horizon_sdc": 0,
-              "oow_masked": int(oow.sum())}
+              "oow_masked": int(oow.sum()),
+              "engine": self.engine, "fallback_lanes": 0}
         self.last_stats = st    # live view — valid even on a failed run
+        if self.engine == "exact":
+            self._outcomes_exact(f_host, outcomes, land_chunk, st)
+        else:
+            self._outcomes_fast(f_host, outcomes, land_chunk, st)
+        self.last_stats = st
+        assert (outcomes >= 0).all(), "unresolved trials after last chunk"
+        return outcomes
+
+    # ---- fast driver (taint / pallas engines) ------------------------------
+
+    def _outcomes_fast(self, f_host, outcomes, land_chunk, st) -> None:
+        """Wave driver over the deviation-set chunk kernels.  Per-trial
+        cross-chunk state is the (orig, age, fault, k-entry set) tuple —
+        host-cheap — and every semantic decision (freeze precedence,
+        boundary convergence, horizon, end classification) happens inside
+        the fast-chunk executable.  Escape/overflow lanes are re-run
+        per-trial on the exact engine afterwards, preserving the
+        bit-identical-to-dense contract."""
+        n_tr = land_chunk.shape[0]
+        B = self._fast_lane_width(n_tr)
+        k = int(self.kernel.cfg.taint_k)
+        may_latch = bool((f_host["kind"] == KIND_LATCH_OP).any())
+        horizon = i32(self.carry_horizon
+                      if self.carry_horizon is not None else _NO_HORIZON)
+        null_leaves = dict(kind=0, cycle=-1, entry=-1, bit=0, shadow_u=1.0)
+        fb_ids: list[np.ndarray] = []
+        carry: dict | None = None
+        for c in range(self.C):
+            fresh = np.nonzero(land_chunk == c)[0]
+            prev, carry = carry, None
+            n_prev = prev["orig"].size if prev is not None else 0
+            if n_prev == 0 and fresh.size == 0:
+                continue
+            is_last = c == self.C - 1
+            fn = self._fast_fn(may_latch, is_last)
+            # one device upload per chunk, not per wave: zero-copy host
+            # views of the preprocessed SoA layout (lazy materialization
+            # when the window is an mmap'd store artifact)
+            trc = self.window.chunk_trace(c)
+            dev = [jnp.asarray(trc[f]) for f in W.TRACE_FIELDS]
+            cov_c = jnp.asarray(
+                self.cov_pad_host[c * self.S:(c + 1) * self.S])
+            reg0 = jnp.asarray(self.gb_reg[c])
+            mem0 = jnp.asarray(self.gb_mem[c])
+            gb_r1 = jnp.asarray(self.gb_reg[c + 1])
+            gb_m1 = jnp.asarray(self.gb_mem[c + 1])
+            start = c * self.S
+            nxt: dict = {"orig": [], "age": [], "tags": [], "vals": [],
+                         "fault": {name: [] for name in f_host}}
+            cpos = fpos = 0
+            while cpos < n_prev or fpos < fresh.size:
+                k_carry = min(B, n_prev - cpos)
+                carry_sl = slice(cpos, cpos + k_carry)
+                cpos += k_carry
+                room = B - k_carry
+                new_idx = fresh[fpos:fpos + room]
+                fpos += new_idx.size
+                b = k_carry + new_idx.size
+                pad = B - b
+                orig = np.full(B, -1, np.int64)
+                ages = np.zeros(B, np.int32)
+                tags0 = np.full((B, k), -1, np.int32)
+                vals0 = np.zeros((B, k), np.uint32)
+                fw: dict[str, np.ndarray] = {}
+                for name in f_host:
+                    dt = np.float32 if name == "shadow_u" else np.int32
+                    parts = []
+                    if k_carry:
+                        parts.append(prev["fault"][name][carry_sl])
+                    if new_idx.size:
+                        parts.append(f_host[name][new_idx].astype(dt))
+                    if pad:
+                        parts.append(np.full(pad, null_leaves[name], dt))
+                    fw[name] = np.concatenate(parts).astype(dt)
+                if k_carry:
+                    orig[:k_carry] = prev["orig"][carry_sl]
+                    ages[:k_carry] = prev["age"][carry_sl]
+                    tags0[:k_carry] = prev["tags"][carry_sl]
+                    vals0[:k_carry] = prev["vals"][carry_sl]
+                if new_idx.size:
+                    orig[k_carry:b] = new_idx
+                # localize fault coordinates to THIS chunk from the global
+                # originals: fresh lanes land in [0, S); carried lanes go
+                # negative and no fault phase re-fires
+                cyc_l = np.where(fw["kind"] == KIND_REGFILE,
+                                 fw["cycle"] - start,
+                                 fw["cycle"]).astype(np.int32)
+                ent_l = np.where(fw["kind"] == KIND_REGFILE, fw["entry"],
+                                 fw["entry"] - start).astype(np.int32)
+                code, frz, conv, tags, vals = fn(
+                    *dev, cov_c, reg0, mem0, gb_r1, gb_m1,
+                    jnp.asarray(fw["kind"]), jnp.asarray(cyc_l),
+                    jnp.asarray(ent_l), jnp.asarray(fw["bit"]),
+                    jnp.asarray(fw["shadow_u"]), jnp.asarray(tags0),
+                    jnp.asarray(vals0), jnp.asarray(ages), horizon)
+                code = np.asarray(code)[:b]
+                frz = np.asarray(frz)[:b]
+                conv = np.asarray(conv)[:b]
+                st["waves"] += 1
+                st["lanes_run"] += b
+                st["chunk_replays"] += B     # padded lanes included
+                fbm = code == -2
+                st["resolved_frozen"] += int((frz & ~fbm).sum())
+                final = code >= 0
+                outcomes[orig[:b][final]] = code[final]
+                st["resolved_eq"] += int((conv & ~frz & final).sum())
+                if is_last:
+                    st["resolved_at_end"] += int(
+                        (~frz & ~conv & final).sum())
+                if fbm.any():
+                    fb_ids.append(orig[:b][fbm])
+                    st["fallback_lanes"] += int(fbm.sum())
+                hz = code == -3
+                if hz.any():
+                    outcomes[orig[:b][hz]] = C.OUTCOME_SDC
+                    st["horizon_sdc"] += int(hz.sum())
+                carried = code == -1
+                if carried.any():
+                    st["carried"] += int(carried.sum())
+                    nxt["orig"].append(orig[:b][carried])
+                    nxt["age"].append(ages[:b][carried] + 1)
+                    nxt["tags"].append(np.asarray(tags)[:b][carried])
+                    nxt["vals"].append(np.asarray(vals)[:b][carried])
+                    for name in f_host:
+                        nxt["fault"][name].append(fw[name][:b][carried])
+            if nxt["orig"]:
+                carry = {
+                    "orig": np.concatenate(nxt["orig"]),
+                    "age": np.concatenate(nxt["age"]),
+                    "tags": np.concatenate(nxt["tags"]),
+                    "vals": np.concatenate(nxt["vals"]),
+                    "fault": {name: np.concatenate(nxt["fault"][name])
+                              for name in f_host},
+                }
+        if fb_ids:
+            # escape/overflow lanes re-run per trial on the exact engine
+            # (from their landing chunk, fresh age — exactly what an
+            # exact-everywhere run would have computed for them)
+            ids = np.concatenate(fb_ids)
+            sub = {name: f_host[name][ids] for name in f_host}
+            sub_out = np.full(ids.size, -1, np.int32)
+            self._outcomes_exact(sub, sub_out, land_chunk[ids], st)
+            outcomes[ids] = sub_out
+
+    # ---- exact driver ------------------------------------------------------
+
+    def _outcomes_exact(self, f_host, outcomes, land_chunk, st) -> None:
+        """Wave driver over the dense per-chunk replay kernel (full
+        reg+mem state carried per lane) — the reference strategy and the
+        fallback target for fast-engine escapes."""
+        self._ensure_exact()
+        kernel = self.kernel
+        n_tr = land_chunk.shape[0]
+        # occupancy-aware, same as the fast driver: an exact wave costs
+        # B full (reg+mem) chunk replays whether the lanes are live or
+        # padding, and fallback/audit sub-campaigns arrive as a few
+        # trials scattered across many landing chunks — sizing B to the
+        # sub-campaign would pad every wave ~B× (the 26.2M fallback path
+        # spent ~60× its live lane-steps on padding before this)
+        B = self._fast_lane_width(n_tr)
+        null_leaves = dict(kind=0, cycle=-1, entry=-1, bit=0, shadow_u=1.0)
+        carry: _Carry | None = None
 
         for c in range(self.C):
             fresh = np.nonzero(land_chunk == c)[0]
@@ -392,11 +851,51 @@ class ChunkedCampaign:
                             for k in f_host}),
                         orig=np.concatenate([carry.orig, new_carry.orig]),
                         age=np.concatenate([carry.age, new_carry.age])))
-        self.last_stats = st
-        assert (outcomes >= 0).all(), "unresolved trials after last chunk"
-        return outcomes
 
     def run_keys(self, keys: jax.Array, structure: str) -> np.ndarray:
         """Outcome tally (N_OUTCOMES,), the campaign-facing surface."""
         out = self.outcomes_from_keys(keys, structure)
         return np.bincount(out, minlength=C.N_OUTCOMES).astype(np.int64)
+
+
+def _record_chunk(tr: TraceArrays, init_reg, init_mem, final_reg,
+                  final_mem) -> GoldenRecord:
+    """In-graph golden recording over one chunk: ``record_golden``'s scan
+    with the opcode classing done in-graph (``record_golden`` itself
+    calls ``np.asarray`` on the opcode and is not traceable), so the
+    per-chunk golden streams never need host storage — the window store
+    holds only the SoA trace + boundary states and the streams are
+    recomputed inside the fast-chunk executable."""
+    mem_words = init_mem.shape[0]
+
+    def step(carry, xs):
+        reg, mem = carry
+        op, dstr, s1, s2, imm = xs
+        a = reg[s1]
+        b = reg[s2]
+        eff = _alu(op, a, b, imm)
+        is_ld = op == U.LOAD
+        is_st = op == U.STORE
+        slot = (eff >> u32(2)).astype(i32) & i32(mem_words - 1)
+        st_old = mem[slot]
+        res = jnp.where(is_ld, st_old, eff)
+        dst_old = reg[dstr]
+        writes = (((op >= U.ADD) & (op <= U.REMU)) | is_ld
+                  | ((op >= U.FADD) & (op <= U.MULHU)))
+        reg = reg.at[dstr].set(jnp.where(writes, res, dst_old))
+        mem = mem.at[slot].set(jnp.where(is_st, b, st_old))
+        return (reg, mem), (a, b, eff, res, st_old, dst_old)
+
+    xs = (tr.opcode, tr.dst, tr.src1, tr.src2, tr.imm)
+    (_, _), ys = jax.lax.scan(
+        step, (init_reg.astype(u32), init_mem.astype(u32)), xs)
+    a, b, ea, res, st_old, dst_old = ys
+    op = tr.opcode
+    is_ld = op == U.LOAD
+    is_st = op == U.STORE
+    wr = (((op >= U.ADD) & (op <= U.REMU)) | is_ld
+          | ((op >= U.FADD) & (op <= U.MULHU)))     # == U.writes_dest
+    return GoldenRecord(a=a, b=b, ea=ea, res=res, st_old=st_old,
+                        dst_old=dst_old, wr=wr, is_ld=is_ld, is_st=is_st,
+                        reg_t=None, mem_t=None,
+                        final_reg=final_reg, final_mem=final_mem)

@@ -46,6 +46,10 @@ from shrewd_tpu.ops.taint import EMPTY, GoldenRecord, TaintResult
 i32 = jnp.int32
 u32 = jnp.uint32
 
+#: renamed TPUCompilerParams → CompilerParams across jax releases
+_CompilerParams = getattr(pltpu, "CompilerParams", None) \
+    or getattr(pltpu, "TPUCompilerParams")
+
 LANE = 128          # TPU lane width; B_TILE and n must be multiples
 S_CHUNK = PALLAS_S_CHUNK
                     # per-step golden streams arrive in (15, S_CHUNK) SMEM
@@ -203,7 +207,7 @@ def _alu_vec(op, a, b, imm):
 
 
 def _make_kernel(n: int, k: int, nphys: int, mem_words: int, may_latch: bool,
-                 u_steps: int = 1):
+                 u_steps: int = 1, carry_sets: bool = False):
     """Grid-over-steps kernel: grid = (lane_tiles, ceil(n/u_steps)) with the
     step (µop) axis as the LAST, sequential ("arbitrary") grid dimension —
     the Pallas pipeline delivers the golden scalars as
@@ -218,16 +222,27 @@ def _make_kernel(n: int, k: int, nphys: int, mem_words: int, may_latch: bool,
     NOP (=0) columns are provably inert in every path (no write enables,
     no mem/branch/div class, golden write flags 0).
     Deviation sets and outcome masks persist across steps in VMEM scratch;
-    outputs are flushed on the final grid step of each lane tile."""
+    outputs are flushed on the final grid step of each lane tile.
+    ``carry_sets=True`` is the chunk-granular variant (taint_chunk_pallas):
+    two extra (k, B) inputs seed the deviation sets at block 0 — the
+    architectural state carried across chunk invocations — instead of the
+    EMPTY/zeros fresh-trial init."""
     idx_mask = nphys - 1          # python ints: no captured traced constants
     EMPTY_C = -1
     n_blocks = -(-n // u_steps)
 
-    def kernel(sv_s, sc_s,
-               kind_r, cycle_r, entry_r, bit_r, su_r, gaf_r, alt1_r, alt2_r,
-               out_r, esc_r, ovf_r, tags_out, vals_out,
-               tags_sc, vals_sc, live_sc, det_sc, trap_sc, div_sc,
-               esc_sc, ovf_sc):
+    def kernel(*refs):
+        (sv_s, sc_s, kind_r, cycle_r, entry_r, bit_r, su_r,
+         gaf_r, alt1_r, alt2_r) = refs[:10]
+        if carry_sets:
+            tags_in, vals_in = refs[10:12]
+            rest = refs[12:]
+        else:
+            tags_in = vals_in = None
+            rest = refs[10:]
+        (out_r, esc_r, ovf_r, tags_out, vals_out,
+         tags_sc, vals_sc, live_sc, det_sc, trap_sc, div_sc,
+         esc_sc, ovf_sc) = rest
         # All lane state is kept 2-D (1, B): Mosaic's layout inference
         # crashes on rank-1 vectors (layout.h implicit-dim check), and
         # (1, B) broadcasts cleanly against the (k, B) sets.
@@ -247,8 +262,12 @@ def _make_kernel(n: int, k: int, nphys: int, mem_words: int, may_latch: bool,
 
         @pl.when(blk == 0)
         def _init():
-            tags_sc[...] = jnp.full((k, B), EMPTY_C, dtype=i32)
-            vals_sc[...] = jnp.zeros((k, B), dtype=i32)
+            if carry_sets:        # static python branch (kernel variant)
+                tags_sc[...] = tags_in[...]
+                vals_sc[...] = vals_in[...]
+            else:
+                tags_sc[...] = jnp.full((k, B), EMPTY_C, dtype=i32)
+                vals_sc[...] = jnp.zeros((k, B), dtype=i32)
             live_sc[...] = jnp.ones((1, B), dtype=i32)
             det_sc[...] = jnp.zeros((1, B), dtype=i32)
             trap_sc[...] = jnp.zeros((1, B), dtype=i32)
@@ -576,7 +595,7 @@ def taint_fast_pallas(gold: GoldenRecord, opcode, dst, src1, src2, imm,
             pltpu.VMEM((1, b_tile), i32), pltpu.VMEM((1, b_tile), i32),
             pltpu.VMEM((1, b_tile), i32), pltpu.VMEM((1, b_tile), i32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=(pltpu.PARALLEL, pltpu.ARBITRARY)),
         interpret=interpret,
     )(sv, sc, *lanes)
@@ -605,3 +624,112 @@ def taint_fast_pallas(gold: GoldenRecord, opcode, dst, src1, src2, imm,
                   jnp.where(diverged | state_diff, i32(C.OUTCOME_SDC),
                             i32(C.OUTCOME_MASKED))))
     return TaintResult(outcome=outcome, escaped=escaped, overflow=overflow)
+
+
+# graftlint: allow-jit -- module-level jit: its function identity is
+# already process-wide (one compile per static-arg combination), so
+# content keying through exec_cache would add nothing
+@functools.partial(jax.jit, static_argnames=("k", "may_latch", "b_tile",
+                                             "u_steps", "interpret"))
+def taint_chunk_pallas(gold: GoldenRecord, opcode, dst, src1, src2, imm,
+                       taken, shadow_cov, faults: Fault,
+                       gold_at_fault, alt1, alt2, tags0, vals0,
+                       k: int = 16, may_latch: bool = True,
+                       b_tile: int = 512, u_steps: int = 1,
+                       interpret: bool = False):
+    """Chunk-granular Pallas fast pass (the chunked engine's per-chunk
+    kernel, ops/chunked.py).  Same per-µop semantics as
+    ``taint_fast_pallas``, with three chunk-replay differences:
+
+    - ``tags0``/``vals0`` ((k, B) i32 / u32) seed the deviation sets —
+      the per-trial architectural state carried across chunk invocations;
+    - ``gold``'s streams/finals cover ONE chunk (final_reg/final_mem are
+      the chunk-end golden boundary — used here only for shapes);
+    - no end classification: returns the raw
+      ``(detected, trapped, diverged, escaped, overflow, tags, vals)``
+      so the driver can resolve boundary convergence / carry / horizon
+      (tags (k, B) i32, vals (k, B) u32).
+
+    Window chunks stream in HBM-side exactly as in ``taint_fast_pallas``:
+    the (15, S_CHUNK) SMEM golden blocks are double-buffered by the grid
+    pipeline over the sequential step axis."""
+    n = int(opcode.shape[0])
+    nphys = int(gold.final_reg.shape[0])
+    mem_words = int(gold.final_mem.shape[0])
+    B = int(faults.kind.shape[0])
+    B_pad = -(-B // b_tile) * b_tile
+
+    sv = jnp.stack([
+        jnp.asarray(opcode, i32), jnp.asarray(dst, i32),
+        jnp.asarray(src1, i32), jnp.asarray(src2, i32),
+        _s(jnp.asarray(imm).astype(u32)), jnp.asarray(taken, i32),
+        _s(gold.a), _s(gold.b), _s(gold.ea), _s(gold.res),
+        _s(gold.st_old), _s(gold.dst_old),
+        gold.wr.astype(i32), gold.is_ld.astype(i32),
+        gold.is_st.astype(i32),
+    ])
+    sc = jnp.asarray(shadow_cov, jnp.float32).reshape(1, -1)
+    n_pad = -(-n // S_CHUNK) * S_CHUNK
+    sv = jnp.pad(sv, ((0, 0), (0, n_pad - n)))
+    sc = jnp.pad(sc, ((0, 0), (0, n_pad - n)))
+
+    def pad_lane(x, dtype=i32):
+        x = jnp.asarray(x).astype(dtype).reshape(1, -1)
+        return jnp.pad(x, ((0, 0), (0, B_pad - B)))
+
+    lanes = [
+        pad_lane(faults.kind), pad_lane(faults.cycle),
+        pad_lane(faults.entry), pad_lane(faults.bit),
+        jnp.pad(jnp.asarray(faults.shadow_u, jnp.float32).reshape(1, -1),
+                ((0, 0), (0, B_pad - B)), constant_values=2.0),
+        pad_lane(_s(gold_at_fault)), pad_lane(_s(alt1)), pad_lane(_s(alt2)),
+        # carried deviation sets; padded lanes start EMPTY (inert)
+        jnp.pad(jnp.asarray(tags0, i32), ((0, 0), (0, B_pad - B)),
+                constant_values=-1),
+        jnp.pad(_s(jnp.asarray(vals0).astype(u32)),
+                ((0, 0), (0, B_pad - B))),
+    ]
+
+    assert S_CHUNK % u_steps == 0, (u_steps, S_CHUNK)
+    kernel = _make_kernel(n, k, nphys, mem_words, may_latch, u_steps,
+                          carry_sets=True)
+    grid = (B_pad // b_tile, -(-n // u_steps))
+    sv_spec = pl.BlockSpec((15, S_CHUNK),
+                           lambda b, i: (0, (i * u_steps) // S_CHUNK),
+                           memory_space=pltpu.SMEM)
+    sc_spec = pl.BlockSpec((1, S_CHUNK),
+                           lambda b, i: (0, (i * u_steps) // S_CHUNK),
+                           memory_space=pltpu.SMEM)
+    lane_spec = pl.BlockSpec((1, b_tile), lambda b, i: (0, b),
+                             memory_space=pltpu.VMEM)
+    kset_spec = pl.BlockSpec((k, b_tile), lambda b, i: (0, b),
+                             memory_space=pltpu.VMEM)
+    in_specs = ([sv_spec, sc_spec] + [lane_spec] * (len(lanes) - 2)
+                + [kset_spec, kset_spec])
+    outcome_bits, esc, ovf, tags, vals = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=[lane_spec, lane_spec, lane_spec, kset_spec, kset_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, B_pad), i32),   # det/trap/div bits
+            jax.ShapeDtypeStruct((1, B_pad), i32),
+            jax.ShapeDtypeStruct((1, B_pad), i32),
+            jax.ShapeDtypeStruct((k, B_pad), i32),
+            jax.ShapeDtypeStruct((k, B_pad), i32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((k, b_tile), i32), pltpu.VMEM((k, b_tile), i32),
+            pltpu.VMEM((1, b_tile), i32), pltpu.VMEM((1, b_tile), i32),
+            pltpu.VMEM((1, b_tile), i32), pltpu.VMEM((1, b_tile), i32),
+            pltpu.VMEM((1, b_tile), i32), pltpu.VMEM((1, b_tile), i32),
+        ],
+        compiler_params=_CompilerParams(
+            dimension_semantics=(pltpu.PARALLEL, pltpu.ARBITRARY)),
+        interpret=interpret,
+    )(sv, sc, *lanes)
+
+    bits = outcome_bits[0, :B]
+    return ((bits & 1) != 0, (bits & 2) != 0, (bits & 4) != 0,
+            esc[0, :B] != 0, ovf[0, :B] != 0,
+            tags[:, :B], _u(vals[:, :B]))

@@ -224,17 +224,20 @@ def setup_scan(tr: TraceArrays, init_reg: jax.Array, init_mem: jax.Array,
     return gaf, alt1, alt2
 
 
-def taint_replay(gold: GoldenRecord, tr: TraceArrays, fault: Fault,
-                 shadow_cov: jax.Array, k: int = 16,
-                 compare_regs: bool = True, setup=None) -> TaintResult:
-    """One trial via deviation tracking. jit/vmap-safe.
+def _scan_deviation(gold: GoldenRecord, tr: TraceArrays, fault: Fault,
+                    shadow_cov: jax.Array, k: int, setup,
+                    init_tags: jax.Array, init_vals: jax.Array):
+    """The deviation-set scan shared by the full-window kernel
+    (``taint_replay``) and the chunk-granular kernel (``taint_chunk``):
+    runs every µop of ``tr`` and returns the raw final carry
+    ``(tags, vals, live, detected, trapped, diverged, escaped,
+    overflowed)`` — classification belongs to the caller.
 
-    Phase order matches ops/replay.py exactly (the event-priority-ladder
-    analog); every dense-kernel fault kind is supported.
-
-    ``setup`` optionally supplies this lane's precomputed
-    ``(gold_at_fault, alt1, alt2)`` triple (from ``setup_scan``) when the
-    GoldenRecord was built without the register timeline.
+    ``init_tags``/``init_vals`` seed the deviation set: EMPTY/zeros for a
+    fresh trial, or the carried set from the previous chunk (the chunked
+    engine's cross-chunk architectural state — the scan carry is exactly
+    ``(sets, flags)``, so splitting a window at any boundary and
+    re-seeding reproduces the unsplit scan bit-for-bit).
     """
     nphys = gold.final_reg.shape[0]
     mem_words = gold.final_mem.shape[0]
@@ -388,12 +391,48 @@ def taint_replay(gold: GoldenRecord, tr: TraceArrays, fault: Fault,
     # under shard_map matches the step outputs (same trick as ops/replay.py).
     vary0 = (fault.cycle * 0).astype(i32)
     vary_false = fault.cycle != fault.cycle
-    init = (jnp.full((k,), EMPTY, dtype=i32) + vary0,
-            jnp.zeros((k,), dtype=u32) ^ vary0.astype(u32),
+    init = (init_tags.astype(i32) + vary0,
+            init_vals.astype(u32) ^ vary0.astype(u32),
             ~vary_false, vary_false, vary_false, vary_false, vary_false,
             vary_false)
-    (tags, vals, _live, detected, trapped, diverged, escaped, overflowed), _ \
-        = jax.lax.scan(step, init, xs)
+    carry, _ = jax.lax.scan(step, init, xs)
+    return carry
+
+
+def taint_chunk(gold: GoldenRecord, tr: TraceArrays, fault: Fault,
+                shadow_cov: jax.Array, tags0: jax.Array, vals0: jax.Array,
+                k: int = 16, setup=None):
+    """One CHUNK of a trial via deviation tracking (jit/vmap-safe).
+
+    ``tr``/``gold``/``shadow_cov`` cover one chunk; fault coordinates must
+    be pre-localized to the chunk (a carried lane's coordinates go
+    negative and no fault phase re-fires).  ``tags0``/``vals0`` are the
+    deviation set carried in from the previous chunk boundary (EMPTY/0
+    for a lane starting in its landing chunk).  Returns the raw carry
+    ``(tags, vals, live, detected, trapped, diverged, escaped,
+    overflowed)``; boundary convergence / horizon / end classification is
+    the chunked driver's job (ops/chunked.py)."""
+    return _scan_deviation(gold, tr, fault, shadow_cov, k, setup,
+                           tags0, vals0)
+
+
+def taint_replay(gold: GoldenRecord, tr: TraceArrays, fault: Fault,
+                 shadow_cov: jax.Array, k: int = 16,
+                 compare_regs: bool = True, setup=None) -> TaintResult:
+    """One trial via deviation tracking. jit/vmap-safe.
+
+    Phase order matches ops/replay.py exactly (the event-priority-ladder
+    analog); every dense-kernel fault kind is supported.
+
+    ``setup`` optionally supplies this lane's precomputed
+    ``(gold_at_fault, alt1, alt2)`` triple (from ``setup_scan``) when the
+    GoldenRecord was built without the register timeline.
+    """
+    nphys = gold.final_reg.shape[0]
+    (tags, vals, _live, detected, trapped, diverged, escaped, overflowed) \
+        = _scan_deviation(gold, tr, fault, shadow_cov, k, setup,
+                          jnp.full((k,), EMPTY, dtype=i32),
+                          jnp.zeros((k,), dtype=u32))
 
     # End classification: any surviving deviation vs the golden FINAL state.
     final_state = jnp.concatenate([gold.final_reg, gold.final_mem])

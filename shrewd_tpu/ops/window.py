@@ -1,0 +1,196 @@
+"""Preprocessed chunk-window container + registry + store glue.
+
+A ``PreprocessedWindow`` is everything the chunked engines (ops/chunked.py)
+need that depends only on the TRACE and the chunk length S — not on the
+campaign config: the NOP-padded SoA trace arrays (C·S µops, so the hot
+loop slices zero-copy views instead of re-padding per chunk) and the
+golden boundary states at every chunk edge (the in-window checkpoints).
+
+Three tiers of reuse, cheapest first:
+
+1. **process registry** — an LRU of recent windows keyed by
+   ``(trace digest, S, memmap identity)``.  The integrity layer's audit
+   alternate, bench warm/timed pairs, and re-built campaigns over the
+   same trace hit here and skip the padding pass AND the golden boundary
+   replay entirely (previously every ChunkedCampaign re-did both).
+2. **artifact store** — ``ArtifactStore`` objects under the binary's
+   content digest (``ingest/store.py``), one ``window_chunks`` document
+   per (digest, S) plus one ``.npy`` payload per array.  Payloads are
+   loaded ``mmap_mode="r"``: a 26M-µop window "loads" in O(1) and chunks
+   materialize lazily as the campaign touches them.  Federated pods that
+   share a store root share one preprocessed copy — the second campaign
+   over a stored window performs 0 lifts and 0 re-preprocessing
+   (``STATS`` pins this).
+3. **build** — ops/chunked.py's ``preprocess_window`` pads + replays and
+   then back-fills tiers 1-2.
+
+Import discipline: numpy-only at module import (the ingest pipeline's
+preprocess stage imports this before any backend exists); jax is touched
+only inside the lazy device-cache properties.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+
+import numpy as np
+
+#: SoA trace field order (matches ops.replay.TraceArrays)
+TRACE_FIELDS = ("opcode", "dst", "src1", "src2", "imm", "taken")
+
+#: store addressing: one doc + payloads per (binary digest, chunk size)
+DOC_NAME = "window_chunks"
+STORE_VERSION = 1
+
+#: warm-start observability (tests + the CI smoke pin zero-re-preprocess
+#: on these): builds = golden-boundary passes actually run this process
+STATS = {"builds": 0, "registry_hits": 0, "store_hits": 0, "stored": 0}
+
+_REGISTRY: OrderedDict = OrderedDict()
+_REGISTRY_MAX = 4
+
+
+def store_axes(S: int) -> dict:
+    return {"kind": DOC_NAME, "S": int(S), "v": STORE_VERSION}
+
+
+class PreprocessedWindow:
+    """Padded SoA chunk arrays + golden boundary states for one trace.
+
+    ``tr`` maps TRACE_FIELDS to host arrays of length C·S (possibly
+    np.memmap views into the store); ``gb_reg``/``gb_mem`` are
+    ``(C+1, nphys)`` / ``(C+1, mem_words)`` uint32 boundary goldens
+    (row 0 = init state, row C = golden final).  ``memmap`` (the
+    VA-space MemMap of a lifted trace, or None) rides along only so the
+    exact engine can rebuild its padded cluster map without touching the
+    kernel again; windows with a memmap are registry-only, never stored.
+    """
+
+    def __init__(self, *, n: int, S: int, nphys: int, mem_words: int,
+                 trace_digest: str, tr: dict, gb_reg: np.ndarray,
+                 gb_mem: np.ndarray, memmap=None, mm_cluster_pad=None,
+                 source: str = "built"):
+        self.n = int(n)
+        self.S = int(S)
+        self.C = (self.n + self.S - 1) // self.S
+        self.nphys = int(nphys)
+        self.mem_words = int(mem_words)
+        self.trace_digest = trace_digest
+        self.tr = tr
+        self.gb_reg = gb_reg
+        self.gb_mem = gb_mem
+        self.memmap = memmap
+        self.mm_cluster_pad = mm_cluster_pad   # host i32[C·S] or None
+        self.source = source
+        self._tr_dev = None
+        self._mm_cluster_dev = None
+
+    # --- per-chunk host views (zero-copy; lazy materialization on mmap) --
+
+    def chunk_trace(self, c: int) -> dict:
+        """Host views of chunk ``c``'s SoA arrays — slicing only, no
+        padding, no copies (the satellite-3 fix: padding happened once at
+        preprocess time)."""
+        lo, hi = c * self.S, (c + 1) * self.S
+        return {k: v[lo:hi] for k, v in self.tr.items()}
+
+    # --- device caches (exact engine; shared across campaigns) ----------
+
+    @property
+    def tr_dev(self):
+        """Device-resident padded TraceArrays, uploaded once per window
+        and shared by every exact-engine campaign over it."""
+        if self._tr_dev is None:
+            import jax.numpy as jnp
+
+            from shrewd_tpu.ops.replay import TraceArrays
+            self._tr_dev = TraceArrays(
+                **{k: jnp.asarray(v) for k, v in self.tr.items()})
+        return self._tr_dev
+
+    @property
+    def mm_cluster_dev(self):
+        if self._mm_cluster_dev is None:
+            import jax.numpy as jnp
+            self._mm_cluster_dev = (
+                jnp.asarray(self.mm_cluster_pad)
+                if self.mm_cluster_pad is not None
+                else jnp.zeros(1, jnp.int32))
+        return self._mm_cluster_dev
+
+
+# --------------------------------------------------------------------------
+# process registry
+# --------------------------------------------------------------------------
+
+def _reg_key(trace_digest: str, S: int, memmap) -> tuple:
+    # memmap identity (not content): a lifted window's MemMap is built
+    # once per kernel; two kernels over the same trace+memmap object share
+    return (trace_digest, int(S), id(memmap) if memmap is not None else None)
+
+
+def lookup(trace_digest: str, S: int, memmap=None):
+    win = _REGISTRY.get(_reg_key(trace_digest, S, memmap))
+    if win is not None:
+        _REGISTRY.move_to_end(_reg_key(trace_digest, S, memmap))
+        STATS["registry_hits"] += 1
+    return win
+
+
+def register(win: PreprocessedWindow) -> PreprocessedWindow:
+    key = _reg_key(win.trace_digest, win.S, win.memmap)
+    _REGISTRY[key] = win
+    _REGISTRY.move_to_end(key)
+    while len(_REGISTRY) > _REGISTRY_MAX:
+        _REGISTRY.popitem(last=False)
+    return win
+
+
+def clear_registry() -> None:
+    _REGISTRY.clear()
+
+
+# --------------------------------------------------------------------------
+# store glue (ArtifactStore: checksummed doc + one .npy payload per array)
+# --------------------------------------------------------------------------
+
+def load_from_store(store, trace_digest: str, S: int):
+    """Stored window → PreprocessedWindow (arrays mmap'd), or None on any
+    miss/rot — ``get_arrays`` re-verifies every payload byte, so a rotted
+    array reads as a rebuild, never as corruption."""
+    from shrewd_tpu.ingest.store import axes_key
+
+    key = axes_key(store_axes(S))
+    got = store.get_arrays(trace_digest, key, DOC_NAME, mmap=True)
+    if got is None:
+        return None
+    doc, arrays = got
+    if doc.get("v") != STORE_VERSION:
+        return None
+    try:
+        tr = {f: arrays[f] for f in TRACE_FIELDS}
+        gb_reg, gb_mem = arrays["gb_reg"], arrays["gb_mem"]
+    except KeyError:
+        return None
+    STATS["store_hits"] += 1
+    return PreprocessedWindow(
+        n=int(doc["n"]), S=int(doc["S"]), nphys=int(doc["nphys"]),
+        mem_words=int(doc["mem_words"]), trace_digest=trace_digest,
+        tr=tr, gb_reg=gb_reg, gb_mem=gb_mem, source="store")
+
+
+def save_to_store(store, win: PreprocessedWindow) -> None:
+    """Persist one window (memmap-free windows only: a VA-space MemMap is
+    kernel-private state the store cannot rebuild a campaign from)."""
+    assert win.memmap is None, "memmap windows are registry-only"
+    from shrewd_tpu.ingest.store import axes_key
+
+    key = axes_key(store_axes(win.S))
+    arrays = dict(win.tr)
+    arrays["gb_reg"] = win.gb_reg
+    arrays["gb_mem"] = win.gb_mem
+    store.put_arrays(
+        win.trace_digest, key, DOC_NAME, arrays,
+        meta={"v": STORE_VERSION, "n": win.n, "S": win.S, "C": win.C,
+              "nphys": win.nphys, "mem_words": win.mem_words})
+    STATS["stored"] += 1

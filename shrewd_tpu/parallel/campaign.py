@@ -47,7 +47,7 @@ class ShardedCampaign:
     def __init__(self, kernel, mesh, structure: str,
                  resolution: str = "device", stratify: bool = False,
                  watchdog: DeviceWatchdog | None = None,
-                 integrity_check: bool = False):
+                 integrity_check: bool = False, chunked=None):
         """``watchdog`` (resilience.DeviceWatchdog, optional): every jitted
         device step routes through ``watchdog.call`` so a wedged dispatch
         surfaces as ``DispatchTimeout`` in bounded time instead of hanging
@@ -58,13 +58,24 @@ class ShardedCampaign:
         the locals sum to the replicated psum result — the shard-vs-psum
         invariant of the integrity layer (shrewd_tpu/integrity.py).  A
         mismatch raises ``integrity.IntegrityError``; the extra output is
-        a few dozen integers per batch, so the hot path is unaffected."""
+        a few dozen integers per batch, so the hot path is unaffected.
+
+        ``chunked`` (ops.chunked.ChunkedCampaign, optional): route every
+        tally through the chunked execution strategy instead of the
+        full-window jitted steps — the SimPoint-scale path, where one
+        dense whole-window program would not fit compile/memory budgets.
+        The chunked driver is host-orchestrated (its wave loop is the
+        dispatch unit), so the mesh is not consulted for sharding and the
+        multi-batch interval steps don't apply; outcomes are bit-identical
+        to the dense protocol on the same keys."""
         if resolution not in ("device", "host"):
             raise ValueError(f"unknown resolution {resolution!r}")
         if stratify and not hasattr(kernel, "run_keys_stratified"):
             raise ValueError(
                 f"{type(kernel).__name__} has no stratified tally path")
-        if stratify and resolution != "device":
+        if chunked is not None and chunked.kernel is not kernel:
+            raise ValueError("chunked campaign wraps a different kernel")
+        if stratify and resolution != "device" and chunked is None:
             # the stratified step uses the budgeted device resolution; a
             # host-resolution campaign would make summed strata disagree
             # with tally_batch on over-budget batches
@@ -76,6 +87,7 @@ class ShardedCampaign:
         self.stratify = stratify
         self.watchdog = watchdog
         self.integrity_check = integrity_check
+        self.chunked = chunked
         self.shard_checks = 0        # shard-vs-psum verifications run
         self.shard_mismatches = 0    # ... that failed (each also raises)
         # collective-timeout detection (elastic layer): in a multi-host
@@ -85,6 +97,15 @@ class ShardedCampaign:
         self.mode = getattr(getattr(kernel, "cfg", None),
                             "replay_kernel", "dense")
         may_latch = structure == "latch"
+        if chunked is not None:
+            # host-orchestrated: no jitted campaign steps to build here
+            # (the chunked driver owns its per-chunk executables, shared
+            # through the same exec_cache)
+            self._step = None
+            self._taint_step = None
+            self._device_step = None
+            self._strat_step = None
+            return
 
         def build_step():
             def local_step(keys):
@@ -238,6 +259,10 @@ class ShardedCampaign:
         """Sharded keys (B,) → replicated (N_STRATA, N_OUTCOMES) tally for
         the post-stratified estimator; summing over strata reproduces
         ``tally_batch`` exactly (same outcomes, same resolution)."""
+        if self.chunked is not None:
+            if not self.stratify:
+                raise ValueError("campaign built without stratify=True")
+            return self._tally_chunked(keys, stratified=True)
         if self._strat_step is None:
             raise ValueError("campaign built without stratify=True")
         out = self._dispatch(self._strat_step, shard_keys(self.mesh, keys))
@@ -252,8 +277,29 @@ class ShardedCampaign:
             self.kernel.taint_trials += int(keys.shape[0])
         return tally_h
 
+    def _tally_chunked(self, keys: jax.Array, stratified: bool):
+        """Chunked-strategy tally: outcomes from the chunked wave driver
+        (host-orchestrated; per-chunk executables dispatch on device),
+        binned host-side.  Same keys → same outcomes as the dense
+        protocol, so summing the stratified tally over strata reproduces
+        ``tally_batch`` exactly, as on the jitted paths."""
+        from shrewd_tpu.ops.trial import N_STRATA
+
+        kernel = self.kernel
+        faults = kernel.sampler(self.structure).sample_batch(keys)
+        out = self.chunked.outcomes_of_faults(faults)
+        if not stratified:
+            return jnp.asarray(np.bincount(
+                out, minlength=C.N_OUTCOMES).astype(np.int32))
+        strata = np.asarray(kernel.strata_of(faults, self.structure))
+        tally = np.zeros((N_STRATA, C.N_OUTCOMES), np.int32)
+        np.add.at(tally, (strata, out), 1)
+        return jnp.asarray(tally)
+
     def tally_batch(self, keys: jax.Array) -> jax.Array:
         """Sharded keys (B,) → replicated tally (N_OUTCOMES,)."""
+        if self.chunked is not None:
+            return self._tally_chunked(keys, stratified=False)
         if self._device_step is not None:
             out = self._dispatch(self._device_step,
                                  shard_keys(self.mesh, keys))
@@ -296,9 +342,11 @@ class ShardedCampaign:
     def supports_intervals(self) -> bool:
         """Whether the multi-batch jitted interval step applies: the
         host-resolution taint path does per-batch host re-runs (nothing to
-        accumulate on device), and a multi-process mesh would need the
-        distributed key-data transport ``shard_batch_stack`` doesn't do."""
-        return self._taint_step is None and jax.process_count() == 1
+        accumulate on device), the chunked strategy is host-orchestrated,
+        and a multi-process mesh would need the distributed key-data
+        transport ``shard_batch_stack`` doesn't do."""
+        return (self.chunked is None and self._taint_step is None
+                and jax.process_count() == 1)
 
     def _build_interval_step(self, S: int):
         """Jitted S-batch step: raw key data (S, B, ...) sharded on B →

@@ -147,6 +147,14 @@ QUICK_TESTS = {
     "test_lift_rate_is_high",              # capture → x86 lift
     "test_mulhu_bit_exact_across_backends",  # MULHU parity
     "test_latch_structure_parity_with_padding",  # chunked replay + oow fix
+    # SimPoint-scale fast chunked path (tests/test_chunked_fast.py): one
+    # representative each for fast-vs-exact bit-identity under forced
+    # fallbacks, the content-addressed window store round-trip, and the
+    # chunked route composing with quarantine recovery — the full
+    # structure × engine sweep stays slow-tier
+    "test_fast_fallback_lanes_still_bit_identical",
+    "test_store_roundtrip_byte_identical",
+    "test_chunked_quarantine_recovers_bit_identical",
 }
 QUICK_CLASSES = {
     "TestSuffixStems", "TestSimdSubset",   # emulator units, no capture

@@ -1,0 +1,228 @@
+"""Fast chunked engines + preprocessed window store (ops/chunked.py,
+ops/window.py).
+
+test_chunked.py pins chunked-vs-dense parity for the default engine; this
+file pins the rest of the SimPoint-scale contract: the deviation-set
+engines ("taint", and "pallas" in interpret mode) are bit-identical to the
+exact engine across structures and ragged tails, the carry-horizon
+relabeling is engine-independent, the content-addressed window store
+round-trips byte-identical (and rot reads as a rebuild, never as
+corruption), warm starts re-preprocess nothing, and a corrupted chunked
+tally quarantines and recovers bit-identical through the integrity layer.
+"""
+
+import numpy as np
+import pytest
+
+from shrewd_tpu.models.o3 import O3Config
+from shrewd_tpu.ops import classify as C
+from shrewd_tpu.ops import window as W
+from shrewd_tpu.ops.chunked import ChunkedCampaign, preprocess_window
+from shrewd_tpu.ops.trial import TrialKernel
+from shrewd_tpu.trace.synth import WorkloadConfig, generate
+from shrewd_tpu.utils import prng
+
+
+def mk_kernel(n=384, seed=11, **cfg):
+    t = generate(WorkloadConfig(n=n, nphys=32, mem_words=64,
+                                working_set_words=32, seed=seed))
+    return TrialKernel(t, O3Config(**cfg))
+
+
+# --- fast-vs-exact bit-identity ----------------------------------------------
+
+@pytest.mark.parametrize("structure",
+                         ["regfile", "fu", "rob", "iq", "lsq", "latch"])
+def test_taint_engine_matches_exact(structure):
+    # 300 = 3*77 + 69: a ragged tail, so the NOP-padded final chunk and
+    # the out-of-window resolver are both in play
+    kernel = mk_kernel(n=300)
+    keys = prng.trial_keys(prng.campaign_key(41), 64)
+    exact = ChunkedCampaign(kernel, chunk=77, engine="exact")
+    fast = ChunkedCampaign(kernel, chunk=77, engine="taint")
+    np.testing.assert_array_equal(
+        fast.outcomes_from_keys(keys, structure),
+        exact.outcomes_from_keys(keys, structure), err_msg=structure)
+    assert fast.last_stats["engine"] == "taint"
+    assert exact.last_stats["engine"] == "exact"
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("structure", ["regfile", "fu"])
+def test_pallas_engine_matches_exact(structure):
+    # interpret mode off-TPU: small window keeps the cost bounded
+    kernel = mk_kernel(n=160, pallas="on")
+    keys = prng.trial_keys(prng.campaign_key(41), 32)
+    exact = ChunkedCampaign(kernel, chunk=96, engine="exact")
+    fast = ChunkedCampaign(kernel, chunk=96, engine="pallas")
+    np.testing.assert_array_equal(
+        fast.outcomes_from_keys(keys, structure),
+        exact.outcomes_from_keys(keys, structure), err_msg=structure)
+    assert fast.last_stats["engine"] == "pallas"
+
+
+def test_fast_fallback_lanes_still_bit_identical():
+    # a tiny deviation-set budget forces overflow fallbacks through the
+    # per-trial exact path — outcomes must not change
+    kernel = mk_kernel(n=300, seed=3, taint_k=4)
+    keys = prng.trial_keys(prng.campaign_key(9), 64)
+    exact = ChunkedCampaign(kernel, chunk=77, engine="exact")
+    fast = ChunkedCampaign(kernel, chunk=77, engine="taint")
+    np.testing.assert_array_equal(
+        fast.outcomes_from_keys(keys, "regfile"),
+        exact.outcomes_from_keys(keys, "regfile"))
+    assert fast.last_stats["fallback_lanes"] > 0
+
+
+# --- carry-horizon parity -----------------------------------------------------
+
+def test_carry_horizon_relabeling_is_engine_independent():
+    """The horizon cut is part of the outcome semantics, not of any one
+    engine: fast and exact with the same horizon produce identical
+    outcomes AND relabel the same number of trials."""
+    kernel = mk_kernel(n=512, seed=17)
+    keys = prng.trial_keys(prng.campaign_key(23), 96)
+    exact = ChunkedCampaign(kernel, chunk=64, carry_horizon=1,
+                            engine="exact")
+    fast = ChunkedCampaign(kernel, chunk=64, carry_horizon=1,
+                           engine="taint")
+    oe = exact.outcomes_from_keys(keys, "regfile")
+    of = fast.outcomes_from_keys(keys, "regfile")
+    np.testing.assert_array_equal(of, oe)
+    assert fast.last_stats["horizon_sdc"] == exact.last_stats["horizon_sdc"]
+    assert fast.last_stats["horizon_sdc"] > 0
+
+
+# --- window store -------------------------------------------------------------
+
+def test_store_roundtrip_byte_identical(tmp_path):
+    from shrewd_tpu.ingest.store import ArtifactStore
+
+    kernel = mk_kernel(n=300)
+    store = ArtifactStore(str(tmp_path))
+    W.clear_registry()
+    stored0 = W.STATS["stored"]
+    w1 = preprocess_window(kernel, 77, store=store)
+    assert w1.source == "built"
+    assert W.STATS["stored"] == stored0 + 1
+
+    # a fresh process (registry cleared) loads the stored window mmap'd,
+    # byte-identical, with zero re-preprocessing
+    W.clear_registry()
+    builds0, hits0 = W.STATS["builds"], W.STATS["store_hits"]
+    w2 = preprocess_window(kernel, 77, store=store)
+    assert w2.source == "store"
+    assert W.STATS["builds"] == builds0
+    assert W.STATS["store_hits"] == hits0 + 1
+    for f in W.TRACE_FIELDS:
+        np.testing.assert_array_equal(np.asarray(w2.tr[f]),
+                                      np.asarray(w1.tr[f]), err_msg=f)
+    np.testing.assert_array_equal(np.asarray(w2.gb_reg), w1.gb_reg)
+    np.testing.assert_array_equal(np.asarray(w2.gb_mem), w1.gb_mem)
+
+    # and a campaign over the loaded window is bit-identical
+    keys = prng.trial_keys(prng.campaign_key(5), 48)
+    np.testing.assert_array_equal(
+        ChunkedCampaign(kernel, chunk=77, window=w2)
+        .outcomes_from_keys(keys, "fu"),
+        ChunkedCampaign(kernel, chunk=77, window=w1)
+        .outcomes_from_keys(keys, "fu"))
+
+
+def test_store_rot_reads_as_rebuild(tmp_path):
+    """A rotted payload must never load as corruption: get_arrays
+    re-verifies every byte, so the window rebuilds byte-identical."""
+    from shrewd_tpu.ingest.store import ArtifactStore
+
+    kernel = mk_kernel(n=300)
+    store = ArtifactStore(str(tmp_path))
+    W.clear_registry()
+    w1 = preprocess_window(kernel, 77, store=store)
+    payloads = sorted(tmp_path.rglob("*.npy"))
+    assert payloads
+    blob = bytearray(payloads[0].read_bytes())
+    blob[len(blob) // 2] ^= 0xFF
+    payloads[0].write_bytes(bytes(blob))
+
+    assert W.load_from_store(store, w1.trace_digest, 77) is None
+
+    W.clear_registry()
+    builds0 = W.STATS["builds"]
+    w3 = preprocess_window(kernel, 77, store=store)
+    assert w3.source == "built"
+    assert W.STATS["builds"] == builds0 + 1
+    for f in W.TRACE_FIELDS:
+        np.testing.assert_array_equal(np.asarray(w3.tr[f]),
+                                      np.asarray(w1.tr[f]), err_msg=f)
+    np.testing.assert_array_equal(np.asarray(w3.gb_reg), w1.gb_reg)
+    np.testing.assert_array_equal(np.asarray(w3.gb_mem), w1.gb_mem)
+
+
+def test_native_boundary_pass_matches_jax_pass(monkeypatch):
+    """The C++ boundary pass (the 26M-µop setup enabler) and the jax
+    chunk-scan fallback produce byte-identical boundary states."""
+    import shrewd_tpu.ops.chunked as chunked_mod
+
+    kernel = mk_kernel(n=300, seed=5)
+    W.clear_registry()
+    wn = preprocess_window(kernel, 77)
+    if not chunked_mod._native_boundary_pass(wn):
+        pytest.skip("native library unavailable")
+    W.clear_registry()
+    monkeypatch.setattr(chunked_mod, "NATIVE_BOUNDARY", False)
+    wj = preprocess_window(kernel, 77)
+    np.testing.assert_array_equal(wn.gb_reg, wj.gb_reg)
+    np.testing.assert_array_equal(wn.gb_mem, wj.gb_mem)
+
+
+def test_registry_warm_start_skips_boundary_pass():
+    kernel = mk_kernel()
+    W.clear_registry()
+    builds0 = W.STATS["builds"]
+    w1 = preprocess_window(kernel, 128)
+    assert W.STATS["builds"] == builds0 + 1
+    hits0 = W.STATS["registry_hits"]
+    assert preprocess_window(kernel, 128) is w1
+    assert W.STATS["registry_hits"] == hits0 + 1
+    # a second campaign over the same (trace, S) re-preprocesses nothing
+    ChunkedCampaign(kernel, chunk=128)
+    assert W.STATS["builds"] == builds0 + 1
+    # ...but a different chunk length is a different window
+    preprocess_window(kernel, 96)
+    assert W.STATS["builds"] == builds0 + 2
+
+
+# --- integrity over the chunked path -------------------------------------------
+
+def test_chunked_quarantine_recovers_bit_identical():
+    """A corrupted chunked tally trips the batch invariants, quarantines,
+    and the re-dispatch on the SAME frozen keys recovers bit-identical —
+    the chunked route composes with the integrity layer unchanged."""
+    from shrewd_tpu import resilience as resil
+    from shrewd_tpu.integrity import (IntegrityConfig, IntegrityMonitor,
+                                      checked_dispatcher_for)
+    from shrewd_tpu.parallel.campaign import ShardedCampaign
+    from shrewd_tpu.parallel.mesh import make_mesh
+
+    kernel = mk_kernel(n=256, seed=7)
+    ch = ChunkedCampaign(kernel, chunk=96, max_batch=64)
+    camp = ShardedCampaign(kernel, make_mesh(), "fu", chunked=ch)
+    keys = prng.trial_keys(prng.campaign_key(3), 64)
+    want = np.asarray(camp.tally_batch(keys))
+    assert int(want.sum()) == 64
+
+    rcfg = resil.ResilienceConfig()
+    rcfg.backoff_base = rcfg.backoff_max = 0.0
+    mon = IntegrityMonitor(IntegrityConfig(canary_trials=0, audit_rate=0.0))
+    cd = checked_dispatcher_for(resil.dispatcher_for_campaign(camp, rcfg),
+                                camp, mon, "w0", "fu")
+
+    def corrupt(t):
+        t = t.copy()
+        t[C.OUTCOME_MASKED] += 7        # breaks sum == batch
+        return t
+
+    mon.arm_corruption(corrupt)
+    res = cd.tally_batch(keys, batch_id=0)
+    assert mon.quarantined == 1 and mon.requeues == 1 and mon.recovered == 1
+    np.testing.assert_array_equal(np.asarray(res.tally), want)
